@@ -1,0 +1,108 @@
+"""ErasureCoder interface — the pluggable codec seam.
+
+This is the interface BASELINE.json asks for: the reference hard-wires
+klauspost/reedsolomon (`reedsolomon.New(10, 4)` at
+reference weed/storage/erasure_coding/ec_encoder.go:199); we instead route
+every encode/reconstruct through an `ErasureCoder` so the CPU path stays the
+default and the TPU (JAX/Pallas) path is selected by configuration.
+
+Semantics mirror the reference codec's contract:
+  - encode(shards): shards is a list of `total` equal-length byte buffers;
+    the first `data` ones are inputs; parity buffers are overwritten.
+  - reconstruct(shards): missing entries are None; all missing shards are
+    recomputed in place (requires >= data present).
+  - reconstruct_data(shards): only the first `data` entries are guaranteed
+    to be filled afterwards (cheaper on the degraded-read path, matching
+    reference weed/storage/store_ec.go:328-382).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional, Sequence
+
+
+class RSScheme:
+    """An (data, parity) Reed-Solomon scheme. Default RS(10,4) like the
+    reference (weed/storage/erasure_coding/ec_encoder.go:17-23)."""
+
+    __slots__ = ("data_shards", "parity_shards")
+
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4):
+        if not (0 < data_shards and 0 < parity_shards
+                and data_shards + parity_shards <= 256):
+            raise ValueError(f"invalid RS scheme ({data_shards},{parity_shards})")
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+
+    @property
+    def total_shards(self) -> int:
+        return self.data_shards + self.parity_shards
+
+    def __repr__(self):
+        return f"RS({self.data_shards},{self.parity_shards})"
+
+    def __eq__(self, other):
+        return (isinstance(other, RSScheme)
+                and other.data_shards == self.data_shards
+                and other.parity_shards == self.parity_shards)
+
+    def __hash__(self):
+        return hash((self.data_shards, self.parity_shards))
+
+
+DEFAULT_SCHEME = RSScheme(10, 4)
+
+
+class ErasureCoder(abc.ABC):
+    """Codec over byte buffers. Implementations: CpuCoder (numpy / native C++),
+    JaxCoder (jnp, runs on TPU), PallasCoder (hand-tiled TPU kernel)."""
+
+    def __init__(self, scheme: RSScheme = DEFAULT_SCHEME):
+        self.scheme = scheme
+
+    @abc.abstractmethod
+    def encode(self, shards: Sequence[bytearray | bytes | memoryview]) -> list[bytes]:
+        """Compute parity. Returns the full list of `total` shard buffers
+        (data shards passed through, parity freshly computed)."""
+
+    @abc.abstractmethod
+    def reconstruct(self, shards: Sequence[Optional[bytes]]) -> list[bytes]:
+        """Fill in every None shard. Returns complete shard list."""
+
+    def reconstruct_data(self, shards: Sequence[Optional[bytes]]) -> list[Optional[bytes]]:
+        """Fill in only missing *data* shards (parity may remain None)."""
+        full = self.reconstruct(shards)
+        k = self.scheme.data_shards
+        return list(full[:k]) + [
+            full[i] if shards[i] is not None else None
+            for i in range(k, self.scheme.total_shards)
+        ]
+
+    def verify(self, shards: Sequence[bytes]) -> bool:
+        """True iff parity shards are consistent with data shards."""
+        redone = self.encode([bytes(s) for s in shards])
+        k = self.scheme.data_shards
+        return all(bytes(redone[i]) == bytes(shards[i])
+                   for i in range(k, self.scheme.total_shards))
+
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register_coder(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def make_coder(name: str = "cpu", scheme: RSScheme = DEFAULT_SCHEME) -> ErasureCoder:
+    """Factory: 'cpu' (default, like the reference), 'jax', 'pallas'."""
+    # import for registration side effects
+    from seaweedfs_tpu.ops import rs_cpu  # noqa: F401
+    if name in ("jax", "tpu", "pallas"):
+        from seaweedfs_tpu.ops import rs_jax  # noqa: F401
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown coder {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](scheme)

@@ -1,0 +1,125 @@
+// Native CPU GF(2^8) Reed-Solomon kernel.
+//
+// Fills the role the SIMD assembly in klauspost/reedsolomon fills for the
+// reference (go.mod:61): a fast CPU codec. Strategy: "shared doubling
+// chains" — multiplication by a constant c in GF(256) is XOR of x2^b(v)
+// for each set bit b of c, where x2 is multiply-by-2 under poly 0x11D.
+// We compute the 8 doubled versions of each source word once (SWAR over
+// 8 packed bytes in a uint64) and XOR them into each parity accumulator
+// according to the bits of the matrix constants. ~6 scalar ops/byte;
+// gcc -O3 vectorizes the word loop.
+//
+// Exposed via ctypes (see rs_native.py); no pybind11 dependency.
+
+#include <cstdint>
+#include <cstring>
+
+typedef uint64_t word;
+
+static inline word x2(word v) {
+    // multiply each of the 8 packed bytes by 2 in GF(2^8)/0x11D
+    word hi = v & 0x8080808080808080ULL;
+    word lo = (v & 0x7f7f7f7f7f7f7f7fULL) << 1;
+    return lo ^ ((hi >> 7) * 0x1D);
+}
+
+extern "C" {
+
+// out[i*n..] ^= sum_j mat[i*k+j] * data[j*n..]   over GF(256)
+// n must be the shard length in bytes. out must be zero-initialised by the
+// caller (or hold a partial accumulation).
+void gf_apply(const uint8_t* mat, int64_t m, int64_t k,
+              const uint8_t* data, uint8_t* out, int64_t n) {
+    const int64_t nw = n / 8;
+    // per (j, bit): bitmask over i of parities that need this doubled version
+    // (m <= 64)
+    uint64_t need[256][8];
+    for (int64_t j = 0; j < k; j++) {
+        for (int b = 0; b < 8; b++) {
+            uint64_t mask = 0;
+            for (int64_t i = 0; i < m; i++) {
+                if ((mat[i * k + j] >> b) & 1) mask |= (1ULL << i);
+            }
+            need[j][b] = mask;
+        }
+    }
+    for (int64_t j = 0; j < k; j++) {
+        const word* src = reinterpret_cast<const word*>(data + j * n);
+        for (int64_t w = 0; w < nw; w++) {
+            word d = src[w];
+            for (int b = 0; b < 8; b++) {
+                uint64_t mask = need[j][b];
+                while (mask) {
+                    int i = __builtin_ctzll(mask);
+                    mask &= mask - 1;
+                    reinterpret_cast<word*>(out + i * n)[w] ^= d;
+                }
+                d = x2(d);
+            }
+        }
+    }
+    // byte tail (n not multiple of 8)
+    for (int64_t t = nw * 8; t < n; t++) {
+        for (int64_t i = 0; i < m; i++) {
+            uint8_t acc = out[i * n + t];
+            for (int64_t j = 0; j < k; j++) {
+                uint8_t c = mat[i * k + j];
+                uint8_t v = data[j * n + t];
+                uint8_t p = 0;
+                while (c) {
+                    if (c & 1) p ^= v;
+                    c >>= 1;
+                    v = (uint8_t)((v << 1) ^ ((v & 0x80) ? 0x1D : 0));
+                }
+                acc ^= p;
+            }
+            out[i * n + t] = acc;
+        }
+    }
+}
+
+// CRC32-C (Castagnoli), table-driven slicing-by-8, matching Go's
+// hash/crc32 Castagnoli used by the needle checksum
+// (reference weed/storage/needle/crc.go:13).
+static uint32_t crc_tab[8][256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+    const uint32_t poly = 0x82f63b78u;  // reflected 0x1EDC6F41
+    for (int i = 0; i < 256; i++) {
+        uint32_t c = (uint32_t)i;
+        for (int kk = 0; kk < 8; kk++)
+            c = (c & 1) ? (poly ^ (c >> 1)) : (c >> 1);
+        crc_tab[0][i] = c;
+    }
+    for (int i = 0; i < 256; i++) {
+        uint32_t c = crc_tab[0][i];
+        for (int t = 1; t < 8; t++) {
+            c = crc_tab[0][c & 0xff] ^ (c >> 8);
+            crc_tab[t][i] = c;
+        }
+    }
+    crc_init_done = true;
+}
+
+uint32_t crc32c(uint32_t crc, const uint8_t* buf, int64_t len) {
+    if (!crc_init_done) crc_init();
+    crc = ~crc;
+    while (len >= 8) {
+        crc ^= (uint32_t)buf[0] | ((uint32_t)buf[1] << 8) |
+               ((uint32_t)buf[2] << 16) | ((uint32_t)buf[3] << 24);
+        uint32_t hi = (uint32_t)buf[4] | ((uint32_t)buf[5] << 8) |
+                      ((uint32_t)buf[6] << 16) | ((uint32_t)buf[7] << 24);
+        crc = crc_tab[7][crc & 0xff] ^ crc_tab[6][(crc >> 8) & 0xff] ^
+              crc_tab[5][(crc >> 16) & 0xff] ^ crc_tab[4][crc >> 24] ^
+              crc_tab[3][hi & 0xff] ^ crc_tab[2][(hi >> 8) & 0xff] ^
+              crc_tab[1][(hi >> 16) & 0xff] ^ crc_tab[0][hi >> 24];
+        buf += 8;
+        len -= 8;
+    }
+    while (len-- > 0)
+        crc = crc_tab[0][(crc ^ *buf++) & 0xff] ^ (crc >> 8);
+    return ~crc;
+}
+
+}  // extern "C"

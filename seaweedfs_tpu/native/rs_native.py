@@ -1,0 +1,90 @@
+"""ctypes loader for the native C++ RS/CRC kernel (rs_cpu.cpp).
+
+Builds the shared library on first use with g++ (no pip involved) and caches
+it next to the source. Falls back cleanly if no compiler is present —
+callers must check `available()`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "rs_cpu.cpp")
+_SO = os.path.join(_DIR, "_rs_cpu.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", _SO, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:  # retry without -march=native (portable)
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+                check=True, capture_output=True, timeout=120)
+            return True
+        except (OSError, subprocess.SubprocessError):
+            return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.gf_apply.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.gf_apply.restype = None
+        lib.crc32c.argtypes = [ctypes.c_uint32, ctypes.c_void_p, ctypes.c_int64]
+        lib.crc32c.restype = ctypes.c_uint32
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def gf_apply(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """out = mat (m,k) x data (k,n) over GF(256)."""
+    lib = _load()
+    assert lib is not None
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    m, k = mat.shape
+    k2, n = data.shape
+    assert k == k2
+    out = np.zeros((m, n), dtype=np.uint8)
+    lib.gf_apply(mat.ctypes.data, m, k, data.ctypes.data, out.ctypes.data, n)
+    return out
+
+
+def crc32c(data: bytes | np.ndarray, crc: int = 0) -> int:
+    lib = _load()
+    assert lib is not None
+    if isinstance(data, np.ndarray):
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        return int(lib.crc32c(crc, data.ctypes.data, data.size))
+    buf = (ctypes.c_char * len(data)).from_buffer_copy(data)
+    return int(lib.crc32c(crc, buf, len(data)))

@@ -1,0 +1,46 @@
+"""CRC32-C (Castagnoli) — the needle checksum algorithm
+(reference weed/storage/needle/crc.go:13 uses Go hash/crc32 Castagnoli).
+
+Uses the native C++ kernel when available, else a numpy table-driven
+fallback.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_POLY = 0x82F63B78  # reflected Castagnoli
+
+
+def _make_table() -> np.ndarray:
+    tab = np.zeros(256, dtype=np.uint32)
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (_POLY ^ (c >> 1)) if (c & 1) else (c >> 1)
+        tab[i] = c
+    return tab
+
+
+_TAB = _make_table()
+
+
+def _crc32c_py(data: bytes | np.ndarray, crc: int = 0) -> int:
+    buf = np.frombuffer(bytes(data) if not isinstance(data, np.ndarray)
+                        else data.tobytes(), dtype=np.uint8)
+    c = np.uint32(crc ^ 0xFFFFFFFF)
+    tab = _TAB
+    for b in buf.tolist():
+        c = tab[(int(c) ^ b) & 0xFF] ^ (int(c) >> 8)
+        c = np.uint32(c)
+    return int(c) ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes | np.ndarray, crc: int = 0) -> int:
+    try:
+        from seaweedfs_tpu.native import rs_native
+        if rs_native.available():
+            return rs_native.crc32c(data, crc)
+    except ImportError:
+        pass
+    return _crc32c_py(data, crc)
