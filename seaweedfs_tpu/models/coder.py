@@ -79,6 +79,25 @@ class ErasureCoder(abc.ABC):
             for i in range(k, self.scheme.total_shards)
         ]
 
+    def encode_array(self, data) -> "np.ndarray":
+        """(k, n) uint8 -> (m, n) uint8 parity. Default goes through the
+        bytes API; coders override with a zero-copy path."""
+        import numpy as np
+        full = self.encode([np.ascontiguousarray(row).tobytes() for row in data])
+        k = self.scheme.data_shards
+        return np.stack([np.frombuffer(full[k + i], dtype=np.uint8)
+                         for i in range(self.scheme.parity_shards)])
+
+    def reconstruct_arrays(self, present: dict, n: int) -> list:
+        """present: {shard_id: (n,) uint8 array}. Returns all `total` shards
+        as uint8 arrays (missing ones reconstructed)."""
+        import numpy as np
+        shards = [None] * self.scheme.total_shards
+        for i, a in present.items():
+            shards[i] = np.ascontiguousarray(a).tobytes()
+        full = self.reconstruct(shards)
+        return [np.frombuffer(s, dtype=np.uint8) for s in full]
+
     def verify(self, shards: Sequence[bytes]) -> bool:
         """True iff parity shards are consistent with data shards."""
         redone = self.encode([bytes(s) for s in shards])

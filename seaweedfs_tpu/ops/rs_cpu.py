@@ -67,6 +67,11 @@ class CpuCoder(ErasureCoder):
         out += [parity[i].tobytes() for i in range(total - k)]
         return out
 
+    def encode_array(self, data: np.ndarray) -> np.ndarray:
+        """(k, n) uint8 -> (m, n) uint8 parity, no bytes round-trip."""
+        return _gf_apply(self._parity, np.ascontiguousarray(data, dtype=np.uint8),
+                         self.use_native)
+
     def reconstruct(self, shards: Sequence[Optional[bytes]]) -> list[bytes]:
         k, total = self.scheme.data_shards, self.scheme.total_shards
         assert len(shards) == total

@@ -1,0 +1,4 @@
+from seaweedfs_tpu.storage.erasure_coding.layout import (  # noqa: F401
+    DATA_SHARDS_COUNT, LARGE_BLOCK_SIZE, PARITY_SHARDS_COUNT,
+    SMALL_BLOCK_SIZE, TOTAL_SHARDS_COUNT, Interval, locate_data, shard_ext,
+    shard_file_size)

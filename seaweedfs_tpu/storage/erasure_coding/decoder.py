@@ -1,0 +1,85 @@
+"""EC decode: .ec00-.ec09 -> .dat, .ecx/.ecj -> .idx.
+
+Functional equivalent of reference weed/storage/erasure_coding/ec_decoder.go.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from seaweedfs_tpu.storage import idx as idxmod
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.erasure_coding import layout
+
+_COPY_CHUNK = 8 * 1024 * 1024
+
+
+def write_idx_file_from_ec_index(base_file_name: str) -> None:
+    """.idx = copy of .ecx + a tombstone entry per .ecj journal id
+    (reference ec_decoder.go:18-43)."""
+    from seaweedfs_tpu.storage.erasure_coding.ec_volume import iterate_ecj_file
+    shutil.copyfile(base_file_name + ".ecx", base_file_name + ".idx")
+    with open(base_file_name + ".idx", "ab") as f:
+        for key in iterate_ecj_file(base_file_name):
+            f.write(t.pack_entry(key, 0, t.TOMBSTONE_FILE_SIZE))
+
+
+def find_dat_file_size(data_base_file_name: str,
+                       index_base_file_name: str) -> int:
+    """Derive original .dat size from the max live .ecx entry
+    (reference ec_decoder.go:48-70)."""
+    version = read_ec_volume_version(data_base_file_name)
+    dat_size = 0
+    for key, off, size in idxmod.iter_index(index_base_file_name + ".ecx"):
+        if t.size_is_deleted(size):
+            continue
+        stop = t.offset_to_actual(off) + t.get_actual_size(size, version)
+        dat_size = max(dat_size, stop)
+    return dat_size
+
+
+def read_ec_volume_version(base_file_name: str) -> int:
+    """Volume version from the superblock at the head of .ec00 (the first
+    bytes of the .dat are the superblock and land in shard 0)."""
+    from seaweedfs_tpu.storage.super_block import SuperBlock
+    with open(base_file_name + layout.shard_ext(0), "rb") as f:
+        sb = SuperBlock.parse(f.read(8))
+    return sb.version
+
+
+def write_dat_file(base_file_name: str, dat_file_size: int,
+                   large_block: int = layout.LARGE_BLOCK_SIZE,
+                   small_block: int = layout.SMALL_BLOCK_SIZE) -> None:
+    """Reassemble .dat from data shards .ec00-.ec09 by walking rows
+    (reference ec_decoder.go:154-195). Note the reference reads shards
+    sequentially, so the per-shard read cursor advances across rows."""
+    k = layout.DATA_SHARDS_COUNT
+    ins = [open(base_file_name + layout.shard_ext(i), "rb") for i in range(k)]
+    try:
+        with open(base_file_name + ".dat", "wb") as out:
+            remaining = dat_file_size
+            while remaining >= k * large_block:
+                for i in range(k):
+                    _copy_n(ins[i], out, large_block)
+                    remaining -= large_block
+            while remaining > 0:
+                for i in range(k):
+                    to_read = min(remaining, small_block)
+                    if to_read <= 0:
+                        break
+                    _copy_n(ins[i], out, to_read)
+                    remaining -= to_read
+    finally:
+        for f in ins:
+            f.close()
+
+
+def _copy_n(src, dst, n: int) -> None:
+    left = n
+    while left > 0:
+        chunk = src.read(min(left, _COPY_CHUNK))
+        if not chunk:
+            raise IOError(f"unexpected EOF with {left} bytes left")
+        dst.write(chunk)
+        left -= len(chunk)

@@ -1,0 +1,249 @@
+"""EC volume serving: sorted-index search, deletion journal, shard files.
+
+Functional equivalent of reference weed/storage/erasure_coding/ec_volume.go,
+ec_shard.go, ec_volume_delete.go, ec_volume_info.go.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Iterator, Optional
+
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.erasure_coding import layout
+
+
+class NotFoundError(Exception):
+    pass
+
+
+def mark_needle_deleted(f, entry_offset: int) -> None:
+    """Overwrite the size field of an .ecx entry with the tombstone
+    (reference ec_volume_delete.go:13-25)."""
+    f.seek(entry_offset + t.NEEDLE_ID_SIZE + t.OFFSET_SIZE)
+    f.write(t.pack_entry(0, 0, t.TOMBSTONE_FILE_SIZE)[-t.SIZE_SIZE:])
+
+
+def search_needle_from_sorted_index(
+        ecx_file, ecx_size: int, needle_id: int,
+        process: Optional[Callable] = None) -> tuple[int, int]:
+    """Binary search a sorted 16-byte-entry index for needle_id. Returns
+    (offset_units, size); raises NotFoundError
+    (reference ec_volume.go:221-250 SearchNeedleFromSortedIndex)."""
+    lo, hi = 0, ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
+    while lo < hi:
+        mid = (lo + hi) // 2
+        ecx_file.seek(mid * t.NEEDLE_MAP_ENTRY_SIZE)
+        buf = ecx_file.read(t.NEEDLE_MAP_ENTRY_SIZE)
+        key, off, size = t.unpack_entry(buf)
+        if key == needle_id:
+            if process is not None:
+                process(ecx_file, mid * t.NEEDLE_MAP_ENTRY_SIZE)
+            return off, size
+        if key < needle_id:
+            lo = mid + 1
+        else:
+            hi = mid
+    raise NotFoundError(f"needle {needle_id:x} not in ecx")
+
+
+def iterate_ecj_file(base_file_name: str) -> Iterator[int]:
+    """Yield needle ids from the deletion journal (8-byte big-endian each,
+    reference ec_decoder.go iterateEcjFile)."""
+    path = base_file_name + ".ecj"
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(t.NEEDLE_ID_SIZE)
+            if len(buf) != t.NEEDLE_ID_SIZE:
+                return
+            yield int.from_bytes(buf, "big")
+
+
+class ShardBits:
+    """Bitmask of owned shard ids (reference ec_volume_info.go:65-117)."""
+
+    __slots__ = ("bits",)
+
+    def __init__(self, bits: int = 0):
+        self.bits = bits
+
+    def add_shard_id(self, shard_id: int) -> "ShardBits":
+        return ShardBits(self.bits | (1 << shard_id))
+
+    def remove_shard_id(self, shard_id: int) -> "ShardBits":
+        return ShardBits(self.bits & ~(1 << shard_id))
+
+    def has_shard_id(self, shard_id: int) -> bool:
+        return bool(self.bits & (1 << shard_id))
+
+    def shard_ids(self) -> list[int]:
+        return [i for i in range(layout.TOTAL_SHARDS_COUNT)
+                if self.has_shard_id(i)]
+
+    def shard_id_count(self) -> int:
+        return bin(self.bits).count("1")
+
+    def minus_parity_shards(self) -> "ShardBits":
+        b = self
+        for i in range(layout.DATA_SHARDS_COUNT, layout.TOTAL_SHARDS_COUNT):
+            b = b.remove_shard_id(i)
+        return b
+
+    def plus(self, other: "ShardBits") -> "ShardBits":
+        return ShardBits(self.bits | other.bits)
+
+    def minus(self, other: "ShardBits") -> "ShardBits":
+        return ShardBits(self.bits & ~other.bits)
+
+    def __eq__(self, other):
+        return isinstance(other, ShardBits) and other.bits == self.bits
+
+    def __repr__(self):
+        return f"ShardBits({self.shard_ids()})"
+
+
+class EcVolumeShard:
+    """One local .ecNN file (reference ec_shard.go:17-49)."""
+
+    def __init__(self, directory: str, collection: str, volume_id: int,
+                 shard_id: int):
+        self.directory = directory
+        self.collection = collection
+        self.volume_id = volume_id
+        self.shard_id = shard_id
+        self.path = os.path.join(
+            directory, f"{volume_id}{layout.shard_ext(shard_id)}")
+        self._f = open(self.path, "rb")
+        self.shard_size = os.path.getsize(self.path)
+        self._lock = threading.Lock()
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        with self._lock:
+            self._f.seek(offset)
+            return self._f.read(length)
+
+    def close(self):
+        self._f.close()
+
+    def destroy(self):
+        self.close()
+        os.remove(self.path)
+
+
+class EcVolume:
+    """A mounted EC volume: local shards + .ecx index + .ecj journal
+    (reference ec_volume.go:25-76)."""
+
+    def __init__(self, directory: str, collection: str, volume_id: int,
+                 version: int = 3):
+        self.directory = directory
+        self.collection = collection
+        self.volume_id = volume_id
+        self.version = version
+        self.base_file_name = os.path.join(directory, str(volume_id))
+        self.shards: dict[int, EcVolumeShard] = {}
+        self._ecx_lock = threading.Lock()
+        self._ecj_lock = threading.Lock()
+        ecx = self.base_file_name + ".ecx"
+        self.ecx_file = open(ecx, "r+b") if os.path.exists(ecx) else None
+        self.ecx_file_size = os.path.getsize(ecx) if self.ecx_file else 0
+        self.ecx_created_at = os.path.getmtime(ecx) if self.ecx_file else 0
+        # shard-location cache for remote reads (volume server fills this)
+        self.shard_locations: dict[int, list[str]] = {}
+        self.shard_locations_refreshed_at = 0.0
+        self.shard_locations_lock = threading.Lock()
+
+    def add_shard(self, shard: EcVolumeShard) -> bool:
+        if shard.shard_id in self.shards:
+            return False
+        self.shards[shard.shard_id] = shard
+        return True
+
+    def delete_shard(self, shard_id: int) -> Optional[EcVolumeShard]:
+        return self.shards.pop(shard_id, None)
+
+    def shard_bits(self) -> ShardBits:
+        b = ShardBits()
+        for sid in self.shards:
+            b = b.add_shard_id(sid)
+        return b
+
+    def shard_size(self) -> int:
+        for s in self.shards.values():
+            return s.shard_size
+        return 0
+
+    def find_needle_from_ecx(self, needle_id: int) -> tuple[int, int]:
+        """(offset_bytes, size); raises NotFoundError; tombstones surface as
+        deleted size (reference ec_volume.go:205-250)."""
+        if self.ecx_file is None:
+            raise NotFoundError("no ecx file")
+        with self._ecx_lock:
+            off_units, size = search_needle_from_sorted_index(
+                self.ecx_file, self.ecx_file_size, needle_id)
+        return t.offset_to_actual(off_units), size
+
+    def locate_needle(self, needle_id: int,
+                      large_block: int = layout.LARGE_BLOCK_SIZE,
+                      small_block: int = layout.SMALL_BLOCK_SIZE
+                      ) -> tuple[list[layout.Interval], int, int]:
+        """(intervals, offset, size) for the needle's whole on-disk record
+        (reference ec_volume.go LocateEcShardNeedle)."""
+        offset, size = self.find_needle_from_ecx(needle_id)
+        if t.size_is_deleted(size):
+            return [], offset, size
+        shard_size = self.shard_size()
+        record = t.get_actual_size(size, self.version)
+        intervals = layout.locate_data(
+            large_block, small_block,
+            layout.DATA_SHARDS_COUNT * shard_size, offset, record)
+        return intervals, offset, size
+
+    def delete_needle(self, needle_id: int) -> None:
+        """Tombstone in .ecx + journal append to .ecj
+        (reference ec_volume_delete.go:27-49)."""
+        if self.ecx_file is None:
+            raise NotFoundError("no ecx file")
+        try:
+            with self._ecx_lock:
+                search_needle_from_sorted_index(
+                    self.ecx_file, self.ecx_file_size, needle_id,
+                    mark_needle_deleted)
+        except NotFoundError:
+            return
+        with self._ecj_lock:
+            with open(self.base_file_name + ".ecj", "ab") as f:
+                f.write(needle_id.to_bytes(t.NEEDLE_ID_SIZE, "big"))
+
+    def read_interval(self, interval: layout.Interval,
+                      large_block: int = layout.LARGE_BLOCK_SIZE,
+                      small_block: int = layout.SMALL_BLOCK_SIZE
+                      ) -> tuple[Optional[bytes], int]:
+        """Read one interval from a LOCAL shard. Returns (data, shard_id);
+        data is None when the shard is not local (caller goes remote /
+        degraded, reference store_ec.go:188-218)."""
+        shard_id, off = interval.to_shard_id_and_offset(large_block, small_block)
+        shard = self.shards.get(shard_id)
+        if shard is None:
+            return None, shard_id
+        return shard.read_at(off, interval.size), shard_id
+
+    def close(self):
+        if self.ecx_file:
+            self.ecx_file.close()
+            self.ecx_file = None
+        for s in self.shards.values():
+            s.close()
+        self.shards.clear()
+
+    def destroy(self):
+        for s in list(self.shards.values()):
+            s.destroy()
+        self.close()
+        for ext in (".ecx", ".ecj", ".vif"):
+            p = self.base_file_name + ext
+            if os.path.exists(p):
+                os.remove(p)
