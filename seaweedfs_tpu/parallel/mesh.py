@@ -1,0 +1,55 @@
+"""Device-mesh construction for the EC engine.
+
+Axis vocabulary (the storage-system analogue of dp/tp/sp, SURVEY.md §5.7):
+  - 'data'  : batch of independent volumes (data parallel)
+  - 'shard' : the 14 RS shards of one volume (tensor/model parallel — the
+              dimension collectives run over during degraded rebuild)
+  - 'seq'   : position along the stripe (sequence parallel — EC columns are
+              independent, so this axis never needs a collective on encode)
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: int | None = None,
+              axis_names: tuple[str, ...] = ("data", "shard", "seq"),
+              shape: tuple[int, ...] | None = None) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if shape is None:
+        shape = _default_shape(n, len(axis_names))
+    assert math.prod(shape) == n, (shape, n)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def _default_shape(n: int, naxes: int) -> tuple[int, ...]:
+    """Factor n into naxes dims, biasing size toward the trailing ('seq')
+    axis, then 'shard', keeping 'data' smallest."""
+    dims = [1] * naxes
+    i = naxes - 1
+    while n > 1:
+        # peel smallest prime factor
+        f = 2
+        while n % f:
+            f += 1
+        dims[i] *= f
+        n //= f
+        i = (i - 1) if i > 0 else naxes - 1
+    return tuple(dims)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def spec(mesh: Mesh, *axes) -> NamedSharding:
+    return NamedSharding(mesh, P(*axes))

@@ -1,12 +1,21 @@
 """Test harness: force an 8-device virtual CPU mesh so multi-chip sharding
 logic is exercised without TPU hardware (bench.py, by contrast, runs on the
-real chip and must NOT import this)."""
+real chip and must NOT import this).
+
+Note: this environment's sitecustomize registers the TPU backend and forces
+jax_platforms — the config update below (after env vars, before any backend
+use) overrides it back to CPU.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
