@@ -1,0 +1,225 @@
+"""Needle record codec — byte-compatible with the reference on-disk format.
+
+Record layout (reference weed/storage/needle/needle.go:25-45,
+needle_write.go prepareWriteBuffer, needle_read.go):
+
+  header: cookie(4) id(8) size(4)                       [big-endian]
+  v1 body: data[size]
+  v2/3 body (`size` covers): data_size(4) data flags(1)
+      [name_size(1) name] [mime_size(1) mime] [last_modified(5)]
+      [ttl(2)] [pairs_size(2) pairs]
+  tail: crc32c(4) [v3: append_at_ns(8)] padding to 8B boundary
+
+An empty-data needle (size==0) is a deletion record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import time
+from typing import Optional
+
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.utils.crc import crc32c
+
+FLAG_IS_COMPRESSED = 0x01
+FLAG_HAS_NAME = 0x02
+FLAG_HAS_MIME = 0x04
+FLAG_HAS_LAST_MODIFIED_DATE = 0x08
+FLAG_HAS_TTL = 0x10
+FLAG_HAS_PAIRS = 0x20
+FLAG_IS_CHUNK_MANIFEST = 0x80
+
+VERSION1, VERSION2, VERSION3 = 1, 2, 3
+CURRENT_VERSION = VERSION3
+
+
+class CrcError(Exception):
+    pass
+
+
+class SizeMismatchError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class Needle:
+    id: int = 0
+    cookie: int = 0
+    data: bytes = b""
+    name: bytes = b""
+    mime: bytes = b""
+    pairs: bytes = b""
+    flags: int = 0
+    last_modified: int = 0
+    ttl: Optional[bytes] = None  # 2 raw bytes or None
+    append_at_ns: int = 0
+    checksum: int = 0
+    size: int = 0  # body size as stored in the header (v2/3)
+
+    # ---- flags ----
+    def _flag(self, bit: int) -> bool:
+        return bool(self.flags & bit)
+
+    @property
+    def has_name(self):
+        return self._flag(FLAG_HAS_NAME)
+
+    @property
+    def has_mime(self):
+        return self._flag(FLAG_HAS_MIME)
+
+    @property
+    def has_ttl(self):
+        return self._flag(FLAG_HAS_TTL)
+
+    @property
+    def has_pairs(self):
+        return self._flag(FLAG_HAS_PAIRS)
+
+    @property
+    def has_last_modified(self):
+        return self._flag(FLAG_HAS_LAST_MODIFIED_DATE)
+
+    @property
+    def is_compressed(self):
+        return self._flag(FLAG_IS_COMPRESSED)
+
+    @property
+    def is_chunk_manifest(self):
+        return self._flag(FLAG_IS_CHUNK_MANIFEST)
+
+    def set_flags_from_fields(self) -> None:
+        if self.name:
+            self.flags |= FLAG_HAS_NAME
+        if self.mime:
+            self.flags |= FLAG_HAS_MIME
+        if self.pairs:
+            self.flags |= FLAG_HAS_PAIRS
+        if self.last_modified:
+            self.flags |= FLAG_HAS_LAST_MODIFIED_DATE
+        if self.ttl and self.ttl != b"\x00\x00":
+            self.flags |= FLAG_HAS_TTL
+
+    # ---- write ----
+    def to_bytes(self, version: int = CURRENT_VERSION) -> bytes:
+        """Full on-disk record, 8-byte padded."""
+        self.checksum = crc32c(self.data)
+        if version == VERSION1:
+            self.size = len(self.data)
+            buf = bytearray()
+            buf += struct.pack(">IQi", self.cookie, self.id, self.size)
+            buf += self.data
+            tail = struct.pack(">I", self.checksum)
+            buf += tail + b"\x00" * t.padding_length(self.size, version)
+            return bytes(buf)
+
+        assert version in (VERSION2, VERSION3)
+        body = bytearray()
+        if len(self.data) > 0:
+            body += struct.pack(">I", len(self.data))
+            body += self.data
+            body += bytes([self.flags & 0xFF])
+            if self.has_name:
+                name = self.name[:255]
+                body += bytes([len(name)]) + name
+            if self.has_mime:
+                mime = self.mime[:255]
+                body += bytes([len(mime)]) + mime
+            if self.has_last_modified:
+                body += struct.pack(">Q", self.last_modified)[
+                    8 - t.LAST_MODIFIED_BYTES_LENGTH:]
+            if self.has_ttl:
+                body += (self.ttl or b"\x00\x00")[:2]
+            if self.has_pairs:
+                body += struct.pack(">H", len(self.pairs)) + self.pairs
+        self.size = len(body)
+        buf = bytearray()
+        buf += struct.pack(">IQi", self.cookie, self.id, self.size)
+        buf += body
+        buf += struct.pack(">I", self.checksum)
+        if version == VERSION3:
+            buf += struct.pack(">Q", self.append_at_ns)
+        buf += b"\x00" * t.padding_length(self.size, version)
+        return bytes(buf)
+
+    # ---- read ----
+    @classmethod
+    def parse_header(cls, buf: bytes) -> "Needle":
+        cookie, nid, size = struct.unpack_from(">IQi", buf, 0)
+        return cls(id=nid, cookie=cookie, size=size)
+
+    @classmethod
+    def from_bytes(cls, buf: bytes, size: int,
+                   version: int = CURRENT_VERSION,
+                   check_crc: bool = True) -> "Needle":
+        """Parse a full record blob previously located via the needle map
+        (reference needle_read.go ReadBytes)."""
+        n = cls.parse_header(buf)
+        if n.size != size:
+            raise SizeMismatchError(
+                f"found size {n.size}, expected {size} (id {n.id:x})")
+        h = t.NEEDLE_HEADER_SIZE
+        if version == VERSION1:
+            n.data = bytes(buf[h:h + size])
+        else:
+            n._parse_body_v2(buf[h:h + n.size])
+        if size > 0 and check_crc:
+            stored, = struct.unpack_from(">I", buf, h + size)
+            actual = crc32c(n.data)
+            if stored != actual and stored != _legacy_crc_value(actual):
+                raise CrcError("CRC error! Data On Disk Corrupted")
+            n.checksum = actual
+        if version == VERSION3:
+            n.append_at_ns, = struct.unpack_from(
+                ">Q", buf, h + size + t.NEEDLE_CHECKSUM_SIZE)
+        return n
+
+    def _parse_body_v2(self, body: bytes) -> None:
+        idx = 0
+        if idx < len(body):
+            data_size, = struct.unpack_from(">I", body, idx)
+            idx += 4
+            if data_size + idx > len(body):
+                raise ValueError("index out of range")
+            self.data = bytes(body[idx:idx + data_size])
+            idx += data_size
+            self.flags = body[idx]
+            idx += 1
+            if self.has_name:
+                ln = body[idx]
+                idx += 1
+                self.name = bytes(body[idx:idx + ln])
+                idx += ln
+            if self.has_mime:
+                ln = body[idx]
+                idx += 1
+                self.mime = bytes(body[idx:idx + ln])
+                idx += ln
+            if self.has_last_modified:
+                raw = b"\x00" * (8 - t.LAST_MODIFIED_BYTES_LENGTH) + \
+                    body[idx:idx + t.LAST_MODIFIED_BYTES_LENGTH]
+                self.last_modified, = struct.unpack(">Q", raw)
+                idx += t.LAST_MODIFIED_BYTES_LENGTH
+            if self.has_ttl:
+                self.ttl = bytes(body[idx:idx + 2])
+                idx += 2
+            if self.has_pairs:
+                ln, = struct.unpack_from(">H", body, idx)
+                idx += 2
+                self.pairs = bytes(body[idx:idx + ln])
+                idx += ln
+
+    def disk_size(self, version: int = CURRENT_VERSION) -> int:
+        return t.get_actual_size(self.size, version)
+
+    def stamp(self) -> None:
+        self.append_at_ns = time.time_ns()
+
+
+def _legacy_crc_value(c: int) -> int:
+    """Go crc.Value(): rotated+offset form kept for backward compat
+    (reference weed/storage/needle/crc.go:26)."""
+    c &= 0xFFFFFFFF
+    return (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF

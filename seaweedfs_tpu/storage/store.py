@@ -1,0 +1,289 @@
+"""Store: a volume server's set of disk locations + the EC read path.
+
+Functional equivalent of reference weed/storage/store.go:43-61 and
+store_ec.go. The EC needle read walks intervals; each interval is served
+from a local shard, else via the injected remote reader, else degraded-
+reconstructed from >= k other shards through the ErasureCoder — the
+TPU-backed coder slots in here (reference store_ec.go:125-163,328-382).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from seaweedfs_tpu.models.coder import DEFAULT_SCHEME, ErasureCoder, make_coder
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.disk_location import DiskLocation
+from seaweedfs_tpu.storage.erasure_coding import layout
+from seaweedfs_tpu.storage.erasure_coding.ec_volume import EcVolume
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement, TTL
+from seaweedfs_tpu.storage.volume import DeletedError, NotFoundError, Volume
+
+# remote_shard_reader(vid, shard_id, offset, size) -> bytes | None
+RemoteShardReader = Callable[[int, int, int, int], Optional[bytes]]
+
+
+class Store:
+    def __init__(self, directories: list[str],
+                 max_volume_counts: Optional[list[int]] = None,
+                 ip: str = "localhost", port: int = 8080,
+                 public_url: str = "", rack: str = "", data_center: str = "",
+                 coder: Optional[ErasureCoder] = None):
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.rack = rack
+        self.data_center = data_center
+        self.locations = [
+            DiskLocation(d, (max_volume_counts or [8] * len(directories))[i])
+            for i, d in enumerate(directories)]
+        self.coder = coder or make_coder("cpu")
+        self.remote_shard_reader: Optional[RemoteShardReader] = None
+        self._lock = threading.RLock()
+        # delta channels to master (drained by the heartbeat loop)
+        self.new_volumes: list[dict] = []
+        self.deleted_volumes: list[dict] = []
+        self.new_ec_shards: list[dict] = []
+        self.deleted_ec_shards: list[dict] = []
+
+    def load_existing_volumes(self) -> None:
+        for loc in self.locations:
+            loc.load_existing_volumes()
+
+    # ---- normal volumes ----
+    def add_volume(self, vid: int, collection: str = "",
+                   replica_placement: str = "000", ttl: str = "") -> Volume:
+        with self._lock:
+            if self.find_volume(vid) is not None:
+                raise ValueError(f"volume {vid} already exists")
+            loc = min(self.locations, key=lambda l: l.volumes_len())
+            vol = Volume(loc.directory, collection, vid,
+                         ReplicaPlacement.parse(replica_placement),
+                         TTL.parse(ttl))
+            loc.add_volume(vol)
+            self.new_volumes.append(self.volume_info(vol))
+            return vol
+
+    def find_volume(self, vid: int) -> Optional[Volume]:
+        for loc in self.locations:
+            v = loc.find_volume(vid)
+            if v is not None:
+                return v
+        return None
+
+    def delete_volume(self, vid: int) -> bool:
+        with self._lock:
+            for loc in self.locations:
+                v = loc.find_volume(vid)
+                if v is not None:
+                    info = self.volume_info(v)
+                    loc.delete_volume(vid)
+                    self.deleted_volumes.append(info)
+                    return True
+            return False
+
+    def write_volume_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        return v.write_needle(n)
+
+    def read_volume_needle(self, vid: int, needle_id: int,
+                           cookie: Optional[int] = None) -> Needle:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        return v.read_needle(needle_id, cookie)
+
+    def delete_volume_needle(self, vid: int, needle_id: int,
+                             cookie: Optional[int] = None) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        return v.delete_needle(needle_id, cookie)
+
+    def mark_volume_readonly(self, vid: int, read_only: bool = True) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        v.read_only = read_only
+        return True
+
+    # ---- EC shards ----
+    def mount_ec_shards(self, collection: str, vid: int,
+                        shard_ids: list[int]) -> None:
+        for sid in shard_ids:
+            for loc in self.locations:
+                try:
+                    if loc.load_ec_shard(collection, vid, sid):
+                        self.new_ec_shards.append(
+                            {"id": vid, "collection": collection,
+                             "ec_index_bits": 1 << sid})
+                        break
+                except FileNotFoundError:
+                    continue
+
+    def generate_ec_shards(self, vid: int) -> str:
+        """VolumeEcShardsGenerate equivalent: write .ec00-.ec13 + .ecx +
+        .vif next to the volume's files (reference
+        server/volume_grpc_erasure_coding.go:38-81). Returns the base file
+        name. The volume must exist locally; it is marked readonly first."""
+        import json
+
+        from seaweedfs_tpu.storage.erasure_coding import encoder as ecenc
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        v.read_only = True
+        v.sync()
+        base = v.file_name()
+        ecenc.write_sorted_ecx(base)
+        ecenc.write_ec_files(base, self.coder)
+        with open(base + ".vif", "w") as f:
+            json.dump({"version": v.version}, f)
+        return base
+
+    def unmount_ec_shards(self, vid: int, shard_ids: list[int]) -> None:
+        for sid in shard_ids:
+            for loc in self.locations:
+                if loc.unload_ec_shard(vid, sid):
+                    self.deleted_ec_shards.append(
+                        {"id": vid, "ec_index_bits": 1 << sid})
+                    break
+
+    def find_ec_volume(self, vid: int) -> Optional[EcVolume]:
+        for loc in self.locations:
+            ev = loc.find_ec_volume(vid)
+            if ev is not None:
+                return ev
+        return None
+
+    def has_ec_volume(self, vid: int) -> bool:
+        return self.find_ec_volume(vid) is not None
+
+    def read_ec_shard_needle(self, vid: int, needle_id: int,
+                             cookie: Optional[int] = None) -> Needle:
+        """Locate via .ecx, then read intervals with local -> remote ->
+        degraded-reconstruction fallback (reference store_ec.go:125-163)."""
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise NotFoundError(f"ec volume {vid} not found")
+        intervals, offset, size = ev.locate_needle(needle_id)
+        if t.size_is_deleted(size):
+            raise DeletedError(f"needle {needle_id:x} deleted")
+        blob = b"".join(
+            self._read_one_interval(ev, iv) for iv in intervals)
+        n = Needle.from_bytes(blob, size, ev.version)
+        if cookie is not None and n.cookie != cookie:
+            raise NotFoundError(f"cookie mismatch for needle {needle_id:x}")
+        return n
+
+    def _read_one_interval(self, ev: EcVolume, iv: layout.Interval) -> bytes:
+        data, shard_id = ev.read_interval(iv)
+        if data is not None:
+            return data
+        # remote shard
+        if self.remote_shard_reader is not None:
+            shard_off = iv.to_shard_id_and_offset()[1]
+            data = self.remote_shard_reader(ev.volume_id, shard_id, shard_off,
+                                            iv.size)
+            if data is not None and len(data) == iv.size:
+                return data
+        # degraded: fetch the same range of >= k other shards and reconstruct
+        return self._recover_one_interval(ev, iv, shard_id)
+
+    def _recover_one_interval(self, ev: EcVolume, iv: layout.Interval,
+                              wanted_shard: int) -> bytes:
+        k = self.coder.scheme.data_shards
+        total = self.coder.scheme.total_shards
+        shard_off = iv.to_shard_id_and_offset()[1]
+        bufs: dict[int, bytes] = {}
+        for sid in range(total):
+            if sid == wanted_shard:
+                continue
+            local = ev.shards.get(sid)
+            if local is not None:
+                bufs[sid] = local.read_at(shard_off, iv.size)
+            elif self.remote_shard_reader is not None:
+                got = self.remote_shard_reader(ev.volume_id, sid, shard_off,
+                                               iv.size)
+                if got is not None and len(got) == iv.size:
+                    bufs[sid] = got
+            if len(bufs) >= k:
+                break
+        if len(bufs) < k:
+            raise NotFoundError(
+                f"ec volume {ev.volume_id}: only {len(bufs)} shards "
+                f"reachable, need {k}")
+        shards: list[Optional[bytes]] = [None] * total
+        for sid, b in bufs.items():
+            shards[sid] = b
+        full = self.coder.reconstruct(shards)
+        return full[wanted_shard]
+
+    def delete_ec_shard_needle(self, vid: int, needle_id: int,
+                               cookie: Optional[int] = None) -> int:
+        """Cookie-check then tombstone locally (the server layer fans the
+        delete to peer shard owners, reference store_ec_delete.go)."""
+        n = self.read_ec_shard_needle(vid, needle_id, cookie)
+        ev = self.find_ec_volume(vid)
+        ev.delete_needle(needle_id)
+        return len(n.data)
+
+    # ---- heartbeat ----
+    def volume_info(self, v: Volume) -> dict:
+        return {
+            "id": v.id,
+            "collection": v.collection,
+            "size": v.content_size(),
+            "file_count": v.file_count(),
+            "delete_count": v.deleted_count(),
+            "deleted_byte_count": v.deleted_bytes(),
+            "read_only": v.read_only,
+            "replica_placement": v.super_block.replica_placement.to_byte(),
+            "ttl": v.super_block.ttl.to_uint32(),
+            "version": v.version,
+        }
+
+    def collect_heartbeat(self) -> dict:
+        volumes = []
+        ec_shards = []
+        max_volume_count = 0
+        for loc in self.locations:
+            max_volume_count += loc.max_volume_count
+            for v in loc.volumes.values():
+                volumes.append(self.volume_info(v))
+            for ev in loc.ec_volumes.values():
+                ec_shards.append({
+                    "id": ev.volume_id,
+                    "collection": ev.collection,
+                    "ec_index_bits": ev.shard_bits().bits,
+                })
+        return {
+            "ip": self.ip, "port": self.port, "public_url": self.public_url,
+            "rack": self.rack, "data_center": self.data_center,
+            "max_volume_count": max_volume_count,
+            "volumes": volumes,
+            "ec_shards": ec_shards,
+            "has_no_volumes": not volumes and not ec_shards,
+        }
+
+    def drain_deltas(self) -> dict:
+        with self._lock:
+            out = {
+                "new_volumes": self.new_volumes,
+                "deleted_volumes": self.deleted_volumes,
+                "new_ec_shards": self.new_ec_shards,
+                "deleted_ec_shards": self.deleted_ec_shards,
+            }
+            self.new_volumes = []
+            self.deleted_volumes = []
+            self.new_ec_shards = []
+            self.deleted_ec_shards = []
+            return out
+
+    def close(self) -> None:
+        for loc in self.locations:
+            loc.close()

@@ -1,0 +1,273 @@
+"""Volume: append-only needle log (.dat) + index log (.idx).
+
+Functional equivalent of reference weed/storage/volume.go,
+volume_write.go, volume_read.go, volume_loading.go, volume_vacuum.go,
+volume_checking.go. The .dat begins with an 8-byte superblock; every write
+appends a padded needle record to .dat and a 16-byte entry to .idx; deletes
+append an empty needle to .dat and a tombstone entry to .idx; vacuum
+rewrites live needles into a fresh pair of files and bumps the superblock's
+compaction revision.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.needle import CURRENT_VERSION, Needle
+from seaweedfs_tpu.storage.needle_map import CompactMap
+from seaweedfs_tpu.storage import idx as idxmod
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement, SuperBlock, TTL
+
+
+class NotFoundError(Exception):
+    pass
+
+
+class DeletedError(Exception):
+    pass
+
+
+class CookieMismatchError(Exception):
+    pass
+
+
+class Volume:
+    def __init__(self, directory: str, collection: str, volume_id: int,
+                 replica_placement: Optional[ReplicaPlacement] = None,
+                 ttl: Optional[TTL] = None, version: int = CURRENT_VERSION):
+        self.directory = directory
+        self.collection = collection
+        self.id = volume_id
+        self.read_only = False
+        self._lock = threading.RLock()
+        self.last_append_at_ns = 0
+        self.is_compacting = False
+
+        base = self.file_name()
+        exists = os.path.exists(base + ".dat")
+        if exists:
+            self._load()
+        else:
+            self.super_block = SuperBlock(
+                version=version,
+                replica_placement=replica_placement or ReplicaPlacement(),
+                ttl=ttl or TTL())
+            self._dat = open(base + ".dat", "w+b")
+            self._dat.write(self.super_block.to_bytes())
+            self._dat.flush()
+            self._idx = open(base + ".idx", "a+b")
+            self.nm = CompactMap()
+
+    # ---- naming ----
+    def file_name(self) -> str:
+        name = str(self.id) if not self.collection else \
+            f"{self.collection}_{self.id}"
+        return os.path.join(self.directory, name)
+
+    @property
+    def version(self) -> int:
+        return self.super_block.version
+
+    # ---- load ----
+    def _load(self):
+        base = self.file_name()
+        self._dat = open(base + ".dat", "r+b")
+        self._dat.seek(0)
+        head = self._dat.read(super_block_probe_len())
+        self.super_block = SuperBlock.parse(head)
+        self._idx = open(base + ".idx", "a+b")
+        self.nm = CompactMap()
+        if os.path.exists(base + ".idx"):
+            def visit(key, off, size):
+                if off != 0 and size != t.TOMBSTONE_FILE_SIZE:
+                    self.nm.set(key, off, size)
+                    self.nm.file_count += 1
+                elif self.nm.delete(key):
+                    self.nm.deleted_count += 1
+            idxmod.walk_index_file(base + ".idx", visit)
+
+    # ---- write ----
+    def write_needle(self, n: Needle) -> int:
+        """Append; returns stored size (reference volume_write.go:109-162).
+        """
+        with self._lock:
+            if self.read_only:
+                raise PermissionError(f"volume {self.id} is read only")
+            if not n.append_at_ns:
+                n.append_at_ns = time.time_ns()
+            self._dat.seek(0, os.SEEK_END)
+            offset = self._dat.tell()
+            if offset % t.NEEDLE_PADDING_SIZE != 0:
+                offset += (-offset) % t.NEEDLE_PADDING_SIZE
+                self._dat.seek(offset)
+            if offset >= t.MAX_POSSIBLE_VOLUME_SIZE:
+                raise IOError(f"volume {self.id} exceeds max size")
+            rec = n.to_bytes(self.version)
+            self._dat.write(rec)
+            self.last_append_at_ns = n.append_at_ns
+            off_units = t.actual_to_offset(offset)
+            self.nm.set(n.id, off_units, n.size)
+            self._idx.write(t.pack_entry(n.id, off_units, n.size))
+            return n.size
+
+    # ---- read ----
+    def read_needle(self, needle_id: int, cookie: Optional[int] = None,
+                    check_crc: bool = True) -> Needle:
+        with self._lock:
+            nv = self.nm.get(needle_id)
+            if nv is None:
+                raise NotFoundError(f"needle {needle_id:x} not found")
+            off_units, size = nv
+            if not t.size_is_valid(size):
+                raise DeletedError(f"needle {needle_id:x} deleted")
+            blob = self._read_at(t.offset_to_actual(off_units),
+                                 t.get_actual_size(size, self.version))
+        n = Needle.from_bytes(blob, size, self.version, check_crc)
+        if cookie is not None and n.cookie != cookie:
+            raise CookieMismatchError(
+                f"cookie mismatch for needle {needle_id:x}")
+        return n
+
+    def _read_at(self, offset: int, length: int) -> bytes:
+        self._dat.seek(offset)
+        return self._dat.read(length)
+
+    def has_needle(self, needle_id: int) -> bool:
+        return self.nm.get(needle_id) is not None
+
+    # ---- delete ----
+    def delete_needle(self, needle_id: int, cookie: Optional[int] = None) -> int:
+        """Append a deletion record + tombstone the index
+        (reference volume_write.go doDeleteRequest:211-231). Returns the
+        freed size (0 if absent)."""
+        with self._lock:
+            if self.read_only:
+                raise PermissionError(f"volume {self.id} is read only")
+            nv = self.nm.get(needle_id)
+            if nv is None or not t.size_is_valid(nv[1]):
+                return 0
+            if cookie is not None:
+                existing = self.read_needle(needle_id, cookie)
+                del existing
+            size = nv[1]
+            n = Needle(id=needle_id, cookie=cookie or 0)
+            n.append_at_ns = time.time_ns()
+            self._dat.seek(0, os.SEEK_END)
+            self._dat.write(n.to_bytes(self.version))
+            self.nm.delete(needle_id)
+            self.nm.deleted_count += 1
+            self.nm.deleted_bytes += size
+            self._idx.write(t.pack_entry(needle_id, 0, t.TOMBSTONE_FILE_SIZE))
+            return size
+
+    # ---- stats ----
+    def content_size(self) -> int:
+        self._dat.seek(0, os.SEEK_END)
+        return self._dat.tell()
+
+    def file_count(self) -> int:
+        return len(self.nm)
+
+    def deleted_count(self) -> int:
+        return self.nm.deleted_count
+
+    def deleted_bytes(self) -> int:
+        return self.nm.deleted_bytes
+
+    # ---- vacuum (Compact2-style: copy live needles) ----
+    def garbage_level(self) -> float:
+        size = self.content_size()
+        if size <= 8:
+            return 0.0
+        return self.nm.deleted_bytes / size
+
+    def compact(self) -> None:
+        """Rewrite live needles to .cpd/.cpx then atomically commit
+        (reference volume_vacuum.go Compact2/CommitCompact)."""
+        with self._lock:
+            self.is_compacting = True
+        try:
+            base = self.file_name()
+            new_sb = SuperBlock(
+                version=self.super_block.version,
+                replica_placement=self.super_block.replica_placement,
+                ttl=self.super_block.ttl,
+                compaction_revision=self.super_block.compaction_revision + 1,
+                extra=self.super_block.extra)
+            with open(base + ".cpd", "wb") as dat, \
+                    open(base + ".cpx", "wb") as idxf:
+                dat.write(new_sb.to_bytes())
+                entries = []
+                with self._lock:
+                    self.nm.ascending_visit(
+                        lambda k, o, s: entries.append((k, o, s)))
+                    for key, off_units, size in entries:
+                        if not t.size_is_valid(size):
+                            continue
+                        blob = self._read_at(
+                            t.offset_to_actual(off_units),
+                            t.get_actual_size(size, self.version))
+                        new_off = dat.tell()
+                        dat.write(blob)
+                        idxf.write(t.pack_entry(
+                            key, t.actual_to_offset(new_off), size))
+            with self._lock:
+                self._dat.close()
+                self._idx.close()
+                os.replace(base + ".cpd", base + ".dat")
+                os.replace(base + ".cpx", base + ".idx")
+                self._load()
+        finally:
+            self.is_compacting = False
+
+    # ---- integrity ----
+    def check_integrity(self) -> bool:
+        """Verify the last index entry points at a well-formed needle
+        (reference volume_checking.go CheckAndFixVolumeDataIntegrity)."""
+        base = self.file_name()
+        idx_size = os.path.getsize(base + ".idx")
+        if idx_size == 0:
+            return True
+        with open(base + ".idx", "rb") as f:
+            f.seek(idx_size - t.NEEDLE_MAP_ENTRY_SIZE)
+            key, off, size = t.unpack_entry(f.read(t.NEEDLE_MAP_ENTRY_SIZE))
+        if off == 0 or size == t.TOMBSTONE_FILE_SIZE:
+            return True
+        try:
+            blob = self._read_at(t.offset_to_actual(off),
+                                 t.get_actual_size(size, self.version))
+            n = Needle.from_bytes(blob, size, self.version)
+            return n.id == key
+        except Exception:
+            return False
+
+    def sync(self) -> None:
+        with self._lock:
+            self._dat.flush()
+            os.fsync(self._dat.fileno())
+            self._idx.flush()
+            os.fsync(self._idx.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._dat.flush()
+                self._idx.flush()
+            finally:
+                self._dat.close()
+                self._idx.close()
+
+    def destroy(self) -> None:
+        self.close()
+        base = self.file_name()
+        for ext in (".dat", ".idx", ".vif", ".note"):
+            if os.path.exists(base + ext):
+                os.remove(base + ext)
+
+
+def super_block_probe_len() -> int:
+    return 8 + 65536  # superblock + max extra
