@@ -1,0 +1,349 @@
+"""Cluster topology tree: Topology -> DataCenter -> Rack -> DataNode.
+
+Functional equivalent of reference weed/topology (topology.go, node.go,
+data_center.go, rack.go, data_node.go, topology_ec.go): slot counting,
+volume location registry, per-(collection, rp, ttl) volume layouts, and the
+EC shard map. All pure in-memory logic — the master server wires heartbeats
+into it; planners (shell) run against its read API.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+from seaweedfs_tpu.storage.erasure_coding import layout as ec_layout
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement, TTL
+
+
+class DataNode:
+    def __init__(self, ip: str, port: int, public_url: str = "",
+                 max_volume_count: int = 8):
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.max_volume_count = max_volume_count
+        self.volumes: dict[int, dict] = {}
+        self.ec_shards: dict[int, int] = {}  # vid -> shard bits
+        self.rack: Optional["Rack"] = None
+        self.last_seen = time.time()
+
+    @property
+    def id(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def ec_shard_count(self) -> int:
+        return sum(bin(bits).count("1") for bits in self.ec_shards.values())
+
+    def free_space(self) -> float:
+        """Free volume slots; EC shards consume fractional slots
+        (reference counts 1 slot per TotalShardsCount shards)."""
+        used = len(self.volumes) + \
+            self.ec_shard_count() / ec_layout.TOTAL_SHARDS_COUNT
+        return self.max_volume_count - used
+
+    def to_info(self) -> dict:
+        return {
+            "id": self.id, "ip": self.ip, "port": self.port,
+            "public_url": self.public_url,
+            "max_volume_count": self.max_volume_count,
+            "volumes": list(self.volumes.values()),
+            "ec_shards": [
+                {"id": vid, "ec_index_bits": bits}
+                for vid, bits in self.ec_shards.items()],
+            "rack": self.rack.id if self.rack else "",
+            "data_center": self.rack.data_center.id
+            if self.rack and self.rack.data_center else "",
+        }
+
+
+class Rack:
+    def __init__(self, rack_id: str):
+        self.id = rack_id
+        self.nodes: dict[str, DataNode] = {}
+        self.data_center: Optional["DataCenter"] = None
+
+    def get_or_create_node(self, ip: str, port: int, public_url: str = "",
+                           max_volume_count: int = 8) -> DataNode:
+        key = f"{ip}:{port}"
+        n = self.nodes.get(key)
+        if n is None:
+            n = DataNode(ip, port, public_url, max_volume_count)
+            n.rack = self
+            self.nodes[key] = n
+        return n
+
+    def free_space(self) -> float:
+        return sum(n.free_space() for n in self.nodes.values())
+
+
+class DataCenter:
+    def __init__(self, dc_id: str):
+        self.id = dc_id
+        self.racks: dict[str, Rack] = {}
+
+    def get_or_create_rack(self, rack_id: str) -> Rack:
+        r = self.racks.get(rack_id)
+        if r is None:
+            r = Rack(rack_id)
+            r.data_center = self
+            self.racks[rack_id] = r
+        return r
+
+    def free_space(self) -> float:
+        return sum(r.free_space() for r in self.racks.values())
+
+
+class VolumeLayout:
+    """Writable-volume bookkeeping per (collection, rp, ttl)
+    (reference weed/topology/volume_layout.go)."""
+
+    def __init__(self, rp: ReplicaPlacement, ttl: TTL,
+                 volume_size_limit: int):
+        self.rp = rp
+        self.ttl = ttl
+        self.volume_size_limit = volume_size_limit
+        self.locations: dict[int, list[DataNode]] = {}
+        self.writable: set[int] = set()
+        self.readonly: set[int] = set()
+
+    def register_volume(self, vinfo: dict, node: DataNode) -> None:
+        vid = vinfo["id"]
+        locs = self.locations.setdefault(vid, [])
+        if node not in locs:
+            locs.append(node)
+        enough_copies = len(locs) >= self.rp.copy_count
+        if vinfo.get("read_only"):
+            self.readonly.add(vid)
+            self.writable.discard(vid)
+        elif vinfo.get("size", 0) >= self.volume_size_limit:
+            self.writable.discard(vid)
+        elif enough_copies and vid not in self.readonly:
+            self.writable.add(vid)
+
+    def unregister_volume(self, vid: int, node: DataNode) -> None:
+        locs = self.locations.get(vid)
+        if not locs:
+            return
+        if node in locs:
+            locs.remove(node)
+        if len(locs) < self.rp.copy_count:
+            self.writable.discard(vid)
+        if not locs:
+            self.locations.pop(vid, None)
+            self.readonly.discard(vid)
+
+    def pick_for_write(self) -> tuple[int, list[DataNode]]:
+        if not self.writable:
+            raise LookupError("no writable volumes")
+        vid = random.choice(sorted(self.writable))
+        return vid, self.locations[vid]
+
+    def set_volume_unavailable(self, vid: int) -> None:
+        self.writable.discard(vid)
+
+    def active_volume_count(self) -> int:
+        return len(self.writable)
+
+
+class Topology:
+    def __init__(self, volume_size_limit: int = 30 * 1024 ** 3,
+                 pulse_seconds: float = 5.0):
+        self.data_centers: dict[str, DataCenter] = {}
+        self.layouts: dict[tuple[str, str, str], VolumeLayout] = {}
+        self.ec_shard_map: dict[int, list[list[DataNode]]] = {}
+        self.volume_size_limit = volume_size_limit
+        self.pulse_seconds = pulse_seconds
+        self.max_volume_id = 0
+        self.lock = threading.RLock()
+
+    # ---- tree ----
+    def get_or_create_data_center(self, dc_id: str) -> DataCenter:
+        dc = self.data_centers.get(dc_id)
+        if dc is None:
+            dc = DataCenter(dc_id)
+            self.data_centers[dc_id] = dc
+        return dc
+
+    def all_nodes(self) -> list[DataNode]:
+        out = []
+        for dc in self.data_centers.values():
+            for rack in dc.racks.values():
+                out.extend(rack.nodes.values())
+        return out
+
+    def find_node(self, node_id: str) -> Optional[DataNode]:
+        for n in self.all_nodes():
+            if n.id == node_id:
+                return n
+        return None
+
+    # ---- layouts ----
+    def get_layout(self, collection: str, rp: str, ttl: str) -> VolumeLayout:
+        key = (collection, rp, ttl)
+        lo = self.layouts.get(key)
+        if lo is None:
+            lo = VolumeLayout(ReplicaPlacement.parse(rp), TTL.parse(ttl),
+                              self.volume_size_limit)
+            self.layouts[key] = lo
+        return lo
+
+    # ---- heartbeat intake ----
+    def sync_data_node_registration(self, hb: dict, dc: str = "",
+                                    rack: str = "") -> DataNode:
+        """Full heartbeat: (re)register the node and its volumes/EC shards
+        (reference master_grpc_server.go:61-234 + topology_ec.go:16)."""
+        with self.lock:
+            dcn = self.get_or_create_data_center(
+                dc or hb.get("data_center") or "DefaultDataCenter")
+            rk = dcn.get_or_create_rack(
+                rack or hb.get("rack") or "DefaultRack")
+            node = rk.get_or_create_node(
+                hb["ip"], hb["port"], hb.get("public_url", ""),
+                hb.get("max_volume_count", 8))
+            node.last_seen = time.time()
+
+            # volumes: full sync (replace set)
+            new_vols = {v["id"]: v for v in hb.get("volumes", [])}
+            for vid in list(node.volumes):
+                if vid not in new_vols:
+                    self._unregister_volume(node.volumes[vid], node)
+                    del node.volumes[vid]
+            for vid, v in new_vols.items():
+                node.volumes[vid] = v
+                self._register_volume(v, node)
+                self.max_volume_id = max(self.max_volume_id, vid)
+
+            # EC shards: full sync
+            new_ec = {e["id"]: e["ec_index_bits"]
+                      for e in hb.get("ec_shards", [])}
+            for vid in list(node.ec_shards):
+                if vid not in new_ec:
+                    self._unregister_ec_shards(vid, node, node.ec_shards[vid])
+                    del node.ec_shards[vid]
+            for vid, bits in new_ec.items():
+                old = node.ec_shards.get(vid, 0)
+                node.ec_shards[vid] = bits
+                self._register_ec_shards(vid, node, bits, old)
+                self.max_volume_id = max(self.max_volume_id, vid)
+            return node
+
+    def incremental_sync(self, node: DataNode, deltas: dict) -> None:
+        with self.lock:
+            node.last_seen = time.time()
+            for v in deltas.get("new_volumes", []):
+                node.volumes[v["id"]] = v
+                self._register_volume(v, node)
+                self.max_volume_id = max(self.max_volume_id, v["id"])
+            for v in deltas.get("deleted_volumes", []):
+                node.volumes.pop(v["id"], None)
+                self._unregister_volume(v, node)
+            for e in deltas.get("new_ec_shards", []):
+                vid, bits = e["id"], e["ec_index_bits"]
+                old = node.ec_shards.get(vid, 0)
+                node.ec_shards[vid] = old | bits
+                self._register_ec_shards(vid, node, bits, 0)
+            for e in deltas.get("deleted_ec_shards", []):
+                vid, bits = e["id"], e["ec_index_bits"]
+                old = node.ec_shards.get(vid, 0)
+                remaining = old & ~bits
+                if remaining:
+                    node.ec_shards[vid] = remaining
+                else:
+                    node.ec_shards.pop(vid, None)
+                self._unregister_ec_shards(vid, node, bits)
+
+    def unregister_data_node(self, node: DataNode) -> None:
+        """Stream dropped: remove everything the node served
+        (reference master_grpc_server.go:63-91)."""
+        with self.lock:
+            for v in node.volumes.values():
+                self._unregister_volume(v, node)
+            for vid, bits in node.ec_shards.items():
+                self._unregister_ec_shards(vid, node, bits)
+            node.volumes.clear()
+            node.ec_shards.clear()
+            if node.rack:
+                node.rack.nodes.pop(node.id, None)
+
+    # ---- volume registry ----
+    def _register_volume(self, v: dict, node: DataNode) -> None:
+        rp = ReplicaPlacement.from_byte(v.get("replica_placement", 0))
+        ttl = TTL.from_bytes(
+            v.get("ttl", 0).to_bytes(2, "big")) if v.get("ttl") else TTL()
+        lo = self.get_layout(v.get("collection", ""), str(rp), str(ttl))
+        lo.register_volume(v, node)
+
+    def _unregister_volume(self, v: dict, node: DataNode) -> None:
+        rp = ReplicaPlacement.from_byte(v.get("replica_placement", 0))
+        ttl = TTL.from_bytes(
+            v.get("ttl", 0).to_bytes(2, "big")) if v.get("ttl") else TTL()
+        lo = self.get_layout(v.get("collection", ""), str(rp), str(ttl))
+        lo.unregister_volume(v["id"], node)
+
+    # ---- EC registry ----
+    def _register_ec_shards(self, vid: int, node: DataNode, bits: int,
+                            old_bits: int = 0) -> None:
+        shards = self.ec_shard_map.setdefault(
+            vid, [[] for _ in range(ec_layout.TOTAL_SHARDS_COUNT)])
+        for sid in range(ec_layout.TOTAL_SHARDS_COUNT):
+            if bits & (1 << sid) and node not in shards[sid]:
+                shards[sid].append(node)
+
+    def _unregister_ec_shards(self, vid: int, node: DataNode,
+                              bits: int) -> None:
+        shards = self.ec_shard_map.get(vid)
+        if not shards:
+            return
+        for sid in range(ec_layout.TOTAL_SHARDS_COUNT):
+            if bits & (1 << sid) and node in shards[sid]:
+                shards[sid].remove(node)
+        if all(not s for s in shards):
+            self.ec_shard_map.pop(vid, None)
+
+    # ---- lookup ----
+    def lookup(self, collection: str, vid: int) -> list[DataNode]:
+        for (col, _, _), lo in self.layouts.items():
+            if collection and col != collection:
+                continue
+            locs = lo.locations.get(vid)
+            if locs:
+                return list(locs)
+        return []
+
+    def lookup_ec_shards(self, vid: int) -> Optional[list[list[DataNode]]]:
+        return self.ec_shard_map.get(vid)
+
+    def next_volume_id(self) -> int:
+        with self.lock:
+            self.max_volume_id += 1
+            return self.max_volume_id
+
+    def prune_dead_nodes(self, timeout: Optional[float] = None) -> list[DataNode]:
+        timeout = timeout or self.pulse_seconds * 5
+        dead = [n for n in self.all_nodes()
+                if time.time() - n.last_seen > timeout]
+        for n in dead:
+            self.unregister_data_node(n)
+        return dead
+
+    def to_info(self) -> dict:
+        """Serializable topology dump (the shell planners' input, like
+        master_pb.TopologyInfo)."""
+        with self.lock:
+            return {
+                "max_volume_id": self.max_volume_id,
+                "data_centers": [{
+                    "id": dc.id,
+                    "racks": [{
+                        "id": r.id,
+                        "nodes": [n.to_info() for n in r.nodes.values()],
+                    } for r in dc.racks.values()],
+                } for dc in self.data_centers.values()],
+            }

@@ -1,0 +1,148 @@
+"""Topology/layout/growth tests — in-memory cluster-state fixtures, the
+reference's own strategy for testing multi-node logic without nodes
+(weed/shell/command_ec_test.go, command_volume_balance_test.go)."""
+
+import pytest
+
+from seaweedfs_tpu.cluster.sequence import MemorySequencer, SnowflakeSequencer
+from seaweedfs_tpu.cluster.topology import Topology
+from seaweedfs_tpu.cluster.volume_growth import (NoFreeSpaceError,
+                                                 find_empty_slots,
+                                                 grow_by_type)
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+
+
+def _hb(ip, port, volumes=(), ec=(), dc="dc1", rack="r1", maxv=10):
+    return {
+        "ip": ip, "port": port, "data_center": dc, "rack": rack,
+        "max_volume_count": maxv,
+        "volumes": list(volumes), "ec_shards": list(ec),
+    }
+
+
+def _vol(vid, size=0, collection="", rp=0, read_only=False):
+    return {"id": vid, "size": size, "collection": collection,
+            "replica_placement": rp, "read_only": read_only,
+            "file_count": 1, "delete_count": 0, "deleted_byte_count": 0,
+            "ttl": 0, "version": 3}
+
+
+def test_register_and_lookup():
+    topo = Topology(volume_size_limit=1000)
+    n1 = topo.sync_data_node_registration(_hb("a", 1, [_vol(1), _vol(2)]))
+    n2 = topo.sync_data_node_registration(_hb("b", 2, [_vol(1)], rack="r2"))
+    assert {n.id for n in topo.lookup("", 1)} == {"a:1", "b:2"}
+    assert [n.id for n in topo.lookup("", 2)] == ["a:1"]
+    lo = topo.get_layout("", "000", "")
+    assert 1 in lo.writable and 2 in lo.writable
+    vid, locs = lo.pick_for_write()
+    assert vid in (1, 2)
+
+    # full resync without volume 2 -> unregistered
+    topo.sync_data_node_registration(_hb("a", 1, [_vol(1)]))
+    assert topo.lookup("", 2) == []
+    assert 2 not in lo.writable
+
+    # node death removes its volumes
+    topo.unregister_data_node(n2)
+    assert [n.id for n in topo.lookup("", 1)] == ["a:1"]
+
+
+def test_oversized_and_readonly_not_writable():
+    topo = Topology(volume_size_limit=100)
+    topo.sync_data_node_registration(
+        _hb("a", 1, [_vol(1, size=200), _vol(2, read_only=True), _vol(3)]))
+    lo = topo.get_layout("", "000", "")
+    assert lo.writable == {3}
+
+
+def test_replica_layout_needs_enough_copies():
+    topo = Topology(volume_size_limit=1000)
+    rp = ReplicaPlacement.parse("001").to_byte()
+    topo.sync_data_node_registration(_hb("a", 1, [_vol(1, rp=rp)]))
+    lo = topo.get_layout("", "001", "")
+    assert 1 not in lo.writable  # only 1 of 2 copies present
+    topo.sync_data_node_registration(_hb("b", 2, [_vol(1, rp=rp)]))
+    assert 1 in lo.writable
+
+
+def test_ec_shard_map():
+    topo = Topology()
+    topo.sync_data_node_registration(
+        _hb("a", 1, ec=[{"id": 5, "ec_index_bits": 0b11111}]))
+    topo.sync_data_node_registration(
+        _hb("b", 2, ec=[{"id": 5, "ec_index_bits": 0b11111111100000}]))
+    shards = topo.lookup_ec_shards(5)
+    assert [n.id for n in shards[0]] == ["a:1"]
+    assert [n.id for n in shards[13]] == ["b:2"]
+    # delta: node b drops shard 13
+    nb = topo.find_node("b:2")
+    topo.incremental_sync(nb, {"deleted_ec_shards":
+                               [{"id": 5, "ec_index_bits": 1 << 13}]})
+    assert topo.lookup_ec_shards(5)[13] == []
+    assert nb.ec_shards[5] == 0b1111111100000
+
+
+def test_find_empty_slots_placement():
+    topo = Topology()
+    for dc in ("dc1", "dc2"):
+        for rack in ("r1", "r2"):
+            for i in range(2):
+                topo.sync_data_node_registration(
+                    _hb(f"{dc}-{rack}-{i}", 80, dc=dc, rack=rack))
+    # 010: one replica on a different rack, same dc
+    nodes = find_empty_slots(topo, ReplicaPlacement.parse("010"))
+    assert len(nodes) == 2
+    assert nodes[0].rack.id != nodes[1].rack.id
+    assert nodes[0].rack.data_center.id == nodes[1].rack.data_center.id
+    # 100: one replica in a different dc
+    nodes = find_empty_slots(topo, ReplicaPlacement.parse("100"))
+    assert len(nodes) == 2
+    assert nodes[0].rack.data_center.id != nodes[1].rack.data_center.id
+    # 001: same rack, different node
+    nodes = find_empty_slots(topo, ReplicaPlacement.parse("001"))
+    assert len(nodes) == 2
+    assert nodes[0].rack is nodes[1].rack and nodes[0] is not nodes[1]
+    # 200 impossible with 2 DCs
+    with pytest.raises(NoFreeSpaceError):
+        find_empty_slots(topo, ReplicaPlacement.parse("200"))
+
+
+def test_grow_by_type_allocates_and_assigns_ids():
+    topo = Topology()
+    for i in range(3):
+        topo.sync_data_node_registration(_hb(f"n{i}", 80))
+    allocated = []
+
+    def alloc(node, vid, collection, rp, ttl):
+        allocated.append((node.id, vid))
+        node.volumes[vid] = _vol(vid)
+        topo._register_volume(_vol(vid), node)
+        return True
+
+    vids = grow_by_type(topo, "", "001", "", alloc, count=2)
+    assert len(vids) == 2 and vids[0] != vids[1]
+    assert len(allocated) == 4  # 2 volumes x 2 copies
+    assert topo.max_volume_id == max(vids)
+
+
+def test_sequencers():
+    s = MemorySequencer()
+    a = s.next_file_id(3)
+    b = s.next_file_id()
+    assert b == a + 3
+    s.set_max(100)
+    assert s.next_file_id() == 101
+
+    sf = SnowflakeSequencer(node_id=5)
+    ids = {sf.next_file_id() for _ in range(100)}
+    assert len(ids) == 100
+
+
+def test_prune_dead_nodes():
+    topo = Topology(pulse_seconds=0.01)
+    n = topo.sync_data_node_registration(_hb("a", 1, [_vol(1)]))
+    n.last_seen -= 10
+    dead = topo.prune_dead_nodes()
+    assert [d.id for d in dead] == ["a:1"]
+    assert topo.lookup("", 1) == []
