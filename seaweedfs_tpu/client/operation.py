@@ -1,0 +1,82 @@
+"""High-level client operations: assign + upload/download/delete.
+
+Functional equivalent of reference weed/operation (assign_file_id.go,
+upload_content.go, delete_content.go): assign a fid from the master, then
+move bytes with the volume server, optionally gzip-compressing.
+"""
+
+from __future__ import annotations
+
+import gzip
+import urllib.parse
+from typing import Optional
+
+from seaweedfs_tpu.client.wdclient import MasterClient
+from seaweedfs_tpu.utils.httpd import HttpError, http_call
+
+
+class UploadResult:
+    def __init__(self, fid: str, url: str, size: int, etag: str = ""):
+        self.fid = fid
+        self.url = url
+        self.size = size
+        self.etag = etag
+
+    def __repr__(self):
+        return f"UploadResult(fid={self.fid!r}, size={self.size})"
+
+
+def upload_data(mc: MasterClient, data: bytes, name: str = "",
+                collection: str = "", replication: str = "",
+                ttl: str = "", mime: str = "",
+                compress: bool = False) -> UploadResult:
+    a = mc.assign(collection=collection, replication=replication, ttl=ttl)
+    if "error" in a and a["error"]:
+        raise RuntimeError(a["error"])
+    fid, url = a["fid"], a["url"]
+    return upload_to(fid, url, data, name=name, mime=mime, compress=compress)
+
+
+def upload_to(fid: str, server_url: str, data: bytes, name: str = "",
+              mime: str = "", compress: bool = False) -> UploadResult:
+    body = data
+    qs = {"name": name, "mime": mime}
+    if compress and len(data) > 128:
+        gz = gzip.compress(data, 6)
+        if len(gz) < len(data) * 0.9:
+            body = gz
+            qs["gzip"] = "1"
+    query = urllib.parse.urlencode({k: v for k, v in qs.items() if v})
+    status, resp, _ = http_call(
+        "POST", f"http://{server_url}/{fid}?{query}", body=body)
+    if status >= 400:
+        raise HttpError(status, resp)
+    return UploadResult(fid, server_url, len(data))
+
+
+def read_data(mc: MasterClient, fid: str) -> bytes:
+    last: Exception = RuntimeError("no locations")
+    vid = int(fid.split(",")[0])
+    for loc in mc.lookup_volume(vid):
+        try:
+            status, body, headers = http_call(
+                "GET", f"http://{loc['url']}/{fid}")
+        except ConnectionError as e:
+            last = e
+            continue
+        if status == 200:
+            return body
+        last = HttpError(status, body)
+    raise last
+
+
+def delete_file(mc: MasterClient, fid: str) -> bool:
+    vid = int(fid.split(",")[0])
+    for loc in mc.lookup_volume(vid):
+        try:
+            status, _, _ = http_call("DELETE",
+                                     f"http://{loc['url']}/{fid}")
+            return status < 400
+        except ConnectionError:
+            continue
+    return False

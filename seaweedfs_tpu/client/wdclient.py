@@ -1,0 +1,86 @@
+"""Client-side master access: vid -> locations cache + lookup fallback.
+
+Functional equivalent of reference weed/wdclient/masterclient.go (vidMap
+cache with generation-based expiry instead of the KeepConnected push
+stream — entries refresh after `cache_ttl`)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from seaweedfs_tpu.utils.httpd import HttpError, http_json
+
+
+class MasterClient:
+    def __init__(self, master_urls: list[str] | str, cache_ttl: float = 10.0):
+        if isinstance(master_urls, str):
+            master_urls = [master_urls]
+        self.master_urls = master_urls
+        self._leader = master_urls[0]
+        self.cache_ttl = cache_ttl
+        self._cache: dict[int, tuple[float, list[dict]]] = {}
+        self._ec_cache: dict[int, tuple[float, list[dict]]] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def leader(self) -> str:
+        return self._leader
+
+    def _call(self, method: str, path: str, body=None):
+        last_err: Exception = RuntimeError("no masters")
+        for url in [self._leader] + [u for u in self.master_urls
+                                     if u != self._leader]:
+            try:
+                out = http_json(method, f"http://{url}{path}", body)
+                self._leader = url
+                return out
+            except (ConnectionError, HttpError) as e:
+                last_err = e
+        raise last_err
+
+    def lookup_volume(self, vid: int, collection: str = "") -> list[dict]:
+        with self._lock:
+            hit = self._cache.get(vid)
+            if hit and time.time() - hit[0] < self.cache_ttl:
+                return hit[1]
+        out = self._call(
+            "GET", f"/dir/lookup?volumeId={vid}&collection={collection}")
+        locs = out.get("locations", [])
+        with self._lock:
+            self._cache[vid] = (time.time(), locs)
+        return locs
+
+    def lookup_file_id(self, fid: str) -> list[str]:
+        vid = int(fid.split(",")[0])
+        return [f"http://{l['url']}/{fid}" for l in self.lookup_volume(vid)]
+
+    def lookup_ec_volume(self, vid: int) -> list[dict]:
+        with self._lock:
+            hit = self._ec_cache.get(vid)
+            if hit and time.time() - hit[0] < self.cache_ttl:
+                return hit[1]
+        out = self._call("GET", f"/dir/lookup_ec?volumeId={vid}")
+        shards = out.get("shards", [])
+        with self._lock:
+            self._ec_cache[vid] = (time.time(), shards)
+        return shards
+
+    def invalidate(self, vid: int) -> None:
+        with self._lock:
+            self._cache.pop(vid, None)
+            self._ec_cache.pop(vid, None)
+
+    def assign(self, count: int = 1, collection: str = "",
+               replication: str = "", ttl: str = "",
+               data_center: str = "") -> dict:
+        qs = (f"count={count}&collection={collection}"
+              f"&replication={replication}&ttl={ttl}&dataCenter={data_center}")
+        return self._call("POST", f"/dir/assign?{qs}")
+
+    def cluster_status(self) -> dict:
+        return self._call("GET", "/cluster/status")
+
+    def topology(self) -> dict:
+        return self._call("GET", "/dir/status")

@@ -52,7 +52,9 @@ def find_empty_slots(topo: Topology, rp: ReplicaPlacement,
     # main rack must fit 1 + same_rack copies; need diff_rack_count other
     # racks in main DC
     racks = [r for r in main_dc.racks.values()
-             if r.free_space() >= 1 + rp.same_rack_count]
+             if r.free_space() >= 1 + rp.same_rack_count
+             and len([n for n in r.nodes.values() if n.free_space() >= 1])
+             >= 1 + rp.same_rack_count]
     racks = [r for r in racks
              if len([x for x in main_dc.racks.values()
                      if x is not r and x.free_space() >= 1])

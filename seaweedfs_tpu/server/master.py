@@ -1,0 +1,222 @@
+"""Master server: topology bookkeeping, assignment, lookup, growth.
+
+Functional equivalent of reference weed/server/master_server.go +
+master_grpc_server*.go over HTTP/JSON:
+
+  POST /heartbeat        full or delta heartbeat from a volume server
+  GET  /dir/assign       pick/grow a writable volume, mint a fid
+  GET  /dir/lookup       vid -> locations
+  GET  /dir/lookup_ec    vid -> per-shard locations
+  GET  /dir/status       topology dump (shell planners' input)
+  POST /vol/grow         explicit growth
+  POST /vol/vacuum       trigger vacuum check on all nodes
+  GET  /cluster/status   leader info
+  POST /admin/lock, /admin/unlock   exclusive shell lock
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from seaweedfs_tpu.cluster.sequence import MemorySequencer
+from seaweedfs_tpu.cluster.topology import Topology
+from seaweedfs_tpu.cluster.volume_growth import (NoFreeSpaceError,
+                                                 grow_by_type)
+from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+from seaweedfs_tpu.utils.httpd import (HttpServer, Request, Response,
+                                       http_json)
+import random
+
+
+class MasterServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 volume_size_limit_mb: int = 1024,
+                 default_replication: str = "000",
+                 garbage_threshold: float = 0.3):
+        self.topo = Topology(volume_size_limit=volume_size_limit_mb * 1024 * 1024)
+        self.sequencer = MemorySequencer()
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        self.http = HttpServer(host, port)
+        self._grow_lock = threading.Lock()
+        self._admin_lock_holder: Optional[str] = None
+        self._admin_lock_ts = 0.0
+        self._register_routes()
+        self._stop = threading.Event()
+        self._pruner: Optional[threading.Thread] = None
+
+    # ---- lifecycle ----
+    def start(self) -> None:
+        self.http.start()
+        self._pruner = threading.Thread(target=self._prune_loop, daemon=True)
+        self._pruner.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.http.stop()
+
+    @property
+    def url(self) -> str:
+        return f"{self.http.host}:{self.http.port}"
+
+    def _prune_loop(self):
+        while not self._stop.wait(self.topo.pulse_seconds):
+            self.topo.prune_dead_nodes()
+
+    # ---- routes ----
+    def _register_routes(self) -> None:
+        r = self.http.add
+        r("POST", "/heartbeat", self._handle_heartbeat)
+        r("GET", "/dir/assign", self._handle_assign)
+        r("POST", "/dir/assign", self._handle_assign)
+        r("GET", "/dir/lookup", self._handle_lookup)
+        r("GET", "/dir/lookup_ec", self._handle_lookup_ec)
+        r("GET", "/dir/status", self._handle_dir_status)
+        r("POST", "/vol/grow", self._handle_grow)
+        r("GET", "/cluster/status", self._handle_cluster_status)
+        r("POST", "/admin/lock", self._handle_lock)
+        r("POST", "/admin/unlock", self._handle_unlock)
+
+    def _handle_heartbeat(self, req: Request) -> Response:
+        hb = req.json()
+        if hb.get("is_delta"):
+            node = self.topo.find_node(f"{hb['ip']}:{hb['port']}")
+            if node is None:
+                return Response({"error": "unknown node, send full"},
+                                status=409)
+            self.topo.incremental_sync(node, hb)
+        else:
+            self.topo.sync_data_node_registration(hb)
+        # mirror reference reply: volume size limit + leader
+        return Response({
+            "volume_size_limit": self.topo.volume_size_limit,
+            "leader": self.url,
+            "metrics_address": "",
+        })
+
+    def _handle_assign(self, req: Request) -> Response:
+        count = int(req.query.get("count", 1))
+        collection = req.query.get("collection", "")
+        replication = req.query.get("replication",
+                                    self.default_replication)
+        ttl = req.query.get("ttl", "")
+        dc = req.query.get("dataCenter", "")
+        layout = self.topo.get_layout(collection, replication, ttl)
+        with self._grow_lock:
+            if layout.active_volume_count() == 0:
+                try:
+                    grow_by_type(self.topo, collection, replication, ttl,
+                                 self._allocate_rpc, count=1,
+                                 preferred_dc=dc)
+                except NoFreeSpaceError as e:
+                    return Response({"error": str(e)}, status=500)
+        try:
+            vid, nodes = layout.pick_for_write()
+        except LookupError as e:
+            return Response({"error": str(e)}, status=500)
+        key = self.sequencer.next_file_id(count)
+        cookie = random.getrandbits(32)
+        fid = f"{vid},{format_needle_id_cookie(key, cookie)}"
+        node = nodes[0]
+        return Response({
+            "fid": fid,
+            "url": node.url,
+            "publicUrl": node.public_url,
+            "count": count,
+            "replicas": [{"url": n.url, "publicUrl": n.public_url}
+                         for n in nodes[1:]],
+        })
+
+    def _allocate_rpc(self, node, vid, collection, rp, ttl) -> bool:
+        from seaweedfs_tpu.storage.super_block import (ReplicaPlacement,
+                                                       TTL)
+        try:
+            http_json("POST",
+                      f"http://{node.url}/admin/allocate_volume",
+                      {"volume_id": vid, "collection": collection,
+                       "replication": rp, "ttl": ttl})
+        except Exception:
+            return False
+        # register immediately (like the reference's RegisterVolumeLayout
+        # after AllocateVolume) instead of waiting for the next heartbeat
+        vinfo = {"id": vid, "size": 0, "collection": collection,
+                 "replica_placement": ReplicaPlacement.parse(rp).to_byte(),
+                 "read_only": False, "file_count": 0, "delete_count": 0,
+                 "deleted_byte_count": 0,
+                 "ttl": TTL.parse(ttl).to_uint32(), "version": 3}
+        with self.topo.lock:
+            node.volumes[vid] = vinfo
+            self.topo._register_volume(vinfo, node)
+        return True
+
+    def _handle_lookup(self, req: Request) -> Response:
+        vid_str = req.query.get("volumeId", "")
+        vid = int(vid_str.split(",")[0]) if vid_str else 0
+        collection = req.query.get("collection", "")
+        nodes = self.topo.lookup(collection, vid)
+        if not nodes:
+            return Response(
+                {"volumeId": vid_str, "error": "volume id not found"},
+                status=404)
+        return Response({
+            "volumeId": vid_str,
+            "locations": [{"url": n.url, "publicUrl": n.public_url}
+                          for n in nodes],
+        })
+
+    def _handle_lookup_ec(self, req: Request) -> Response:
+        vid = int(req.query.get("volumeId", 0))
+        shards = self.topo.lookup_ec_shards(vid)
+        if shards is None:
+            return Response({"error": "ec volume not found"}, status=404)
+        return Response({
+            "volumeId": vid,
+            "shards": [
+                {"shard_id": sid,
+                 "locations": [{"url": n.url, "publicUrl": n.public_url}
+                               for n in nodes]}
+                for sid, nodes in enumerate(shards)],
+        })
+
+    def _handle_dir_status(self, req: Request) -> Response:
+        return Response({"Topology": self.topo.to_info(),
+                         "Version": "seaweedfs-tpu 0.1"})
+
+    def _handle_grow(self, req: Request) -> Response:
+        count = int(req.query.get("count", 1))
+        collection = req.query.get("collection", "")
+        replication = req.query.get("replication", self.default_replication)
+        ttl = req.query.get("ttl", "")
+        try:
+            vids = grow_by_type(self.topo, collection, replication, ttl,
+                                self._allocate_rpc, count=count)
+        except NoFreeSpaceError as e:
+            return Response({"error": str(e)}, status=500)
+        return Response({"count": len(vids), "volume_ids": vids})
+
+    def _handle_cluster_status(self, req: Request) -> Response:
+        return Response({
+            "IsLeader": True,
+            "Leader": self.url,
+            "MaxVolumeId": self.topo.max_volume_id,
+        })
+
+    def _handle_lock(self, req: Request) -> Response:
+        body = req.json() or {}
+        client = body.get("client", "unknown")
+        now = time.time()
+        if (self._admin_lock_holder
+                and self._admin_lock_holder != client
+                and now - self._admin_lock_ts < 60):
+            return Response({"error":
+                             f"locked by {self._admin_lock_holder}"},
+                            status=409)
+        self._admin_lock_holder = client
+        self._admin_lock_ts = now
+        return Response({"holder": client})
+
+    def _handle_unlock(self, req: Request) -> Response:
+        self._admin_lock_holder = None
+        return Response({})

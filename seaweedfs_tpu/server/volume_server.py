@@ -1,0 +1,471 @@
+"""Volume server: public read/write/delete + admin/EC RPCs + heartbeat.
+
+Functional equivalent of reference weed/server/volume_server*.go over
+HTTP/JSON. Public data path:
+
+  POST/PUT /<vid>,<key_cookie>     upload (raw body; ?type=replicate for
+                                   the replica fan-out leg)
+  GET/HEAD /<vid>,<key_cookie>     read (normal volume, else EC, with
+                                   remote/degraded fallback)
+  DELETE   /<vid>,<key_cookie>     delete (replicated like writes)
+
+Admin plane under /admin/... (JSON), including the nine EC RPCs of
+reference weed/server/volume_grpc_erasure_coding.go:24-35.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+from seaweedfs_tpu.models.coder import ErasureCoder
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.erasure_coding import decoder as ecdec
+from seaweedfs_tpu.storage.erasure_coding import encoder as ecenc
+from seaweedfs_tpu.storage.erasure_coding import layout
+from seaweedfs_tpu.storage.file_id import parse_needle_id_cookie
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.volume import (CookieMismatchError, DeletedError,
+                                          NotFoundError)
+from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
+                                       Response, http_call, http_json)
+
+PULSE_SECONDS = 2.0
+
+
+class VolumeServer:
+    def __init__(self, directories: list[str], master_url: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 public_url: str = "", rack: str = "", data_center: str = "",
+                 coder: Optional[ErasureCoder] = None,
+                 max_volume_counts: Optional[list[int]] = None):
+        self.master_url = master_url
+        self.http = HttpServer(host, port)
+        self._store_dirs = directories
+        self._max_volume_counts = max_volume_counts
+        self._rack = rack
+        self._dc = data_center
+        self._coder = coder
+        self._public_url = public_url
+        self.store: Optional[Store] = None
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self.volume_size_limit = 0
+
+    # ---- lifecycle ----
+    def start(self) -> None:
+        self.http.start()
+        self.store = Store(
+            self._store_dirs, self._max_volume_counts,
+            ip=self.http.host, port=self.http.port,
+            public_url=self._public_url or f"{self.http.host}:{self.http.port}",
+            rack=self._rack, data_center=self._dc, coder=self._coder)
+        self.store.load_existing_volumes()
+        self.store.remote_shard_reader = self._remote_shard_reader
+        self._register_routes()
+        self.heartbeat_once()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True)
+        self._hb_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.http.stop()
+        if self.store:
+            self.store.close()
+
+    @property
+    def url(self) -> str:
+        return f"{self.http.host}:{self.http.port}"
+
+    # ---- heartbeat (reference volume_grpc_client_to_master.go) ----
+    def heartbeat_once(self) -> None:
+        hb = self.store.collect_heartbeat()
+        try:
+            reply = http_json("POST", f"http://{self.master_url}/heartbeat",
+                              hb, timeout=5)
+            if reply:
+                self.volume_size_limit = reply.get("volume_size_limit", 0)
+        except (ConnectionError, HttpError):
+            pass
+
+    def _heartbeat_loop(self) -> None:
+        ticks = 0
+        while not self._stop.wait(PULSE_SECONDS):
+            ticks += 1
+            deltas = self.store.drain_deltas()
+            has_delta = any(deltas.values())
+            try:
+                if has_delta:
+                    body = {"ip": self.store.ip, "port": self.store.port,
+                            "is_delta": True, **deltas}
+                    reply = http_json(
+                        "POST", f"http://{self.master_url}/heartbeat", body,
+                        timeout=5)
+                else:
+                    self.heartbeat_once()
+            except HttpError as e:
+                if e.status == 409:  # master forgot us: full resync
+                    self.heartbeat_once()
+            except ConnectionError:
+                pass
+
+    # ---- routes ----
+    def _register_routes(self) -> None:
+        r = self.http.add
+        for method in ("POST", "PUT"):
+            r(method, r"/(\d+),([0-9a-fA-F]+)(?:_\d+)?(?:\.\w+)?",
+              self._handle_write)
+        r("GET", r"/(\d+),([0-9a-fA-F]+)(?:_\d+)?(?:\.\w+)?",
+          self._handle_read)
+        r("HEAD", r"/(\d+),([0-9a-fA-F]+)(?:_\d+)?(?:\.\w+)?",
+          self._handle_read)
+        r("DELETE", r"/(\d+),([0-9a-fA-F]+)(?:_\d+)?(?:\.\w+)?",
+          self._handle_delete)
+        r("GET", "/status", self._handle_status)
+        # admin
+        r("POST", "/admin/allocate_volume", self._admin_allocate_volume)
+        r("POST", "/admin/delete_volume", self._admin_delete_volume)
+        r("POST", "/admin/mark_readonly", self._admin_mark_readonly)
+        r("POST", "/admin/vacuum", self._admin_vacuum)
+        r("POST", "/admin/sync", self._admin_sync)
+        # EC rpcs
+        r("POST", "/admin/ec/generate", self._ec_generate)
+        r("POST", "/admin/ec/rebuild", self._ec_rebuild)
+        r("POST", "/admin/ec/copy", self._ec_copy)
+        r("POST", "/admin/ec/mount", self._ec_mount)
+        r("POST", "/admin/ec/unmount", self._ec_unmount)
+        r("POST", "/admin/ec/delete_shards", self._ec_delete_shards)
+        r("POST", "/admin/ec/to_volume", self._ec_to_volume)
+        r("POST", "/admin/ec/blob_delete", self._ec_blob_delete)
+        r("GET", "/admin/ec/shard_read", self._ec_shard_read)
+        r("GET", "/admin/ec/shard_file", self._ec_shard_file)
+
+    # ---- public data path ----
+    def _parse_fid(self, req: Request) -> tuple[int, int, int]:
+        vid = int(req.match.group(1))
+        key, cookie = parse_needle_id_cookie(req.match.group(2))
+        return vid, key, cookie
+
+    def _handle_write(self, req: Request) -> Response:
+        vid, key, cookie = self._parse_fid(req)
+        n = Needle(id=key, cookie=cookie, data=req.body,
+                   name=req.query.get("name", "").encode(),
+                   mime=req.query.get("mime", "").encode())
+        if req.query.get("ts"):
+            n.last_modified = int(req.query["ts"])
+        n.set_flags_from_fields()
+        try:
+            size = self.store.write_volume_needle(vid, n)
+        except NotFoundError:
+            return Response({"error": f"volume {vid} not found"}, status=404)
+        except PermissionError as e:
+            return Response({"error": str(e)}, status=409)
+        if req.query.get("type") != "replicate":
+            err = self._replicate(req, "write")
+            if err:
+                return Response({"error": err}, status=500)
+        return Response({"name": req.query.get("name", ""),
+                         "size": len(req.body), "eTag": f"{n.checksum:x}"},
+                        status=201)
+
+    def _handle_read(self, req: Request) -> Response:
+        vid, key, cookie = self._parse_fid(req)
+        try:
+            if self.store.find_volume(vid) is not None:
+                n = self.store.read_volume_needle(vid, key, cookie)
+            elif self.store.has_ec_volume(vid):
+                n = self.store.read_ec_shard_needle(vid, key, cookie)
+            else:
+                return Response({"error": f"volume {vid} not found"},
+                                status=404)
+        except (NotFoundError, DeletedError):
+            return Response(b"", status=404, content_type="text/plain")
+        except CookieMismatchError:
+            return Response(b"", status=404, content_type="text/plain")
+        headers = {}
+        if n.last_modified:
+            headers["X-Last-Modified"] = str(n.last_modified)
+        if n.name:
+            headers["X-File-Name"] = n.name.decode(errors="replace")
+        mime = (n.mime.decode(errors="replace")
+                if n.mime else "application/octet-stream")
+        return Response(n.data, content_type=mime, headers=headers)
+
+    def _handle_delete(self, req: Request) -> Response:
+        vid, key, cookie = self._parse_fid(req)
+        try:
+            if self.store.find_volume(vid) is not None:
+                size = self.store.delete_volume_needle(vid, key, cookie)
+            elif self.store.has_ec_volume(vid):
+                size = self._ec_delete_fanout(vid, key, cookie)
+            else:
+                return Response({"error": f"volume {vid} not found"},
+                                status=404)
+        except (NotFoundError, DeletedError):
+            return Response({"size": 0}, status=404)
+        if req.query.get("type") != "replicate" \
+                and self.store.find_volume(vid) is not None:
+            err = self._replicate(req, "delete")
+            if err:
+                return Response({"error": err}, status=500)
+        return Response({"size": size}, status=202)
+
+    def _replicate(self, req: Request, op: str) -> Optional[str]:
+        """Synchronous fan-out to the other replicas
+        (reference topology/store_replicate.go:58-110)."""
+        vid = int(req.match.group(1))
+        try:
+            locs = http_json(
+                "GET",
+                f"http://{self.master_url}/dir/lookup?volumeId={vid}",
+                timeout=5)
+        except (ConnectionError, HttpError):
+            return None  # nobody to replicate to (not registered yet)
+        others = [l["url"] for l in locs.get("locations", [])
+                  if l["url"] != self.url]
+        qs = "&".join(f"{k}={v}" for k, v in req.query.items()
+                      if k != "type")
+        sep = "&" if qs else ""
+        for url in others:
+            target = (f"http://{url}{req.path}?{qs}{sep}type=replicate")
+            try:
+                if op == "write":
+                    status, body, _ = http_call("POST", target, body=req.body)
+                else:
+                    status, body, _ = http_call("DELETE", target)
+                if status >= 400 and status != 404:
+                    return f"replica {url}: HTTP {status}"
+            except ConnectionError as e:
+                return f"replica {url}: {e}"
+        return None
+
+    def _handle_status(self, req: Request) -> Response:
+        hb = self.store.collect_heartbeat()
+        return Response({"Version": "seaweedfs-tpu 0.1", **hb})
+
+    # ---- admin ----
+    def _admin_allocate_volume(self, req: Request) -> Response:
+        b = req.json()
+        self.store.add_volume(b["volume_id"], b.get("collection", ""),
+                              b.get("replication", "000"), b.get("ttl", ""))
+        return Response({})
+
+    def _admin_delete_volume(self, req: Request) -> Response:
+        b = req.json()
+        ok = self.store.delete_volume(b["volume_id"])
+        return Response({"deleted": ok})
+
+    def _admin_mark_readonly(self, req: Request) -> Response:
+        b = req.json()
+        ok = self.store.mark_volume_readonly(b["volume_id"],
+                                             b.get("read_only", True))
+        return Response({"ok": ok})
+
+    def _admin_vacuum(self, req: Request) -> Response:
+        b = req.json()
+        v = self.store.find_volume(b["volume_id"])
+        if v is None:
+            return Response({"error": "volume not found"}, status=404)
+        garbage = v.garbage_level()
+        if b.get("check_only"):
+            return Response({"garbage_ratio": garbage})
+        v.compact()
+        return Response({"garbage_ratio": garbage, "compacted": True})
+
+    def _admin_sync(self, req: Request) -> Response:
+        b = req.json() or {}
+        v = self.store.find_volume(b.get("volume_id", 0))
+        if v:
+            v.sync()
+        return Response({})
+
+    # ---- EC rpcs (reference volume_grpc_erasure_coding.go) ----
+    def _ec_generate(self, req: Request) -> Response:
+        b = req.json()
+        base = self.store.generate_ec_shards(b["volume_id"])
+        return Response({"base": os.path.basename(base)})
+
+    def _ec_rebuild(self, req: Request) -> Response:
+        b = req.json()
+        vid = b["volume_id"]
+        base = self._ec_base_name(vid, b.get("collection", ""))
+        rebuilt = ecenc.rebuild_ec_files(base, self.store.coder)
+        ecenc.rebuild_ecx_file(base)
+        return Response({"rebuilt_shard_ids": rebuilt})
+
+    def _ec_base_name(self, vid: int, collection: str = "") -> str:
+        name = f"{collection}_{vid}" if collection else str(vid)
+        for loc in self.store.locations:
+            base = os.path.join(loc.directory, name)
+            if os.path.exists(base + ".ecx") or \
+                    any(os.path.exists(base + layout.shard_ext(i))
+                        for i in range(layout.TOTAL_SHARDS_COUNT)):
+                return base
+        return os.path.join(self.store.locations[0].directory, name)
+
+    def _ec_copy(self, req: Request) -> Response:
+        """Pull shard files (+ .ecx/.ecj/.vif) from a source server
+        (reference VolumeEcShardsCopy:117-168)."""
+        b = req.json()
+        vid = b["volume_id"]
+        src = b["source_data_node"]
+        base = self._ec_base_name(vid, b.get("collection", ""))
+        exts = [layout.shard_ext(sid) for sid in b.get("shard_ids", [])]
+        if b.get("copy_ecx_file", True):
+            exts += [".ecx"]
+        exts += [e for e in (".ecj", ".vif") if b.get("copy_aux", True)]
+        for ext in exts:
+            url = (f"http://{src}/admin/ec/shard_file?volumeId={vid}"
+                   f"&ext={ext}&collection={b.get('collection', '')}")
+            status, body, _ = http_call("GET", url, timeout=120)
+            if status == 404 and ext in (".ecj", ".vif"):
+                continue
+            if status >= 400:
+                return Response({"error": f"copy {ext}: HTTP {status}"},
+                                status=500)
+            with open(base + ext, "wb") as f:
+                f.write(body)
+        return Response({})
+
+    def _ec_shard_file(self, req: Request) -> Response:
+        vid = int(req.query["volumeId"])
+        ext = req.query["ext"]
+        base = self._ec_base_name(vid, req.query.get("collection", ""))
+        path = base + ext
+        if not os.path.exists(path):
+            return Response({"error": "not found"}, status=404)
+        with open(path, "rb") as f:
+            return Response(f.read(), content_type="application/octet-stream")
+
+    def _ec_mount(self, req: Request) -> Response:
+        b = req.json()
+        self.store.mount_ec_shards(b.get("collection", ""), b["volume_id"],
+                                   b["shard_ids"])
+        return Response({})
+
+    def _ec_unmount(self, req: Request) -> Response:
+        b = req.json()
+        self.store.unmount_ec_shards(b["volume_id"], b["shard_ids"])
+        return Response({})
+
+    def _ec_delete_shards(self, req: Request) -> Response:
+        b = req.json()
+        vid = b["volume_id"]
+        base = self._ec_base_name(vid, b.get("collection", ""))
+        for sid in b["shard_ids"]:
+            p = base + layout.shard_ext(sid)
+            if os.path.exists(p):
+                os.remove(p)
+        # when all shards gone, remove index files too (reference
+        # VolumeEcShardsDelete removes .ecx/.ecj when no shards remain)
+        if not any(os.path.exists(base + layout.shard_ext(i))
+                   for i in range(layout.TOTAL_SHARDS_COUNT)):
+            for ext in (".ecx", ".ecj", ".vif"):
+                if os.path.exists(base + ext):
+                    os.remove(base + ext)
+        return Response({})
+
+    def _ec_to_volume(self, req: Request) -> Response:
+        """VolumeEcShardsToVolume: shards -> normal .dat/.idx
+        (reference :381-413)."""
+        b = req.json()
+        vid = b["volume_id"]
+        collection = b.get("collection", "")
+        base = self._ec_base_name(vid, collection)
+        dat_size = ecdec.find_dat_file_size(base, base)
+        ecdec.write_dat_file(base, dat_size)
+        ecdec.write_idx_file_from_ec_index(base)
+        # unmount EC view, load as normal volume
+        self.store.unmount_ec_shards(
+            vid, list(range(layout.TOTAL_SHARDS_COUNT)))
+        from seaweedfs_tpu.storage.volume import Volume
+        loc = next(l for l in self.store.locations
+                   if os.path.dirname(base) == l.directory)
+        vol = Volume(loc.directory, collection, vid)
+        loc.add_volume(vol)
+        self.store.new_volumes.append(self.store.volume_info(vol))
+        return Response({"dat_size": dat_size})
+
+    def _ec_blob_delete(self, req: Request) -> Response:
+        b = req.json()
+        ev = self.store.find_ec_volume(b["volume_id"])
+        if ev is None:
+            return Response({"error": "ec volume not found"}, status=404)
+        ev.delete_needle(b["needle_id"])
+        return Response({})
+
+    def _ec_shard_read(self, req: Request) -> Response:
+        vid = int(req.query["volumeId"])
+        sid = int(req.query["shardId"])
+        offset = int(req.query["offset"])
+        size = int(req.query["size"])
+        ev = self.store.find_ec_volume(vid)
+        if ev is None or sid not in ev.shards:
+            return Response({"error": "shard not found"}, status=404)
+        return Response(ev.shards[sid].read_at(offset, size),
+                        content_type="application/octet-stream")
+
+    # ---- EC client-side helpers ----
+    def _remote_shard_reader(self, vid: int, shard_id: int, offset: int,
+                             size: int) -> Optional[bytes]:
+        """Find the shard's server via the master and fetch the range
+        (reference store_ec.go readRemoteEcShardInterval:270)."""
+        try:
+            info = http_json(
+                "GET",
+                f"http://{self.master_url}/dir/lookup_ec?volumeId={vid}",
+                timeout=5)
+        except (ConnectionError, HttpError):
+            return None
+        for entry in info.get("shards", []):
+            if entry["shard_id"] != shard_id:
+                continue
+            for loc in entry["locations"]:
+                if loc["url"] == self.url:
+                    continue
+                try:
+                    status, body, _ = http_call(
+                        "GET",
+                        f"http://{loc['url']}/admin/ec/shard_read"
+                        f"?volumeId={vid}&shardId={shard_id}"
+                        f"&offset={offset}&size={size}", timeout=30)
+                except ConnectionError:
+                    continue
+                if status == 200:
+                    return body
+        return None
+
+    def _ec_delete_fanout(self, vid: int, key: int, cookie: int) -> int:
+        """Cookie-check locally then fan the tombstone to every shard
+        owner (reference store_ec_delete.go:16-110)."""
+        n = self.store.read_ec_shard_needle(vid, key, cookie)
+        size = len(n.data)
+        try:
+            info = http_json(
+                "GET",
+                f"http://{self.master_url}/dir/lookup_ec?volumeId={vid}",
+                timeout=5)
+        except (ConnectionError, HttpError):
+            info = {"shards": []}
+        done = set()
+        ev = self.store.find_ec_volume(vid)
+        if ev is not None:
+            ev.delete_needle(key)
+            done.add(self.url)
+        for entry in info.get("shards", []):
+            for loc in entry["locations"]:
+                if loc["url"] in done:
+                    continue
+                done.add(loc["url"])
+                try:
+                    http_json("POST",
+                              f"http://{loc['url']}/admin/ec/blob_delete",
+                              {"volume_id": vid, "needle_id": key},
+                              timeout=10)
+                except (ConnectionError, HttpError):
+                    pass
+        return size

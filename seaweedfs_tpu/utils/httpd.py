@@ -1,0 +1,169 @@
+"""Minimal threaded HTTP/JSON server + client helpers.
+
+The control plane speaks HTTP/JSON end to end (the reference speaks
+gRPC + HTTP; we keep one wire format for the whole plane — long-lived
+streams become periodic POSTs / long-polls). Data paths (uploads, shard
+copy) use raw bodies with query params.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import socket
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+
+
+class Request:
+    def __init__(self, handler: BaseHTTPRequestHandler, match: re.Match,
+                 body: bytes):
+        self.handler = handler
+        self.method = handler.command
+        parsed = urllib.parse.urlparse(handler.path)
+        self.path = parsed.path
+        self.query = {k: v[0] for k, v in
+                      urllib.parse.parse_qs(parsed.query).items()}
+        self.match = match
+        self.body = body
+        self.headers = handler.headers
+
+    def json(self) -> Any:
+        return json.loads(self.body) if self.body else None
+
+
+class Response:
+    def __init__(self, body: Any = None, status: int = 200,
+                 content_type: str = "application/json",
+                 headers: Optional[dict] = None):
+        self.status = status
+        self.headers = headers or {}
+        if isinstance(body, (dict, list)):
+            self.body = json.dumps(body).encode()
+            self.content_type = "application/json"
+        elif isinstance(body, str):
+            self.body = body.encode()
+            self.content_type = content_type
+        elif body is None:
+            self.body = b""
+            self.content_type = content_type
+        else:
+            self.body = bytes(body)
+            self.content_type = content_type
+
+
+Route = tuple[str, re.Pattern, Callable[[Request], Response]]
+
+
+class HttpServer:
+    """Route table + ThreadingHTTPServer. Routes are (METHOD, regex)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.routes: list[Route] = []
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def route(self, method: str, pattern: str):
+        compiled = re.compile("^" + pattern + "$")
+
+        def deco(fn):
+            self.routes.append((method.upper(), compiled, fn))
+            return fn
+        return deco
+
+    def add(self, method: str, pattern: str, fn) -> None:
+        self.routes.append((method.upper(), re.compile("^" + pattern + "$"),
+                            fn))
+
+    def start(self) -> None:
+        routes = self.routes
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _dispatch(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                path = urllib.parse.urlparse(self.path).path
+                for method, pattern, fn in routes:
+                    if method != self.command:
+                        continue
+                    m = pattern.match(path)
+                    if m:
+                        try:
+                            resp = fn(Request(self, m, body))
+                        except Exception as e:  # surface as 500 JSON
+                            resp = Response({"error": f"{type(e).__name__}: {e}"},
+                                            status=500)
+                        break
+                else:
+                    resp = Response({"error": "not found"}, status=404)
+                try:
+                    self.send_response(resp.status)
+                    self.send_header("Content-Type", resp.content_type)
+                    self.send_header("Content-Length", str(len(resp.body)))
+                    for k, v in resp.headers.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    if self.command != "HEAD":
+                        self.wfile.write(resp.body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, body: bytes):
+        self.status = status
+        self.body = body
+        super().__init__(f"HTTP {status}: {body[:200]!r}")
+
+
+def http_call(method: str, url: str, body: Optional[bytes] = None,
+              json_body: Any = None, timeout: float = 30.0,
+              headers: Optional[dict] = None) -> tuple[int, bytes, dict]:
+    if json_body is not None:
+        body = json.dumps(json_body).encode()
+        headers = dict(headers or {})
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=body, method=method.upper(),
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+    except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
+        raise ConnectionError(f"{method} {url}: {e}") from e
+
+
+def http_json(method: str, url: str, json_body: Any = None,
+              timeout: float = 30.0) -> Any:
+    status, body, _ = http_call(method, url, json_body=json_body,
+                                timeout=timeout)
+    if status >= 400:
+        raise HttpError(status, body)
+    return json.loads(body) if body else None
