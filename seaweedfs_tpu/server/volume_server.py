@@ -92,6 +92,24 @@ class VolumeServer:
         except (ConnectionError, HttpError):
             pass
 
+    def _push_deltas(self) -> None:
+        """Send pending volume/EC-shard deltas to the master immediately
+        (the reference's delta channels wake the heartbeat stream;
+        volume_grpc_client_to_master.go:164-260)."""
+        deltas = self.store.drain_deltas()
+        if not any(deltas.values()):
+            return
+        body = {"ip": self.store.ip, "port": self.store.port,
+                "is_delta": True, **deltas}
+        try:
+            http_json("POST", f"http://{self.master_url}/heartbeat", body,
+                      timeout=5)
+        except HttpError as e:
+            if e.status == 409:
+                self.heartbeat_once()
+        except ConnectionError:
+            pass
+
     def _heartbeat_loop(self) -> None:
         ticks = 0
         while not self._stop.wait(PULSE_SECONDS):
@@ -132,6 +150,8 @@ class VolumeServer:
         r("POST", "/admin/mark_readonly", self._admin_mark_readonly)
         r("POST", "/admin/vacuum", self._admin_vacuum)
         r("POST", "/admin/sync", self._admin_sync)
+        r("POST", "/admin/copy_volume", self._admin_copy_volume)
+        r("GET", "/admin/volume_file", self._admin_volume_file)
         # EC rpcs
         r("POST", "/admin/ec/generate", self._ec_generate)
         r("POST", "/admin/ec/rebuild", self._ec_rebuild)
@@ -257,6 +277,7 @@ class VolumeServer:
     def _admin_delete_volume(self, req: Request) -> Response:
         b = req.json()
         ok = self.store.delete_volume(b["volume_id"])
+        self._push_deltas()
         return Response({"deleted": ok})
 
     def _admin_mark_readonly(self, req: Request) -> Response:
@@ -282,6 +303,47 @@ class VolumeServer:
         if v:
             v.sync()
         return Response({})
+
+    def _admin_copy_volume(self, req: Request) -> Response:
+        """Pull a volume's .dat/.idx from a peer and load it
+        (reference volume_grpc_copy.go VolumeCopy)."""
+        b = req.json()
+        vid = b["volume_id"]
+        collection = b.get("collection", "")
+        src = b["source_data_node"]
+        if self.store.find_volume(vid) is not None:
+            return Response({"error": f"volume {vid} already exists"},
+                            status=409)
+        loc = min(self.store.locations, key=lambda l: l.volumes_len())
+        name = f"{collection}_{vid}" if collection else str(vid)
+        base = os.path.join(loc.directory, name)
+        for ext in (".dat", ".idx"):
+            url = (f"http://{src}/admin/volume_file?volumeId={vid}"
+                   f"&ext={ext}&collection={collection}")
+            status, body, _ = http_call("GET", url, timeout=300)
+            if status >= 400:
+                return Response({"error": f"copy {ext}: HTTP {status}"},
+                                status=500)
+            with open(base + ext, "wb") as f:
+                f.write(body)
+        from seaweedfs_tpu.storage.volume import Volume
+        vol = Volume(loc.directory, collection, vid)
+        loc.add_volume(vol)
+        self.store.new_volumes.append(self.store.volume_info(vol))
+        self._push_deltas()
+        return Response({})
+
+    def _admin_volume_file(self, req: Request) -> Response:
+        vid = int(req.query["volumeId"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return Response({"error": "volume not found"}, status=404)
+        ext = req.query["ext"]
+        if ext not in (".dat", ".idx"):
+            return Response({"error": "bad ext"}, status=400)
+        v.sync()
+        with open(v.file_name() + ext, "rb") as f:
+            return Response(f.read(), content_type="application/octet-stream")
 
     # ---- EC rpcs (reference volume_grpc_erasure_coding.go) ----
     def _ec_generate(self, req: Request) -> Response:
@@ -345,11 +407,13 @@ class VolumeServer:
         b = req.json()
         self.store.mount_ec_shards(b.get("collection", ""), b["volume_id"],
                                    b["shard_ids"])
+        self._push_deltas()
         return Response({})
 
     def _ec_unmount(self, req: Request) -> Response:
         b = req.json()
         self.store.unmount_ec_shards(b["volume_id"], b["shard_ids"])
+        self._push_deltas()
         return Response({})
 
     def _ec_delete_shards(self, req: Request) -> Response:
@@ -388,6 +452,7 @@ class VolumeServer:
         vol = Volume(loc.directory, collection, vid)
         loc.add_volume(vol)
         self.store.new_volumes.append(self.store.volume_info(vol))
+        self._push_deltas()
         return Response({"dat_size": dat_size})
 
     def _ec_blob_delete(self, req: Request) -> Response:

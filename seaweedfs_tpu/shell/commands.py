@@ -1,0 +1,237 @@
+"""Shell command appliers: execute EC/volume plans via server RPCs.
+
+The workflow sequences mirror the reference shell commands
+(weed/shell/command_ec_encode.go:57-123, command_ec_rebuild.go,
+command_ec_balance.go, command_ec_decode.go, command_volume_fix_replication.go):
+planning is delegated to shell/ec_plan.py pure functions; this module owns
+the RPC choreography.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Optional
+
+from seaweedfs_tpu.shell import ec_plan
+from seaweedfs_tpu.storage.erasure_coding import layout
+from seaweedfs_tpu.utils.httpd import HttpError, http_json
+
+
+class ShellContext:
+    def __init__(self, master_url: str):
+        self.master_url = master_url
+
+    # ---- helpers ----
+    def topology(self) -> dict:
+        return http_json(
+            "GET", f"http://{self.master_url}/dir/status")["Topology"]
+
+    def _vs(self, node: str, path: str, body: dict, timeout: float = 300):
+        return http_json("POST", f"http://{node}{path}", body,
+                         timeout=timeout)
+
+    def lock(self, client: str = "shell") -> None:
+        http_json("POST", f"http://{self.master_url}/admin/lock",
+                  {"client": client})
+
+    def unlock(self) -> None:
+        http_json("POST", f"http://{self.master_url}/admin/unlock", {})
+
+    # ---- volume commands ----
+    def volume_list(self) -> dict:
+        return self.topology()
+
+    def volume_fix_replication(self, apply: bool = True) -> list[dict]:
+        """Re-replicate under-replicated volumes (reference
+        command_volume_fix_replication.go). Returns the fixes planned."""
+        topo = self.topology()
+        replicas: dict[int, list[str]] = defaultdict(list)
+        vinfos: dict[int, dict] = {}
+        all_nodes = []
+        for dc in topo.get("data_centers", []):
+            for rack in dc.get("racks", []):
+                for n in rack.get("nodes", []):
+                    all_nodes.append(n)
+                    for v in n.get("volumes", []):
+                        replicas[v["id"]].append(n["id"])
+                        vinfos[v["id"]] = v
+        from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+        fixes = []
+        for vid, owners in sorted(replicas.items()):
+            rp = ReplicaPlacement.from_byte(
+                vinfos[vid].get("replica_placement", 0))
+            need = rp.copy_count - len(owners)
+            if need <= 0:
+                continue
+            candidates = [n for n in all_nodes if n["id"] not in owners
+                          and len(n.get("volumes", []))
+                          < n.get("max_volume_count", 8)]
+            candidates.sort(key=lambda n: len(n.get("volumes", [])))
+            for target in candidates[:need]:
+                fixes.append({"vid": vid, "source": owners[0],
+                              "target": target["id"],
+                              "collection": vinfos[vid].get("collection", "")})
+        if apply:
+            for fix in fixes:
+                self._vs(fix["target"], "/admin/copy_volume",
+                         {"volume_id": fix["vid"],
+                          "collection": fix["collection"],
+                          "source_data_node": fix["source"]})
+        return fixes
+
+    def volume_vacuum(self, garbage_threshold: float = 0.3) -> list[int]:
+        """Compact volumes whose garbage ratio exceeds the threshold
+        (reference shell `volume.vacuum`)."""
+        topo = self.topology()
+        compacted = []
+        for dc in topo.get("data_centers", []):
+            for rack in dc.get("racks", []):
+                for n in rack.get("nodes", []):
+                    for v in n.get("volumes", []):
+                        check = self._vs(n["id"], "/admin/vacuum",
+                                         {"volume_id": v["id"],
+                                          "check_only": True})
+                        if check.get("garbage_ratio", 0) > garbage_threshold:
+                            self._vs(n["id"], "/admin/vacuum",
+                                     {"volume_id": v["id"]})
+                            compacted.append(v["id"])
+        return compacted
+
+    # ---- ec.encode (reference command_ec_encode.go doEcEncode) ----
+    def ec_encode(self, vid: Optional[int] = None, collection: str = "",
+                  delete_source: bool = True) -> list[dict]:
+        topo = self.topology()
+        vids = [vid] if vid is not None else \
+            ec_plan.collect_volume_ids_for_ec_encode(topo, collection)
+        results = []
+        for v in vids:
+            results.append(self._ec_encode_one(topo, v, delete_source))
+            topo = self.topology()  # refresh between volumes
+        return results
+
+    def _ec_encode_one(self, topo: dict, vid: int,
+                       delete_source: bool) -> dict:
+        plan = ec_plan.plan_ec_encode(topo, vid)
+        source = plan["source"]
+        collection = ""
+        for dc in topo.get("data_centers", []):
+            for rack in dc.get("racks", []):
+                for n in rack.get("nodes", []):
+                    for v in n.get("volumes", []):
+                        if v["id"] == vid:
+                            collection = v.get("collection", "")
+
+        # 1. mark every replica readonly
+        for replica in plan["replicas"]:
+            self._vs(replica, "/admin/mark_readonly",
+                     {"volume_id": vid, "read_only": True})
+        # 2. generate shards on the source
+        self._vs(source, "/admin/ec/generate",
+                 {"volume_id": vid, "collection": collection})
+        # 3. spread: copy to targets, mount
+        by_target: dict[str, list[int]] = defaultdict(list)
+        for mv in plan["moves"]:
+            by_target[mv.target].append(mv.shard_id)
+        for target, sids in by_target.items():
+            if target != source:
+                self._vs(target, "/admin/ec/copy",
+                         {"volume_id": vid, "collection": collection,
+                          "shard_ids": sids, "source_data_node": source})
+            self._vs(target, "/admin/ec/mount",
+                     {"volume_id": vid, "collection": collection,
+                      "shard_ids": sids})
+        # 4. delete the shard files that moved away from the source
+        moved = [sid for t, sids in by_target.items() if t != source
+                 for sid in sids]
+        if moved:
+            self._vs(source, "/admin/ec/unmount",
+                     {"volume_id": vid, "shard_ids": moved})
+            self._vs(source, "/admin/ec/delete_shards",
+                     {"volume_id": vid, "collection": collection,
+                      "shard_ids": moved})
+        # 5. delete the original volume replicas
+        if delete_source:
+            for replica in plan["replicas"]:
+                self._vs(replica, "/admin/delete_volume",
+                         {"volume_id": vid})
+        return {"vid": vid, "source": source,
+                "placement": {t: sorted(s) for t, s in by_target.items()}}
+
+    # ---- ec.rebuild (reference command_ec_rebuild.go) ----
+    def ec_rebuild(self, apply: bool = True) -> list[dict]:
+        topo = self.topology()
+        plans = ec_plan.plan_ec_rebuild(topo)
+        if not apply:
+            return plans
+        for plan in plans:
+            if "error" in plan:
+                continue
+            rebuilder = plan["rebuilder"]
+            by_source: dict[str, list[int]] = defaultdict(list)
+            for mv in plan["copies"]:
+                by_source[mv.source].append(mv.shard_id)
+            for source, sids in by_source.items():
+                self._vs(rebuilder, "/admin/ec/copy",
+                         {"volume_id": plan["vid"], "shard_ids": sids,
+                          "source_data_node": source, "copy_ecx_file": True})
+            out = self._vs(rebuilder, "/admin/ec/rebuild",
+                           {"volume_id": plan["vid"]})
+            plan["rebuilt"] = out.get("rebuilt_shard_ids", [])
+            self._vs(rebuilder, "/admin/ec/mount",
+                     {"volume_id": plan["vid"],
+                      "shard_ids": plan["rebuilt"]})
+        return plans
+
+    # ---- ec.balance (reference command_ec_balance.go) ----
+    def ec_balance(self, apply: bool = True) -> list[ec_plan.ShardMove]:
+        topo = self.topology()
+        moves = ec_plan.plan_ec_balance(topo)
+        if not apply:
+            return moves
+        for mv in moves:
+            if mv.target == "":  # duplicate copy: drop it
+                self._vs(mv.source, "/admin/ec/unmount",
+                         {"volume_id": mv.vid, "shard_ids": [mv.shard_id]})
+                self._vs(mv.source, "/admin/ec/delete_shards",
+                         {"volume_id": mv.vid, "shard_ids": [mv.shard_id]})
+                continue
+            self._vs(mv.target, "/admin/ec/copy",
+                     {"volume_id": mv.vid, "shard_ids": [mv.shard_id],
+                      "source_data_node": mv.source, "copy_ecx_file": True})
+            self._vs(mv.target, "/admin/ec/mount",
+                     {"volume_id": mv.vid, "shard_ids": [mv.shard_id]})
+            self._vs(mv.source, "/admin/ec/unmount",
+                     {"volume_id": mv.vid, "shard_ids": [mv.shard_id]})
+            self._vs(mv.source, "/admin/ec/delete_shards",
+                     {"volume_id": mv.vid, "shard_ids": [mv.shard_id]})
+        return moves
+
+    # ---- ec.decode (reference command_ec_decode.go) ----
+    def ec_decode(self, vid: int) -> dict:
+        topo = self.topology()
+        plan = ec_plan.plan_ec_decode(topo, vid)
+        collector = plan["collector"]
+        by_source: dict[str, list[int]] = defaultdict(list)
+        for mv in plan["copies"]:
+            by_source[mv.source].append(mv.shard_id)
+        for source, sids in by_source.items():
+            self._vs(collector, "/admin/ec/copy",
+                     {"volume_id": vid, "shard_ids": sids,
+                      "source_data_node": source, "copy_ecx_file": True})
+            self._vs(collector, "/admin/ec/mount",
+                     {"volume_id": vid, "shard_ids": sids})
+        out = self._vs(collector, "/admin/ec/to_volume", {"volume_id": vid})
+        # clean up shards everywhere else
+        for sid, owner_list in plan["all_owners"].items():
+            for owner in owner_list:
+                if owner == collector:
+                    continue
+                try:
+                    self._vs(owner, "/admin/ec/unmount",
+                             {"volume_id": vid, "shard_ids": [sid]})
+                    self._vs(owner, "/admin/ec/delete_shards",
+                             {"volume_id": vid, "shard_ids": [sid]})
+                except (ConnectionError, HttpError):
+                    pass
+        return {"vid": vid, "collector": collector,
+                "dat_size": out.get("dat_size")}

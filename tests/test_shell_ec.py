@@ -1,0 +1,220 @@
+"""Shell planner unit tests (pure, no cluster — the reference's strategy in
+command_ec_test.go) + the full distributed EC lifecycle over an in-process
+cluster: encode -> spread -> degraded read -> rebuild -> decode."""
+
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.client import operation
+from seaweedfs_tpu.client.wdclient import MasterClient
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell import ec_plan
+from seaweedfs_tpu.shell.commands import ShellContext
+from seaweedfs_tpu.storage.erasure_coding import layout
+
+
+def _node(node_id, rack="r1", dc="dc1", maxv=8, volumes=(), ec=()):
+    return {"id": node_id, "rack": rack, "data_center": dc,
+            "max_volume_count": maxv, "volumes": list(volumes),
+            "ec_shards": list(ec)}
+
+
+def _topo(nodes):
+    racks = {}
+    for n in nodes:
+        racks.setdefault((n["data_center"], n["rack"]), []).append(n)
+    dcs = {}
+    for (dc, rack), ns in racks.items():
+        dcs.setdefault(dc, []).append({"id": rack, "nodes": ns})
+    return {"data_centers": [{"id": dc, "racks": rs}
+                             for dc, rs in dcs.items()]}
+
+
+def test_balanced_distribution_round_robin():
+    nodes = [ec_plan.EcNode("a", 100), ec_plan.EcNode("b", 100),
+             ec_plan.EcNode("c", 100)]
+    targets = ec_plan.balanced_ec_distribution(nodes)
+    assert len(targets) == 14
+    counts = {t: targets.count(t) for t in set(targets)}
+    assert max(counts.values()) - min(counts.values()) <= 1
+
+
+def test_balanced_distribution_prefers_free():
+    nodes = [ec_plan.EcNode("big", 100), ec_plan.EcNode("small", 2)]
+    targets = ec_plan.balanced_ec_distribution(nodes)
+    assert targets.count("small") <= 3
+
+
+def test_plan_ec_encode():
+    topo = _topo([
+        _node("a:1", volumes=[{"id": 3, "collection": ""}]),
+        _node("b:1", rack="r2"),
+        _node("c:1", rack="r3"),
+    ])
+    plan = ec_plan.plan_ec_encode(topo, 3)
+    assert plan["source"] == "a:1"
+    assert len(plan["moves"]) == 14
+    with pytest.raises(LookupError):
+        ec_plan.plan_ec_encode(topo, 99)
+
+
+def test_plan_ec_rebuild():
+    # volume 7 has shards 0..11 only (12,13 lost)
+    bits = sum(1 << s for s in range(12))
+    topo = _topo([
+        _node("a:1", ec=[{"id": 7, "ec_index_bits": bits & 0x3F}]),
+        _node("b:1", ec=[{"id": 7, "ec_index_bits": bits & ~0x3F}]),
+        _node("c:1"),
+    ])
+    plans = ec_plan.plan_ec_rebuild(topo)
+    assert len(plans) == 1
+    assert plans[0]["missing"] == [12, 13]
+    assert plans[0]["rebuilder"] == "c:1"  # most free slots
+
+    # unrepairable case
+    topo2 = _topo([_node("a:1", ec=[{"id": 9, "ec_index_bits": 0b111}])])
+    plans2 = ec_plan.plan_ec_rebuild(topo2)
+    assert "error" in plans2[0]
+
+
+def test_plan_ec_balance_drops_duplicates():
+    topo = _topo([
+        _node("a:1", ec=[{"id": 5, "ec_index_bits": 0b1}]),
+        _node("b:1", rack="r2", ec=[{"id": 5, "ec_index_bits": 0b1}]),
+    ])
+    moves = ec_plan.plan_ec_balance(topo)
+    drops = [m for m in moves if m.target == ""]
+    assert len(drops) == 1 and drops[0].shard_id == 0
+
+
+def test_collect_volume_ids():
+    topo = _topo([
+        _node("a:1", volumes=[{"id": 1, "collection": "", "size": 900},
+                              {"id": 2, "collection": "photos", "size": 10}]),
+    ])
+    assert ec_plan.collect_volume_ids_for_ec_encode(topo) == [1]
+    assert ec_plan.collect_volume_ids_for_ec_encode(topo, "photos") == [2]
+    assert ec_plan.collect_volume_ids_for_ec_encode(
+        topo, "", size_limit=1000, full_percent=50) == [1]
+
+
+# ---------------- full lifecycle over a live in-process cluster ----------
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    servers = []
+    for i in range(4):
+        vs = VolumeServer([str(tmp_path / f"v{i}")], master.url,
+                          rack=f"r{i % 2}", data_center="dc1")
+        vs.start()
+        servers.append(vs)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        topo = ShellContext(master.url).topology()
+        n = sum(len(r["nodes"]) for dc in topo["data_centers"]
+                for r in dc["racks"])
+        if n == 4:
+            break
+        time.sleep(0.05)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_full_ec_lifecycle(cluster):
+    master, servers = cluster
+    mc = MasterClient(master.url, cache_ttl=0.0)
+    sh = ShellContext(master.url)
+    rng = np.random.default_rng(42)
+
+    # 1. upload files into one volume
+    files = {}
+    first = operation.upload_data(mc, b"seed")
+    vid = int(first.fid.split(",")[0])
+    files[first.fid] = b"seed"
+    for i in range(25):
+        data = rng.integers(0, 256, int(rng.integers(500, 8000)),
+                            dtype=np.uint8).tobytes()
+        a = mc.assign()
+        # force same volume for determinism when possible
+        res = operation.upload_to(a["fid"], a["url"], data)
+        files[a["fid"]] = data
+
+    # 2. ec.encode every volume
+    sh.lock()
+    results = sh.ec_encode()
+    assert results, "no volumes encoded"
+    time.sleep(0.2)
+
+    # EC shards registered on master; volumes gone
+    shards = mc.lookup_ec_volume(vid)
+    placed_nodes = {loc["url"] for e in shards for loc in e["locations"]}
+    assert len(placed_nodes) >= 2, "shards not spread"
+
+    # 3. every file still readable (EC path, remote intervals)
+    for fid, data in files.items():
+        v = int(fid.split(",")[0])
+        urls = [l["url"] for e in mc.lookup_ec_volume(v)
+                for l in e["locations"]]
+        status = None
+        from seaweedfs_tpu.utils.httpd import http_call
+        status, body, _ = http_call("GET", f"http://{urls[0]}/{fid}")
+        assert status == 200 and body == data, fid
+
+    # 4. kill one server entirely -> rebuild restores full redundancy
+    victim = None
+    for vs in servers:
+        if vs.url in placed_nodes:
+            victim = vs
+            break
+    victim.stop()
+    servers.remove(victim)
+    # wait for master to prune the dead node
+    deadline = time.time() + 40
+    while time.time() < deadline:
+        mc.invalidate(vid)
+        try:
+            shards = mc.lookup_ec_volume(vid)
+        except Exception:
+            time.sleep(0.2)
+            continue
+        owners = {loc["url"] for e in shards for loc in e["locations"]}
+        n_present = sum(1 for e in shards if e["locations"])
+        if owners and victim.url not in owners and n_present >= 10:
+            break
+        master.topo.prune_dead_nodes(timeout=6.0)
+        time.sleep(0.3)
+
+    plans = sh.ec_rebuild(apply=True)
+    assert plans and "rebuilt" in plans[0], plans
+    time.sleep(0.2)
+    mc.invalidate(vid)
+    shards = mc.lookup_ec_volume(vid)
+    present = {e["shard_id"] for e in shards if e["locations"]}
+    assert len(present) == layout.TOTAL_SHARDS_COUNT
+
+    for fid, data in files.items():
+        v = int(fid.split(",")[0])
+        urls = [l["url"] for e in mc.lookup_ec_volume(v)
+                for l in e["locations"]]
+        from seaweedfs_tpu.utils.httpd import http_call
+        status, body, _ = http_call("GET", f"http://{urls[0]}/{fid}")
+        assert status == 200 and body == data, f"post-rebuild {fid}"
+
+    # 5. ec.decode back to a normal volume; files readable the plain way
+    out = sh.ec_decode(vid)
+    assert out["dat_size"] > 0
+    time.sleep(0.3)
+    mc.invalidate(vid)
+    for fid, data in files.items():
+        if int(fid.split(",")[0]) != vid:
+            continue
+        assert operation.read_data(mc, fid) == data, f"post-decode {fid}"
+    sh.unlock()
