@@ -1,0 +1,271 @@
+"""weed-tpu command line — the `weed` binary equivalent
+(reference weed/command/command.go dispatch).
+
+Subcommands: master, volume, server (all-in-one), shell, upload, download,
+delete, benchmark, ec (one-shot admin ops), filer, s3.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _add_common_volume_args(p):
+    p.add_argument("-dir", default="./data", help="data directory (comma-separated)")
+    p.add_argument("-max", type=int, default=8, help="max volumes per dir")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8080)
+    p.add_argument("-mserver", default="127.0.0.1:9333")
+    p.add_argument("-rack", default="")
+    p.add_argument("-dataCenter", default="")
+    p.add_argument("-coder", default="cpu", choices=["cpu", "jax", "pallas"],
+                   help="erasure coder backend (jax/pallas = TPU)")
+
+
+def cmd_master(args):
+    from seaweedfs_tpu.server.master import MasterServer
+    ms = MasterServer(host=args.ip, port=args.port,
+                      volume_size_limit_mb=args.volumeSizeLimitMB,
+                      default_replication=args.defaultReplication)
+    ms.start()
+    print(f"master listening on {ms.url}")
+    _wait_forever()
+
+
+def cmd_volume(args):
+    from seaweedfs_tpu.models.coder import make_coder
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    dirs = args.dir.split(",")
+    vs = VolumeServer(dirs, args.mserver, host=args.ip, port=args.port,
+                      rack=args.rack, data_center=args.dataCenter,
+                      coder=make_coder(args.coder),
+                      max_volume_counts=[args.max] * len(dirs))
+    vs.start()
+    print(f"volume server listening on {vs.url}, master {args.mserver}")
+    _wait_forever()
+
+
+def cmd_server(args):
+    """All-in-one: master + volume (+ filer + s3 when available)."""
+    from seaweedfs_tpu.models.coder import make_coder
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    ms = MasterServer(host=args.ip, port=args.masterPort,
+                      volume_size_limit_mb=args.volumeSizeLimitMB)
+    ms.start()
+    dirs = args.dir.split(",")
+    vs = VolumeServer(dirs, ms.url, host=args.ip, port=args.port,
+                      coder=make_coder(args.coder),
+                      max_volume_counts=[args.max] * len(dirs))
+    vs.start()
+    print(f"master {ms.url}; volume {vs.url}")
+    extra = []
+    if args.filer:
+        from seaweedfs_tpu.server.filer_server import FilerServer
+        fs = FilerServer(ms.url, host=args.ip, port=args.filerPort,
+                         store_dir=dirs[0])
+        fs.start()
+        print(f"filer {fs.url}")
+        extra.append(fs)
+        if args.s3:
+            from seaweedfs_tpu.gateway.s3_server import S3Server
+            s3 = S3Server(fs.url, host=args.ip, port=args.s3Port)
+            s3.start()
+            print(f"s3 {s3.url}")
+            extra.append(s3)
+    _wait_forever()
+
+
+def cmd_upload(args):
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    mc = MasterClient(args.master)
+    for path in args.files:
+        with open(path, "rb") as f:
+            data = f.read()
+        res = operation.upload_data(mc, data, name=path,
+                                    collection=args.collection,
+                                    replication=args.replication)
+        print(json.dumps({"file": path, "fid": res.fid, "size": res.size}))
+
+
+def cmd_download(args):
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    mc = MasterClient(args.master)
+    data = operation.read_data(mc, args.fid)
+    out = args.output or args.fid.replace(",", "_")
+    with open(out, "wb") as f:
+        f.write(data)
+    print(f"{args.fid} -> {out} ({len(data)} bytes)")
+
+
+def cmd_delete(args):
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    mc = MasterClient(args.master)
+    for fid in args.fids:
+        ok = operation.delete_file(mc, fid)
+        print(json.dumps({"fid": fid, "deleted": ok}))
+
+
+def cmd_shell(args):
+    from seaweedfs_tpu.shell.repl import run_repl
+    run_repl(args.master)
+
+
+def cmd_ec(args):
+    from seaweedfs_tpu.shell.commands import ShellContext
+    sh = ShellContext(args.master)
+    sh.lock()
+    try:
+        if args.op == "encode":
+            out = sh.ec_encode(vid=args.volumeId,
+                               collection=args.collection or "")
+        elif args.op == "rebuild":
+            out = sh.ec_rebuild()
+        elif args.op == "balance":
+            out = [vars(m) for m in sh.ec_balance()]
+        elif args.op == "decode":
+            out = sh.ec_decode(args.volumeId)
+        else:
+            raise SystemExit(f"unknown ec op {args.op}")
+        print(json.dumps(out, default=str, indent=2))
+    finally:
+        sh.unlock()
+
+
+def cmd_benchmark(args):
+    """weed benchmark equivalent: write then randomly read N small files
+    (reference weed/command/benchmark.go)."""
+    import concurrent.futures
+    import random
+
+    import numpy as np
+
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    mc = MasterClient(args.master)
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, args.size, dtype=np.uint8).tobytes()
+
+    fids = []
+    t0 = time.perf_counter()
+    lat = []
+
+    def write_one(i):
+        s = time.perf_counter()
+        res = operation.upload_data(mc, payload, name=f"bench{i}")
+        lat.append(time.perf_counter() - s)
+        return res.fid
+
+    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
+        fids = list(ex.map(write_one, range(args.n)))
+    dt = time.perf_counter() - t0
+    _report("write", args.n, args.size, dt, lat)
+
+    lat = []
+    t0 = time.perf_counter()
+
+    def read_one(_):
+        fid = random.choice(fids)
+        s = time.perf_counter()
+        data = operation.read_data(mc, fid)
+        lat.append(time.perf_counter() - s)
+        assert len(data) == args.size
+
+    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
+        list(ex.map(read_one, range(args.n)))
+    dt = time.perf_counter() - t0
+    _report("read", args.n, args.size, dt, lat)
+
+
+def _report(op, n, size, dt, lat):
+    lat.sort()
+    pct = lambda p: lat[min(len(lat) - 1, int(p * len(lat)))] * 1000
+    print(json.dumps({
+        "op": op, "requests_per_sec": round(n / dt, 2),
+        "transfer_mb_per_sec": round(n * size / dt / 1e6, 2),
+        "p50_ms": round(pct(0.5), 2), "p95_ms": round(pct(0.95), 2),
+        "p99_ms": round(pct(0.99), 2), "max_ms": round(lat[-1] * 1000, 2),
+    }))
+
+
+def _wait_forever():
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="weed-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    m = sub.add_parser("master")
+    m.add_argument("-ip", default="127.0.0.1")
+    m.add_argument("-port", type=int, default=9333)
+    m.add_argument("-volumeSizeLimitMB", type=int, default=1024)
+    m.add_argument("-defaultReplication", default="000")
+    m.set_defaults(fn=cmd_master)
+
+    v = sub.add_parser("volume")
+    _add_common_volume_args(v)
+    v.set_defaults(fn=cmd_volume)
+
+    s = sub.add_parser("server")
+    _add_common_volume_args(s)
+    s.add_argument("-masterPort", type=int, default=9333)
+    s.add_argument("-volumeSizeLimitMB", type=int, default=1024)
+    s.add_argument("-filer", action="store_true")
+    s.add_argument("-filerPort", type=int, default=8888)
+    s.add_argument("-s3", action="store_true")
+    s.add_argument("-s3Port", type=int, default=8333)
+    s.set_defaults(fn=cmd_server)
+
+    u = sub.add_parser("upload")
+    u.add_argument("-master", default="127.0.0.1:9333")
+    u.add_argument("-collection", default="")
+    u.add_argument("-replication", default="")
+    u.add_argument("files", nargs="+")
+    u.set_defaults(fn=cmd_upload)
+
+    d = sub.add_parser("download")
+    d.add_argument("-master", default="127.0.0.1:9333")
+    d.add_argument("-output", default="")
+    d.add_argument("fid")
+    d.set_defaults(fn=cmd_download)
+
+    de = sub.add_parser("delete")
+    de.add_argument("-master", default="127.0.0.1:9333")
+    de.add_argument("fids", nargs="+")
+    de.set_defaults(fn=cmd_delete)
+
+    sh = sub.add_parser("shell")
+    sh.add_argument("-master", default="127.0.0.1:9333")
+    sh.set_defaults(fn=cmd_shell)
+
+    ec = sub.add_parser("ec")
+    ec.add_argument("op", choices=["encode", "rebuild", "balance", "decode"])
+    ec.add_argument("-master", default="127.0.0.1:9333")
+    ec.add_argument("-volumeId", type=int, default=None)
+    ec.add_argument("-collection", default=None)
+    ec.set_defaults(fn=cmd_ec)
+
+    b = sub.add_parser("benchmark")
+    b.add_argument("-master", default="127.0.0.1:9333")
+    b.add_argument("-n", type=int, default=1000)
+    b.add_argument("-size", type=int, default=1024)
+    b.add_argument("-concurrency", type=int, default=16)
+    b.set_defaults(fn=cmd_benchmark)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Interactive admin shell (reference weed/shell/shell_liner.go)."""
+
+from __future__ import annotations
+
+import json
+import shlex
+
+from seaweedfs_tpu.shell.commands import ShellContext
+
+HELP = """commands:
+  volume.list                       show topology
+  volume.fix.replication [-n]      re-replicate under-replicated volumes
+  volume.vacuum [threshold]         compact garbage-heavy volumes
+  ec.encode [-volumeId N] [-collection C]
+  ec.rebuild [-n]
+  ec.balance [-n]
+  ec.decode -volumeId N
+  lock / unlock
+  help / exit
+"""
+
+
+def run_repl(master_url: str) -> None:
+    sh = ShellContext(master_url)
+    print(f"connected to master {master_url}; `help` for commands")
+    while True:
+        try:
+            line = input("> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return
+        if not line:
+            continue
+        try:
+            out = run_command(sh, line)
+        except SystemExit:
+            return
+        except Exception as e:
+            print(f"error: {type(e).__name__}: {e}")
+            continue
+        if out is not None:
+            print(json.dumps(out, default=str, indent=2))
+
+
+def run_command(sh: ShellContext, line: str):
+    parts = shlex.split(line)
+    cmd, args = parts[0], parts[1:]
+    flags = _parse_flags(args)
+    apply = "-n" not in args
+    if cmd in ("exit", "quit"):
+        raise SystemExit
+    if cmd == "help":
+        print(HELP)
+        return None
+    if cmd == "lock":
+        sh.lock()
+        return {"locked": True}
+    if cmd == "unlock":
+        sh.unlock()
+        return {"locked": False}
+    if cmd == "volume.list":
+        return sh.volume_list()
+    if cmd == "volume.fix.replication":
+        return sh.volume_fix_replication(apply=apply)
+    if cmd == "volume.vacuum":
+        thr = float(args[0]) if args and not args[0].startswith("-") else 0.3
+        return sh.volume_vacuum(thr)
+    if cmd == "ec.encode":
+        vid = int(flags["volumeId"]) if "volumeId" in flags else None
+        return sh.ec_encode(vid=vid, collection=flags.get("collection", ""))
+    if cmd == "ec.rebuild":
+        return sh.ec_rebuild(apply=apply)
+    if cmd == "ec.balance":
+        return [vars(m) for m in sh.ec_balance(apply=apply)]
+    if cmd == "ec.decode":
+        return sh.ec_decode(int(flags["volumeId"]))
+    raise ValueError(f"unknown command {cmd!r}; `help` lists commands")
+
+
+def _parse_flags(args: list[str]) -> dict:
+    out = {}
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a.startswith("-") and a != "-n":
+            key = a.lstrip("-")
+            if i + 1 < len(args) and not args[i + 1].startswith("-"):
+                out[key] = args[i + 1]
+                i += 1
+            else:
+                out[key] = "true"
+        i += 1
+    return out

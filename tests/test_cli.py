@@ -1,0 +1,68 @@
+"""CLI + shell REPL command-path smoke tests."""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.cli import main as cli_main
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.commands import ShellContext
+from seaweedfs_tpu.shell.repl import run_command
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url)
+    vs.start()
+    vs2 = VolumeServer([str(tmp_path / "v1")], master.url)
+    vs2.start()
+    time.sleep(0.2)
+    yield master, [vs, vs2]
+    vs.stop()
+    vs2.stop()
+    master.stop()
+
+
+def test_cli_upload_download_delete(cluster, tmp_path, capsys):
+    master, _ = cluster
+    src = tmp_path / "hello.txt"
+    src.write_bytes(b"cli payload")
+    cli_main(["upload", "-master", master.url, str(src)])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    fid = out["fid"]
+
+    dst = tmp_path / "out.bin"
+    cli_main(["download", "-master", master.url, "-output", str(dst), fid])
+    capsys.readouterr()
+    assert dst.read_bytes() == b"cli payload"
+
+    cli_main(["delete", "-master", master.url, fid])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["deleted"]
+
+
+def test_repl_commands(cluster, capsys):
+    master, _ = cluster
+    sh = ShellContext(master.url)
+    topo = run_command(sh, "volume.list")
+    assert "data_centers" in topo
+    assert run_command(sh, "lock") == {"locked": True}
+    assert run_command(sh, "ec.rebuild -n") == []
+    assert run_command(sh, "unlock") == {"locked": False}
+    with pytest.raises(ValueError):
+        run_command(sh, "bogus.command")
+
+
+def test_cli_benchmark_small(cluster, capsys):
+    master, _ = cluster
+    cli_main(["benchmark", "-master", master.url, "-n", "20",
+              "-size", "256", "-concurrency", "4"])
+    lines = capsys.readouterr().out.strip().splitlines()
+    w = json.loads(lines[0])
+    r = json.loads(lines[1])
+    assert w["op"] == "write" and w["requests_per_sec"] > 0
+    assert r["op"] == "read" and r["requests_per_sec"] > 0
