@@ -1,0 +1,192 @@
+"""Filer core: namespace operations over a FilerStore + meta change log.
+
+Functional equivalent of reference weed/filer/filer.go: create/find/delete/
+list entries with automatic parent-directory creation, rename, chunk
+garbage collection on delete/overwrite, and a metadata change log feeding
+subscriptions (the CDC backbone of filer.sync / meta.backup / mount cache
+invalidation — reference filer_notify.go + util/log_buffer).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+from seaweedfs_tpu.filer.entry import Attr, Entry, new_directory_entry
+from seaweedfs_tpu.filer.filerstore import FilerStore, MemoryStore
+
+
+class MetaLogEvent:
+    __slots__ = ("tsns", "directory", "old_entry", "new_entry")
+
+    def __init__(self, directory: str, old_entry: Optional[dict],
+                 new_entry: Optional[dict], tsns: Optional[int] = None):
+        self.tsns = tsns or time.time_ns()
+        self.directory = directory
+        self.old_entry = old_entry
+        self.new_entry = new_entry
+
+    def to_dict(self) -> dict:
+        return {"tsns": self.tsns, "directory": self.directory,
+                "old_entry": self.old_entry, "new_entry": self.new_entry}
+
+
+class MetaLog:
+    """In-memory bounded meta event log with offset-based subscription
+    (the reference persists to /topics/.system/log inside the filer; we
+    keep a ring buffer + optional persistence hook)."""
+
+    def __init__(self, capacity: int = 65536):
+        self.capacity = capacity
+        self.events: list[MetaLogEvent] = []
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def append(self, ev: MetaLogEvent) -> None:
+        with self._cond:
+            self.events.append(ev)
+            if len(self.events) > self.capacity:
+                self.events = self.events[-self.capacity:]
+            self._cond.notify_all()
+
+    def read_since(self, tsns: int, path_prefix: str = "/",
+                   limit: int = 1024) -> list[MetaLogEvent]:
+        with self._lock:
+            return [e for e in self.events
+                    if e.tsns > tsns
+                    and e.directory.startswith(path_prefix.rstrip("/") or "/")
+                    ][:limit]
+
+    def wait_for_events(self, tsns: int, timeout: float = 10.0) -> bool:
+        with self._cond:
+            if any(e.tsns > tsns for e in self.events):
+                return True
+            return self._cond.wait(timeout)
+
+
+class Filer:
+    def __init__(self, store: Optional[FilerStore] = None,
+                 delete_chunks_fn: Optional[Callable[[list[str]], None]] = None):
+        self.store = store or MemoryStore()
+        self.meta_log = MetaLog()
+        self.delete_chunks_fn = delete_chunks_fn
+        self._lock = threading.RLock()
+        root = self.store.find_entry("/")
+        if root is None:
+            self.store.insert_entry(new_directory_entry("/"))
+
+    # ---- entry ops ----
+    def create_entry(self, entry: Entry, o_excl: bool = False) -> Entry:
+        with self._lock:
+            self._ensure_parents(entry.dir_path)
+            old = self.store.find_entry(entry.full_path)
+            if old is not None:
+                if o_excl:
+                    raise FileExistsError(entry.full_path)
+                if not old.is_directory and old.chunks:
+                    self._gc_replaced_chunks(old, entry)
+            if old is not None and old.is_directory and not entry.is_directory:
+                raise IsADirectoryError(entry.full_path)
+            self.store.insert_entry(entry)
+        self._notify(entry.dir_path,
+                     old.to_dict() if old else None, entry.to_dict())
+        return entry
+
+    def find_entry(self, full_path: str) -> Optional[Entry]:
+        full_path = _norm(full_path)
+        return self.store.find_entry(full_path)
+
+    def update_entry(self, entry: Entry) -> None:
+        old = self.store.find_entry(entry.full_path)
+        self.store.update_entry(entry)
+        self._notify(entry.dir_path,
+                     old.to_dict() if old else None, entry.to_dict())
+
+    def delete_entry(self, full_path: str, recursive: bool = False,
+                     ignore_recursive_error: bool = False) -> None:
+        full_path = _norm(full_path)
+        entry = self.store.find_entry(full_path)
+        if entry is None:
+            raise FileNotFoundError(full_path)
+        if entry.is_directory:
+            children = self.store.list_directory_entries(full_path, limit=1)
+            if children and not recursive:
+                raise OSError(f"directory {full_path} not empty")
+            if children:
+                self._delete_children(full_path)
+        self.store.delete_entry(full_path)
+        if entry.chunks and self.delete_chunks_fn:
+            self.delete_chunks_fn([c.fid for c in entry.chunks])
+        self._notify(entry.dir_path, entry.to_dict(), None)
+
+    def _delete_children(self, dir_path: str) -> None:
+        while True:
+            children = self.store.list_directory_entries(dir_path, limit=256)
+            if not children:
+                break
+            for child in children:
+                if child.is_directory:
+                    self._delete_children(child.full_path)
+                self.store.delete_entry(child.full_path)
+                if child.chunks and self.delete_chunks_fn:
+                    self.delete_chunks_fn([c.fid for c in child.chunks])
+                self._notify(dir_path, child.to_dict(), None)
+
+    def list_entries(self, dir_path: str, start_name: str = "",
+                     include_start: bool = False, limit: int = 1024,
+                     prefix: str = "") -> list[Entry]:
+        return self.store.list_directory_entries(
+            _norm(dir_path), start_name, include_start, limit, prefix)
+
+    def rename_entry(self, old_path: str, new_path: str) -> Entry:
+        """AtomicRenameEntry (files and whole directories)."""
+        old_path, new_path = _norm(old_path), _norm(new_path)
+        with self._lock:
+            entry = self.store.find_entry(old_path)
+            if entry is None:
+                raise FileNotFoundError(old_path)
+            if entry.is_directory:
+                children = self.store.list_directory_entries(
+                    old_path, limit=1 << 30)
+                for child in children:
+                    self.rename_entry(
+                        child.full_path,
+                        new_path + child.full_path[len(old_path):])
+            entry_dict_old = entry.to_dict()
+            self.store.delete_entry(old_path)
+            entry.full_path = new_path
+            self._ensure_parents(entry.dir_path)
+            self.store.insert_entry(entry)
+        self._notify(entry.dir_path, entry_dict_old, entry.to_dict())
+        return entry
+
+    def mkdirs(self, dir_path: str) -> None:
+        with self._lock:
+            self._ensure_parents(_norm(dir_path) + "/x")
+
+    # ---- helpers ----
+    def _ensure_parents(self, dir_path: str) -> None:
+        dir_path = _norm(dir_path)
+        if dir_path == "/" or self.store.find_entry(dir_path) is not None:
+            return
+        self._ensure_parents(dir_path.rsplit("/", 1)[0] or "/")
+        self.store.insert_entry(new_directory_entry(dir_path))
+
+    def _gc_replaced_chunks(self, old: Entry, new: Entry) -> None:
+        keep = {c.fid for c in new.chunks}
+        doomed = [c.fid for c in old.chunks if c.fid not in keep]
+        if doomed and self.delete_chunks_fn:
+            self.delete_chunks_fn(doomed)
+
+    def _notify(self, directory: str, old_entry: Optional[dict],
+                new_entry: Optional[dict]) -> None:
+        self.meta_log.append(MetaLogEvent(directory, old_entry, new_entry))
+
+    def close(self) -> None:
+        self.store.close()
+
+
+def _norm(p: str) -> str:
+    p = "/" + p.strip("/")
+    return p if p != "//" else "/"

@@ -1,0 +1,220 @@
+"""FilerStore SPI + embedded implementations.
+
+Functional equivalent of reference weed/filer/filerstore.go:21-44. The
+reference ships 22 store plugins (leveldb/rocksdb/sql/redis/...); we ship
+the SPI plus two embedded stores covering the same contract:
+  - MemoryStore: sorted dict (tests, ephemeral filers)
+  - SqliteStore: stdlib sqlite3 (the abstract_sql analogue; durable)
+New stores implement the same five entry ops + kv + listing.
+"""
+
+from __future__ import annotations
+
+import abc
+import bisect
+import json
+import sqlite3
+import threading
+from typing import Iterator, Optional
+
+from seaweedfs_tpu.filer.entry import Entry
+
+
+class FilerStore(abc.ABC):
+    name = "abstract"
+
+    @abc.abstractmethod
+    def insert_entry(self, entry: Entry) -> None: ...
+
+    @abc.abstractmethod
+    def update_entry(self, entry: Entry) -> None: ...
+
+    @abc.abstractmethod
+    def find_entry(self, full_path: str) -> Optional[Entry]: ...
+
+    @abc.abstractmethod
+    def delete_entry(self, full_path: str) -> None: ...
+
+    @abc.abstractmethod
+    def delete_folder_children(self, full_path: str) -> None: ...
+
+    @abc.abstractmethod
+    def list_directory_entries(self, dir_path: str, start_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]: ...
+
+    # kv store used for filer.conf etc (reference KvPut/KvGet)
+    @abc.abstractmethod
+    def kv_put(self, key: bytes, value: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def kv_get(self, key: bytes) -> Optional[bytes]: ...
+
+    def kv_delete(self, key: bytes) -> None:
+        self.kv_put(key, b"")
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryStore(FilerStore):
+    name = "memory"
+
+    def __init__(self):
+        self._entries: dict[str, Entry] = {}
+        self._sorted: list[str] = []
+        self._kv: dict[bytes, bytes] = {}
+        self._lock = threading.RLock()
+
+    def insert_entry(self, entry: Entry) -> None:
+        with self._lock:
+            if entry.full_path not in self._entries:
+                bisect.insort(self._sorted, entry.full_path)
+            self._entries[entry.full_path] = entry
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Optional[Entry]:
+        return self._entries.get(full_path)
+
+    def delete_entry(self, full_path: str) -> None:
+        with self._lock:
+            if full_path in self._entries:
+                del self._entries[full_path]
+                i = bisect.bisect_left(self._sorted, full_path)
+                if i < len(self._sorted) and self._sorted[i] == full_path:
+                    self._sorted.pop(i)
+
+    def delete_folder_children(self, full_path: str) -> None:
+        prefix = full_path.rstrip("/") + "/"
+        with self._lock:
+            doomed = [p for p in self._sorted if p.startswith(prefix)]
+            for p in doomed:
+                self.delete_entry(p)
+
+    def list_directory_entries(self, dir_path: str, start_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        base = dir_path.rstrip("/") or ""
+        out = []
+        with self._lock:
+            lo = bisect.bisect_right(self._sorted, base + "/")
+            for p in self._sorted[lo:]:
+                if not p.startswith(base + "/"):
+                    break
+                name = p[len(base) + 1:]
+                if "/" in name:
+                    continue  # deeper level
+                if prefix and not name.startswith(prefix):
+                    continue
+                if start_name:
+                    if name < start_name:
+                        continue
+                    if name == start_name and not include_start:
+                        continue
+                out.append(self._entries[p])
+                if len(out) >= limit:
+                    break
+        return out
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self._kv[key] = value
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        return self._kv.get(key) or None
+
+
+class SqliteStore(FilerStore):
+    name = "sqlite"
+
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS entries ("
+                "dir TEXT NOT NULL, name TEXT NOT NULL, meta TEXT NOT NULL, "
+                "PRIMARY KEY (dir, name))")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv ("
+                "k BLOB PRIMARY KEY, v BLOB)")
+            self._conn.commit()
+
+    @staticmethod
+    def _split(full_path: str) -> tuple[str, str]:
+        full_path = full_path.rstrip("/") or "/"
+        if full_path == "/":
+            return "", "/"
+        d, _, n = full_path.rpartition("/")
+        return d or "/", n
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = self._split(entry.full_path)
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO entries (dir, name, meta) "
+                "VALUES (?, ?, ?)", (d, n, json.dumps(entry.to_dict())))
+            self._conn.commit()
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Optional[Entry]:
+        d, n = self._split(full_path)
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT meta FROM entries WHERE dir=? AND name=?",
+                (d, n)).fetchone()
+        return Entry.from_dict(json.loads(row[0])) if row else None
+
+    def delete_entry(self, full_path: str) -> None:
+        d, n = self._split(full_path)
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM entries WHERE dir=? AND name=?", (d, n))
+            self._conn.commit()
+
+    def delete_folder_children(self, full_path: str) -> None:
+        base = full_path.rstrip("/")
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM entries WHERE dir=? OR dir LIKE ?",
+                (base or "/", base + "/%"))
+            self._conn.commit()
+
+    def list_directory_entries(self, dir_path: str, start_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        cmp = ">=" if include_start else ">"
+        q = (f"SELECT meta FROM entries WHERE dir=? AND name {cmp} ? "
+             "AND name LIKE ? ORDER BY name LIMIT ?")
+        with self._lock:
+            rows = self._conn.execute(
+                q, (d, start_name, (prefix or "") + "%", limit)).fetchall()
+        return [Entry.from_dict(json.loads(r[0])) for r in rows]
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)",
+                (key, value))
+            self._conn.commit()
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT v FROM kv WHERE k=?", (key,)).fetchone()
+        return row[0] if row and row[0] else None
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+STORES = {"memory": MemoryStore, "sqlite": SqliteStore}
+
+
+def make_store(name: str, **kwargs) -> FilerStore:
+    return STORES[name](**kwargs)

@@ -1,0 +1,217 @@
+"""Filer HTTP server: a file-system namespace over the object store.
+
+Functional equivalent of reference weed/server/filer_server*.go:
+
+  POST/PUT <path>      upload: body is split into chunks, each assigned +
+                       uploaded to volume servers (auto-chunking,
+                       reference filer_server_handlers_write_autochunk.go);
+                       small files are inlined in the entry
+  GET  <path>          file -> stream assembled chunks; dir -> JSON listing
+  DELETE <path>        delete entry (+ ?recursive=true), chunks GC'd
+  POST /__api/rename   {"from":..., "to":...}
+  GET  /__api/meta_events?since_ns=N&prefix=/  meta change log (CDC)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from seaweedfs_tpu.client import operation
+from seaweedfs_tpu.client.wdclient import MasterClient
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
+from seaweedfs_tpu.filer.filechunks import (non_overlapping_visible_intervals,
+                                            view_from_visibles)
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filerstore import make_store
+from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
+                                       Response, http_call)
+
+CHUNK_SIZE = 4 * 1024 * 1024
+INLINE_LIMIT = 2048  # small content stored in the entry itself
+
+
+class FilerServer:
+    def __init__(self, master_url: str, host: str = "127.0.0.1",
+                 port: int = 0, store: str = "memory",
+                 store_dir: Optional[str] = None,
+                 default_replication: str = ""):
+        self.master_url = master_url
+        self.mc = MasterClient(master_url)
+        kwargs = {}
+        if store == "sqlite":
+            kwargs["path"] = (store_dir or ".") + "/filer.db"
+        self.filer = Filer(make_store(store, **kwargs),
+                           delete_chunks_fn=self._delete_chunks)
+        self.default_replication = default_replication
+        self.http = HttpServer(host, port)
+        self._register_routes()
+
+    def start(self) -> None:
+        self.http.start()
+
+    def stop(self) -> None:
+        self.http.stop()
+        self.filer.close()
+
+    @property
+    def url(self) -> str:
+        return f"{self.http.host}:{self.http.port}"
+
+    # ---- chunk GC ----
+    def _delete_chunks(self, fids: list[str]) -> None:
+        def work():
+            for fid in fids:
+                try:
+                    operation.delete_file(self.mc, fid)
+                except Exception:
+                    pass
+        threading.Thread(target=work, daemon=True).start()
+
+    # ---- routes ----
+    def _register_routes(self) -> None:
+        r = self.http.add
+        r("POST", "/__api/rename", self._api_rename)
+        r("GET", "/__api/meta_events", self._api_meta_events)
+        for method in ("POST", "PUT"):
+            r(method, "/.*", self._handle_write)
+        r("GET", "/.*", self._handle_read)
+        r("HEAD", "/.*", self._handle_read)
+        r("DELETE", "/.*", self._handle_delete)
+
+    # ---- write ----
+    def _handle_write(self, req: Request) -> Response:
+        path = req.path.rstrip("/") or "/"
+        if req.query.get("mkdir") == "true":
+            self.filer.mkdirs(path)
+            return Response({"path": path}, status=201)
+        data = req.body
+        collection = req.query.get("collection", "")
+        replication = req.query.get("replication",
+                                    self.default_replication)
+        mime = (req.headers.get("Content-Type")
+                or "application/octet-stream")
+        now = time.time()
+        entry = Entry(full_path=path,
+                      attr=Attr(mtime=now, crtime=now, mime=mime,
+                                file_size=len(data),
+                                collection=collection,
+                                replication=replication))
+        if len(data) <= INLINE_LIMIT:
+            entry.content = data
+        else:
+            entry.chunks = self._upload_chunks(data, collection, replication)
+        try:
+            self.filer.create_entry(entry)
+        except IsADirectoryError:
+            return Response({"error": "is a directory"}, status=409)
+        return Response({"name": entry.name, "size": len(data)}, status=201)
+
+    def _upload_chunks(self, data: bytes, collection: str,
+                       replication: str) -> list[FileChunk]:
+        """Split into CHUNK_SIZE pieces, assign + upload each
+        (reference filer_server_handlers_write_upload.go:32-140)."""
+        chunks = []
+        for off in range(0, len(data), CHUNK_SIZE):
+            piece = data[off:off + CHUNK_SIZE]
+            a = self.mc.assign(collection=collection,
+                               replication=replication)
+            if a.get("error"):
+                raise HttpError(500, a["error"].encode())
+            operation.upload_to(a["fid"], a["url"], piece)
+            chunks.append(FileChunk(fid=a["fid"], offset=off,
+                                    size=len(piece),
+                                    mtime_ns=time.time_ns()))
+        return chunks
+
+    # ---- read ----
+    def _handle_read(self, req: Request) -> Response:
+        path = req.path.rstrip("/") or "/"
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return Response({"error": "not found"}, status=404)
+        if entry.is_directory:
+            limit = int(req.query.get("limit", 1024))
+            last = req.query.get("lastFileName", "")
+            entries = self.filer.list_entries(path, start_name=last,
+                                              limit=limit)
+            return Response({
+                "Path": path,
+                "Entries": [self._entry_json(e) for e in entries],
+                "ShouldDisplayLoadMore": len(entries) == limit,
+            })
+        data = self._read_entry_bytes(entry)
+        return Response(data, content_type=entry.attr.mime
+                        or "application/octet-stream",
+                        headers={"Content-Disposition":
+                                 f'inline; filename="{entry.name}"'})
+
+    def _read_entry_bytes(self, entry: Entry) -> bytes:
+        if entry.content or not entry.chunks:
+            return entry.content
+        size = entry.file_size()
+        visibles = non_overlapping_visible_intervals(entry.chunks)
+        views = view_from_visibles(visibles, 0, size)
+        out = bytearray(size)
+        for view in views:
+            urls = self.mc.lookup_file_id(view.fid)
+            blob = None
+            for url in urls:
+                try:
+                    status, body, _ = http_call("GET", url)
+                except ConnectionError:
+                    continue
+                if status == 200:
+                    blob = body
+                    break
+            if blob is None:
+                raise HttpError(500, f"chunk {view.fid} unreachable".encode())
+            piece = blob[view.offset_in_chunk:
+                         view.offset_in_chunk + view.size]
+            out[view.logic_offset:view.logic_offset + view.size] = piece
+        return bytes(out)
+
+    @staticmethod
+    def _entry_json(e: Entry) -> dict:
+        return {
+            "FullPath": e.full_path,
+            "Mtime": e.attr.mtime,
+            "Crtime": e.attr.crtime,
+            "Mode": e.attr.mode,
+            "Mime": e.attr.mime,
+            "IsDirectory": e.is_directory,
+            "FileSize": e.file_size(),
+            "chunks": [c.to_dict() for c in e.chunks],
+        }
+
+    # ---- delete ----
+    def _handle_delete(self, req: Request) -> Response:
+        path = req.path.rstrip("/") or "/"
+        recursive = req.query.get("recursive") == "true"
+        try:
+            self.filer.delete_entry(path, recursive=recursive)
+        except FileNotFoundError:
+            return Response({"error": "not found"}, status=404)
+        except OSError as e:
+            return Response({"error": str(e)}, status=409)
+        return Response(b"", status=204, content_type="text/plain")
+
+    # ---- api ----
+    def _api_rename(self, req: Request) -> Response:
+        b = req.json()
+        try:
+            entry = self.filer.rename_entry(b["from"], b["to"])
+        except FileNotFoundError:
+            return Response({"error": "not found"}, status=404)
+        return Response({"path": entry.full_path})
+
+    def _api_meta_events(self, req: Request) -> Response:
+        since = int(req.query.get("since_ns", 0))
+        prefix = req.query.get("prefix", "/")
+        wait = float(req.query.get("wait", 0))
+        if wait > 0:
+            self.filer.meta_log.wait_for_events(since, timeout=min(wait, 30))
+        events = self.filer.meta_log.read_since(since, prefix)
+        return Response({"events": [e.to_dict() for e in events]})

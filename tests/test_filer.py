@@ -1,0 +1,153 @@
+"""Filer tests: store contract (both embedded stores), chunk overlap
+resolution, and the HTTP filer over a live mini-cluster."""
+
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
+from seaweedfs_tpu.filer.filechunks import (non_overlapping_visible_intervals,
+                                            view_from_visibles)
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filerstore import MemoryStore, SqliteStore
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+@pytest.mark.parametrize("store_cls", [MemoryStore, SqliteStore])
+def test_store_contract(store_cls):
+    s = store_cls()
+    e = Entry("/a/b/file.txt", Attr(mtime=1.0, file_size=5))
+    s.insert_entry(e)
+    got = s.find_entry("/a/b/file.txt")
+    assert got is not None and got.attr.file_size == 5
+
+    s.insert_entry(Entry("/a/b/other.txt"))
+    s.insert_entry(Entry("/a/b/sub", Attr(is_directory=True)))
+    s.insert_entry(Entry("/a/b/sub/deep.txt"))
+    names = [x.name for x in s.list_directory_entries("/a/b")]
+    assert names == ["file.txt", "other.txt", "sub"]
+    # prefix + pagination
+    names = [x.name for x in s.list_directory_entries("/a/b", prefix="o")]
+    assert names == ["other.txt"]
+    names = [x.name for x in s.list_directory_entries(
+        "/a/b", start_name="file.txt")]
+    assert names == ["other.txt", "sub"]
+
+    s.delete_folder_children("/a/b")
+    assert s.list_directory_entries("/a/b") == []
+
+    s.kv_put(b"conf", b"xyz")
+    assert s.kv_get(b"conf") == b"xyz"
+    assert s.kv_get(b"missing") is None
+
+
+def test_chunk_overlap_resolution():
+    # chunk A covers [0,100); newer chunk B overwrites [30,60)
+    chunks = [FileChunk("1,a", 0, 100, mtime_ns=1),
+              FileChunk("1,b", 30, 30, mtime_ns=2)]
+    vis = non_overlapping_visible_intervals(chunks)
+    spans = [(v.start, v.stop, v.fid) for v in vis]
+    assert spans == [(0, 30, "1,a"), (30, 60, "1,b"), (60, 100, "1,a")]
+    views = view_from_visibles(vis, 20, 30)
+    assert [(v.logic_offset, v.size, v.fid, v.offset_in_chunk)
+            for v in views] == [(20, 10, "1,a", 20), (30, 20, "1,b", 0)]
+
+
+def test_filer_core_namespace():
+    f = Filer()
+    f.create_entry(Entry("/docs/readme.md", Attr(mtime=1.0)))
+    assert f.find_entry("/docs") is not None  # parent auto-created
+    assert f.find_entry("/docs").is_directory
+
+    with pytest.raises(FileExistsError):
+        f.create_entry(Entry("/docs/readme.md"), o_excl=True)
+
+    f.rename_entry("/docs/readme.md", "/docs/intro.md")
+    assert f.find_entry("/docs/readme.md") is None
+    assert f.find_entry("/docs/intro.md") is not None
+
+    with pytest.raises(OSError):
+        f.delete_entry("/docs")  # not empty
+    f.delete_entry("/docs", recursive=True)
+    assert f.find_entry("/docs") is None
+
+    # meta log captured the churn
+    events = f.meta_log.read_since(0)
+    assert len(events) >= 3
+
+
+def test_filer_rename_directory_moves_children():
+    f = Filer()
+    f.create_entry(Entry("/a/x/1.txt"))
+    f.create_entry(Entry("/a/x/sub/2.txt"))
+    f.rename_entry("/a/x", "/a/y")
+    assert f.find_entry("/a/y/1.txt") is not None
+    assert f.find_entry("/a/y/sub/2.txt") is not None
+    assert f.find_entry("/a/x/1.txt") is None
+
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    time.sleep(0.2)
+    yield master, vs, fs
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_filer_http_small_and_chunked(stack):
+    master, vs, fs = stack
+    base = f"http://{fs.url}"
+
+    # small file -> inlined
+    status, _, _ = http_call("POST", f"{base}/dir/small.txt",
+                             body=b"tiny content")
+    assert status == 201
+    status, body, _ = http_call("GET", f"{base}/dir/small.txt")
+    assert status == 200 and body == b"tiny content"
+
+    # large file -> chunked through volume servers
+    rng = np.random.default_rng(0)
+    big = rng.integers(0, 256, 9_000_000, dtype=np.uint8).tobytes()
+    status, _, _ = http_call("POST", f"{base}/dir/big.bin", body=big)
+    assert status == 201
+    status, body, _ = http_call("GET", f"{base}/dir/big.bin")
+    assert status == 200 and body == big
+
+    # listing
+    listing = http_json("GET", f"{base}/dir")
+    names = sorted(e["FullPath"] for e in listing["Entries"])
+    assert names == ["/dir/big.bin", "/dir/small.txt"]
+    sizes = {e["FullPath"]: e["FileSize"] for e in listing["Entries"]}
+    assert sizes["/dir/big.bin"] == len(big)
+
+    # delete
+    status, _, _ = http_call("DELETE", f"{base}/dir/big.bin")
+    assert status == 204
+    status, _, _ = http_call("GET", f"{base}/dir/big.bin")
+    assert status == 404
+
+    # meta events observed
+    ev = http_json("GET", f"{base}/__api/meta_events?since_ns=0")
+    assert len(ev["events"]) >= 3
+
+
+def test_filer_http_rename(stack):
+    master, vs, fs = stack
+    base = f"http://{fs.url}"
+    http_call("POST", f"{base}/r/a.txt", body=b"abc")
+    out = http_json("POST", f"{base}/__api/rename",
+                    {"from": "/r/a.txt", "to": "/r/b.txt"})
+    assert out["path"] == "/r/b.txt"
+    status, body, _ = http_call("GET", f"{base}/r/b.txt")
+    assert status == 200 and body == b"abc"
