@@ -163,7 +163,7 @@ class Filer:
 
     def mkdirs(self, dir_path: str) -> None:
         with self._lock:
-            self._ensure_parents(_norm(dir_path) + "/x")
+            self._ensure_parents(_norm(dir_path))
 
     # ---- helpers ----
     def _ensure_parents(self, dir_path: str) -> None:

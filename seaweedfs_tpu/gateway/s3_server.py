@@ -1,0 +1,402 @@
+"""S3-compatible gateway over the filer namespace.
+
+Functional equivalent of (a subset of) reference weed/s3api: bucket CRUD,
+object PUT/GET/HEAD/DELETE, ListObjectsV2, ListBuckets, multipart uploads
+(init/part/complete/abort — completion composes the parts' chunk lists
+without copying data, like reference s3api/filer_multipart.go), and
+optional AWS SigV4 verification (reference auth_signature_v4.go) with
+anonymous access when no credentials are configured.
+
+Buckets live at /buckets/<name> in the filer (reference filer_buckets.go).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import time
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.utils.httpd import HttpServer, Request, Response
+
+BUCKETS_PATH = "/buckets"
+UPLOADS_PATH = "/buckets/.uploads"
+
+
+def _xml(root: ET.Element) -> bytes:
+    return (b'<?xml version="1.0" encoding="UTF-8"?>'
+            + ET.tostring(root))
+
+
+def _err(code: str, message: str, status: int) -> Response:
+    root = ET.Element("Error")
+    ET.SubElement(root, "Code").text = code
+    ET.SubElement(root, "Message").text = message
+    return Response(_xml(root), status=status, content_type="application/xml")
+
+
+class S3Server:
+    def __init__(self, filer_server, host: str = "127.0.0.1", port: int = 0,
+                 access_key: str = "", secret_key: str = ""):
+        # filer_server: in-process FilerServer (gateway composes chunk
+        # lists directly; the data path still flows through volume servers)
+        self.fs = filer_server
+        self.filer: Filer = filer_server.filer
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.http = HttpServer(host, port)
+        self._register_routes()
+
+    def start(self) -> None:
+        self.http.start()
+
+    def stop(self) -> None:
+        self.http.stop()
+
+    @property
+    def url(self) -> str:
+        return f"{self.http.host}:{self.http.port}"
+
+    # ---- routing ----
+    def _register_routes(self) -> None:
+        r = self.http.add
+        r("GET", "/", self._list_buckets)
+        for m in ("GET", "PUT", "DELETE", "HEAD", "POST"):
+            r(m, r"/([^/]+)", self._bucket_dispatch)
+            r(m, r"/([^/]+)/(.+)", self._object_dispatch)
+
+    # ---- auth (SigV4 subset) ----
+    def _check_auth(self, req: Request) -> Optional[Response]:
+        if not self.access_key:
+            return None  # anonymous allowed
+        auth = req.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256 "):
+            return _err("AccessDenied", "missing signature", 403)
+        try:
+            parts = dict(p.strip().split("=", 1)
+                         for p in auth[len("AWS4-HMAC-SHA256 "):].split(","))
+            cred = parts["Credential"].split("/")
+            akey, date, region, service = cred[0], cred[1], cred[2], cred[3]
+            if akey != self.access_key:
+                return _err("InvalidAccessKeyId", "unknown key", 403)
+            signed_headers = parts["SignedHeaders"].split(";")
+            # canonical request
+            cq = "&".join(
+                f"{urllib.parse.quote(k, safe='~')}="
+                f"{urllib.parse.quote(v, safe='~')}"
+                for k, v in sorted(req.query.items()))
+            ch = "".join(f"{h}:{req.headers.get(h, '').strip()}\n"
+                         for h in signed_headers)
+            payload_hash = req.headers.get("x-amz-content-sha256",
+                                           "UNSIGNED-PAYLOAD")
+            creq = "\n".join([req.method, urllib.parse.quote(req.path),
+                              cq, ch, ";".join(signed_headers),
+                              payload_hash])
+            scope = f"{date}/{region}/{service}/aws4_request"
+            sts = "\n".join([
+                "AWS4-HMAC-SHA256",
+                req.headers.get("x-amz-date", ""),
+                scope,
+                hashlib.sha256(creq.encode()).hexdigest()])
+            k = ("AWS4" + self.secret_key).encode()
+            for msg in (date, region, service, "aws4_request"):
+                k = hmac.new(k, msg.encode(), hashlib.sha256).digest()
+            sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+            if sig != parts["Signature"]:
+                return _err("SignatureDoesNotMatch", "bad signature", 403)
+        except (KeyError, IndexError, ValueError):
+            return _err("AccessDenied", "malformed authorization", 403)
+        return None
+
+    # ---- buckets ----
+    def _list_buckets(self, req: Request) -> Response:
+        denied = self._check_auth(req)
+        if denied:
+            return denied
+        root = ET.Element("ListAllMyBucketsResult")
+        owner = ET.SubElement(root, "Owner")
+        ET.SubElement(owner, "ID").text = "seaweedfs-tpu"
+        buckets = ET.SubElement(root, "Buckets")
+        for e in self.filer.list_entries(BUCKETS_PATH):
+            if not e.is_directory or e.name.startswith("."):
+                continue
+            b = ET.SubElement(buckets, "Bucket")
+            ET.SubElement(b, "Name").text = e.name
+            ET.SubElement(b, "CreationDate").text = _iso(e.attr.crtime)
+        return Response(_xml(root), content_type="application/xml")
+
+    def _bucket_dispatch(self, req: Request) -> Response:
+        denied = self._check_auth(req)
+        if denied:
+            return denied
+        bucket = req.match.group(1)
+        if req.method == "PUT":
+            self.filer.mkdirs(f"{BUCKETS_PATH}/{bucket}")
+            return Response(b"", content_type="application/xml")
+        if req.method == "DELETE":
+            try:
+                self.filer.delete_entry(f"{BUCKETS_PATH}/{bucket}",
+                                        recursive=True)
+            except FileNotFoundError:
+                return _err("NoSuchBucket", bucket, 404)
+            return Response(b"", status=204, content_type="application/xml")
+        if req.method in ("GET", "HEAD"):
+            if self.filer.find_entry(f"{BUCKETS_PATH}/{bucket}") is None:
+                return _err("NoSuchBucket", bucket, 404)
+            if req.method == "HEAD":
+                return Response(b"", content_type="application/xml")
+            return self._list_objects(req, bucket)
+        if req.method == "POST" and "delete" in req.query:
+            return self._delete_objects(req, bucket)
+        return _err("MethodNotAllowed", req.method, 405)
+
+    def _list_objects(self, req: Request, bucket: str) -> Response:
+        prefix = req.query.get("prefix", "")
+        delimiter = req.query.get("delimiter", "")
+        max_keys = int(req.query.get("max-keys", 1000))
+        start_after = req.query.get("start-after",
+                                    req.query.get("continuation-token", ""))
+        base = f"{BUCKETS_PATH}/{bucket}"
+
+        keys: list[tuple[str, Entry]] = []
+        prefixes: set[str] = set()
+        self._walk(base, "", prefix, delimiter, keys, prefixes,
+                   start_after, max_keys)
+
+        root = ET.Element("ListBucketResult")
+        ET.SubElement(root, "Name").text = bucket
+        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "MaxKeys").text = str(max_keys)
+        ET.SubElement(root, "KeyCount").text = str(len(keys))
+        truncated = len(keys) >= max_keys
+        ET.SubElement(root, "IsTruncated").text = \
+            "true" if truncated else "false"
+        if truncated and keys:
+            ET.SubElement(root, "NextContinuationToken").text = keys[-1][0]
+        for key, e in keys:
+            c = ET.SubElement(root, "Contents")
+            ET.SubElement(c, "Key").text = key
+            ET.SubElement(c, "LastModified").text = _iso(e.attr.mtime)
+            ET.SubElement(c, "Size").text = str(e.file_size())
+            ET.SubElement(c, "ETag").text = f'"{e.attr.md5.hex()}"'
+            ET.SubElement(c, "StorageClass").text = "STANDARD"
+        for p in sorted(prefixes):
+            cp = ET.SubElement(root, "CommonPrefixes")
+            ET.SubElement(cp, "Prefix").text = p
+        return Response(_xml(root), content_type="application/xml")
+
+    def _walk(self, base, rel, prefix, delimiter, keys, prefixes,
+              start_after, max_keys):
+        if len(keys) >= max_keys:
+            return
+        for e in self.filer.list_entries(base + ("/" + rel if rel else ""),
+                                         limit=1 << 20):
+            key = f"{rel}/{e.name}" if rel else e.name
+            if e.is_directory:
+                if prefix and not (key + "/").startswith(prefix) \
+                        and not prefix.startswith(key + "/"):
+                    continue
+                if delimiter == "/" and key.startswith(prefix):
+                    # collapse under a common prefix
+                    tail = key[len(prefix):]
+                    if "/" not in tail:
+                        prefixes.add(key + "/")
+                        continue
+                self._walk(base, key, prefix, delimiter, keys, prefixes,
+                           start_after, max_keys)
+            else:
+                if prefix and not key.startswith(prefix):
+                    continue
+                if start_after and key <= start_after:
+                    continue
+                keys.append((key, e))
+                if len(keys) >= max_keys:
+                    return
+
+    def _delete_objects(self, req: Request, bucket: str) -> Response:
+        body = ET.fromstring(req.body)
+        ns = ""
+        if body.tag.startswith("{"):
+            ns = body.tag.split("}")[0] + "}"
+        root = ET.Element("DeleteResult")
+        for obj in body.findall(f"{ns}Object"):
+            key = obj.find(f"{ns}Key").text
+            try:
+                self.filer.delete_entry(f"{BUCKETS_PATH}/{bucket}/{key}")
+                d = ET.SubElement(root, "Deleted")
+                ET.SubElement(d, "Key").text = key
+            except (FileNotFoundError, OSError):
+                d = ET.SubElement(root, "Error")
+                ET.SubElement(d, "Key").text = key
+        return Response(_xml(root), content_type="application/xml")
+
+    # ---- objects ----
+    def _object_dispatch(self, req: Request) -> Response:
+        denied = self._check_auth(req)
+        if denied:
+            return denied
+        bucket, key = req.match.group(1), req.match.group(2)
+        if "uploads" in req.query and req.method == "POST":
+            return self._initiate_multipart(bucket, key)
+        if "uploadId" in req.query:
+            if req.method == "PUT":
+                return self._upload_part(req, bucket, key)
+            if req.method == "POST":
+                return self._complete_multipart(req, bucket, key)
+            if req.method == "DELETE":
+                return self._abort_multipart(req, bucket, key)
+        path = f"{BUCKETS_PATH}/{bucket}/{key}"
+        if req.method == "PUT":
+            return self._put_object(req, bucket, key)
+        if req.method in ("GET", "HEAD"):
+            entry = self.filer.find_entry(path)
+            if entry is None or entry.is_directory:
+                return _err("NoSuchKey", key, 404)
+            if req.method == "HEAD":
+                return Response(b"", headers={
+                    "Content-Length-Hint": str(entry.file_size()),
+                    "ETag": f'"{entry.attr.md5.hex()}"',
+                    "Last-Modified": _http_date(entry.attr.mtime),
+                })
+            data = self.fs._read_entry_bytes(entry)
+            rng = req.headers.get("Range")
+            if rng and rng.startswith("bytes="):
+                lo_s, _, hi_s = rng[6:].partition("-")
+                lo = int(lo_s or 0)
+                hi = int(hi_s) if hi_s else len(data) - 1
+                piece = data[lo:hi + 1]
+                return Response(piece, status=206,
+                                content_type=entry.attr.mime
+                                or "application/octet-stream",
+                                headers={"Content-Range":
+                                         f"bytes {lo}-{hi}/{len(data)}"})
+            return Response(data, content_type=entry.attr.mime
+                            or "application/octet-stream",
+                            headers={"ETag": f'"{entry.attr.md5.hex()}"'})
+        if req.method == "DELETE":
+            try:
+                self.filer.delete_entry(path)
+            except (FileNotFoundError, OSError):
+                pass
+            return Response(b"", status=204, content_type="application/xml")
+        return _err("MethodNotAllowed", req.method, 405)
+
+    def _put_object(self, req: Request, bucket: str, key: str) -> Response:
+        if self.filer.find_entry(f"{BUCKETS_PATH}/{bucket}") is None:
+            return _err("NoSuchBucket", bucket, 404)
+        data = req.body
+        md5 = hashlib.md5(data).digest()
+        now = time.time()
+        entry = Entry(
+            full_path=f"{BUCKETS_PATH}/{bucket}/{key}",
+            attr=Attr(mtime=now, crtime=now,
+                      mime=req.headers.get("Content-Type", ""),
+                      file_size=len(data), md5=md5, collection=bucket))
+        if len(data) <= 2048:
+            entry.content = data
+        else:
+            entry.chunks = self.fs._upload_chunks(data, bucket, "")
+        self.filer.create_entry(entry)
+        return Response(b"", headers={"ETag": f'"{md5.hex()}"'})
+
+    # ---- multipart ----
+    def _initiate_multipart(self, bucket: str, key: str) -> Response:
+        upload_id = uuid.uuid4().hex
+        self.filer.mkdirs(f"{UPLOADS_PATH}/{upload_id}")
+        marker = Entry(f"{UPLOADS_PATH}/{upload_id}/.meta",
+                       attr=Attr(mtime=time.time()))
+        marker.extended = {"bucket": bucket, "key": key}
+        self.filer.create_entry(marker)
+        root = ET.Element("InitiateMultipartUploadResult")
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "UploadId").text = upload_id
+        return Response(_xml(root), content_type="application/xml")
+
+    def _upload_part(self, req: Request, bucket: str, key: str) -> Response:
+        upload_id = req.query["uploadId"]
+        part = int(req.query["partNumber"])
+        if self.filer.find_entry(f"{UPLOADS_PATH}/{upload_id}") is None:
+            return _err("NoSuchUpload", upload_id, 404)
+        data = req.body
+        md5 = hashlib.md5(data).digest()
+        now = time.time()
+        entry = Entry(f"{UPLOADS_PATH}/{upload_id}/{part:05d}.part",
+                      attr=Attr(mtime=now, crtime=now, md5=md5,
+                                file_size=len(data)))
+        if len(data) <= 2048:
+            entry.content = data
+        else:
+            entry.chunks = self.fs._upload_chunks(data, bucket, "")
+        self.filer.create_entry(entry)
+        return Response(b"", headers={"ETag": f'"{md5.hex()}"'})
+
+    def _complete_multipart(self, req: Request, bucket: str,
+                            key: str) -> Response:
+        """Compose part chunk lists into the final entry without moving
+        data (reference filer_multipart.go completeMultipartUpload)."""
+        upload_id = req.query["uploadId"]
+        dirp = f"{UPLOADS_PATH}/{upload_id}"
+        parts = [e for e in self.filer.list_entries(dirp, limit=100000)
+                 if e.name.endswith(".part")]
+        if not parts:
+            return _err("NoSuchUpload", upload_id, 404)
+        parts.sort(key=lambda e: e.name)
+        chunks: list[FileChunk] = []
+        offset = 0
+        md5 = hashlib.md5()
+        for p in parts:
+            if p.content:
+                # inline content gets re-uploaded as a chunk
+                up = self.fs._upload_chunks(p.content, bucket, "")
+                for c in up:
+                    c.offset += offset
+                    chunks.append(c)
+            else:
+                for c in sorted(p.chunks, key=lambda c: c.offset):
+                    chunks.append(FileChunk(
+                        fid=c.fid, offset=offset + c.offset, size=c.size,
+                        mtime_ns=c.mtime_ns))
+            offset += p.file_size()
+            md5.update(p.attr.md5)
+        etag = md5.hexdigest() + f"-{len(parts)}"
+        now = time.time()
+        entry = Entry(f"{BUCKETS_PATH}/{bucket}/{key}",
+                      attr=Attr(mtime=now, crtime=now, file_size=offset,
+                                collection=bucket))
+        entry.chunks = chunks
+        self.filer.create_entry(entry)
+        # drop part entries WITHOUT chunk GC (chunks now owned by the
+        # composed object)
+        for p in parts:
+            p.chunks = []
+            self.filer.update_entry(p)
+        self.filer.delete_entry(dirp, recursive=True)
+        root = ET.Element("CompleteMultipartUploadResult")
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "ETag").text = f'"{etag}"'
+        return Response(_xml(root), content_type="application/xml")
+
+    def _abort_multipart(self, req: Request, bucket: str,
+                         key: str) -> Response:
+        upload_id = req.query["uploadId"]
+        try:
+            self.filer.delete_entry(f"{UPLOADS_PATH}/{upload_id}",
+                                    recursive=True)
+        except FileNotFoundError:
+            return _err("NoSuchUpload", upload_id, 404)
+        return Response(b"", status=204, content_type="application/xml")
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
+
+
+def _http_date(ts: float) -> str:
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts))

@@ -97,10 +97,10 @@ class MasterServer:
         })
 
     def _handle_assign(self, req: Request) -> Response:
-        count = int(req.query.get("count", 1))
+        count = int(req.query.get("count") or 1)
         collection = req.query.get("collection", "")
-        replication = req.query.get("replication",
-                                    self.default_replication)
+        replication = (req.query.get("replication")
+                       or self.default_replication)
         ttl = req.query.get("ttl", "")
         dc = req.query.get("dataCenter", "")
         layout = self.topo.get_layout(collection, replication, ttl)
@@ -185,9 +185,10 @@ class MasterServer:
                          "Version": "seaweedfs-tpu 0.1"})
 
     def _handle_grow(self, req: Request) -> Response:
-        count = int(req.query.get("count", 1))
+        count = int(req.query.get("count") or 1)
         collection = req.query.get("collection", "")
-        replication = req.query.get("replication", self.default_replication)
+        replication = (req.query.get("replication")
+                       or self.default_replication)
         ttl = req.query.get("ttl", "")
         try:
             vids = grow_by_type(self.topo, collection, replication, ttl,

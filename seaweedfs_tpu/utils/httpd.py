@@ -27,7 +27,8 @@ class Request:
         parsed = urllib.parse.urlparse(handler.path)
         self.path = parsed.path
         self.query = {k: v[0] for k, v in
-                      urllib.parse.parse_qs(parsed.query).items()}
+                      urllib.parse.parse_qs(
+                          parsed.query, keep_blank_values=True).items()}
         self.match = match
         self.body = body
         self.headers = handler.headers
