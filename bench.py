@@ -36,33 +36,45 @@ def bench_cpu(n_bytes_per_shard: int = 8 * 1024 * 1024, iters: int = 3) -> float
     return data.nbytes / dt / 1e6
 
 
-def bench_tpu(n_bytes_per_shard: int = 32 * 1024 * 1024, iters: int = 6) -> float:
+def bench_tpu(n_bytes_per_shard: int = 32 * 1024 * 1024, outer: int = 5,
+              inner: int = 16) -> float:
+    """Sustained device throughput: the parity kernel runs `inner` times
+    inside one compiled program (input mutated every step so nothing can be
+    cached/CSE'd), synced once by fetching an XOR checksum. This amortizes
+    the fixed per-dispatch sync overhead of the TPU relay (~70ms here),
+    which would otherwise dominate and misreport the kernel by >5x."""
     import jax
     import jax.numpy as jnp
 
     from seaweedfs_tpu.models.coder import RSScheme
-    from seaweedfs_tpu.ops.rs_jax import parity_fn
+    from seaweedfs_tpu.ops.rs_jax import _apply_matrix_words, _mat_to_tuple
+    from seaweedfs_tpu.ops import gf256
 
-    fn = parity_fn(RSScheme(10, 4))
+    scheme = RSScheme(10, 4)
+    pm = _mat_to_tuple(gf256.parity_matrix(scheme.data_shards,
+                                           scheme.parity_shards))
     rng = np.random.default_rng(1)
     nw = n_bytes_per_shard // 4
     words = jax.device_put(
         rng.integers(0, 2**32, (10, nw), dtype=np.uint64).astype(np.uint32))
 
     @jax.jit
-    def step(w, i):
-        p = fn(w ^ i)  # mutate input each step -> no caching anywhere
-        return jnp.bitwise_xor.reduce(jnp.bitwise_xor.reduce(p))
+    def loop(w, i0):
+        def body(r, acc):
+            p = _apply_matrix_words(w ^ (i0 + r), pm)
+            return acc ^ jnp.bitwise_xor.reduce(
+                jnp.bitwise_xor.reduce(p))
+        return jax.lax.fori_loop(0, inner, body, jnp.uint32(0))
 
-    jax.device_get(step(words, jnp.uint32(1)))  # compile + warm
+    jax.device_get(loop(words, jnp.uint32(1)))  # compile + warm
     times = []
-    for i in range(iters):
+    for i in range(outer):
         t0 = time.perf_counter()
-        jax.device_get(step(words, jnp.uint32(i + 2)))
+        jax.device_get(loop(words, jnp.uint32(i * inner + 2)))
         times.append(time.perf_counter() - t0)
     times.sort()
-    dt = times[len(times) // 2]  # median
-    return 10 * n_bytes_per_shard / dt / 1e6
+    dt = times[len(times) // 2]  # median, includes ONE fixed sync
+    return inner * 10 * n_bytes_per_shard / dt / 1e6
 
 
 def main():

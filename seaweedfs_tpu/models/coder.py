@@ -122,6 +122,8 @@ def make_coder(name: str = "cpu", scheme: RSScheme = DEFAULT_SCHEME) -> ErasureC
     from seaweedfs_tpu.ops import rs_cpu  # noqa: F401
     if name in ("jax", "tpu", "pallas"):
         from seaweedfs_tpu.ops import rs_jax  # noqa: F401
+    if name == "pallas":
+        from seaweedfs_tpu.ops import rs_pallas  # noqa: F401
     if name not in _REGISTRY:
         raise KeyError(f"unknown coder {name!r}; known: {sorted(_REGISTRY)}")
     return _REGISTRY[name](scheme)

