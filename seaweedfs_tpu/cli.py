@@ -138,6 +138,31 @@ def cmd_ec(args):
         sh.unlock()
 
 
+def cmd_fix(args):
+    from seaweedfs_tpu.storage.maintenance import fix_volume
+    live = fix_volume(args.base)
+    print(json.dumps({"base": args.base, "live_entries": live}))
+
+
+def cmd_export(args):
+    from seaweedfs_tpu.storage.maintenance import export_volume
+    count = export_volume(args.base, args.output)
+    print(json.dumps({"base": args.base, "exported": count}))
+
+
+def cmd_backup(args):
+    from seaweedfs_tpu.storage.maintenance import backup_volume
+    base = backup_volume(args.master, args.volumeId, args.output,
+                         args.collection)
+    print(json.dumps({"backed_up": base}))
+
+
+def cmd_compact(args):
+    from seaweedfs_tpu.storage.maintenance import compact_volume
+    before, after = compact_volume(args.base)
+    print(json.dumps({"before_bytes": before, "after_bytes": after}))
+
+
 def cmd_benchmark(args):
     """weed benchmark equivalent: write then randomly read N small files
     (reference weed/command/benchmark.go)."""
@@ -255,6 +280,26 @@ def main(argv=None):
     ec.add_argument("-volumeId", type=int, default=None)
     ec.add_argument("-collection", default=None)
     ec.set_defaults(fn=cmd_ec)
+
+    fx = sub.add_parser("fix")
+    fx.add_argument("base", help="volume base path (no extension)")
+    fx.set_defaults(fn=cmd_fix)
+
+    ex = sub.add_parser("export")
+    ex.add_argument("base")
+    ex.add_argument("-output", default="./export")
+    ex.set_defaults(fn=cmd_export)
+
+    bk = sub.add_parser("backup")
+    bk.add_argument("-master", default="127.0.0.1:9333")
+    bk.add_argument("-volumeId", type=int, required=True)
+    bk.add_argument("-collection", default="")
+    bk.add_argument("-output", default="./backup")
+    bk.set_defaults(fn=cmd_backup)
+
+    cp = sub.add_parser("compact")
+    cp.add_argument("base")
+    cp.set_defaults(fn=cmd_compact)
 
     b = sub.add_parser("benchmark")
     b.add_argument("-master", default="127.0.0.1:9333")

@@ -34,11 +34,13 @@ def upload_data(mc: MasterClient, data: bytes, name: str = "",
     if "error" in a and a["error"]:
         raise RuntimeError(a["error"])
     fid, url = a["fid"], a["url"]
-    return upload_to(fid, url, data, name=name, mime=mime, compress=compress)
+    return upload_to(fid, url, data, name=name, mime=mime, compress=compress,
+                     auth=a.get("auth", ""))
 
 
 def upload_to(fid: str, server_url: str, data: bytes, name: str = "",
-              mime: str = "", compress: bool = False) -> UploadResult:
+              mime: str = "", compress: bool = False,
+              auth: str = "") -> UploadResult:
     body = data
     qs = {"name": name, "mime": mime}
     if compress and len(data) > 128:
@@ -47,8 +49,10 @@ def upload_to(fid: str, server_url: str, data: bytes, name: str = "",
             body = gz
             qs["gzip"] = "1"
     query = urllib.parse.urlencode({k: v for k, v in qs.items() if v})
+    headers = {"Authorization": f"Bearer {auth}"} if auth else {}
     status, resp, _ = http_call(
-        "POST", f"http://{server_url}/{fid}?{query}", body=body)
+        "POST", f"http://{server_url}/{fid}?{query}", body=body,
+        headers=headers)
     if status >= 400:
         raise HttpError(status, resp)
     return UploadResult(fid, server_url, len(data))

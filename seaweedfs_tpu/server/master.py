@@ -34,8 +34,21 @@ class MasterServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  volume_size_limit_mb: int = 1024,
                  default_replication: str = "000",
-                 garbage_threshold: float = 0.3):
+                 garbage_threshold: float = 0.3,
+                 jwt_signing_key: str = "",
+                 whitelist: Optional[list] = None):
         self.topo = Topology(volume_size_limit=volume_size_limit_mb * 1024 * 1024)
+        self.jwt_signing_key = jwt_signing_key
+        from seaweedfs_tpu.utils.metrics import Registry
+        from seaweedfs_tpu.utils.security import Guard
+        self.metrics = Registry()
+        self.guard = Guard(whitelist)
+        self._m_assign = self.metrics.counter(
+            "master", "assign_total", "assign requests")
+        self._m_lookup = self.metrics.counter(
+            "master", "lookup_total", "lookup requests")
+        self._m_heartbeat = self.metrics.counter(
+            "master", "received_heartbeats", "heartbeats received")
         self.sequencer = MemorySequencer()
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
@@ -78,9 +91,15 @@ class MasterServer:
         r("GET", "/cluster/status", self._handle_cluster_status)
         r("POST", "/admin/lock", self._handle_lock)
         r("POST", "/admin/unlock", self._handle_unlock)
+        r("GET", "/metrics", self._handle_metrics)
+
+    def _handle_metrics(self, req: Request) -> Response:
+        return Response(self.metrics.expose_text(),
+                        content_type="text/plain; version=0.0.4")
 
     def _handle_heartbeat(self, req: Request) -> Response:
         hb = req.json()
+        self._m_heartbeat.inc()
         if hb.get("is_delta"):
             node = self.topo.find_node(f"{hb['ip']}:{hb['port']}")
             if node is None:
@@ -94,6 +113,7 @@ class MasterServer:
             "volume_size_limit": self.topo.volume_size_limit,
             "leader": self.url,
             "metrics_address": "",
+            "jwt_signing_key": self.jwt_signing_key,
         })
 
     def _handle_assign(self, req: Request) -> Response:
@@ -120,14 +140,19 @@ class MasterServer:
         cookie = random.getrandbits(32)
         fid = f"{vid},{format_needle_id_cookie(key, cookie)}"
         node = nodes[0]
-        return Response({
+        self._m_assign.inc()
+        reply = {
             "fid": fid,
             "url": node.url,
             "publicUrl": node.public_url,
             "count": count,
             "replicas": [{"url": n.url, "publicUrl": n.public_url}
                          for n in nodes[1:]],
-        })
+        }
+        if self.jwt_signing_key:
+            from seaweedfs_tpu.utils.security import gen_jwt
+            reply["auth"] = gen_jwt(self.jwt_signing_key, fid)
+        return Response(reply)
 
     def _allocate_rpc(self, node, vid, collection, rp, ttl) -> bool:
         from seaweedfs_tpu.storage.super_block import (ReplicaPlacement,
@@ -156,6 +181,7 @@ class MasterServer:
         vid = int(vid_str.split(",")[0]) if vid_str else 0
         collection = req.query.get("collection", "")
         nodes = self.topo.lookup(collection, vid)
+        self._m_lookup.inc()
         if not nodes:
             return Response(
                 {"volumeId": vid_str, "error": "volume id not found"},

@@ -41,7 +41,8 @@ class VolumeServer:
                  host: str = "127.0.0.1", port: int = 0,
                  public_url: str = "", rack: str = "", data_center: str = "",
                  coder: Optional[ErasureCoder] = None,
-                 max_volume_counts: Optional[list[int]] = None):
+                 max_volume_counts: Optional[list[int]] = None,
+                 jwt_signing_key: str = ""):
         self.master_url = master_url
         self.http = HttpServer(host, port)
         self._store_dirs = directories
@@ -54,6 +55,13 @@ class VolumeServer:
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self.volume_size_limit = 0
+        self.jwt_signing_key = jwt_signing_key
+        from seaweedfs_tpu.utils.metrics import Registry
+        self.metrics = Registry()
+        self._m_req = self.metrics.counter(
+            "volumeServer", "request_total", "requests", ("type",))
+        self._m_lat = self.metrics.histogram(
+            "volumeServer", "request_seconds", "request latency", ("type",))
 
     # ---- lifecycle ----
     def start(self) -> None:
@@ -89,6 +97,8 @@ class VolumeServer:
                               hb, timeout=5)
             if reply:
                 self.volume_size_limit = reply.get("volume_size_limit", 0)
+                if reply.get("jwt_signing_key") and not self.jwt_signing_key:
+                    self.jwt_signing_key = reply["jwt_signing_key"]
         except (ConnectionError, HttpError):
             pass
 
@@ -144,6 +154,7 @@ class VolumeServer:
         r("DELETE", r"/(\d+),([0-9a-fA-F]+)(?:_\d+)?(?:\.\w+)?",
           self._handle_delete)
         r("GET", "/status", self._handle_status)
+        r("GET", "/metrics", self._handle_metrics)
         # admin
         r("POST", "/admin/allocate_volume", self._admin_allocate_volume)
         r("POST", "/admin/delete_volume", self._admin_delete_volume)
@@ -164,6 +175,22 @@ class VolumeServer:
         r("GET", "/admin/ec/shard_read", self._ec_shard_read)
         r("GET", "/admin/ec/shard_file", self._ec_shard_file)
 
+    def _handle_metrics(self, req: Request) -> Response:
+        return Response(self.metrics.expose_text(),
+                        content_type="text/plain; version=0.0.4")
+
+    def _check_jwt(self, req: Request) -> Optional[Response]:
+        if not self.jwt_signing_key or req.query.get("type") == "replicate":
+            return None
+        from seaweedfs_tpu.utils.security import verify_jwt
+        auth = req.headers.get("Authorization", "")
+        token = auth[7:] if auth.startswith("Bearer ") else \
+            req.query.get("jwt", "")
+        fid = f"{req.match.group(1)},{req.match.group(2)}"
+        if not verify_jwt(self.jwt_signing_key, token, fid):
+            return Response({"error": "unauthorized"}, status=401)
+        return None
+
     # ---- public data path ----
     def _parse_fid(self, req: Request) -> tuple[int, int, int]:
         vid = int(req.match.group(1))
@@ -171,10 +198,17 @@ class VolumeServer:
         return vid, key, cookie
 
     def _handle_write(self, req: Request) -> Response:
+        denied = self._check_jwt(req)
+        if denied:
+            return denied
+        self._m_req.inc("write")
         vid, key, cookie = self._parse_fid(req)
         n = Needle(id=key, cookie=cookie, data=req.body,
                    name=req.query.get("name", "").encode(),
                    mime=req.query.get("mime", "").encode())
+        if req.query.get("gzip") == "1":
+            from seaweedfs_tpu.storage.needle import FLAG_IS_COMPRESSED
+            n.flags |= FLAG_IS_COMPRESSED
         if req.query.get("ts"):
             n.last_modified = int(req.query["ts"])
         n.set_flags_from_fields()
@@ -193,6 +227,7 @@ class VolumeServer:
                         status=201)
 
     def _handle_read(self, req: Request) -> Response:
+        self._m_req.inc("read")
         vid, key, cookie = self._parse_fid(req)
         try:
             if self.store.find_volume(vid) is not None:
@@ -207,6 +242,13 @@ class VolumeServer:
         except CookieMismatchError:
             return Response(b"", status=404, content_type="text/plain")
         headers = {}
+        if n.is_compressed:
+            accept = req.headers.get("Accept-Encoding", "")
+            if "gzip" in accept:
+                headers["Content-Encoding"] = "gzip"
+            else:
+                import gzip as _gz
+                n.data = _gz.decompress(n.data)
         if n.last_modified:
             headers["X-Last-Modified"] = str(n.last_modified)
         if n.name:
@@ -216,6 +258,10 @@ class VolumeServer:
         return Response(n.data, content_type=mime, headers=headers)
 
     def _handle_delete(self, req: Request) -> Response:
+        denied = self._check_jwt(req)
+        if denied:
+            return denied
+        self._m_req.inc("delete")
         vid, key, cookie = self._parse_fid(req)
         try:
             if self.store.find_volume(vid) is not None:

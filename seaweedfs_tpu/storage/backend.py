@@ -1,0 +1,180 @@
+"""Backend storage SPI + volume tiering.
+
+Functional equivalent of reference weed/storage/backend/backend.go:16-35:
+a sealed volume's .dat can live on something other than the local disk —
+a memory-mapped buffer or a cloud (S3) tier. The .vif sidecar records
+where the bytes went (reference volume_tier.go + volume_info pb).
+"""
+
+from __future__ import annotations
+
+import abc
+import io
+import json
+import os
+from typing import Optional
+
+
+class BackendStorageFile(abc.ABC):
+    """ReadAt/WriteAt/Truncate/Sync over some storage medium."""
+
+    @abc.abstractmethod
+    def read_at(self, offset: int, length: int) -> bytes: ...
+
+    @abc.abstractmethod
+    def write_at(self, offset: int, data: bytes) -> int: ...
+
+    @abc.abstractmethod
+    def size(self) -> int: ...
+
+    def truncate(self, size: int) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class DiskFile(BackendStorageFile):
+    def __init__(self, path: str, create: bool = False):
+        mode = "r+b" if os.path.exists(path) else "w+b"
+        if not create and not os.path.exists(path):
+            raise FileNotFoundError(path)
+        self._f = open(path, mode)
+        self.path = path
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        self._f.seek(offset)
+        return self._f.read(length)
+
+    def write_at(self, offset: int, data: bytes) -> int:
+        self._f.seek(offset)
+        return self._f.write(data)
+
+    def size(self) -> int:
+        self._f.seek(0, os.SEEK_END)
+        return self._f.tell()
+
+    def truncate(self, size: int) -> None:
+        self._f.truncate(size)
+
+    def sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class MemoryFile(BackendStorageFile):
+    """In-memory backend (the reference's memory_map analogue)."""
+
+    def __init__(self, data: bytes = b""):
+        self._buf = io.BytesIO(data)
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        self._buf.seek(offset)
+        return self._buf.read(length)
+
+    def write_at(self, offset: int, data: bytes) -> int:
+        self._buf.seek(offset)
+        return self._buf.write(data)
+
+    def size(self) -> int:
+        self._buf.seek(0, os.SEEK_END)
+        return self._buf.tell()
+
+    def truncate(self, size: int) -> None:
+        self._buf.truncate(size)
+
+
+class S3BackendFile(BackendStorageFile):
+    """Read-only cloud-tier file served over an S3-compatible endpoint
+    (including our own gateway). Range reads map to HTTP Range requests
+    (reference storage/backend/s3_backend)."""
+
+    def __init__(self, endpoint: str, bucket: str, key: str):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.key = key
+        self._size: Optional[int] = None
+
+    def _url(self) -> str:
+        return f"{self.endpoint}/{self.bucket}/{self.key}"
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        from seaweedfs_tpu.utils.httpd import http_call
+        status, body, _ = http_call(
+            "GET", self._url(),
+            headers={"Range": f"bytes={offset}-{offset + length - 1}"})
+        if status not in (200, 206):
+            raise IOError(f"s3 read: HTTP {status}")
+        if status == 200:
+            body = body[offset:offset + length]
+        return body
+
+    def write_at(self, offset: int, data: bytes) -> int:
+        raise PermissionError("cloud-tier volumes are read-only")
+
+    def size(self) -> int:
+        if self._size is None:
+            from seaweedfs_tpu.utils.httpd import http_call
+            status, body, _ = http_call("GET", self._url())
+            if status >= 400:
+                raise IOError(f"s3 stat: HTTP {status}")
+            self._size = len(body)
+        return self._size
+
+    def upload(self, local_path: str) -> None:
+        from seaweedfs_tpu.utils.httpd import http_call
+        with open(local_path, "rb") as f:
+            data = f.read()
+        status, _, _ = http_call("PUT", self._url(), body=data, timeout=600)
+        if status >= 400:
+            raise IOError(f"s3 upload: HTTP {status}")
+
+
+# ---- .vif sidecar (volume info) ----
+
+def save_volume_info(base_path: str, info: dict) -> None:
+    with open(base_path + ".vif", "w") as f:
+        json.dump(info, f)
+
+
+def load_volume_info(base_path: str) -> dict:
+    path = base_path + ".vif"
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def tier_volume_to_s3(base_path: str, endpoint: str, bucket: str,
+                      keep_local: bool = False) -> dict:
+    """Move a sealed volume's .dat to an S3 tier; record in .vif
+    (reference volume_tier.go + volume_grpc_tier_upload.go)."""
+    key = os.path.basename(base_path) + ".dat"
+    remote = S3BackendFile(endpoint, bucket, key)
+    remote.upload(base_path + ".dat")
+    info = load_volume_info(base_path)
+    info.update({"version": info.get("version", 3),
+                 "remote": {"backend": "s3", "endpoint": endpoint,
+                            "bucket": bucket, "key": key}})
+    save_volume_info(base_path, info)
+    if not keep_local:
+        os.remove(base_path + ".dat")
+    return info
+
+
+def open_backend_for_volume(base_path: str) -> BackendStorageFile:
+    """Open local .dat, or the remote tier recorded in .vif."""
+    if os.path.exists(base_path + ".dat"):
+        return DiskFile(base_path + ".dat")
+    info = load_volume_info(base_path)
+    remote = info.get("remote")
+    if remote and remote.get("backend") == "s3":
+        return S3BackendFile(remote["endpoint"], remote["bucket"],
+                             remote["key"])
+    raise FileNotFoundError(base_path + ".dat")

@@ -1,0 +1,135 @@
+"""Offline volume maintenance: fix, export, backup.
+
+Functional equivalents of reference weed/command/fix.go (rebuild .idx by
+scanning .dat), export.go (dump needles to files), backup.go (copy a
+volume from a live server), compact.go (offline vacuum).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Callable, Iterator, Optional
+
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.super_block import SuperBlock
+
+
+def scan_volume_file(dat_path: str,
+                     check_crc: bool = False
+                     ) -> Iterator[tuple[int, Needle]]:
+    """Walk every needle record in a .dat, yielding (offset, needle).
+    Deletion records (size==0) are yielded too."""
+    size = os.path.getsize(dat_path)
+    with open(dat_path, "rb") as f:
+        sb = SuperBlock.parse(f.read(super_len := 8 + 65536)[:8 + 65536])
+        offset = sb.block_size
+        version = sb.version
+        while offset + t.NEEDLE_HEADER_SIZE <= size:
+            f.seek(offset)
+            header = f.read(t.NEEDLE_HEADER_SIZE)
+            if len(header) < t.NEEDLE_HEADER_SIZE:
+                break
+            n = Needle.parse_header(header)
+            if n.size < 0:
+                break
+            record_len = t.get_actual_size(n.size, version)
+            f.seek(offset)
+            blob = f.read(record_len)
+            if len(blob) < record_len:
+                break
+            try:
+                needle = Needle.from_bytes(blob, n.size, version,
+                                           check_crc=check_crc)
+            except Exception:
+                break
+            yield offset, needle
+            offset += record_len
+
+
+def fix_volume(base_path: str) -> int:
+    """Rebuild <base>.idx from <base>.dat (reference command/fix.go:62).
+    Returns number of live entries written."""
+    from seaweedfs_tpu.storage.needle_map import MemDb
+    db = MemDb()
+    for offset, n in scan_volume_file(base_path + ".dat"):
+        if n.size > 0:
+            db.set(n.id, t.actual_to_offset(offset), n.size)
+        else:
+            db.delete(n.id)
+    db.save_to_idx(base_path + ".idx")
+    return len(db)
+
+
+def export_volume(base_path: str, out_dir: str,
+                  name_fn: Optional[Callable[[Needle], str]] = None) -> int:
+    """Dump live needles as individual files (reference command/export.go).
+    Returns file count."""
+    from seaweedfs_tpu.storage.needle_map import MemDb
+    os.makedirs(out_dir, exist_ok=True)
+    live = MemDb.load_from_idx(base_path + ".idx") \
+        if os.path.exists(base_path + ".idx") else None
+    count = 0
+    for offset, n in scan_volume_file(base_path + ".dat"):
+        if n.size <= 0:
+            continue
+        if live is not None:
+            hit = live.get(n.id)
+            if hit is None or t.offset_to_actual(hit[0]) != offset:
+                continue  # overwritten or deleted
+        name = (name_fn(n) if name_fn else None) or \
+            (n.name.decode(errors="replace") if n.name else f"{n.id:x}")
+        safe = name.replace("/", "_") or f"{n.id:x}"
+        data = n.data
+        if n.is_compressed:
+            import gzip
+            try:
+                data = gzip.decompress(data)
+            except OSError:
+                pass
+        with open(os.path.join(out_dir, safe), "wb") as f:
+            f.write(data)
+        count += 1
+    return count
+
+
+def backup_volume(master_url: str, vid: int, out_dir: str,
+                  collection: str = "") -> str:
+    """Pull a volume's .dat/.idx from whichever server has it
+    (reference command/backup.go). Returns the local base path."""
+    from seaweedfs_tpu.utils.httpd import http_call, http_json
+    os.makedirs(out_dir, exist_ok=True)
+    locs = http_json(
+        "GET", f"http://{master_url}/dir/lookup?volumeId={vid}")
+    if not locs.get("locations"):
+        raise LookupError(f"volume {vid} has no locations")
+    url = locs["locations"][0]["url"]
+    name = f"{collection}_{vid}" if collection else str(vid)
+    base = os.path.join(out_dir, name)
+    for ext in (".dat", ".idx"):
+        status, body, _ = http_call(
+            "GET", f"http://{url}/admin/volume_file?volumeId={vid}"
+            f"&ext={ext}&collection={collection}", timeout=600)
+        if status >= 400:
+            raise IOError(f"backup {ext}: HTTP {status}")
+        with open(base + ext, "wb") as f:
+            f.write(body)
+    return base
+
+
+def compact_volume(base_path: str) -> tuple[int, int]:
+    """Offline vacuum (reference command/compact.go): open the volume in
+    place and compact. Returns (before_bytes, after_bytes)."""
+    from seaweedfs_tpu.storage.volume import Volume
+    directory, name = os.path.split(base_path)
+    if "_" in name:
+        collection, vid = name.rsplit("_", 1)
+    else:
+        collection, vid = "", name
+    v = Volume(directory, collection, int(vid))
+    before = v.content_size()
+    v.compact()
+    after = v.content_size()
+    v.close()
+    return before, after

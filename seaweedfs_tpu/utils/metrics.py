@@ -1,0 +1,142 @@
+"""Prometheus-style metrics registry + text exposition.
+
+Functional equivalent of reference weed/stats/metrics.go (Namespace
+"SeaweedFS", per-subsystem counters/gauges/histograms exposed on
+/metrics). Stdlib-only implementation of the text format.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Optional
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, label_names: tuple = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, *labels, amount: float = 1.0):
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + amount
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        for labels, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, labels)} {v}")
+        return out
+
+
+class Gauge(Counter):
+    def set(self, *labels, value: float = 0.0):
+        with self._lock:
+            self._values[labels] = value
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        for labels, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, labels)} {v}")
+        return out
+
+
+class Histogram:
+    DEFAULT_BUCKETS = (0.0001, 0.001, 0.01, 0.1, 1, 10)
+
+    def __init__(self, name: str, help_: str, label_names: tuple = (),
+                 buckets: tuple = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self.buckets = sorted(buckets)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, *labels):
+        with self._lock:
+            counts = self._counts.setdefault(
+                labels, [0] * (len(self.buckets) + 1))
+            counts[bisect.bisect_left(self.buckets, value)] += 1
+            self._sums[labels] = self._sums.get(labels, 0.0) + value
+
+    def time(self, *labels):
+        return _Timer(self, labels)
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        for labels, counts in sorted(self._counts.items()):
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += counts[i]
+                lbl = _fmt_labels(self.label_names + ("le",),
+                                  labels + (str(b),))
+                out.append(f"{self.name}_bucket{lbl} {cum}")
+            cum += counts[-1]
+            lbl = _fmt_labels(self.label_names + ("le",), labels + ("+Inf",))
+            out.append(f"{self.name}_bucket{lbl} {cum}")
+            base = _fmt_labels(self.label_names, labels)
+            out.append(f"{self.name}_sum{base} {self._sums[labels]}")
+            out.append(f"{self.name}_count{base} {cum}")
+        return out
+
+
+class _Timer:
+    def __init__(self, hist, labels):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0, *self.labels)
+
+
+def _fmt_labels(names: tuple, values: tuple) -> str:
+    if not names:
+        return ""
+    pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + pairs + "}"
+
+
+class Registry:
+    def __init__(self, namespace: str = "SeaweedFS_TPU"):
+        self.namespace = namespace
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def counter(self, subsystem: str, name: str, help_: str,
+                labels: tuple = ()) -> Counter:
+        return self._add(Counter(
+            f"{self.namespace}_{subsystem}_{name}", help_, labels))
+
+    def gauge(self, subsystem: str, name: str, help_: str,
+              labels: tuple = ()) -> Gauge:
+        return self._add(Gauge(
+            f"{self.namespace}_{subsystem}_{name}", help_, labels))
+
+    def histogram(self, subsystem: str, name: str, help_: str,
+                  labels: tuple = ()) -> Histogram:
+        return self._add(Histogram(
+            f"{self.namespace}_{subsystem}_{name}", help_, labels))
+
+    def _add(self, m):
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def expose_text(self) -> str:
+        lines = []
+        with self._lock:
+            for m in self._metrics:
+                lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
