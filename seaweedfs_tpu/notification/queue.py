@@ -1,0 +1,81 @@
+"""Notification queue SPI: publish filer meta events to a message queue.
+
+Functional equivalent of reference weed/notification (kafka/aws_sqs/
+gcp_pub_sub/gocdk/log backends behind a MessageQueue interface). The
+cloud SDKs aren't available here, so we ship the SPI plus in-memory,
+log, and JSONL-file queues; external-broker backends implement the same
+two methods.
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import queue
+import threading
+from typing import Optional
+
+
+class MessageQueue(abc.ABC):
+    name = "abstract"
+
+    @abc.abstractmethod
+    def send_message(self, key: str, message: dict) -> None: ...
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryQueue(MessageQueue):
+    name = "memory"
+
+    def __init__(self, maxsize: int = 65536):
+        self.q: queue.Queue = queue.Queue(maxsize)
+
+    def send_message(self, key: str, message: dict) -> None:
+        self.q.put((key, message))
+
+    def receive(self, timeout: Optional[float] = None):
+        return self.q.get(timeout=timeout)
+
+
+class LogQueue(MessageQueue):
+    """Log-only backend (reference notification/log)."""
+
+    name = "log"
+
+    def __init__(self, logger=None):
+        import logging
+        self.logger = logger or logging.getLogger("seaweedfs_tpu.notify")
+
+    def send_message(self, key: str, message: dict) -> None:
+        self.logger.info("notification %s: %s", key, json.dumps(message))
+
+
+class FileQueue(MessageQueue):
+    """Durable JSONL file queue."""
+
+    name = "file"
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def send_message(self, key: str, message: dict) -> None:
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(json.dumps({"key": key, "message": message}) + "\n")
+
+
+def attach_to_filer(filer, mq: MessageQueue) -> None:
+    """Forward every filer meta event to the queue (the reference wires
+    this inside Filer.NotifyUpdateEvent)."""
+    original = filer._notify
+
+    def notify(directory, old_entry, new_entry):
+        original(directory, old_entry, new_entry)
+        path = (new_entry or old_entry or {}).get("full_path", directory)
+        mq.send_message(path, {"directory": directory,
+                               "old_entry": old_entry,
+                               "new_entry": new_entry})
+    filer._notify = notify

@@ -1,0 +1,168 @@
+"""Replication sinks + the Replicator.
+
+Functional equivalent of reference weed/replication: a ReplicationSink
+receives filer meta events (create/update/delete) and applies them to a
+destination — another filer, a local directory, or a cloud bucket. The
+reference ships filer/s3/gcs/azure/b2/local sinks (sink SPI at
+replication/sink/replication_sink.go); we ship the SPI plus filer, local,
+and s3 sinks (the s3 sink points at any S3 endpoint, including our own
+gateway).
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import urllib.parse
+from typing import Optional
+
+
+class ReplicationSink(abc.ABC):
+    name = "abstract"
+
+    @abc.abstractmethod
+    def create_entry(self, path: str, entry: dict, data: Optional[bytes]) -> None: ...
+
+    @abc.abstractmethod
+    def delete_entry(self, path: str, is_directory: bool) -> None: ...
+
+    def update_entry(self, path: str, entry: dict,
+                     data: Optional[bytes]) -> None:
+        self.create_entry(path, entry, data)
+
+
+class FilerSink(ReplicationSink):
+    """Replicate into another filer over HTTP."""
+
+    name = "filer"
+
+    def __init__(self, filer_url: str, path_prefix: str = "/"):
+        self.filer_url = filer_url
+        self.path_prefix = path_prefix.rstrip("/")
+
+    def _url(self, path: str) -> str:
+        return (f"http://{self.filer_url}{self.path_prefix}"
+                f"{urllib.parse.quote(path)}")
+
+    def create_entry(self, path: str, entry: dict,
+                     data: Optional[bytes]) -> None:
+        from seaweedfs_tpu.utils.httpd import http_call
+        attr = entry.get("attr", {})
+        if attr.get("is_directory"):
+            http_call("POST", self._url(path) + "?mkdir=true", body=b"")
+            return
+        http_call("POST", self._url(path), body=data or b"")
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        from seaweedfs_tpu.utils.httpd import http_call
+        url = self._url(path)
+        if is_directory:
+            url += "?recursive=true"
+        http_call("DELETE", url)
+
+
+class LocalSink(ReplicationSink):
+    """Replicate into a local directory (reference sink/localsink)."""
+
+    name = "local"
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, path: str) -> str:
+        return os.path.join(self.root, path.lstrip("/"))
+
+    def create_entry(self, path: str, entry: dict,
+                     data: Optional[bytes]) -> None:
+        p = self._path(path)
+        if entry.get("attr", {}).get("is_directory"):
+            os.makedirs(p, exist_ok=True)
+            return
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "wb") as f:
+            f.write(data or b"")
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        p = self._path(path)
+        try:
+            if is_directory:
+                import shutil
+                shutil.rmtree(p)
+            else:
+                os.remove(p)
+        except FileNotFoundError:
+            pass
+
+
+class S3Sink(ReplicationSink):
+    """Replicate objects into an S3-compatible bucket."""
+
+    name = "s3"
+
+    def __init__(self, endpoint: str, bucket: str, prefix: str = ""):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+
+    def _url(self, path: str) -> str:
+        key = (self.prefix + "/" if self.prefix else "") + path.lstrip("/")
+        return f"{self.endpoint}/{self.bucket}/{urllib.parse.quote(key)}"
+
+    def create_entry(self, path: str, entry: dict,
+                     data: Optional[bytes]) -> None:
+        if entry.get("attr", {}).get("is_directory"):
+            return
+        from seaweedfs_tpu.utils.httpd import http_call
+        http_call("PUT", self._url(path), body=data or b"")
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        if is_directory:
+            return
+        from seaweedfs_tpu.utils.httpd import http_call
+        http_call("DELETE", self._url(path))
+
+
+class Replicator:
+    """Apply a stream of filer meta events to a sink
+    (reference replication/replicator.go)."""
+
+    def __init__(self, sink: ReplicationSink, source_filer_url: str,
+                 path_prefix: str = "/"):
+        self.sink = sink
+        self.source_filer_url = source_filer_url
+        self.path_prefix = path_prefix.rstrip("/") or "/"
+
+    def _in_scope(self, path: str) -> bool:
+        return path.startswith(self.path_prefix)
+
+    def _fetch(self, path: str) -> Optional[bytes]:
+        from seaweedfs_tpu.utils.httpd import http_call
+        try:
+            status, body, _ = http_call(
+                "GET",
+                f"http://{self.source_filer_url}{urllib.parse.quote(path)}")
+        except ConnectionError:
+            return None
+        return body if status == 200 else None
+
+    def apply_event(self, event: dict) -> None:
+        old, new = event.get("old_entry"), event.get("new_entry")
+        if new is not None:
+            path = new["full_path"]
+            if not self._in_scope(path):
+                return
+            if old is not None and old["full_path"] != path:
+                self.sink.delete_entry(
+                    old["full_path"],
+                    old.get("attr", {}).get("is_directory", False))
+            data = None
+            if not new.get("attr", {}).get("is_directory"):
+                data = self._fetch(path)
+            self.sink.create_entry(path, new, data)
+        elif old is not None:
+            path = old["full_path"]
+            if not self._in_scope(path):
+                return
+            self.sink.delete_entry(
+                path, old.get("attr", {}).get("is_directory", False))

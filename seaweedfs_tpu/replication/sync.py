@@ -1,0 +1,115 @@
+"""filer.sync / filer.meta.tail / filer.meta.backup — meta-event consumers.
+
+Functional equivalents of reference weed/command/filer_sync.go,
+filer_meta_tail.go, filer_meta_backup.go: subscribe to a filer's metadata
+change stream (our /__api/meta_events long-poll) and apply/print/persist
+the events.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Optional
+
+from seaweedfs_tpu.replication.sink import Replicator, ReplicationSink
+from seaweedfs_tpu.utils.httpd import HttpError, http_json
+
+
+def subscribe_meta_events(filer_url: str, since_ns: int = 0,
+                          path_prefix: str = "/",
+                          poll_wait: float = 5.0):
+    """Generator of meta events from a filer, resuming from since_ns."""
+    while True:
+        try:
+            out = http_json(
+                "GET",
+                f"http://{filer_url}/__api/meta_events?since_ns={since_ns}"
+                f"&prefix={path_prefix}&wait={poll_wait}",
+                timeout=poll_wait + 30)
+        except (ConnectionError, HttpError):
+            time.sleep(1.0)
+            continue
+        events = out.get("events", [])
+        if not events:
+            yield None  # idle tick (lets callers stop cleanly)
+            continue
+        for ev in events:
+            since_ns = max(since_ns, ev["tsns"])
+            yield ev
+
+
+class FilerSync:
+    """Continuous one-way sync source-filer -> sink
+    (half of the reference's bidirectional filer.sync)."""
+
+    def __init__(self, source_filer_url: str, sink: ReplicationSink,
+                 path_prefix: str = "/"):
+        self.source = source_filer_url
+        self.replicator = Replicator(sink, source_filer_url, path_prefix)
+        self.path_prefix = path_prefix
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.applied = 0
+
+    def run_once(self, since_ns: int = 0) -> int:
+        """Apply all currently-available events; returns last tsns."""
+        out = http_json(
+            "GET",
+            f"http://{self.source}/__api/meta_events?since_ns={since_ns}"
+            f"&prefix={self.path_prefix}")
+        last = since_ns
+        for ev in out.get("events", []):
+            self.replicator.apply_event(ev)
+            self.applied += 1
+            last = max(last, ev["tsns"])
+        return last
+
+    def start(self, since_ns: int = 0) -> None:
+        def loop():
+            cursor = since_ns
+            while not self._stop.is_set():
+                try:
+                    cursor = self.run_once(cursor)
+                except (ConnectionError, HttpError):
+                    pass
+                self._stop.wait(0.2)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def meta_tail(filer_url: str, path_prefix: str = "/", since_ns: int = 0,
+              emit: Callable[[dict], None] = None,
+              max_events: Optional[int] = None) -> int:
+    """Print (or hand to `emit`) meta events as they happen
+    (reference filer_meta_tail.go). Returns events seen."""
+    emit = emit or (lambda ev: print(json.dumps(ev)))
+    seen = 0
+    for ev in subscribe_meta_events(filer_url, since_ns, path_prefix):
+        if ev is None:
+            if max_events is not None:
+                break
+            continue
+        emit(ev)
+        seen += 1
+        if max_events is not None and seen >= max_events:
+            break
+    return seen
+
+
+def meta_backup(filer_url: str, backup_path: str, path_prefix: str = "/",
+                since_ns: int = 0, max_events: Optional[int] = None) -> int:
+    """Append meta events to a JSONL file (reference filer_meta_backup.go
+    with the file 'store')."""
+    count = 0
+    with open(backup_path, "a") as f:
+        def emit(ev):
+            f.write(json.dumps(ev) + "\n")
+        count = meta_tail(filer_url, path_prefix, since_ns, emit, max_events)
+    return count
