@@ -1,0 +1,179 @@
+"""WebDAV gateway over the filer (reference weed/server/webdav_server.go,
+which wraps golang.org/x/net/webdav; we implement the protocol subset
+directly: OPTIONS, PROPFIND depth 0/1, GET/HEAD, PUT, DELETE, MKCOL,
+MOVE, COPY, and no-op LOCK/UNLOCK for client compatibility)."""
+
+from __future__ import annotations
+
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.utils.httpd import HttpServer, Request, Response
+
+DAV_NS = "DAV:"
+
+
+class WebDavServer:
+    def __init__(self, filer_server, host: str = "127.0.0.1", port: int = 0,
+                 root: str = "/"):
+        self.fs = filer_server
+        self.filer: Filer = filer_server.filer
+        self.root = "/" + root.strip("/") if root.strip("/") else ""
+        self.http = HttpServer(host, port)
+        for m in ("OPTIONS", "PROPFIND", "GET", "HEAD", "PUT", "DELETE",
+                  "MKCOL", "MOVE", "COPY", "LOCK", "UNLOCK", "PROPPATCH"):
+            self.http.add(m, "/.*", self._dispatch)
+
+    def start(self) -> None:
+        self.http.start()
+
+    def stop(self) -> None:
+        self.http.stop()
+
+    @property
+    def url(self) -> str:
+        return f"{self.http.host}:{self.http.port}"
+
+    # ---- dispatch ----
+    def _fpath(self, url_path: str) -> str:
+        p = urllib.parse.unquote(url_path).rstrip("/") or "/"
+        return (self.root + p).rstrip("/") or "/"
+
+    def _dispatch(self, req: Request) -> Response:
+        m = req.method
+        if m == "OPTIONS":
+            return Response(b"", headers={
+                "DAV": "1,2", "MS-Author-Via": "DAV",
+                "Allow": "OPTIONS,PROPFIND,GET,HEAD,PUT,DELETE,MKCOL,"
+                         "MOVE,COPY,LOCK,UNLOCK"})
+        if m == "PROPFIND":
+            return self._propfind(req)
+        if m in ("GET", "HEAD"):
+            return self._get(req, head=(m == "HEAD"))
+        if m == "PUT":
+            return self._put(req)
+        if m == "DELETE":
+            return self._delete(req)
+        if m == "MKCOL":
+            self.filer.mkdirs(self._fpath(req.path))
+            return Response(b"", status=201)
+        if m in ("MOVE", "COPY"):
+            return self._move_copy(req, copy=(m == "COPY"))
+        if m in ("LOCK", "UNLOCK", "PROPPATCH"):
+            # advertise success; we don't enforce locks
+            if m == "LOCK":
+                tok = "opaquelocktoken:seaweedfs-tpu"
+                body = (f'<?xml version="1.0"?><D:prop xmlns:D="DAV:">'
+                        f'<D:lockdiscovery><D:activelock><D:locktoken>'
+                        f'<D:href>{tok}</D:href></D:locktoken>'
+                        f'</D:activelock></D:lockdiscovery></D:prop>')
+                return Response(body.encode(), status=200,
+                                content_type="application/xml",
+                                headers={"Lock-Token": f"<{tok}>"})
+            return Response(b"", status=204)
+        return Response(b"", status=405)
+
+    # ---- handlers ----
+    def _propfind(self, req: Request) -> Response:
+        path = self._fpath(req.path)
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return Response(b"", status=404)
+        depth = req.headers.get("Depth", "1")
+        items = [(req.path.rstrip("/") or "/", entry)]
+        if entry.is_directory and depth != "0":
+            for child in self.filer.list_entries(path):
+                href = (req.path.rstrip("/") or "") + "/" + child.name
+                items.append((href, child))
+        ET.register_namespace("D", DAV_NS)
+        ms = ET.Element(f"{{{DAV_NS}}}multistatus")
+        for href, e in items:
+            r = ET.SubElement(ms, f"{{{DAV_NS}}}response")
+            ET.SubElement(r, f"{{{DAV_NS}}}href").text = \
+                urllib.parse.quote(href + ("/" if e.is_directory else ""))
+            ps = ET.SubElement(r, f"{{{DAV_NS}}}propstat")
+            prop = ET.SubElement(ps, f"{{{DAV_NS}}}prop")
+            rt = ET.SubElement(prop, f"{{{DAV_NS}}}resourcetype")
+            if e.is_directory:
+                ET.SubElement(rt, f"{{{DAV_NS}}}collection")
+            else:
+                ET.SubElement(
+                    prop, f"{{{DAV_NS}}}getcontentlength").text = \
+                    str(e.file_size())
+                ET.SubElement(
+                    prop, f"{{{DAV_NS}}}getcontenttype").text = \
+                    e.attr.mime or "application/octet-stream"
+            ET.SubElement(prop, f"{{{DAV_NS}}}getlastmodified").text = \
+                time.strftime("%a, %d %b %Y %H:%M:%S GMT",
+                              time.gmtime(e.attr.mtime))
+            ET.SubElement(ps, f"{{{DAV_NS}}}status").text = \
+                "HTTP/1.1 200 OK"
+        body = (b'<?xml version="1.0" encoding="utf-8"?>'
+                + ET.tostring(ms))
+        return Response(body, status=207, content_type="application/xml")
+
+    def _get(self, req: Request, head: bool) -> Response:
+        path = self._fpath(req.path)
+        entry = self.filer.find_entry(path)
+        if entry is None or entry.is_directory:
+            return Response(b"", status=404)
+        data = b"" if head else self.fs._read_entry_bytes(entry)
+        return Response(data, content_type=entry.attr.mime
+                        or "application/octet-stream")
+
+    def _put(self, req: Request) -> Response:
+        path = self._fpath(req.path)
+        from seaweedfs_tpu.filer.entry import Attr
+        now = time.time()
+        entry = Entry(full_path=path,
+                      attr=Attr(mtime=now, crtime=now,
+                                mime=req.headers.get("Content-Type", ""),
+                                file_size=len(req.body)))
+        if len(req.body) <= 2048:
+            entry.content = req.body
+        else:
+            entry.chunks = self.fs._upload_chunks(req.body, "", "")
+        try:
+            self.filer.create_entry(entry)
+        except IsADirectoryError:
+            return Response(b"", status=409)
+        return Response(b"", status=201)
+
+    def _delete(self, req: Request) -> Response:
+        try:
+            self.filer.delete_entry(self._fpath(req.path), recursive=True)
+        except FileNotFoundError:
+            return Response(b"", status=404)
+        return Response(b"", status=204)
+
+    def _move_copy(self, req: Request, copy: bool) -> Response:
+        dest = req.headers.get("Destination", "")
+        if not dest:
+            return Response(b"", status=400)
+        dest_path = self._fpath(urllib.parse.urlparse(dest).path)
+        src_path = self._fpath(req.path)
+        entry = self.filer.find_entry(src_path)
+        if entry is None:
+            return Response(b"", status=404)
+        if copy:
+            if entry.is_directory:
+                return Response(b"", status=501)
+            data = self.fs._read_entry_bytes(entry)
+            from seaweedfs_tpu.filer.entry import Attr
+            now = time.time()
+            new = Entry(full_path=dest_path,
+                        attr=Attr(mtime=now, crtime=now,
+                                  mime=entry.attr.mime,
+                                  file_size=len(data)))
+            if len(data) <= 2048:
+                new.content = data
+            else:
+                new.chunks = self.fs._upload_chunks(data, "", "")
+            self.filer.create_entry(new)
+        else:
+            self.filer.rename_entry(src_path, dest_path)
+        return Response(b"", status=201)

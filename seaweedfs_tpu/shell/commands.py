@@ -97,6 +97,49 @@ class ShellContext:
                             compacted.append(v["id"])
         return compacted
 
+    def volume_move(self, vid: int, source: str, target: str,
+                    collection: str = "") -> None:
+        """Move a volume: copy to target then delete on source
+        (reference shell `volume.move`)."""
+        self._vs(target, "/admin/copy_volume",
+                 {"volume_id": vid, "collection": collection,
+                  "source_data_node": source})
+        self._vs(source, "/admin/delete_volume", {"volume_id": vid})
+
+    def volume_balance(self, apply: bool = True) -> list[dict]:
+        """Even volume counts across nodes (reference
+        command_volume_balance.go, simplified to count balancing)."""
+        topo = self.topology()
+        nodes = []
+        for dc in topo.get("data_centers", []):
+            for rack in dc.get("racks", []):
+                for n in rack.get("nodes", []):
+                    nodes.append(n)
+        if not nodes:
+            return []
+        total = sum(len(n.get("volumes", [])) for n in nodes)
+        avg = total / len(nodes)
+        moves = []
+        donors = sorted(nodes, key=lambda n: -len(n.get("volumes", [])))
+        receivers = sorted(nodes, key=lambda n: len(n.get("volumes", [])))
+        for donor in donors:
+            vols = list(donor.get("volumes", []))
+            while len(vols) > avg + 0.5:
+                target = receivers[0]
+                if len(target.get("volumes", [])) >= avg:
+                    break
+                v = vols.pop()
+                moves.append({"vid": v["id"], "source": donor["id"],
+                              "target": target["id"],
+                              "collection": v.get("collection", "")})
+                target.setdefault("volumes", []).append(v)
+                receivers.sort(key=lambda n: len(n.get("volumes", [])))
+        if apply:
+            for mv in moves:
+                self.volume_move(mv["vid"], mv["source"], mv["target"],
+                                 mv["collection"])
+        return moves
+
     # ---- ec.encode (reference command_ec_encode.go doEcEncode) ----
     def ec_encode(self, vid: Optional[int] = None, collection: str = "",
                   delete_source: bool = True) -> list[dict]:

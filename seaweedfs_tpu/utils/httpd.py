@@ -121,6 +121,9 @@ class HttpServer:
                     pass
 
             do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
+            # WebDAV verbs
+            do_OPTIONS = do_PROPFIND = do_PROPPATCH = _dispatch
+            do_MKCOL = do_MOVE = do_COPY = do_LOCK = do_UNLOCK = _dispatch
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self._httpd.daemon_threads = True

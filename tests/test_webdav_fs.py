@@ -1,0 +1,121 @@
+"""WebDAV gateway + fs shell commands + filer.copy."""
+
+import time
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.gateway.webdav_server import WebDavServer
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.fs_commands import (FsContext, filer_copy,
+                                             filer_download)
+from seaweedfs_tpu.utils.httpd import http_call
+
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer()
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    dav = WebDavServer(fs)
+    dav.start()
+    time.sleep(0.1)
+    yield master, vs, fs, dav, tmp_path
+    dav.stop()
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def _dav(method, url, body=None, headers=None):
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def test_webdav_lifecycle(stack):
+    master, vs, fs, dav, tmp = stack
+    base = f"http://{dav.url}"
+
+    status, _, headers = _dav("OPTIONS", base + "/")
+    assert status == 200 and "PROPFIND" in headers["Allow"]
+
+    status, _, _ = _dav("MKCOL", base + "/www")
+    assert status == 201
+
+    status, _, _ = _dav("PUT", base + "/www/index.html",
+                        body=b"<html>hi</html>",
+                        headers={"Content-Type": "text/html"})
+    assert status == 201
+
+    status, body, _ = _dav("GET", base + "/www/index.html")
+    assert status == 200 and body == b"<html>hi</html>"
+
+    status, body, _ = _dav("PROPFIND", base + "/www",
+                           headers={"Depth": "1"})
+    assert status == 207
+    ms = ET.fromstring(body)
+    hrefs = [h.text for h in ms.iter("{DAV:}href")]
+    assert any("index.html" in h for h in hrefs)
+    lengths = [c.text for c in ms.iter("{DAV:}getcontentlength")]
+    assert "15" in lengths
+
+    status, _, _ = _dav("MOVE", base + "/www/index.html",
+                        headers={"Destination": base + "/www/home.html"})
+    assert status == 201
+    status, body, _ = _dav("GET", base + "/www/home.html")
+    assert status == 200 and body == b"<html>hi</html>"
+    assert _dav("GET", base + "/www/index.html")[0] == 404
+
+    status, _, _ = _dav("COPY", base + "/www/home.html",
+                        headers={"Destination": base + "/www/copy.html"})
+    assert status == 201
+    assert _dav("GET", base + "/www/copy.html")[1] == b"<html>hi</html>"
+
+    status, _, _ = _dav("DELETE", base + "/www/home.html")
+    assert status == 204
+    assert _dav("GET", base + "/www/home.html")[0] == 404
+
+
+def test_fs_commands_and_filer_copy(stack):
+    master, vs, fs, dav, tmp = stack
+    ctx = FsContext(fs.url)
+
+    src = tmp / "local"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.txt").write_bytes(b"AAA")
+    (src / "sub" / "b.txt").write_bytes(b"BBB" * 2000)
+    copied = filer_copy(fs.url, [str(src)], "/import")
+    assert copied == 2
+
+    assert ctx.cat("/import/local/a.txt") == b"AAA"
+    assert ctx.cat("/import/local/sub/b.txt") == b"BBB" * 2000
+    names = sorted(e["FullPath"] for e in ctx.ls("/import/local"))
+    assert names == ["/import/local/a.txt", "/import/local/sub"]
+
+    files, size = ctx.du("/import")
+    assert files == 2 and size == 3 + 6000
+
+    tree = ctx.tree("/import")
+    assert any("b.txt" in line for line in tree)
+
+    out = tmp / "download"
+    n = filer_download(fs.url, "/import/local", str(out))
+    assert n == 2
+    assert (out / "sub" / "b.txt").read_bytes() == b"BBB" * 2000
+
+    ctx.mv("/import/local/a.txt", "/import/renamed.txt")
+    assert ctx.cat("/import/renamed.txt") == b"AAA"
+    ctx.rm("/import", recursive=True)
+    with pytest.raises(FileNotFoundError):
+        ctx.cat("/import/renamed.txt")
