@@ -30,13 +30,27 @@ class MasterClient:
 
     def _call(self, method: str, path: str, body=None):
         last_err: Exception = RuntimeError("no masters")
-        for url in [self._leader] + [u for u in self.master_urls
-                                     if u != self._leader]:
+        candidates = [self._leader] + [u for u in self.master_urls
+                                       if u != self._leader]
+        for url in candidates:
             try:
                 out = http_json(method, f"http://{url}{path}", body)
                 self._leader = url
                 return out
-            except (ConnectionError, HttpError) as e:
+            except HttpError as e:
+                # follower redirect: {"error": "not leader", "leader": url}
+                if e.status == 409:
+                    import json as _json
+                    try:
+                        hint = _json.loads(e.body).get("leader")
+                    except Exception:
+                        hint = None
+                    if hint and hint not in candidates:
+                        candidates.append(hint)
+                    if hint:
+                        self._leader = hint
+                last_err = e
+            except ConnectionError as e:
                 last_err = e
         raise last_err
 

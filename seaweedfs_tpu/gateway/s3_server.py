@@ -49,6 +49,8 @@ class S3Server:
         self.filer: Filer = filer_server.filer
         self.access_key = access_key
         self.secret_key = secret_key
+        from seaweedfs_tpu.gateway.iam_server import IdentityStore
+        self._identities = IdentityStore(self.filer)
         self.http = HttpServer(host, port)
         self._register_routes()
 
@@ -70,9 +72,20 @@ class S3Server:
             r(m, r"/([^/]+)", self._bucket_dispatch)
             r(m, r"/([^/]+)/(.+)", self._object_dispatch)
 
-    # ---- auth (SigV4 subset) ----
+    # ---- auth (SigV4 subset; static key or IAM identities) ----
+    def _secret_for(self, access_key: str) -> Optional[str]:
+        if self.access_key and access_key == self.access_key:
+            return self.secret_key
+        ident = self._identities.find_by_access_key(access_key)
+        return ident["secretKey"] if ident else None
+
+    def _auth_required(self) -> bool:
+        if self.access_key:
+            return True
+        return bool(self._identities.load()["identities"])
+
     def _check_auth(self, req: Request) -> Optional[Response]:
-        if not self.access_key:
+        if not self._auth_required():
             return None  # anonymous allowed
         auth = req.headers.get("Authorization", "")
         if not auth.startswith("AWS4-HMAC-SHA256 "):
@@ -82,7 +95,8 @@ class S3Server:
                          for p in auth[len("AWS4-HMAC-SHA256 "):].split(","))
             cred = parts["Credential"].split("/")
             akey, date, region, service = cred[0], cred[1], cred[2], cred[3]
-            if akey != self.access_key:
+            secret = self._secret_for(akey)
+            if secret is None:
                 return _err("InvalidAccessKeyId", "unknown key", 403)
             signed_headers = parts["SignedHeaders"].split(";")
             # canonical request
@@ -103,7 +117,7 @@ class S3Server:
                 req.headers.get("x-amz-date", ""),
                 scope,
                 hashlib.sha256(creq.encode()).hexdigest()])
-            k = ("AWS4" + self.secret_key).encode()
+            k = ("AWS4" + secret).encode()
             for msg in (date, region, service, "aws4_request"):
                 k = hmac.new(k, msg.encode(), hashlib.sha256).digest()
             sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()

@@ -46,6 +46,8 @@ class FilerServer:
         self.filer = Filer(make_store(store, **kwargs),
                            delete_chunks_fn=self._delete_chunks)
         self.default_replication = default_replication
+        from seaweedfs_tpu.utils.chunk_cache import TieredChunkCache
+        self.chunk_cache = TieredChunkCache()
         self.http = HttpServer(host, port)
         self._register_routes()
 
@@ -156,16 +158,17 @@ class FilerServer:
         views = view_from_visibles(visibles, 0, size)
         out = bytearray(size)
         for view in views:
-            urls = self.mc.lookup_file_id(view.fid)
-            blob = None
-            for url in urls:
-                try:
-                    status, body, _ = http_call("GET", url)
-                except ConnectionError:
-                    continue
-                if status == 200:
-                    blob = body
-                    break
+            blob = self.chunk_cache.get(view.fid)
+            if blob is None:
+                for url in self.mc.lookup_file_id(view.fid):
+                    try:
+                        status, body, _ = http_call("GET", url)
+                    except ConnectionError:
+                        continue
+                    if status == 200:
+                        blob = body
+                        self.chunk_cache.put(view.fid, blob)
+                        break
             if blob is None:
                 raise HttpError(500, f"chunk {view.fid} unreachable".encode())
             piece = blob[view.offset_in_chunk:

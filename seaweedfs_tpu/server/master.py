@@ -59,6 +59,12 @@ class MasterServer:
         self._register_routes()
         self._stop = threading.Event()
         self._pruner: Optional[threading.Thread] = None
+        # ---- HA (lease/probe-based leader election) ----
+        # The reference runs raft (weed/server/raft_server.go); we elect the
+        # smallest-url alive peer by periodic probing — same leader-only
+        # write discipline, follower redirects via 409 {"leader": url}.
+        self.peers: list[str] = []
+        self._leader_url: Optional[str] = None
 
     # ---- lifecycle ----
     def start(self) -> None:
@@ -77,6 +83,41 @@ class MasterServer:
     def _prune_loop(self):
         while not self._stop.wait(self.topo.pulse_seconds):
             self.topo.prune_dead_nodes()
+            self._refresh_leader()
+
+    # ---- HA ----
+    def set_peers(self, peers: list[str]) -> None:
+        """Configure the master group (urls incl. self)."""
+        self.peers = sorted(set(peers) | {self.url})
+        self._refresh_leader()
+
+    def _refresh_leader(self) -> None:
+        if not self.peers:
+            self._leader_url = self.url
+            return
+        for peer in self.peers:  # sorted: smallest alive wins
+            if peer == self.url:
+                self._leader_url = self.url
+                return
+            try:
+                http_json("GET", f"http://{peer}/cluster/status",
+                          timeout=2)
+                self._leader_url = peer
+                return
+            except Exception:
+                continue
+        self._leader_url = self.url
+
+    @property
+    def leader(self) -> str:
+        return self._leader_url or self.url
+
+    def is_leader(self) -> bool:
+        return self.leader == self.url
+
+    def _not_leader(self) -> Response:
+        return Response({"error": "not leader", "leader": self.leader},
+                        status=409)
 
     # ---- routes ----
     def _register_routes(self) -> None:
@@ -98,6 +139,8 @@ class MasterServer:
                         content_type="text/plain; version=0.0.4")
 
     def _handle_heartbeat(self, req: Request) -> Response:
+        if not self.is_leader():
+            return self._not_leader()
         hb = req.json()
         self._m_heartbeat.inc()
         if hb.get("is_delta"):
@@ -117,6 +160,8 @@ class MasterServer:
         })
 
     def _handle_assign(self, req: Request) -> Response:
+        if not self.is_leader():
+            return self._not_leader()
         count = int(req.query.get("count") or 1)
         collection = req.query.get("collection", "")
         replication = (req.query.get("replication")
@@ -225,8 +270,9 @@ class MasterServer:
 
     def _handle_cluster_status(self, req: Request) -> Response:
         return Response({
-            "IsLeader": True,
-            "Leader": self.url,
+            "IsLeader": self.is_leader(),
+            "Leader": self.leader,
+            "Peers": self.peers,
             "MaxVolumeId": self.topo.max_volume_id,
         })
 

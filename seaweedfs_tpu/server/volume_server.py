@@ -37,13 +37,16 @@ PULSE_SECONDS = 2.0
 
 
 class VolumeServer:
-    def __init__(self, directories: list[str], master_url: str,
+    def __init__(self, directories: list[str], master_url: str | list,
                  host: str = "127.0.0.1", port: int = 0,
                  public_url: str = "", rack: str = "", data_center: str = "",
                  coder: Optional[ErasureCoder] = None,
                  max_volume_counts: Optional[list[int]] = None,
                  jwt_signing_key: str = ""):
-        self.master_url = master_url
+        urls = (master_url.split(",") if isinstance(master_url, str)
+                else list(master_url))
+        self.master_urls = [u.strip() for u in urls if u.strip()]
+        self.master_url = self.master_urls[0]
         self.http = HttpServer(host, port)
         self._store_dirs = directories
         self._max_volume_counts = max_volume_counts
@@ -99,8 +102,34 @@ class VolumeServer:
                 self.volume_size_limit = reply.get("volume_size_limit", 0)
                 if reply.get("jwt_signing_key") and not self.jwt_signing_key:
                     self.jwt_signing_key = reply["jwt_signing_key"]
-        except (ConnectionError, HttpError):
-            pass
+        except HttpError as e:
+            self._follow_leader_hint(e)
+        except ConnectionError:
+            self._fail_over()
+
+    def _follow_leader_hint(self, e: "HttpError") -> None:
+        """A follower replied 409 {"leader": url}: re-aim at the leader
+        (the reference restarts doHeartbeat at the new leader,
+        volume_grpc_client_to_master.go newLeader handling)."""
+        import json as _json
+        try:
+            body = _json.loads(e.body)
+        except Exception:
+            return
+        leader = body.get("leader")
+        if leader and leader != self.master_url:
+            self.master_url = leader
+
+    def _fail_over(self) -> None:
+        for url in self.master_urls:
+            if url == self.master_url:
+                continue
+            try:
+                http_json("GET", f"http://{url}/cluster/status", timeout=2)
+                self.master_url = url
+                return
+            except (ConnectionError, HttpError):
+                continue
 
     def _push_deltas(self) -> None:
         """Send pending volume/EC-shard deltas to the master immediately
@@ -116,9 +145,10 @@ class VolumeServer:
                       timeout=5)
         except HttpError as e:
             if e.status == 409:
+                self._follow_leader_hint(e)
                 self.heartbeat_once()
         except ConnectionError:
-            pass
+            self._fail_over()
 
     def _heartbeat_loop(self) -> None:
         ticks = 0
@@ -136,10 +166,12 @@ class VolumeServer:
                 else:
                     self.heartbeat_once()
             except HttpError as e:
-                if e.status == 409:  # master forgot us: full resync
+                if e.status == 409:  # new leader or master forgot us
+                    self._follow_leader_hint(e)
                     self.heartbeat_once()
             except ConnectionError:
-                pass
+                self._fail_over()
+                self.heartbeat_once()
 
     # ---- routes ----
     def _register_routes(self) -> None:
