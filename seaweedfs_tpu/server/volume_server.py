@@ -95,17 +95,25 @@ class VolumeServer:
     # ---- heartbeat (reference volume_grpc_client_to_master.go) ----
     def heartbeat_once(self) -> None:
         hb = self.store.collect_heartbeat()
-        try:
-            reply = http_json("POST", f"http://{self.master_url}/heartbeat",
-                              hb, timeout=5)
-            if reply:
-                self.volume_size_limit = reply.get("volume_size_limit", 0)
-                if reply.get("jwt_signing_key") and not self.jwt_signing_key:
-                    self.jwt_signing_key = reply["jwt_signing_key"]
-        except HttpError as e:
-            self._follow_leader_hint(e)
-        except ConnectionError:
-            self._fail_over()
+        for _attempt in range(2):  # second try after a leader redirect
+            try:
+                reply = http_json(
+                    "POST", f"http://{self.master_url}/heartbeat", hb,
+                    timeout=5)
+                if reply:
+                    self.volume_size_limit = reply.get(
+                        "volume_size_limit", 0)
+                    if reply.get("jwt_signing_key") \
+                            and not self.jwt_signing_key:
+                        self.jwt_signing_key = reply["jwt_signing_key"]
+                return
+            except HttpError as e:
+                old = self.master_url
+                self._follow_leader_hint(e)
+                if self.master_url == old:
+                    return
+            except ConnectionError:
+                self._fail_over()
 
     def _follow_leader_hint(self, e: "HttpError") -> None:
         """A follower replied 409 {"leader": url}: re-aim at the leader
