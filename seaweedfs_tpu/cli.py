@@ -138,6 +138,28 @@ def cmd_ec(args):
         sh.unlock()
 
 
+def cmd_mount(args):
+    """FUSE-mount a filer path (reference `weed mount`). Runs an embedded
+    filer client against the given master; the kernel protocol is served
+    in-process (seaweedfs_tpu/mount)."""
+    from seaweedfs_tpu.mount.fuse_kernel import FuseConnection
+    from seaweedfs_tpu.mount.weedfs import WeedFS
+    from seaweedfs_tpu.server.filer_server import FilerServer
+
+    # an embedded (HTTP-less) filer client: reuse FilerServer's chunk
+    # plumbing against the cluster, but without serving HTTP
+    fs = FilerServer(args.master, store=args.store)
+    w = WeedFS(fs)
+    conn = FuseConnection(w, args.mountpoint)
+    print(f"mounted seaweedfs-tpu at {args.mountpoint}")
+    try:
+        conn.serve_forever(background=False)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        conn.close()
+
+
 def cmd_fix(args):
     from seaweedfs_tpu.storage.maintenance import fix_volume
     live = fix_volume(args.base)
@@ -280,6 +302,12 @@ def main(argv=None):
     ec.add_argument("-volumeId", type=int, default=None)
     ec.add_argument("-collection", default=None)
     ec.set_defaults(fn=cmd_ec)
+
+    mt = sub.add_parser("mount")
+    mt.add_argument("-master", default="127.0.0.1:9333")
+    mt.add_argument("-store", default="memory")
+    mt.add_argument("mountpoint")
+    mt.set_defaults(fn=cmd_mount)
 
     fx = sub.add_parser("fix")
     fx.add_argument("base", help="volume base path (no extension)")

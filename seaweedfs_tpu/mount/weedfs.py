@@ -1,0 +1,280 @@
+"""WeedFS: the mount filesystem over the filer namespace.
+
+Functional equivalent of reference weed/mount/weedfs.go + inode_to_path.go:
+an inode<->path registry, attribute translation, and open-file write-back
+buffers that flush into the filer as chunked uploads on flush/release.
+Serves the Operations interface that fuse_kernel.FuseConnection dispatches
+into. Works against the Filer/FilerServer in process (the `weed-tpu mount`
+command connects one to a remote filer over HTTP using the same interface).
+"""
+
+from __future__ import annotations
+
+import errno
+import stat as statmod
+import threading
+import time
+from typing import Optional
+
+from seaweedfs_tpu.filer.entry import Attr, Entry
+from seaweedfs_tpu.mount.fuse_kernel import ROOT_ID, FileAttr
+
+
+class InodeToPath:
+    """Bidirectional inode<->path map (reference mount/inode_to_path.go)."""
+
+    def __init__(self):
+        self._path_to_inode: dict[str, int] = {"/": ROOT_ID}
+        self._inode_to_path: dict[int, str] = {ROOT_ID: "/"}
+        self._next = ROOT_ID + 1
+        self._lock = threading.Lock()
+
+    def lookup(self, path: str) -> int:
+        with self._lock:
+            ino = self._path_to_inode.get(path)
+            if ino is None:
+                ino = self._next
+                self._next += 1
+                self._path_to_inode[path] = ino
+                self._inode_to_path[ino] = path
+            return ino
+
+    def path(self, ino: int) -> Optional[str]:
+        return self._inode_to_path.get(ino)
+
+    def move(self, old: str, new: str) -> None:
+        with self._lock:
+            ino = self._path_to_inode.pop(old, None)
+            if ino is not None:
+                self._path_to_inode[new] = ino
+                self._inode_to_path[ino] = new
+
+    def forget(self, path: str) -> None:
+        with self._lock:
+            ino = self._path_to_inode.pop(path, None)
+            if ino is not None:
+                self._inode_to_path.pop(ino, None)
+
+
+class OpenFile:
+    """Write-back buffer for one open handle (the reference uses dirty
+    pages + an upload pipeline, mount/page_writer.go; we buffer the whole
+    file and flush on flush/release)."""
+
+    def __init__(self, path: str, data: bytearray, dirty: bool = False):
+        self.path = path
+        self.data = data
+        self.dirty = dirty
+        self.lock = threading.Lock()
+
+
+class WeedFS:
+    """Operations implementation over a filer."""
+
+    def __init__(self, filer_server):
+        self.fs = filer_server
+        self.filer = filer_server.filer
+        self.inodes = InodeToPath()
+        self._handles: dict[int, OpenFile] = {}
+        self._next_fh = 1
+        self._lock = threading.Lock()
+
+    # ---- helpers ----
+    def _entry_attr(self, entry: Entry) -> FileAttr:
+        ino = self.inodes.lookup(entry.full_path)
+        return FileAttr(ino=ino, size=entry.file_size(),
+                        mtime=entry.attr.mtime or time.time(),
+                        mode=(statmod.S_IFDIR | 0o755) if entry.is_directory
+                        else (statmod.S_IFREG | (entry.attr.mode & 0o777
+                                                 or 0o644)),
+                        is_dir=entry.is_directory,
+                        uid=entry.attr.uid, gid=entry.attr.gid)
+
+    def _child_path(self, parent_ino: int, name: str) -> Optional[str]:
+        parent = self.inodes.path(parent_ino)
+        if parent is None:
+            return None
+        return (parent.rstrip("/") + "/" + name) if parent != "/" \
+            else "/" + name
+
+    # ---- operations ----
+    def lookup(self, parent_ino: int, name: str) -> Optional[FileAttr]:
+        path = self._child_path(parent_ino, name)
+        if path is None:
+            return None
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return None
+        return self._entry_attr(entry)
+
+    def getattr(self, ino: int) -> Optional[FileAttr]:
+        path = self.inodes.path(ino)
+        if path is None:
+            return None
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return None
+        return self._entry_attr(entry)
+
+    def setattr(self, ino: int, valid: int, size: int, mode: int,
+                mtime: int, fh: int) -> Optional[FileAttr]:
+        path = self.inodes.path(ino)
+        if path is None:
+            return None
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return None
+        FATTR_SIZE = 1 << 3
+        if valid & FATTR_SIZE:
+            of = self._handles.get(fh)
+            if of is not None:
+                with of.lock:
+                    if size < len(of.data):
+                        del of.data[size:]
+                    else:
+                        of.data.extend(b"\x00" * (size - len(of.data)))
+                    of.dirty = True
+            else:
+                data = bytearray(self.fs._read_entry_bytes(entry))
+                if size < len(data):
+                    del data[size:]
+                else:
+                    data.extend(b"\x00" * (size - len(data)))
+                self._write_back(path, bytes(data), entry)
+                entry = self.filer.find_entry(path)
+        return self._entry_attr(entry)
+
+    def mkdir(self, parent_ino: int, name: str, mode: int) -> FileAttr:
+        path = self._child_path(parent_ino, name)
+        self.filer.mkdirs(path)
+        return self._entry_attr(self.filer.find_entry(path))
+
+    def unlink(self, parent_ino: int, name: str) -> int:
+        path = self._child_path(parent_ino, name)
+        try:
+            self.filer.delete_entry(path)
+        except FileNotFoundError:
+            return errno.ENOENT
+        except OSError:
+            return errno.ENOTEMPTY
+        self.inodes.forget(path)
+        return 0
+
+    def rmdir(self, parent_ino: int, name: str) -> int:
+        path = self._child_path(parent_ino, name)
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return errno.ENOENT
+        if not entry.is_directory:
+            return errno.ENOTDIR
+        try:
+            self.filer.delete_entry(path)
+        except OSError:
+            return errno.ENOTEMPTY
+        self.inodes.forget(path)
+        return 0
+
+    def rename(self, parent_ino: int, oldname: str, newdir_ino: int,
+               newname: str) -> int:
+        old = self._child_path(parent_ino, oldname)
+        new = self._child_path(newdir_ino, newname)
+        if old is None or new is None:
+            return errno.ENOENT
+        try:
+            self.filer.rename_entry(old, new)
+        except FileNotFoundError:
+            return errno.ENOENT
+        self.inodes.move(old, new)
+        return 0
+
+    def open(self, ino: int) -> Optional[int]:
+        path = self.inodes.path(ino)
+        if path is None:
+            return None
+        entry = self.filer.find_entry(path)
+        if entry is None or entry.is_directory:
+            return None
+        data = bytearray(self.fs._read_entry_bytes(entry))
+        with self._lock:
+            fh = self._next_fh
+            self._next_fh += 1
+            self._handles[fh] = OpenFile(path, data)
+        return fh
+
+    def create(self, parent_ino: int, name: str,
+               mode: int) -> tuple[FileAttr, int]:
+        path = self._child_path(parent_ino, name)
+        now = time.time()
+        entry = Entry(full_path=path,
+                      attr=Attr(mtime=now, crtime=now,
+                                mode=mode & 0o777, file_size=0))
+        self.filer.create_entry(entry)
+        with self._lock:
+            fh = self._next_fh
+            self._next_fh += 1
+            self._handles[fh] = OpenFile(path, bytearray(), dirty=True)
+        return self._entry_attr(entry), fh
+
+    def read(self, ino: int, fh: int, offset: int,
+             size: int) -> Optional[bytes]:
+        of = self._handles.get(fh)
+        if of is None:
+            return None
+        with of.lock:
+            return bytes(of.data[offset:offset + size])
+
+    def write(self, ino: int, fh: int, offset: int,
+              data: bytes) -> Optional[int]:
+        of = self._handles.get(fh)
+        if of is None:
+            return None
+        with of.lock:
+            if offset > len(of.data):
+                of.data.extend(b"\x00" * (offset - len(of.data)))
+            of.data[offset:offset + len(data)] = data
+            of.dirty = True
+        return len(data)
+
+    def flush(self, ino: int, fh: int) -> None:
+        of = self._handles.get(fh)
+        if of is None or not of.dirty:
+            return
+        with of.lock:
+            entry = self.filer.find_entry(of.path)
+            self._write_back(of.path, bytes(of.data), entry)
+            of.dirty = False
+
+    def release(self, ino: int, fh: int) -> None:
+        self.flush(ino, fh)
+        with self._lock:
+            self._handles.pop(fh, None)
+
+    def readdir(self, ino: int) -> list[tuple[str, FileAttr]]:
+        path = self.inodes.path(ino)
+        if path is None:
+            return []
+        out = [(".", FileAttr(ino=ino, is_dir=True, mode=statmod.S_IFDIR | 0o755)),
+               ("..", FileAttr(ino=ROOT_ID, is_dir=True,
+                               mode=statmod.S_IFDIR | 0o755))]
+        for e in self.filer.list_entries(path, limit=1 << 20):
+            out.append((e.name, self._entry_attr(e)))
+        return out
+
+    # ---- write-back ----
+    def _write_back(self, path: str, data: bytes,
+                    old_entry: Optional[Entry]) -> None:
+        now = time.time()
+        entry = Entry(full_path=path,
+                      attr=Attr(mtime=now,
+                                crtime=old_entry.attr.crtime
+                                if old_entry else now,
+                                mode=old_entry.attr.mode
+                                if old_entry else 0o644,
+                                mime=old_entry.attr.mime
+                                if old_entry else "",
+                                file_size=len(data)))
+        if len(data) <= 2048:
+            entry.content = data
+        else:
+            entry.chunks = self.fs._upload_chunks(data, "", "")
+        self.filer.create_entry(entry)
