@@ -1,0 +1,106 @@
+"""Message queue broker over the filer (reference weed/mq — embryonic
+there too: topics live under /topics, segments are filer files).
+
+Topics partition by key hash; publish appends JSONL records to the
+active segment file in the filer; subscribe replays segments then tails
+the filer meta log for new appends.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+TOPICS_ROOT = "/topics"
+SEGMENT_MAX_BYTES = 4 * 1024 * 1024
+
+
+class Broker:
+    def __init__(self, filer_server):
+        self.fs = filer_server
+        self.filer = filer_server.filer
+        self._lock = threading.Lock()
+        self._segments: dict[tuple[str, int], bytearray] = {}
+
+    # ---- publish ----
+    def create_topic(self, namespace: str, topic: str,
+                     partition_count: int = 4) -> None:
+        base = f"{TOPICS_ROOT}/{namespace}/{topic}"
+        self.filer.mkdirs(base)
+        from seaweedfs_tpu.filer.entry import Attr, Entry
+        conf = Entry(full_path=f"{base}/.conf",
+                     attr=Attr(mtime=time.time()),
+                     content=json.dumps(
+                         {"partition_count": partition_count}).encode())
+        self.filer.create_entry(conf)
+
+    def topic_conf(self, namespace: str, topic: str) -> dict:
+        e = self.filer.find_entry(
+            f"{TOPICS_ROOT}/{namespace}/{topic}/.conf")
+        if e is None:
+            raise LookupError(f"topic {namespace}/{topic} not found")
+        return json.loads(e.content)
+
+    def publish(self, namespace: str, topic: str, key: str,
+                value: dict | bytes | str) -> int:
+        conf = self.topic_conf(namespace, topic)
+        partition = int(hashlib.sha1(key.encode()).hexdigest(), 16) \
+            % conf["partition_count"]
+        if isinstance(value, bytes):
+            value = value.decode()
+        record = json.dumps({"ts": time.time_ns(), "key": key,
+                             "value": value}) + "\n"
+        with self._lock:
+            seg = self._segments.setdefault(
+                (f"{namespace}/{topic}", partition), bytearray())
+            seg += record.encode()
+            if len(seg) >= SEGMENT_MAX_BYTES:
+                self._flush_segment(namespace, topic, partition)
+        return partition
+
+    def _flush_segment(self, namespace: str, topic: str,
+                       partition: int) -> None:
+        key = (f"{namespace}/{topic}", partition)
+        seg = self._segments.pop(key, None)
+        if not seg:
+            return
+        from seaweedfs_tpu.filer.entry import Attr, Entry
+        path = (f"{TOPICS_ROOT}/{namespace}/{topic}/p{partition:02d}"
+                f"/{time.time_ns()}.seg")
+        entry = Entry(full_path=path,
+                      attr=Attr(mtime=time.time(), file_size=len(seg)))
+        if len(seg) <= 2048:
+            entry.content = bytes(seg)
+        else:
+            entry.chunks = self.fs._upload_chunks(bytes(seg), "", "")
+        self.filer.create_entry(entry)
+
+    def flush(self) -> None:
+        with self._lock:
+            for (nt, partition) in list(self._segments):
+                ns, topic = nt.split("/", 1)
+                self._flush_segment(ns, topic, partition)
+
+    # ---- subscribe ----
+    def read_topic(self, namespace: str, topic: str,
+                   partition: Optional[int] = None) -> Iterator[dict]:
+        """Replay all flushed segments (+ any in-memory tail) in order."""
+        conf = self.topic_conf(namespace, topic)
+        parts = [partition] if partition is not None \
+            else range(conf["partition_count"])
+        for p in parts:
+            pdir = f"{TOPICS_ROOT}/{namespace}/{topic}/p{p:02d}"
+            for seg_entry in self.filer.list_entries(pdir, limit=1 << 20):
+                data = self.fs._read_entry_bytes(seg_entry)
+                for line in data.decode().splitlines():
+                    if line:
+                        yield json.loads(line)
+            with self._lock:
+                tail = self._segments.get((f"{namespace}/{topic}", p))
+                if tail:
+                    for line in tail.decode().splitlines():
+                        if line:
+                            yield json.loads(line)

@@ -36,7 +36,8 @@ class MasterServer:
                  default_replication: str = "000",
                  garbage_threshold: float = 0.3,
                  jwt_signing_key: str = "",
-                 whitelist: Optional[list] = None):
+                 whitelist: Optional[list] = None,
+                 meta_dir: str = ""):
         self.topo = Topology(volume_size_limit=volume_size_limit_mb * 1024 * 1024)
         self.jwt_signing_key = jwt_signing_key
         from seaweedfs_tpu.utils.metrics import Registry
@@ -65,6 +66,10 @@ class MasterServer:
         # write discipline, follower redirects via 409 {"leader": url}.
         self.peers: list[str] = []
         self._leader_url: Optional[str] = None
+        # ---- durable state (reference checkpoints MaxVolumeId + sequence
+        # through raft snapshots, topology/cluster_commands.go) ----
+        self.meta_dir = meta_dir
+        self._load_state()
 
     # ---- lifecycle ----
     def start(self) -> None:
@@ -74,6 +79,7 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self._save_state()
         self.http.stop()
 
     @property
@@ -84,6 +90,37 @@ class MasterServer:
         while not self._stop.wait(self.topo.pulse_seconds):
             self.topo.prune_dead_nodes()
             self._refresh_leader()
+            self._save_state()
+
+    def _state_path(self) -> str:
+        import os
+        return os.path.join(self.meta_dir, "master_state.json")
+
+    def _load_state(self) -> None:
+        if not self.meta_dir:
+            return
+        import json, os
+        os.makedirs(self.meta_dir, exist_ok=True)
+        try:
+            with open(self._state_path()) as f:
+                st = json.load(f)
+            self.topo.max_volume_id = st.get("max_volume_id", 0)
+            self.sequencer.set_max(st.get("sequence", 0))
+        except (OSError, ValueError):
+            pass
+
+    def _save_state(self) -> None:
+        if not self.meta_dir:
+            return
+        import json, os
+        tmp = self._state_path() + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"max_volume_id": self.topo.max_volume_id,
+                           "sequence": self.sequencer.peek()}, f)
+            os.replace(tmp, self._state_path())
+        except OSError:
+            pass
 
     # ---- HA ----
     def set_peers(self, peers: list[str]) -> None:
@@ -133,10 +170,60 @@ class MasterServer:
         r("POST", "/admin/lock", self._handle_lock)
         r("POST", "/admin/unlock", self._handle_unlock)
         r("GET", "/metrics", self._handle_metrics)
+        r("GET", "/col/list", self._handle_col_list)
+        r("POST", "/col/delete", self._handle_col_delete)
+        r("GET", "/ui", self._handle_ui)
+        r("GET", "/", self._handle_ui)
 
     def _handle_metrics(self, req: Request) -> Response:
         return Response(self.metrics.expose_text(),
                         content_type="text/plain; version=0.0.4")
+
+    def _handle_col_list(self, req: Request) -> Response:
+        cols = sorted({c for (c, _, _) in self.topo.layouts if c})
+        return Response({"collections": [{"name": c} for c in cols]})
+
+    def _handle_col_delete(self, req: Request) -> Response:
+        collection = req.query.get("collection", "")
+        if not collection:
+            return Response({"error": "collection required"}, status=400)
+        deleted = []
+        with self.topo.lock:
+            doomed = []
+            for node in self.topo.all_nodes():
+                for vid, v in list(node.volumes.items()):
+                    if v.get("collection", "") == collection:
+                        doomed.append((node, vid, v))
+            for node, vid, v in doomed:
+                try:
+                    http_json("POST",
+                              f"http://{node.url}/admin/delete_volume",
+                              {"volume_id": vid}, timeout=30)
+                except Exception:
+                    pass
+                node.volumes.pop(vid, None)
+                self.topo._unregister_volume(v, node)
+                deleted.append(vid)
+        for key in [k for k in self.topo.layouts if k[0] == collection]:
+            del self.topo.layouts[key]
+        return Response({"deleted_volume_ids": sorted(set(deleted))})
+
+    def _handle_ui(self, req: Request) -> Response:
+        rows = []
+        for node in self.topo.all_nodes():
+            rows.append(
+                f"<tr><td>{node.id}</td><td>{len(node.volumes)}</td>"
+                f"<td>{node.ec_shard_count()}</td>"
+                f"<td>{node.max_volume_count}</td></tr>")
+        html = (
+            "<html><head><title>seaweedfs-tpu master</title></head><body>"
+            f"<h1>Master {self.url}</h1>"
+            f"<p>leader: {self.leader} | max volume id: "
+            f"{self.topo.max_volume_id}</p>"
+            "<table border=1><tr><th>node</th><th>volumes</th>"
+            "<th>ec shards</th><th>capacity</th></tr>"
+            + "".join(rows) + "</table></body></html>")
+        return Response(html, content_type="text/html")
 
     def _handle_heartbeat(self, req: Request) -> Response:
         if not self.is_leader():

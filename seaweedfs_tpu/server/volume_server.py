@@ -291,6 +291,16 @@ class VolumeServer:
                 n.data = _gz.decompress(n.data)
         if n.last_modified:
             headers["X-Last-Modified"] = str(n.last_modified)
+        mime_str = n.mime.decode(errors="replace") if n.mime else ""
+        if (req.query.get("width") or req.query.get("height")) and \
+                not n.is_compressed:
+            from seaweedfs_tpu.utils.images import is_image, resized
+            if is_image(mime_str, n.name.decode(errors="replace")):
+                n.data = resized(
+                    n.data,
+                    int(req.query.get("width") or 0) or None,
+                    int(req.query.get("height") or 0) or None,
+                    req.query.get("mode", ""))
         if n.name:
             headers["X-File-Name"] = n.name.decode(errors="replace")
         mime = (n.mime.decode(errors="replace")

@@ -1,0 +1,111 @@
+"""TOML configuration loading + scaffold defaults.
+
+Functional equivalent of reference weed/util/config.go (viper search in
+./, ~/.seaweedfs, /etc/seaweedfs) and weed/command/scaffold (embedded
+default tomls). Python 3.11+ tomllib reads; scaffold emits the defaults.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from typing import Any, Optional
+
+SEARCH_PATHS = [".", os.path.expanduser("~/.seaweedfs-tpu"),
+                "/etc/seaweedfs-tpu"]
+
+DEFAULTS = {
+    "security": """\
+# security.toml — JWT signing + TLS + whitelists
+[jwt.signing]
+key = ""
+expires_after_seconds = 10
+
+[access]
+ui = false
+# ip whitelist, e.g. ["10.0.0.0/8", "127.0.0.1"]
+white_list = []
+""",
+    "master": """\
+# master.toml
+[master.volume_growth]
+copy_1 = 7
+copy_2 = 6
+copy_3 = 3
+copy_other = 1
+
+[master.maintenance]
+garbage_threshold = 0.3
+""",
+    "filer": """\
+# filer.toml — filer store selection
+[memory]
+enabled = true
+
+[sqlite]
+enabled = false
+dbFile = "./filer.db"
+""",
+    "replication": """\
+# replication.toml — sink for filer.sync / filer.replicate
+[sink.filer]
+enabled = false
+url = "localhost:8888"
+
+[sink.local]
+enabled = false
+directory = "/data/backup"
+
+[sink.s3]
+enabled = false
+endpoint = "http://localhost:8333"
+bucket = "backup"
+""",
+    "notification": """\
+# notification.toml — filer event publishing
+[notification.log]
+enabled = false
+
+[notification.file]
+enabled = false
+path = "./notifications.jsonl"
+""",
+    "shell": """\
+# shell.toml
+[cluster]
+default = "default"
+
+[cluster.default]
+master = "localhost:9333"
+filer = "localhost:8888"
+""",
+}
+
+
+def load_configuration(name: str, required: bool = False) -> dict[str, Any]:
+    """Find <name>.toml in the search paths (reference LoadConfiguration)."""
+    for base in SEARCH_PATHS:
+        path = os.path.join(base, f"{name}.toml")
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return tomllib.load(f)
+    if required:
+        raise FileNotFoundError(
+            f"{name}.toml not found in {SEARCH_PATHS}; "
+            f"run `weed-tpu scaffold -config {name}` to generate one")
+    return {}
+
+
+def get(conf: dict, dotted: str, default: Any = None) -> Any:
+    cur: Any = conf
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return default
+        cur = cur[part]
+    return cur
+
+
+def scaffold(name: str) -> str:
+    if name not in DEFAULTS:
+        raise KeyError(f"unknown config {name!r}; have {sorted(DEFAULTS)}")
+    return DEFAULTS[name]

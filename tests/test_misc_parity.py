@@ -1,0 +1,144 @@
+"""Images, config/scaffold, mq broker, collection admin, master
+persistence, master UI."""
+
+import io
+import time
+
+import pytest
+
+from seaweedfs_tpu.mq.broker import Broker
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils import config as confmod
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+def test_image_resize_roundtrip():
+    from PIL import Image
+    from seaweedfs_tpu.utils.images import is_image, resized
+    buf = io.BytesIO()
+    Image.new("RGB", (100, 60), "red").save(buf, format="PNG")
+    data = buf.getvalue()
+    assert is_image("image/png")
+    assert is_image("", "photo.JPG")
+    small = resized(data, 50, None)
+    img = Image.open(io.BytesIO(small))
+    assert img.size == (50, 30)
+    filled = resized(data, 40, 40, mode="fill")
+    assert Image.open(io.BytesIO(filled)).size == (40, 40)
+
+
+def test_image_resize_via_volume_server(tmp_path):
+    from PIL import Image
+    master = MasterServer()
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    time.sleep(0.1)
+    try:
+        from seaweedfs_tpu.client import operation
+        from seaweedfs_tpu.client.wdclient import MasterClient
+        mc = MasterClient(master.url)
+        buf = io.BytesIO()
+        Image.new("RGB", (80, 80), "blue").save(buf, format="PNG")
+        res = operation.upload_data(mc, buf.getvalue(), name="pic.png",
+                                    mime="image/png")
+        status, body, _ = http_call(
+            "GET", f"http://{res.url}/{res.fid}?width=20")
+        assert status == 200
+        assert Image.open(io.BytesIO(body)).size == (20, 20)
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_config_scaffold_and_load(tmp_path, monkeypatch):
+    text = confmod.scaffold("security")
+    assert "jwt.signing" in text
+    (tmp_path / "security.toml").write_text(text.replace(
+        'key = ""', 'key = "abc"'))
+    monkeypatch.setattr(confmod, "SEARCH_PATHS", [str(tmp_path)])
+    conf = confmod.load_configuration("security")
+    assert confmod.get(conf, "jwt.signing.key") == "abc"
+    assert confmod.get(conf, "nope.deep", 42) == 42
+    with pytest.raises(FileNotFoundError):
+        confmod.load_configuration("master", required=True)
+
+
+def test_mq_broker(tmp_path):
+    master = MasterServer()
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    time.sleep(0.1)
+    try:
+        b = Broker(fs)
+        b.create_topic("chat", "events", partition_count=2)
+        for i in range(10):
+            b.publish("chat", "events", key=f"user{i % 3}",
+                      value={"seq": i})
+        b.flush()
+        records = list(b.read_topic("chat", "events"))
+        assert len(records) == 10
+        assert sorted(r["value"]["seq"] for r in records) == list(range(10))
+        # same key -> same partition
+        p1 = b.publish("chat", "events", "stable-key", "x")
+        p2 = b.publish("chat", "events", "stable-key", "y")
+        assert p1 == p2
+    finally:
+        fs.stop()
+        vs.stop()
+        master.stop()
+
+
+def test_collections_and_ui(tmp_path):
+    master = MasterServer()
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    time.sleep(0.1)
+    try:
+        from seaweedfs_tpu.client import operation
+        from seaweedfs_tpu.client.wdclient import MasterClient
+        mc = MasterClient(master.url)
+        operation.upload_data(mc, b"x", collection="photos")
+        cols = http_json("GET", f"http://{master.url}/col/list")
+        assert {"name": "photos"} in cols["collections"]
+
+        out = http_json("POST",
+                        f"http://{master.url}/col/delete?collection=photos")
+        assert out["deleted_volume_ids"]
+        # an in-flight full heartbeat can transiently re-register the
+        # layout; the next beat (post-deletion) clears it
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            http_json("POST",
+                      f"http://{master.url}/col/delete?collection=photos")
+            cols = http_json("GET", f"http://{master.url}/col/list")
+            if cols["collections"] == []:
+                break
+            time.sleep(0.3)
+        assert cols["collections"] == []
+
+        status, body, _ = http_call("GET", f"http://{master.url}/ui")
+        assert status == 200 and b"<table" in body
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_master_state_persistence(tmp_path):
+    meta = str(tmp_path / "meta")
+    m1 = MasterServer(meta_dir=meta)
+    m1.start()
+    m1.topo.max_volume_id = 42
+    m1.sequencer.set_max(1000)
+    m1.stop()
+
+    m2 = MasterServer(meta_dir=meta)
+    assert m2.topo.max_volume_id == 42
+    assert m2.sequencer.peek() >= 1001
+    m2.stop()
