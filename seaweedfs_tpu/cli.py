@@ -185,6 +185,18 @@ def cmd_compact(args):
     print(json.dumps({"before_bytes": before, "after_bytes": after}))
 
 
+def cmd_scaffold(args):
+    from seaweedfs_tpu.utils.config import scaffold
+    text = scaffold(args.config)
+    if args.output == "-":
+        print(text)
+    else:
+        path = f"{args.output}/{args.config}.toml"
+        with open(path, "w") as f:
+            f.write(text)
+        print(f"wrote {path}")
+
+
 def cmd_benchmark(args):
     """weed benchmark equivalent: write then randomly read N small files
     (reference weed/command/benchmark.go)."""
@@ -328,6 +340,13 @@ def main(argv=None):
     cp = sub.add_parser("compact")
     cp.add_argument("base")
     cp.set_defaults(fn=cmd_compact)
+
+    sc = sub.add_parser("scaffold")
+    sc.add_argument("-config", default="security",
+                    choices=["security", "master", "filer", "replication",
+                             "notification", "shell"])
+    sc.add_argument("-output", default="-")
+    sc.set_defaults(fn=cmd_scaffold)
 
     b = sub.add_parser("benchmark")
     b.add_argument("-master", default="127.0.0.1:9333")
