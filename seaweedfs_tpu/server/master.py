@@ -87,10 +87,29 @@ class MasterServer:
         return f"{self.http.host}:{self.http.port}"
 
     def _prune_loop(self):
+        ticks = 0
         while not self._stop.wait(self.topo.pulse_seconds):
+            ticks += 1
             self.topo.prune_dead_nodes()
             self._refresh_leader()
             self._save_state()
+            if ticks % 12 == 0 and self.is_leader():
+                self._auto_vacuum()
+
+    def _auto_vacuum(self) -> None:
+        """Compact garbage-heavy volumes cluster-wide (reference master
+        vacuum loop, topology_vacuum.go)."""
+        for node in self.topo.all_nodes():
+            for vid in list(node.volumes):
+                try:
+                    check = http_json(
+                        "POST", f"http://{node.url}/admin/vacuum",
+                        {"volume_id": vid, "check_only": True}, timeout=10)
+                    if check.get("garbage_ratio", 0) > self.garbage_threshold:
+                        http_json("POST", f"http://{node.url}/admin/vacuum",
+                                  {"volume_id": vid}, timeout=600)
+                except Exception:
+                    continue
 
     def _state_path(self) -> str:
         import os
@@ -174,6 +193,8 @@ class MasterServer:
         r("POST", "/col/delete", self._handle_col_delete)
         r("GET", "/ui", self._handle_ui)
         r("GET", "/", self._handle_ui)
+        from seaweedfs_tpu.utils.debug import install_debug_routes
+        install_debug_routes(self.http)
 
     def _handle_metrics(self, req: Request) -> Response:
         return Response(self.metrics.expose_text(),

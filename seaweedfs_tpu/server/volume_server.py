@@ -195,6 +195,9 @@ class VolumeServer:
           self._handle_delete)
         r("GET", "/status", self._handle_status)
         r("GET", "/metrics", self._handle_metrics)
+        r("GET", "/ui", self._handle_ui)
+        from seaweedfs_tpu.utils.debug import install_debug_routes
+        install_debug_routes(self.http)
         # admin
         r("POST", "/admin/allocate_volume", self._admin_allocate_volume)
         r("POST", "/admin/delete_volume", self._admin_delete_volume)
@@ -218,6 +221,28 @@ class VolumeServer:
     def _handle_metrics(self, req: Request) -> Response:
         return Response(self.metrics.expose_text(),
                         content_type="text/plain; version=0.0.4")
+
+    def _handle_ui(self, req: Request) -> Response:
+        hb = self.store.collect_heartbeat()
+        rows = "".join(
+            f"<tr><td>{v['id']}</td><td>{v['collection']}</td>"
+            f"<td>{v['size']}</td><td>{v['file_count']}</td>"
+            f"<td>{v['delete_count']}</td>"
+            f"<td>{'RO' if v['read_only'] else 'RW'}</td></tr>"
+            for v in hb["volumes"])
+        ec_rows = "".join(
+            f"<tr><td>{e['id']}</td><td>{bin(e['ec_index_bits'])}</td></tr>"
+            for e in hb["ec_shards"])
+        html = (
+            "<html><head><title>seaweedfs-tpu volume server</title></head>"
+            f"<body><h1>Volume Server {self.url}</h1>"
+            f"<p>master: {self.master_url} | rack: {self.store.rack}</p>"
+            "<h2>Volumes</h2><table border=1><tr><th>id</th>"
+            "<th>collection</th><th>size</th><th>files</th><th>deleted</th>"
+            f"<th>mode</th></tr>{rows}</table>"
+            "<h2>EC shards</h2><table border=1><tr><th>vid</th>"
+            f"<th>shard bits</th></tr>{ec_rows}</table></body></html>")
+        return Response(html, content_type="text/html")
 
     def _check_jwt(self, req: Request) -> Optional[Response]:
         if not self.jwt_signing_key or req.query.get("type") == "replicate":
@@ -249,6 +274,17 @@ class VolumeServer:
         if req.query.get("gzip") == "1":
             from seaweedfs_tpu.storage.needle import FLAG_IS_COMPRESSED
             n.flags |= FLAG_IS_COMPRESSED
+        if req.query.get("ttl"):
+            from seaweedfs_tpu.storage.needle import FLAG_HAS_TTL
+            from seaweedfs_tpu.storage.super_block import TTL
+            n.ttl = TTL.parse(req.query["ttl"]).to_bytes()
+            n.flags |= FLAG_HAS_TTL
+            if not n.last_modified:
+                import time as _time
+                n.last_modified = int(_time.time())
+            from seaweedfs_tpu.storage.needle import \
+                FLAG_HAS_LAST_MODIFIED_DATE
+            n.flags |= FLAG_HAS_LAST_MODIFIED_DATE
         if req.query.get("ts"):
             n.last_modified = int(req.query["ts"])
         n.set_flags_from_fields()
@@ -303,8 +339,27 @@ class VolumeServer:
                     req.query.get("mode", ""))
         if n.name:
             headers["X-File-Name"] = n.name.decode(errors="replace")
+        if n.has_ttl and n.ttl and n.last_modified:
+            from seaweedfs_tpu.storage.super_block import TTL
+            import time as _time
+            ttl = TTL.from_bytes(n.ttl)
+            if ttl.minutes and \
+                    _time.time() > n.last_modified + ttl.minutes * 60:
+                return Response(b"", status=404, content_type="text/plain")
         mime = (n.mime.decode(errors="replace")
                 if n.mime else "application/octet-stream")
+        rng_hdr = req.headers.get("Range", "")
+        if rng_hdr.startswith("bytes="):
+            lo_s, _, hi_s = rng_hdr[6:].partition("-")
+            lo = int(lo_s or 0)
+            hi = int(hi_s) if hi_s else len(n.data) - 1
+            piece = n.data[lo:hi + 1]
+            headers["Content-Range"] = f"bytes {lo}-{hi}/{len(n.data)}"
+            return Response(piece, status=206, content_type=mime,
+                            headers=headers)
+        headers["ETag"] = f'"{n.checksum:x}"'
+        if req.headers.get("If-None-Match") == f'"{n.checksum:x}"':
+            return Response(b"", status=304, content_type=mime)
         return Response(n.data, content_type=mime, headers=headers)
 
     def _handle_delete(self, req: Request) -> Response:

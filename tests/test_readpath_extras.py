@@ -1,0 +1,75 @@
+"""Volume read-path extras: range requests, ETag/304, TTL expiry, debug
+endpoints, volume UI."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.client import operation
+from seaweedfs_tpu.client.wdclient import MasterClient
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils.httpd import http_call
+
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer()
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    time.sleep(0.1)
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def test_range_and_etag(stack):
+    master, vs = stack
+    mc = MasterClient(master.url)
+    data = bytes(range(256)) * 10
+    res = operation.upload_data(mc, data)
+
+    status, body, headers = http_call(
+        "GET", f"http://{vs.url}/{res.fid}",
+        headers={"Range": "bytes=10-29"})
+    assert status == 206 and body == data[10:30]
+    assert headers["Content-Range"] == f"bytes 10-29/{len(data)}"
+
+    status, _, headers = http_call("GET", f"http://{vs.url}/{res.fid}")
+    etag = headers["ETag"]
+    status, body, _ = http_call(
+        "GET", f"http://{vs.url}/{res.fid}",
+        headers={"If-None-Match": etag})
+    assert status == 304 and body == b""
+
+
+def test_ttl_expiry(stack):
+    master, vs = stack
+    mc = MasterClient(master.url)
+    a = mc.assign(ttl="1m")
+    # write with a backdated modification time so 1 minute has elapsed
+    status, _, _ = http_call(
+        "POST",
+        f"http://{a['url']}/{a['fid']}?ttl=1m&ts={int(time.time()) - 120}",
+        body=b"expiring")
+    assert status == 201
+    status, _, _ = http_call("GET", f"http://{a['url']}/{a['fid']}")
+    assert status == 404  # expired
+
+    b = mc.assign(ttl="1h")
+    http_call("POST", f"http://{b['url']}/{b['fid']}?ttl=1h", body=b"fresh")
+    status, body, _ = http_call("GET", f"http://{b['url']}/{b['fid']}")
+    assert status == 200 and body == b"fresh"
+
+
+def test_debug_and_ui_endpoints(stack):
+    master, vs = stack
+    for url in (master.url, vs.url):
+        status, body, _ = http_call("GET", f"http://{url}/debug/stacks")
+        assert status == 200 and b"thread" in body
+        status, body, _ = http_call(
+            "GET", f"http://{url}/debug/profile?seconds=0.1")
+        assert status == 200 and b"cumulative" in body
+    status, body, _ = http_call("GET", f"http://{vs.url}/ui")
+    assert status == 200 and b"Volume Server" in body
