@@ -29,9 +29,12 @@ def cmd_master(args):
     from seaweedfs_tpu.server.master import MasterServer
     ms = MasterServer(host=args.ip, port=args.port,
                       volume_size_limit_mb=args.volumeSizeLimitMB,
-                      default_replication=args.defaultReplication)
+                      default_replication=args.defaultReplication,
+                      meta_dir=args.mdir,
+                      grpc_port=args.port + 10000 if args.grpc else None)
     ms.start()
-    print(f"master listening on {ms.url}")
+    extra = f", grpc {ms.grpc_port}" if ms.grpc_port else ""
+    print(f"master listening on {ms.url}{extra}")
     _wait_forever()
 
 
@@ -72,7 +75,7 @@ def cmd_server(args):
         extra.append(fs)
         if args.s3:
             from seaweedfs_tpu.gateway.s3_server import S3Server
-            s3 = S3Server(fs.url, host=args.ip, port=args.s3Port)
+            s3 = S3Server(fs, host=args.ip, port=args.s3Port)
             s3.start()
             print(f"s3 {s3.url}")
             extra.append(s3)
@@ -270,6 +273,9 @@ def main(argv=None):
     m.add_argument("-port", type=int, default=9333)
     m.add_argument("-volumeSizeLimitMB", type=int, default=1024)
     m.add_argument("-defaultReplication", default="000")
+    m.add_argument("-mdir", default="", help="state persistence dir")
+    m.add_argument("-grpc", action="store_true",
+                   help="also serve the gRPC plane on port+10000")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")

@@ -37,7 +37,7 @@ class MasterServer:
                  garbage_threshold: float = 0.3,
                  jwt_signing_key: str = "",
                  whitelist: Optional[list] = None,
-                 meta_dir: str = ""):
+                 meta_dir: str = "", grpc_port: Optional[int] = None):
         self.topo = Topology(volume_size_limit=volume_size_limit_mb * 1024 * 1024)
         self.jwt_signing_key = jwt_signing_key
         from seaweedfs_tpu.utils.metrics import Registry
@@ -70,16 +70,25 @@ class MasterServer:
         # through raft snapshots, topology/cluster_commands.go) ----
         self.meta_dir = meta_dir
         self._load_state()
+        self._grpc_port = grpc_port
+        self._grpc_server = None
+        self.grpc_port: Optional[int] = None
 
     # ---- lifecycle ----
     def start(self) -> None:
         self.http.start()
+        if self._grpc_port is not None:
+            from seaweedfs_tpu.server.master_grpc import start_master_grpc
+            self._grpc_server, self.grpc_port = start_master_grpc(
+                self, self.http.host, self._grpc_port)
         self._pruner = threading.Thread(target=self._prune_loop, daemon=True)
         self._pruner.start()
 
     def stop(self) -> None:
         self._stop.set()
         self._save_state()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(0)
         self.http.stop()
 
     @property
@@ -267,28 +276,26 @@ class MasterServer:
             "jwt_signing_key": self.jwt_signing_key,
         })
 
-    def _handle_assign(self, req: Request) -> Response:
-        if not self.is_leader():
-            return self._not_leader()
-        count = int(req.query.get("count") or 1)
-        collection = req.query.get("collection", "")
-        replication = (req.query.get("replication")
-                       or self.default_replication)
-        ttl = req.query.get("ttl", "")
-        dc = req.query.get("dataCenter", "")
+    def assign_fid(self, count: int = 1, collection: str = "",
+                   replication: str = "", ttl: str = "",
+                   data_center: str = "") -> dict:
+        """Core assignment: pick/grow a writable volume, mint a fid.
+        Returns the reply dict or {"error": ...} (used by both the HTTP
+        and gRPC planes)."""
+        replication = replication or self.default_replication
         layout = self.topo.get_layout(collection, replication, ttl)
         with self._grow_lock:
             if layout.active_volume_count() == 0:
                 try:
                     grow_by_type(self.topo, collection, replication, ttl,
                                  self._allocate_rpc, count=1,
-                                 preferred_dc=dc)
+                                 preferred_dc=data_center)
                 except NoFreeSpaceError as e:
-                    return Response({"error": str(e)}, status=500)
+                    return {"error": str(e)}
         try:
             vid, nodes = layout.pick_for_write()
         except LookupError as e:
-            return Response({"error": str(e)}, status=500)
+            return {"error": str(e)}
         key = self.sequencer.next_file_id(count)
         cookie = random.getrandbits(32)
         fid = f"{vid},{format_needle_id_cookie(key, cookie)}"
@@ -305,6 +312,19 @@ class MasterServer:
         if self.jwt_signing_key:
             from seaweedfs_tpu.utils.security import gen_jwt
             reply["auth"] = gen_jwt(self.jwt_signing_key, fid)
+        return reply
+
+    def _handle_assign(self, req: Request) -> Response:
+        if not self.is_leader():
+            return self._not_leader()
+        reply = self.assign_fid(
+            count=int(req.query.get("count") or 1),
+            collection=req.query.get("collection", ""),
+            replication=req.query.get("replication", ""),
+            ttl=req.query.get("ttl", ""),
+            data_center=req.query.get("dataCenter", ""))
+        if "error" in reply:
+            return Response(reply, status=500)
         return Response(reply)
 
     def _allocate_rpc(self, node, vid, collection, rp, ttl) -> bool:

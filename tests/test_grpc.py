@@ -1,0 +1,82 @@
+"""gRPC master plane: streaming heartbeat registration, assign, lookups."""
+
+import queue
+import time
+
+import pytest
+
+from seaweedfs_tpu.pb import master_pb2 as pb
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.master_grpc import (GrpcMasterClient,
+                                              start_master_grpc)
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+@pytest.fixture
+def grpc_master(tmp_path):
+    master = MasterServer()
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    server, port = start_master_grpc(master)
+    time.sleep(0.1)
+    client = GrpcMasterClient(f"127.0.0.1:{port}")
+    yield master, vs, client
+    client.close()
+    server.stop(0)
+    vs.stop()
+    master.stop()
+
+
+def test_grpc_assign_and_lookup(grpc_master):
+    master, vs, client = grpc_master
+    res = client.assign(count=1)
+    assert res.fid and not res.error
+    assert res.location.url == vs.url
+
+    vid = res.fid.split(",")[0]
+    lk = client.lookup_volume([vid])
+    assert lk.volume_id_locations[0].locations[0].url == vs.url
+
+    lk2 = client.lookup_volume(["9999"])
+    assert lk2.volume_id_locations[0].error
+
+
+def test_grpc_streaming_heartbeat_registers_and_unregisters(grpc_master):
+    master, vs, client = grpc_master
+    q: "queue.Queue" = queue.Queue()
+
+    def beats():
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            yield item
+
+    hb = pb.Heartbeat(ip="10.9.9.9", port=7777, rack="rz",
+                      data_center="dcz", max_volume_count=5)
+    hb.volumes.add(id=77, size=100, version=3)
+    responses = client.heartbeat_stream(beats())
+    q.put(hb)
+    first = next(responses)
+    assert first.volume_size_limit > 0 and first.leader == master.url
+    assert master.topo.find_node("10.9.9.9:7777") is not None
+    assert [n.id for n in master.topo.lookup("", 77)] == ["10.9.9.9:7777"]
+
+    # delta: add an EC shard
+    delta = pb.Heartbeat(ip="10.9.9.9", port=7777, is_delta=True)
+    delta.new_ec_shards.add(id=88, ec_index_bits=0b11)
+    q.put(delta)
+    next(responses)
+    shards = master.topo.lookup_ec_shards(88)
+    assert [n.id for n in shards[0]] == ["10.9.9.9:7777"]
+
+    # closing the stream unregisters the node (liveness semantics)
+    q.put(None)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if master.topo.find_node("10.9.9.9:7777") is None:
+            break
+        time.sleep(0.05)
+    assert master.topo.find_node("10.9.9.9:7777") is None
+    assert master.topo.lookup("", 77) == []
