@@ -33,30 +33,101 @@ class MetaLogEvent:
 
 
 class MetaLog:
-    """In-memory bounded meta event log with offset-based subscription
-    (the reference persists to /topics/.system/log inside the filer; we
-    keep a ring buffer + optional persistence hook)."""
+    """Meta event log: in-memory ring for hot subscriptions + optional
+    persistence of every event as JSONL segments in a directory (the
+    reference persists to /topics/.system/log files inside the filer,
+    filer_notify_append.go; readers replay persisted segments when their
+    cursor predates the ring, filer_notify.go ReadPersistedLogBuffer)."""
 
-    def __init__(self, capacity: int = 65536):
+    SEGMENT_EVENTS = 4096
+
+    def __init__(self, capacity: int = 65536,
+                 persist_dir: "Optional[str]" = None):
         self.capacity = capacity
         self.events: list[MetaLogEvent] = []
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
+        self.persist_dir = persist_dir
+        self._seg_buf: list[str] = []
+        if persist_dir:
+            import os
+            os.makedirs(persist_dir, exist_ok=True)
 
     def append(self, ev: MetaLogEvent) -> None:
         with self._cond:
             self.events.append(ev)
             if len(self.events) > self.capacity:
                 self.events = self.events[-self.capacity:]
+            if self.persist_dir:
+                import json
+                self._seg_buf.append(json.dumps(ev.to_dict()))
+                if len(self._seg_buf) >= self.SEGMENT_EVENTS:
+                    self._flush_segment_locked()
             self._cond.notify_all()
+
+    def _flush_segment_locked(self) -> None:
+        import os
+        if not self._seg_buf:
+            return
+        path = os.path.join(self.persist_dir,
+                            f"{self.events[-1].tsns}.jsonl")
+        with open(path, "a") as f:
+            f.write("\n".join(self._seg_buf) + "\n")
+        self._seg_buf = []
+
+    def flush(self) -> None:
+        with self._lock:
+            if self.persist_dir:
+                self._flush_segment_locked()
 
     def read_since(self, tsns: int, path_prefix: str = "/",
                    limit: int = 1024) -> list[MetaLogEvent]:
+        prefix = path_prefix.rstrip("/") or "/"
         with self._lock:
-            return [e for e in self.events
-                    if e.tsns > tsns
-                    and e.directory.startswith(path_prefix.rstrip("/") or "/")
-                    ][:limit]
+            ring_start = self.events[0].tsns if self.events else None
+        out: list[MetaLogEvent] = []
+        # cursor predates the ring: replay persisted segments first
+        if self.persist_dir and (ring_start is None or tsns < ring_start - 1):
+            out.extend(self._read_persisted(tsns, prefix, limit, ring_start))
+        with self._lock:
+            for e in self.events:
+                if len(out) >= limit:
+                    break
+                if e.tsns > tsns and e.directory.startswith(prefix):
+                    out.append(e)
+        return out[:limit]
+
+    def _read_persisted(self, tsns: int, prefix: str, limit: int,
+                        ring_start) -> list[MetaLogEvent]:
+        import json
+        import os
+        out: list[MetaLogEvent] = []
+        try:
+            names = sorted(os.listdir(self.persist_dir))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".jsonl"):
+                continue
+            try:
+                with open(os.path.join(self.persist_dir, name)) as f:
+                    for line in f:
+                        if not line.strip():
+                            continue
+                        d = json.loads(line)
+                        if d["tsns"] <= tsns:
+                            continue
+                        if ring_start is not None and d["tsns"] >= ring_start:
+                            return out
+                        if d["directory"].startswith(prefix):
+                            out.append(MetaLogEvent(
+                                d["directory"], d.get("old_entry"),
+                                d.get("new_entry"), d["tsns"]))
+                        if len(out) >= limit:
+                            return out
+            except (OSError, ValueError):
+                continue
+        return out
 
     def wait_for_events(self, tsns: int, timeout: float = 10.0) -> bool:
         with self._cond:
@@ -67,9 +138,10 @@ class MetaLog:
 
 class Filer:
     def __init__(self, store: Optional[FilerStore] = None,
-                 delete_chunks_fn: Optional[Callable[[list[str]], None]] = None):
+                 delete_chunks_fn: Optional[Callable[[list[str]], None]] = None,
+                 meta_log_dir: Optional[str] = None):
         self.store = store or MemoryStore()
-        self.meta_log = MetaLog()
+        self.meta_log = MetaLog(persist_dir=meta_log_dir)
         self.delete_chunks_fn = delete_chunks_fn
         self._lock = threading.RLock()
         root = self.store.find_entry("/")

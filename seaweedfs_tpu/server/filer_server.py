@@ -53,8 +53,23 @@ class FilerServer:
 
     def start(self) -> None:
         self.http.start()
+        self._announce_stop = threading.Event()
+        threading.Thread(target=self._announce_loop, daemon=True).start()
+
+    def _announce_loop(self) -> None:
+        from seaweedfs_tpu.utils.httpd import http_json
+        while not self._announce_stop.wait(0.0 if not hasattr(self, "_announced") else 15.0):
+            self._announced = True
+            try:
+                http_json("POST",
+                          f"http://{self.master_url}/cluster/register",
+                          {"type": "filer", "url": self.url}, timeout=5)
+            except Exception:
+                pass
 
     def stop(self) -> None:
+        if hasattr(self, "_announce_stop"):
+            self._announce_stop.set()
         self.http.stop()
         self.filer.close()
 

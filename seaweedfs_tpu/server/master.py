@@ -71,6 +71,7 @@ class MasterServer:
         self.meta_dir = meta_dir
         self._load_state()
         self._grpc_port = grpc_port
+        self._cluster_nodes: dict = {}
         self._grpc_server = None
         self.grpc_port: Optional[int] = None
 
@@ -199,6 +200,8 @@ class MasterServer:
         r("POST", "/admin/unlock", self._handle_unlock)
         r("GET", "/metrics", self._handle_metrics)
         r("GET", "/col/list", self._handle_col_list)
+        r("POST", "/cluster/register", self._handle_cluster_register)
+        r("GET", "/cluster/nodes", self._handle_cluster_nodes)
         r("POST", "/col/delete", self._handle_col_delete)
         r("GET", "/ui", self._handle_ui)
         r("GET", "/", self._handle_ui)
@@ -208,6 +211,24 @@ class MasterServer:
     def _handle_metrics(self, req: Request) -> Response:
         return Response(self.metrics.expose_text(),
                         content_type="text/plain; version=0.0.4")
+
+    def _handle_cluster_register(self, req: Request) -> Response:
+        """Filer/broker membership announcements (reference
+        weed/cluster/cluster.go + master ListClusterNodes)."""
+        b = req.json()
+        ntype, url = b.get("type", "filer"), b["url"]
+        import time as _time
+        self._cluster_nodes[(ntype, url)] = _time.time()
+        return Response({})
+
+    def _handle_cluster_nodes(self, req: Request) -> Response:
+        import time as _time
+        ntype = req.query.get("type", "")
+        now = _time.time()
+        nodes = [{"type": t, "url": u}
+                 for (t, u), seen in self._cluster_nodes.items()
+                 if now - seen < 60 and (not ntype or t == ntype)]
+        return Response({"cluster_nodes": nodes})
 
     def _handle_col_list(self, req: Request) -> Response:
         cols = sorted({c for (c, _, _) in self.topo.layouts if c})

@@ -8,6 +8,7 @@ import shlex
 from seaweedfs_tpu.shell.commands import ShellContext
 
 HELP = """commands:
+  fs.ls/cat/rm/mkdir/mv/du/tree <path> [..]   filer namespace ops
   volume.list                       show topology
   volume.fix.replication [-n]      re-replicate under-replicated volumes
   volume.vacuum [threshold]         compact garbage-heavy volumes
@@ -42,6 +43,16 @@ def run_repl(master_url: str) -> None:
             print(json.dumps(out, default=str, indent=2))
 
 
+def _find_filer(sh: ShellContext) -> str:
+    from seaweedfs_tpu.utils.httpd import http_json
+    out = http_json("GET",
+                    f"http://{sh.master_url}/cluster/nodes?type=filer")
+    nodes = out.get("cluster_nodes", [])
+    if not nodes:
+        raise RuntimeError("no filer registered with the master")
+    return nodes[0]["url"]
+
+
 def run_command(sh: ShellContext, line: str):
     parts = shlex.split(line)
     cmd, args = parts[0], parts[1:]
@@ -58,10 +69,51 @@ def run_command(sh: ShellContext, line: str):
     if cmd == "unlock":
         sh.unlock()
         return {"locked": False}
+    if cmd.startswith("fs."):
+        from seaweedfs_tpu.shell.fs_commands import FsContext
+        fsc = FsContext(_find_filer(sh))
+        op = cmd[3:]
+        if op == "ls":
+            return fsc.ls(args[0] if args else "/")
+        if op == "cat":
+            data = fsc.cat(args[0])
+            print(data.decode(errors="replace"))
+            return None
+        if op == "rm":
+            paths = [a for a in args if not a.startswith("-")]
+            fsc.rm(paths[0], recursive="-r" in args)
+            return {"removed": paths[0]}
+        if op == "mkdir":
+            fsc.mkdir(args[0])
+            return {"created": args[0]}
+        if op == "mv":
+            fsc.mv(args[0], args[1])
+            return {"moved": [args[0], args[1]]}
+        if op == "du":
+            files, size = fsc.du(args[0] if args else "/")
+            return {"files": files, "bytes": size}
+        if op == "tree":
+            for line_ in fsc.tree(args[0] if args else "/"):
+                print(line_)
+            return None
+        raise ValueError(f"unknown fs command {op!r}")
     if cmd == "volume.list":
         return sh.volume_list()
     if cmd == "volume.fix.replication":
         return sh.volume_fix_replication(apply=apply)
+    if cmd == "volume.balance":
+        return sh.volume_balance(apply=apply)
+    if cmd == "collection.list":
+        from seaweedfs_tpu.utils.httpd import http_json
+        return http_json("GET", f"http://{sh.master_url}/col/list")
+    if cmd == "collection.delete":
+        from seaweedfs_tpu.utils.httpd import http_json
+        return http_json(
+            "POST",
+            f"http://{sh.master_url}/col/delete?collection={args[0]}")
+    if cmd == "cluster.check":
+        from seaweedfs_tpu.utils.httpd import http_json
+        return http_json("GET", f"http://{sh.master_url}/cluster/status")
     if cmd == "volume.vacuum":
         thr = float(args[0]) if args and not args[0].startswith("-") else 0.3
         return sh.volume_vacuum(thr)

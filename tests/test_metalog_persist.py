@@ -1,0 +1,50 @@
+"""Meta-log persistence: events survive ring eviction and process restart."""
+
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.filer.filer import Filer, MetaLog, MetaLogEvent
+
+
+def test_metalog_replays_persisted_segments(tmp_path):
+    log = MetaLog(capacity=10, persist_dir=str(tmp_path / "log"))
+    log.SEGMENT_EVENTS = 5
+    for i in range(50):
+        log.append(MetaLogEvent(f"/d{i % 3}", None,
+                                {"full_path": f"/d{i % 3}/f{i}"},
+                                tsns=1000 + i))
+    log.flush()
+    # ring holds only the last 10; reading from 0 must include evicted ones
+    got = log.read_since(0, "/", limit=1000)
+    assert len(got) == 50
+    assert got[0].tsns == 1000 and got[-1].tsns == 1049
+    # prefix filtering applies across both persisted and ring events
+    got = log.read_since(0, "/d1", limit=1000)
+    assert all(e.directory == "/d1" for e in got)
+    # cursor in the middle
+    got = log.read_since(1039, "/", limit=1000)
+    assert [e.tsns for e in got] == list(range(1040, 1050))
+
+
+def test_metalog_survives_restart(tmp_path):
+    d = str(tmp_path / "log")
+    log = MetaLog(capacity=4, persist_dir=d)
+    log.SEGMENT_EVENTS = 2
+    for i in range(9):
+        log.append(MetaLogEvent("/x", None, {"full_path": f"/x/{i}"},
+                                tsns=i + 1))
+    log.flush()
+    log2 = MetaLog(capacity=4, persist_dir=d)  # fresh process
+    got = log2.read_since(0, "/", limit=100)
+    assert [e.tsns for e in got] == list(range(1, 10))
+
+
+def test_filer_with_persistent_metalog(tmp_path):
+    f = Filer(meta_log_dir=str(tmp_path / "meta"))
+    f.meta_log.SEGMENT_EVENTS = 1  # flush every event
+    f.create_entry(Entry("/a/b.txt"))
+    f.delete_entry("/a/b.txt")
+    f.meta_log.flush()
+    f2 = Filer(meta_log_dir=str(tmp_path / "meta"))
+    events = f2.meta_log.read_since(0, "/", limit=100)
+    paths = [(e.new_entry or e.old_entry or {}).get("full_path")
+             for e in events]
+    assert "/a/b.txt" in paths

@@ -1,0 +1,52 @@
+"""Shell fs.* commands + cluster membership via the master registry."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.commands import ShellContext
+from seaweedfs_tpu.shell.repl import run_command
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+def test_filer_registers_and_shell_fs_commands(tmp_path, capsys):
+    master = MasterServer()
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            nodes = http_json(
+                "GET", f"http://{master.url}/cluster/nodes?type=filer"
+            )["cluster_nodes"]
+            if nodes:
+                break
+            time.sleep(0.1)
+        assert nodes and nodes[0]["url"] == fs.url
+
+        sh = ShellContext(master.url)
+        assert run_command(sh, "fs.mkdir /data") == {"created": "/data"}
+        http_call("POST", f"http://{fs.url}/data/x.txt", body=b"shell!")
+        out = run_command(sh, "fs.ls /data")
+        assert [e["FullPath"] for e in out] == ["/data/x.txt"]
+        run_command(sh, "fs.cat /data/x.txt")
+        assert "shell!" in capsys.readouterr().out
+        du = run_command(sh, "fs.du /data")
+        assert du == {"files": 1, "bytes": 6}
+        run_command(sh, "fs.mv /data/x.txt /data/y.txt")
+        assert run_command(sh, "fs.rm /data -r") == {"removed": "/data"}
+
+        cols = run_command(sh, "collection.list")
+        assert "collections" in cols
+        st = run_command(sh, "cluster.check")
+        assert st["IsLeader"]
+    finally:
+        fs.stop()
+        vs.stop()
+        master.stop()
