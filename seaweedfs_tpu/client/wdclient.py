@@ -1,8 +1,15 @@
 """Client-side master access: vid -> locations cache + lookup fallback.
 
-Functional equivalent of reference weed/wdclient/masterclient.go (vidMap
-cache with generation-based expiry instead of the KeepConnected push
-stream — entries refresh after `cache_ttl`)."""
+Functional equivalent of reference weed/wdclient/masterclient.go. Two
+modes, matching the reference's design:
+
+- push mode (``grpc_address`` given): a background KeepConnected stream
+  feeds a vidMap from VolumeLocation deltas — the reference's
+  ``KeepConnectedToMaster`` loop (masterclient.go:148-240); lookups hit
+  the map first and fall back to a LookupVolume call for unknown vids
+  (``LookupFileIdWithFallback``).
+- pull mode: TTL'd lookup cache over the HTTP plane.
+"""
 
 from __future__ import annotations
 
@@ -14,7 +21,9 @@ from seaweedfs_tpu.utils.httpd import HttpError, http_json
 
 
 class MasterClient:
-    def __init__(self, master_urls: list[str] | str, cache_ttl: float = 10.0):
+    def __init__(self, master_urls: list[str] | str, cache_ttl: float = 10.0,
+                 grpc_address: Optional[str] = None,
+                 client_type: str = "client", client_address: str = ""):
         if isinstance(master_urls, str):
             master_urls = [master_urls]
         self.master_urls = master_urls
@@ -23,6 +32,96 @@ class MasterClient:
         self._cache: dict[int, tuple[float, list[dict]]] = {}
         self._ec_cache: dict[int, tuple[float, list[dict]]] = {}
         self._lock = threading.Lock()
+        # push-mode state
+        self._vidmap: dict[int, list[dict]] = {}
+        self._vidmap_ready = threading.Event()
+        self._stop = threading.Event()
+        self._kc_thread: Optional[threading.Thread] = None
+        self._kc_stream = None
+        if grpc_address:
+            addrs = ([grpc_address] if isinstance(grpc_address, str)
+                     else list(grpc_address))
+            self._kc_thread = threading.Thread(
+                target=self._keep_connected_loop,
+                args=(addrs, client_type, client_address), daemon=True)
+            self._kc_thread.start()
+
+    # ---- KeepConnected push stream ----
+    def _keep_connected_loop(self, addresses: list[str], client_type: str,
+                             client_address: str) -> None:
+        from seaweedfs_tpu.server.master_grpc import GrpcMasterClient
+        backoff = 0.2
+        idx = 0
+        while not self._stop.is_set():
+            address = addresses[idx % len(addresses)]
+            client = GrpcMasterClient(address)
+            got_data = False
+            try:
+                stream = client.keep_connected(client_type, client_address)
+                self._kc_stream = stream
+                # fresh connection: the snapshot supersedes everything —
+                # deletions missed while disconnected must not linger
+                # (reference resets its vidMap per connection)
+                with self._lock:
+                    self._vidmap.clear()
+                for resp in stream:
+                    if self._stop.is_set():
+                        stream.cancel()
+                        break
+                    if resp.HasField("volume_location"):
+                        vl = resp.volume_location
+                        if not vl.url and vl.leader:
+                            # follower redirect: note the hint and rotate
+                            with self._lock:
+                                self._leader = vl.leader
+                                if vl.leader not in self.master_urls:
+                                    self.master_urls.append(vl.leader)
+                            continue
+                        got_data = True
+                        backoff = 0.2
+                        self._apply_volume_location(vl)
+            except Exception:
+                pass
+            finally:
+                self._kc_stream = None
+                client.close()
+            if not self._stop.is_set():
+                self._vidmap_ready.clear()
+                if not got_data:
+                    # dead or follower master: try the next address
+                    idx += 1
+                    backoff = min(backoff * 2, 2.0)
+                time.sleep(backoff)
+
+    def _apply_volume_location(self, vl) -> None:
+        loc = {"url": vl.url, "publicUrl": vl.public_url or vl.url}
+        with self._lock:
+            for vid in list(vl.new_vids) + list(vl.new_ec_vids):
+                locs = self._vidmap.setdefault(vid, [])
+                if not any(l["url"] == loc["url"] for l in locs):
+                    locs.append(dict(loc))
+            for vid in list(vl.deleted_vids) + list(vl.deleted_ec_vids):
+                locs = self._vidmap.get(vid)
+                if locs is not None:
+                    locs[:] = [l for l in locs if l["url"] != loc["url"]]
+                    if not locs:
+                        del self._vidmap[vid]
+            if vl.leader:
+                hint = vl.leader
+                if hint and hint not in self.master_urls:
+                    self.master_urls.append(hint)
+        self._vidmap_ready.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        stream = self._kc_stream
+        if stream is not None:
+            try:
+                stream.cancel()
+            except Exception:
+                pass
+        if self._kc_thread is not None:
+            self._kc_thread.join(timeout=2)
 
     @property
     def leader(self) -> str:
@@ -56,6 +155,10 @@ class MasterClient:
 
     def lookup_volume(self, vid: int, collection: str = "") -> list[dict]:
         with self._lock:
+            # push-fed vidMap first (LookupFileIdWithFallback)
+            locs = self._vidmap.get(vid)
+            if locs:
+                return list(locs)
             hit = self._cache.get(vid)
             if hit and time.time() - hit[0] < self.cache_ttl:
                 return hit[1]

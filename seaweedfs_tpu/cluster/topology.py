@@ -162,6 +162,24 @@ class Topology:
         self.pulse_seconds = pulse_seconds
         self.max_volume_id = 0
         self.lock = threading.RLock()
+        # VolumeLocation delta subscribers (reference
+        # master_grpc_server.go broadcastToClients for KeepConnected)
+        self.listeners: list = []
+
+    def _notify(self, node: "DataNode", new_vids=(), deleted_vids=(),
+                new_ec_vids=(), deleted_ec_vids=()) -> None:
+        if not (new_vids or deleted_vids or new_ec_vids or deleted_ec_vids):
+            return
+        ev = {"url": node.url, "public_url": node.public_url,
+              "new_vids": sorted(new_vids),
+              "deleted_vids": sorted(deleted_vids),
+              "new_ec_vids": sorted(new_ec_vids),
+              "deleted_ec_vids": sorted(deleted_ec_vids)}
+        for fn in list(self.listeners):
+            try:
+                fn(ev)
+            except Exception:
+                pass
 
     # ---- tree ----
     def get_or_create_data_center(self, dc_id: str) -> DataCenter:
@@ -208,6 +226,8 @@ class Topology:
                 hb["ip"], hb["port"], hb.get("public_url", ""),
                 hb.get("max_volume_count", 8))
             node.last_seen = time.time()
+            prev_vids = set(node.volumes)
+            prev_ec_vids = set(node.ec_shards)
 
             # volumes: full sync (replace set)
             new_vols = {v["id"]: v for v in hb.get("volumes", [])}
@@ -232,23 +252,34 @@ class Topology:
                 node.ec_shards[vid] = bits
                 self._register_ec_shards(vid, node, bits, old)
                 self.max_volume_id = max(self.max_volume_id, vid)
+            self._notify(
+                node,
+                new_vids=set(new_vols) - prev_vids,
+                deleted_vids=prev_vids - set(new_vols),
+                new_ec_vids=set(new_ec) - prev_ec_vids,
+                deleted_ec_vids=prev_ec_vids - set(new_ec))
             return node
 
     def incremental_sync(self, node: DataNode, deltas: dict) -> None:
         with self.lock:
             node.last_seen = time.time()
+            new_vids, deleted_vids = set(), set()
+            new_ec_vids, deleted_ec_vids = set(), set()
             for v in deltas.get("new_volumes", []):
                 node.volumes[v["id"]] = v
                 self._register_volume(v, node)
                 self.max_volume_id = max(self.max_volume_id, v["id"])
+                new_vids.add(v["id"])
             for v in deltas.get("deleted_volumes", []):
                 node.volumes.pop(v["id"], None)
                 self._unregister_volume(v, node)
+                deleted_vids.add(v["id"])
             for e in deltas.get("new_ec_shards", []):
                 vid, bits = e["id"], e["ec_index_bits"]
                 old = node.ec_shards.get(vid, 0)
                 node.ec_shards[vid] = old | bits
                 self._register_ec_shards(vid, node, bits, 0)
+                new_ec_vids.add(vid)
             for e in deltas.get("deleted_ec_shards", []):
                 vid, bits = e["id"], e["ec_index_bits"]
                 old = node.ec_shards.get(vid, 0)
@@ -257,7 +288,11 @@ class Topology:
                     node.ec_shards[vid] = remaining
                 else:
                     node.ec_shards.pop(vid, None)
+                    deleted_ec_vids.add(vid)
                 self._unregister_ec_shards(vid, node, bits)
+            self._notify(node, new_vids=new_vids, deleted_vids=deleted_vids,
+                         new_ec_vids=new_ec_vids,
+                         deleted_ec_vids=deleted_ec_vids)
 
     def unregister_data_node(self, node: DataNode) -> None:
         """Stream dropped: remove everything the node served
@@ -267,6 +302,8 @@ class Topology:
                 self._unregister_volume(v, node)
             for vid, bits in node.ec_shards.items():
                 self._unregister_ec_shards(vid, node, bits)
+            self._notify(node, deleted_vids=set(node.volumes),
+                         deleted_ec_vids=set(node.ec_shards))
             node.volumes.clear()
             node.ec_shards.clear()
             if node.rack:

@@ -256,6 +256,7 @@ class Filer:
         self.meta_log.append(MetaLogEvent(directory, old_entry, new_entry))
 
     def close(self) -> None:
+        self.meta_log.flush()
         self.store.close()
 
 

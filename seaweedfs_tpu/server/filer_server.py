@@ -58,14 +58,18 @@ class FilerServer:
 
     def _announce_loop(self) -> None:
         from seaweedfs_tpu.utils.httpd import http_json
-        while not self._announce_stop.wait(0.0 if not hasattr(self, "_announced") else 15.0):
-            self._announced = True
+
+        def announce():
             try:
                 http_json("POST",
                           f"http://{self.master_url}/cluster/register",
                           {"type": "filer", "url": self.url}, timeout=5)
             except Exception:
                 pass
+
+        announce()
+        while not self._announce_stop.wait(15.0):
+            announce()
 
     def stop(self) -> None:
         if hasattr(self, "_announce_stop"):

@@ -112,6 +112,11 @@ class Volume:
             off_units = t.actual_to_offset(offset)
             self.nm.set(n.id, off_units, n.size)
             self._idx.write(t.pack_entry(n.id, off_units, n.size))
+            # push both appends to the OS page cache so they survive
+            # process death (the Go reference's unbuffered writes do —
+            # Python's buffered writers would silently drop them)
+            self._dat.flush()
+            self._idx.flush()
             return n.size
 
     # ---- read ----
@@ -162,6 +167,8 @@ class Volume:
             self.nm.deleted_count += 1
             self.nm.deleted_bytes += size
             self._idx.write(t.pack_entry(needle_id, 0, t.TOMBSTONE_FILE_SIZE))
+            self._dat.flush()
+            self._idx.flush()
             return size
 
     # ---- stats ----

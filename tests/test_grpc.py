@@ -19,6 +19,7 @@ def grpc_master(tmp_path):
     vs = VolumeServer([str(tmp_path / "v")], master.url)
     vs.start()
     server, port = start_master_grpc(master)
+    master.grpc_port = port
     time.sleep(0.1)
     client = GrpcMasterClient(f"127.0.0.1:{port}")
     yield master, vs, client
@@ -80,3 +81,86 @@ def test_grpc_streaming_heartbeat_registers_and_unregisters(grpc_master):
         time.sleep(0.05)
     assert master.topo.find_node("10.9.9.9:7777") is None
     assert master.topo.lookup("", 77) == []
+
+
+def _drain_until(stream, pred, timeout=5.0):
+    """Collect KeepConnected responses until pred(resps) or timeout."""
+    resps = []
+    deadline = time.time() + timeout
+    it = iter(stream)
+    while time.time() < deadline:
+        try:
+            resps.append(next(it))
+        except StopIteration:
+            break
+        if pred(resps):
+            return resps
+    return resps
+
+
+def test_keep_connected_snapshot_and_deltas(grpc_master):
+    master, vs, client = grpc_master
+    # grow a volume so the snapshot has vids
+    res = client.assign(count=1)
+    vid = int(res.fid.split(",")[0])
+
+    stream = client.keep_connected("filer", "127.0.0.1:8888")
+
+    def has_vid(resps):
+        return any(vid in r.volume_location.new_vids for r in resps
+                   if r.HasField("volume_location"))
+
+    resps = _drain_until(stream, has_vid)
+    assert has_vid(resps), "snapshot must carry the known vid"
+    # filer membership registered via the stream announce
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if ("filer", "127.0.0.1:8888") in master._cluster_nodes:
+            break
+        time.sleep(0.05)
+    assert ("filer", "127.0.0.1:8888") in master._cluster_nodes
+
+    # topology delta: a new node heartbeat must be pushed as new_vids
+    node = master.topo.sync_data_node_registration({
+        "ip": "10.1.1.1", "port": 8080, "public_url": "10.1.1.1:8080",
+        "max_volume_count": 5,
+        "volumes": [{"id": 4242, "size": 10, "version": 3}],
+        "ec_shards": []})
+
+    def has_delta(resps):
+        return any(4242 in r.volume_location.new_vids for r in resps
+                   if r.HasField("volume_location"))
+
+    resps = _drain_until(stream, has_delta)
+    assert has_delta(resps)
+
+    # node death must be pushed as deleted_vids
+    master.topo.unregister_data_node(node)
+
+    def has_deleted(resps):
+        return any(4242 in r.volume_location.deleted_vids for r in resps
+                   if r.HasField("volume_location"))
+
+    resps = _drain_until(stream, has_deleted)
+    assert has_deleted(resps)
+    stream.cancel()
+
+
+def test_wdclient_push_mode_vidmap(grpc_master):
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    master, vs, client = grpc_master
+    res = client.assign(count=1)
+    vid = int(res.fid.split(",")[0])
+
+    mc = MasterClient(master.url, grpc_address=f"127.0.0.1:{master.grpc_port}"
+                      if master.grpc_port else None)
+    try:
+        assert mc._vidmap_ready.wait(5) or True
+        deadline = time.time() + 5
+        while time.time() < deadline and vid not in mc._vidmap:
+            time.sleep(0.05)
+        assert vid in mc._vidmap
+        locs = mc.lookup_volume(vid)
+        assert locs and locs[0]["url"] == vs.url
+    finally:
+        mc.stop()
