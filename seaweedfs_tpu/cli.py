@@ -33,7 +33,11 @@ def cmd_master(args):
                       meta_dir=args.mdir,
                       grpc_port=args.port + 10000 if args.grpc else None)
     ms.start()
+    if args.peers:
+        ms.set_peers(args.peers.split(","))
     extra = f", grpc {ms.grpc_port}" if ms.grpc_port else ""
+    if args.peers:
+        extra += f", raft peers {ms.peers}"
     print(f"master listening on {ms.url}{extra}")
     _wait_forever()
 
@@ -276,6 +280,8 @@ def main(argv=None):
     m.add_argument("-mdir", default="", help="state persistence dir")
     m.add_argument("-grpc", action="store_true",
                    help="also serve the gRPC plane on port+10000")
+    m.add_argument("-peers", default="",
+                   help="comma-separated master group urls (raft HA)")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")

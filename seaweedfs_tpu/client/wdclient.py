@@ -59,11 +59,6 @@ class MasterClient:
             try:
                 stream = client.keep_connected(client_type, client_address)
                 self._kc_stream = stream
-                # fresh connection: the snapshot supersedes everything —
-                # deletions missed while disconnected must not linger
-                # (reference resets its vidMap per connection)
-                with self._lock:
-                    self._vidmap.clear()
                 for resp in stream:
                     if self._stop.is_set():
                         stream.cancel()
@@ -77,6 +72,14 @@ class MasterClient:
                                 if vl.leader not in self.master_urls:
                                     self.master_urls.append(vl.leader)
                             continue
+                        if not got_data:
+                            # working stream established: the incoming
+                            # snapshot supersedes the old map — deletions
+                            # missed while disconnected must not linger.
+                            # (Cleared only now, so a dead master doesn't
+                            # wipe a still-useful map.)
+                            with self._lock:
+                                self._vidmap.clear()
                         got_data = True
                         backoff = 0.2
                         self._apply_volume_location(vl)

@@ -60,12 +60,15 @@ class MasterServer:
         self._register_routes()
         self._stop = threading.Event()
         self._pruner: Optional[threading.Thread] = None
-        # ---- HA (lease/probe-based leader election) ----
-        # The reference runs raft (weed/server/raft_server.go); we elect the
-        # smallest-url alive peer by periodic probing — same leader-only
-        # write discipline, follower redirects via 409 {"leader": url}.
+        # ---- HA: raft consensus (reference weed/server/raft_server.go;
+        # MaxVolumeId commands replicate like
+        # topology/cluster_commands.go, sequence checkpoints ride the
+        # snapshot). Single-master mode (no peers) has no raft node and
+        # is trivially leader.
         self.peers: list[str] = []
-        self._leader_url: Optional[str] = None
+        self.raft = None
+        self._seq_ckpt = 0  # highest committed sequence checkpoint
+        self._seq_synced_term = -1  # raft term our sequencer is synced to
         # ---- durable state (reference checkpoints MaxVolumeId + sequence
         # through raft snapshots, topology/cluster_commands.go) ----
         self.meta_dir = meta_dir
@@ -88,6 +91,8 @@ class MasterServer:
     def stop(self) -> None:
         self._stop.set()
         self._save_state()
+        if self.raft is not None:
+            self.raft.stop()
         if self._grpc_server is not None:
             self._grpc_server.stop(0)
         self.http.stop()
@@ -101,7 +106,6 @@ class MasterServer:
         while not self._stop.wait(self.topo.pulse_seconds):
             ticks += 1
             self.topo.prune_dead_nodes()
-            self._refresh_leader()
             self._save_state()
             if ticks % 12 == 0 and self.is_leader():
                 self._auto_vacuum()
@@ -153,33 +157,71 @@ class MasterServer:
 
     # ---- HA ----
     def set_peers(self, peers: list[str]) -> None:
-        """Configure the master group (urls incl. self)."""
+        """Configure the master group (urls incl. self) and start raft."""
+        from seaweedfs_tpu.cluster.raft import RaftNode
         self.peers = sorted(set(peers) | {self.url})
-        self._refresh_leader()
+        if self.raft is not None:
+            self.raft.stop()
+        import os
+        state_path = (os.path.join(self.meta_dir, "raft_state.json")
+                      if self.meta_dir else "")
+        self.raft = RaftNode(
+            self.url, self.peers,
+            apply_fn=self._apply_raft_command,
+            snapshot_fn=lambda: {"max_volume_id": self.topo.max_volume_id,
+                                 "sequence": self.sequencer.peek()},
+            restore_fn=self._restore_raft_snapshot,
+            state_path=state_path)
+        self.raft.start()
 
-    def _refresh_leader(self) -> None:
-        if not self.peers:
-            self._leader_url = self.url
-            return
-        for peer in self.peers:  # sorted: smallest alive wins
-            if peer == self.url:
-                self._leader_url = self.url
-                return
-            try:
-                http_json("GET", f"http://{peer}/cluster/status",
-                          timeout=2)
-                self._leader_url = peer
-                return
-            except Exception:
-                continue
-        self._leader_url = self.url
+    def _apply_raft_command(self, cmd: dict) -> None:
+        """State machine: committed log entries (every master applies)."""
+        if cmd.get("type") == "max_volume_id":
+            with self.topo.lock:
+                self.topo.max_volume_id = max(self.topo.max_volume_id,
+                                              cmd["value"])
+        elif cmd.get("type") == "sequence":
+            # record only; the live counter fast-forwards to the
+            # checkpoint once per leadership change (assign_fid) so a
+            # continuing leader doesn't burn a batch per checkpoint
+            self._seq_ckpt = max(self._seq_ckpt, cmd["value"])
+
+    def _restore_raft_snapshot(self, state: dict) -> None:
+        with self.topo.lock:
+            self.topo.max_volume_id = max(self.topo.max_volume_id,
+                                          state.get("max_volume_id", 0))
+        self._seq_ckpt = max(self._seq_ckpt, state.get("sequence", 0))
+
+    def _raft_propose(self, cmd: dict) -> bool:
+        """Replicate a command; returns True once committed. Callers
+        minting ids/vids MUST fail when this fails — handing out an
+        uncommitted id invites reuse after failover."""
+        if self.raft is None:
+            return True
+        try:
+            return self.raft.propose(cmd, timeout=5.0)
+        except Exception:
+            return False
+
+    def _handle_raft(self, method: str):
+        def handler(req: Request) -> Response:
+            if self.raft is None:
+                return Response({"error": "raft not configured"},
+                                status=503)
+            return Response(getattr(self.raft, method)(req.json()))
+        return handler
 
     @property
     def leader(self) -> str:
-        return self._leader_url or self.url
+        if self.raft is not None:
+            return self.raft.leader_id or self.url
+        return self.url
 
     def is_leader(self) -> bool:
-        return self.leader == self.url
+        if self.raft is not None:
+            from seaweedfs_tpu.cluster.raft import LEADER
+            return self.raft.state == LEADER
+        return True
 
     def _not_leader(self) -> Response:
         return Response({"error": "not leader", "leader": self.leader},
@@ -205,6 +247,9 @@ class MasterServer:
         r("POST", "/col/delete", self._handle_col_delete)
         r("GET", "/ui", self._handle_ui)
         r("GET", "/", self._handle_ui)
+        r("POST", "/raft/vote", self._handle_raft("on_request_vote"))
+        r("POST", "/raft/append", self._handle_raft("on_append_entries"))
+        r("POST", "/raft/snapshot", self._handle_raft("on_install_snapshot"))
         from seaweedfs_tpu.utils.debug import install_debug_routes
         install_debug_routes(self.http)
 
@@ -303,6 +348,19 @@ class MasterServer:
         """Core assignment: pick/grow a writable volume, mint a fid.
         Returns the reply dict or {"error": ...} (used by both the HTTP
         and gRPC planes)."""
+        if self.raft is not None:
+            if not self.raft.is_ready():
+                # a fresh leader must commit its no-op barrier first so
+                # inherited checkpoints are applied before minting ids
+                if not self.raft.wait_ready(timeout=2.0):
+                    return {"error": "raft leader not ready",
+                            "leader": self.leader}
+            term = self.raft.current_term
+            if self._seq_synced_term != term:
+                # once per leadership change: jump past every id any
+                # previous leader may have handed out
+                self.sequencer.set_max(self._seq_ckpt)
+                self._seq_synced_term = term
         replication = replication or self.default_replication
         layout = self.topo.get_layout(collection, replication, ttl)
         with self._grow_lock:
@@ -313,11 +371,29 @@ class MasterServer:
                                  preferred_dc=data_center)
                 except NoFreeSpaceError as e:
                     return {"error": str(e)}
+                # replicate the new MaxVolumeId so a failed-over leader
+                # never re-issues a vid (cluster_commands.go)
+                if not self._raft_propose({"type": "max_volume_id",
+                                           "value":
+                                           self.topo.max_volume_id}):
+                    return {"error": "raft: volume id not committed",
+                            "leader": self.leader}
         try:
             vid, nodes = layout.pick_for_write()
         except LookupError as e:
             return {"error": str(e)}
         key = self.sequencer.next_file_id(count)
+        if self.raft is not None and key + count >= self._seq_ckpt:
+            # checkpoint the sequence ahead of use so a failed-over
+            # leader resumes past every id this one may have handed
+            # out; minting beyond an uncommitted checkpoint is unsafe,
+            # so the assign fails if the commit does
+            new_ckpt = key + count + 1000
+            if not self._raft_propose({"type": "sequence",
+                                       "value": new_ckpt}):
+                return {"error": "raft: sequence checkpoint not committed",
+                        "leader": self.leader}
+            self._seq_ckpt = max(self._seq_ckpt, new_ckpt)
         cookie = random.getrandbits(32)
         fid = f"{vid},{format_needle_id_cookie(key, cookie)}"
         node = nodes[0]
@@ -405,6 +481,8 @@ class MasterServer:
                          "Version": "seaweedfs-tpu 0.1"})
 
     def _handle_grow(self, req: Request) -> Response:
+        if not self.is_leader():
+            return self._not_leader()
         count = int(req.query.get("count") or 1)
         collection = req.query.get("collection", "")
         replication = (req.query.get("replication")
@@ -415,6 +493,10 @@ class MasterServer:
                                 self._allocate_rpc, count=count)
         except NoFreeSpaceError as e:
             return Response({"error": str(e)}, status=500)
+        if not self._raft_propose({"type": "max_volume_id",
+                                   "value": self.topo.max_volume_id}):
+            return Response({"error": "raft: volume id not committed",
+                             "leader": self.leader}, status=500)
         return Response({"count": len(vids), "volume_ids": vids})
 
     def _handle_cluster_status(self, req: Request) -> Response:

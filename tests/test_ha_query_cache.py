@@ -13,6 +13,16 @@ from seaweedfs_tpu.utils.chunk_cache import MemChunkCache, TieredChunkCache
 from seaweedfs_tpu.utils.httpd import http_json
 
 
+def _wait_unique_leader(masters, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [m for m in masters if m.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.1)
+    raise AssertionError("raft did not elect a unique leader")
+
+
 def test_multi_master_failover(tmp_path):
     masters = [MasterServer() for _ in range(3)]
     for m in masters:
@@ -20,10 +30,8 @@ def test_multi_master_failover(tmp_path):
     urls = [m.url for m in masters]
     for m in masters:
         m.set_peers(urls)
-    leader_url = min(urls)
-    leader = next(m for m in masters if m.url == leader_url)
+    leader = _wait_unique_leader(masters)
     followers = [m for m in masters if m is not leader]
-    assert leader.is_leader()
     assert all(not f.is_leader() for f in followers)
 
     vs = VolumeServer([str(tmp_path / "v")], urls, rack="r1")
@@ -33,24 +41,18 @@ def test_multi_master_failover(tmp_path):
         mc = MasterClient(urls)
         res = operation.upload_data(mc, b"ha payload")
         assert operation.read_data(mc, res.fid) == b"ha payload"
+        max_vid_before = leader.topo.max_volume_id
+        assert max_vid_before >= 1
 
-        # follower redirects writes to the leader
+        # follower redirects writes to the leader (raft leader hint)
         st = http_json("GET", f"http://{followers[0].url}/cluster/status")
-        assert st["Leader"] == leader_url and not st["IsLeader"]
+        assert st["Leader"] == leader.url and not st["IsLeader"]
 
-        # kill the leader -> next-smallest alive peer takes over
+        # kill the leader -> raft elects a new one from the survivors
         leader.stop()
-        new_leader = next(m for m in followers
-                          if m.url == min(f.url for f in followers))
-        deadline = time.time() + 30
-        while time.time() < deadline:
-            new_leader._refresh_leader()
-            for f in followers:
-                f._refresh_leader()
-            if new_leader.is_leader():
-                break
-            time.sleep(0.2)
-        assert new_leader.is_leader()
+        new_leader = _wait_unique_leader(followers, timeout=30)
+        # replicated MaxVolumeId survived the failover: no vid reuse
+        assert new_leader.topo.max_volume_id >= max_vid_before
 
         # volume server re-registers with the new leader; uploads work again
         deadline = time.time() + 30

@@ -1,0 +1,226 @@
+"""Raft consensus: election, replication, persistence, snapshots.
+
+The reference's master HA runs raft (weed/server/raft_server.go,
+raft_hashicorp.go) replicating MaxVolumeId commands
+(topology/cluster_commands.go). These tests drive our implementation
+through an in-process transport (no HTTP) plus the real master-group
+integration in test_ha_query_cache.py.
+"""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster.raft import LEADER, NotLeaderError, RaftNode
+
+
+class Net:
+    """In-process message fabric with partitions."""
+
+    def __init__(self):
+        self.nodes: dict[str, RaftNode] = {}
+        self.down: set[str] = set()
+
+    def send(self, sender: str, peer: str, path: str, body: dict,
+             timeout: float) -> dict:
+        if sender in self.down or peer in self.down or peer not in self.nodes:
+            raise ConnectionError(f"{sender}->{peer} unreachable")
+        node = self.nodes[peer]
+        if path == "/raft/vote":
+            return node.on_request_vote(body)
+        if path == "/raft/append":
+            return node.on_append_entries(body)
+        if path == "/raft/snapshot":
+            return node.on_install_snapshot(body)
+        raise ValueError(path)
+
+
+def make_cluster(n, tmp_path=None, compact_threshold=10 ** 9):
+    net = Net()
+    ids = [f"m{i}" for i in range(n)]
+    applied = {i: [] for i in ids}
+    states = {i: {} for i in ids}
+    nodes = []
+    for i in ids:
+        node = RaftNode(
+            i, ids,
+            apply_fn=lambda cmd, i=i: applied[i].append(cmd),
+            snapshot_fn=lambda i=i: {"applied": list(applied[i])},
+            restore_fn=lambda st, i=i: applied[i].extend(
+                c for c in st.get("applied", []) if c not in applied[i]),
+            state_path=str(tmp_path / f"{i}.json") if tmp_path else "",
+            send_fn=lambda peer, path, body, timeout, i=i:
+                net.send(i, peer, path, body, timeout),
+            election_timeout=(0.15, 0.4), heartbeat_interval=0.05,
+            compact_threshold=compact_threshold)
+        net.nodes[i] = node
+        nodes.append(node)
+    return net, nodes, applied
+
+
+def wait_leader(nodes, net=None, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        alive = [n for n in nodes
+                 if net is None or n.id not in net.down]
+        leaders = [n for n in alive if n.state == LEADER]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.02)
+    raise AssertionError("no unique leader elected")
+
+
+def test_election_and_replication():
+    net, nodes, applied = make_cluster(3)
+    for n in nodes:
+        n.start()
+    try:
+        leader = wait_leader(nodes)
+        for k in range(5):
+            assert leader.propose({"op": k}, timeout=5)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(len(applied[n.id]) == 5 for n in nodes):
+                break
+            time.sleep(0.02)
+        for n in nodes:
+            assert applied[n.id] == [{"op": k} for k in range(5)]
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_follower_rejects_propose():
+    net, nodes, _ = make_cluster(3)
+    for n in nodes:
+        n.start()
+    try:
+        leader = wait_leader(nodes)
+        follower = next(n for n in nodes if n is not leader)
+        with pytest.raises(NotLeaderError):
+            follower.propose({"op": 1})
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_leader_failover_preserves_log():
+    net, nodes, applied = make_cluster(3)
+    for n in nodes:
+        n.start()
+    try:
+        leader = wait_leader(nodes)
+        assert leader.propose({"op": "before"}, timeout=5)
+        # partition the leader away; a new leader emerges with the entry
+        net.down.add(leader.id)
+        survivors = [n for n in nodes if n is not leader]
+        new_leader = wait_leader(survivors, net)
+        assert new_leader is not leader
+        assert new_leader.propose({"op": "after"}, timeout=5)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(applied[n.id] == [{"op": "before"}, {"op": "after"}]
+                   for n in survivors):
+                break
+            time.sleep(0.02)
+        for n in survivors:
+            assert applied[n.id] == [{"op": "before"}, {"op": "after"}]
+        # healed old leader catches up and steps down
+        net.down.discard(leader.id)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if applied[leader.id] == [{"op": "before"}, {"op": "after"}] \
+                    and leader.state != LEADER:
+                break
+            time.sleep(0.02)
+        assert applied[leader.id] == [{"op": "before"}, {"op": "after"}]
+        assert leader.state != LEADER
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_partitioned_leader_steps_down():
+    """Check-quorum: a leader cut off from the majority must stop
+    claiming leadership (split-brain prevention) — while partitioned,
+    not merely after healing."""
+    net, nodes, _ = make_cluster(3)
+    for n in nodes:
+        n.start()
+    try:
+        leader = wait_leader(nodes)
+        net.down.add(leader.id)
+        deadline = time.time() + 5
+        while time.time() < deadline and leader.state == LEADER:
+            time.sleep(0.02)
+        assert leader.state != LEADER, \
+            "partitioned leader kept serving (split-brain)"
+        with pytest.raises(NotLeaderError):
+            leader.propose({"op": "zombie write"})
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_persistence_across_restart(tmp_path):
+    net, nodes, applied = make_cluster(3, tmp_path)
+    for n in nodes:
+        n.start()
+    leader = wait_leader(nodes)
+    for k in range(3):
+        assert leader.propose({"op": k}, timeout=5)
+    term_before = leader.current_term
+    for n in nodes:
+        n.stop()
+
+    # restart from disk: term + log survive
+    net2, nodes2, applied2 = make_cluster(3, tmp_path)
+    for n in nodes2:
+        assert n.current_term >= term_before
+        assert len(n.log) + n.snap_index >= 3
+    for n in nodes2:
+        n.start()
+    try:
+        leader2 = wait_leader(nodes2)
+        assert leader2.propose({"op": "post-restart"}, timeout=5)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if applied2[leader2.id] and \
+                    applied2[leader2.id][-1] == {"op": "post-restart"}:
+                break
+            time.sleep(0.02)
+        # committed entries re-applied in order after restart
+        assert applied2[leader2.id] == [{"op": 0}, {"op": 1}, {"op": 2},
+                                        {"op": "post-restart"}]
+    finally:
+        for n in nodes2:
+            n.stop()
+
+
+def test_snapshot_compaction_and_install():
+    net, nodes, applied = make_cluster(3, compact_threshold=8)
+    for n in nodes:
+        n.start()
+    try:
+        leader = wait_leader(nodes)
+        # take a follower down; write enough to force compaction past it
+        straggler = next(n for n in nodes if n is not leader)
+        net.down.add(straggler.id)
+        for k in range(20):
+            assert leader.propose({"op": k}, timeout=5)
+        deadline = time.time() + 5
+        while time.time() < deadline and leader.snap_index == 0:
+            time.sleep(0.02)
+        assert leader.snap_index > 0, "leader should have compacted"
+        # heal: the straggler is behind the snapshot -> InstallSnapshot
+        net.down.discard(straggler.id)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if len(applied[straggler.id]) == 20:
+                break
+            time.sleep(0.02)
+        assert applied[straggler.id] == [{"op": k} for k in range(20)]
+    finally:
+        for n in nodes:
+            n.stop()
