@@ -1,19 +1,31 @@
 """S3-compatible gateway over the filer namespace.
 
 Functional equivalent of (a subset of) reference weed/s3api: bucket CRUD,
-object PUT/GET/HEAD/DELETE, ListObjectsV2, ListBuckets, multipart uploads
-(init/part/complete/abort — completion composes the parts' chunk lists
-without copying data, like reference s3api/filer_multipart.go), and
-optional AWS SigV4 verification (reference auth_signature_v4.go) with
-anonymous access when no credentials are configured.
+object PUT/GET/HEAD/DELETE, ListObjects V1+V2, ListBuckets, multipart
+uploads (init/part/complete/abort — completion composes the parts' chunk
+lists without copying data, like reference s3api/filer_multipart.go),
+CopyObject (chunk-list compose, s3api_object_copy_handlers.go), object
+tagging (s3api_object_tagging_handlers.go; tags live in entry.extended
+with the reference's "Seaweed-x-amz-tagging-" convention), POST policy
+form uploads (s3api_object_handlers_postpolicy.go), a circuit breaker
+(global/bucket concurrent-request limits, s3api_circuit_breaker.go), ACL
+/ location / versioning stubs, and AWS SigV4 verification — both the
+Authorization header and presigned X-Amz-Signature query forms
+(auth_signature_v4.go) — with anonymous access when no credentials are
+configured.
 
 Buckets live at /buckets/<name> in the filer (reference filer_buckets.go).
 """
 
 from __future__ import annotations
 
+import base64
+import calendar
 import hashlib
 import hmac
+import json
+import re
+import threading
 import time
 import urllib.parse
 import uuid
@@ -26,6 +38,48 @@ from seaweedfs_tpu.utils.httpd import HttpServer, Request, Response
 
 BUCKETS_PATH = "/buckets"
 UPLOADS_PATH = "/buckets/.uploads"
+TAG_PREFIX = "Seaweed-x-amz-tagging-"
+
+
+class CircuitBreaker:
+    """Concurrent-request limiter (reference s3api_circuit_breaker.go).
+
+    Limits are counts of simultaneous read/write requests, globally and
+    per bucket; exceeding one returns 503 TooManyRequests. Byte limits
+    from the reference are a plug point (our handlers buffer bodies, so
+    count limits dominate).
+    """
+
+    def __init__(self, global_read: int = 0, global_write: int = 0,
+                 buckets: Optional[dict] = None):
+        # 0 = unlimited, matching the reference's "absent action" default
+        self.global_limits = {"Read": global_read, "Write": global_write}
+        self.bucket_limits = buckets or {}  # bucket -> {"Read": n, ...}
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def _keys(self, bucket: str, action: str):
+        return [("", action), (bucket, action)] if bucket else [("", action)]
+
+    def _limit(self, bucket: str, action: str) -> int:
+        if bucket:
+            return int(self.bucket_limits.get(bucket, {}).get(action, 0))
+        return int(self.global_limits.get(action, 0))
+
+    def acquire(self, bucket: str, action: str) -> bool:
+        with self._lock:
+            for b, a in self._keys(bucket, action):
+                limit = self._limit(b, a)
+                if limit and self._counts.get((b, a), 0) >= limit:
+                    return False
+            for key in self._keys(bucket, action):
+                self._counts[key] = self._counts.get(key, 0) + 1
+            return True
+
+    def release(self, bucket: str, action: str) -> None:
+        with self._lock:
+            for key in self._keys(bucket, action):
+                self._counts[key] = max(0, self._counts.get(key, 0) - 1)
 
 
 def _xml(root: ET.Element) -> bytes:
@@ -42,13 +96,15 @@ def _err(code: str, message: str, status: int) -> Response:
 
 class S3Server:
     def __init__(self, filer_server, host: str = "127.0.0.1", port: int = 0,
-                 access_key: str = "", secret_key: str = ""):
+                 access_key: str = "", secret_key: str = "",
+                 circuit_breaker: Optional[CircuitBreaker] = None):
         # filer_server: in-process FilerServer (gateway composes chunk
         # lists directly; the data path still flows through volume servers)
         self.fs = filer_server
         self.filer: Filer = filer_server.filer
         self.access_key = access_key
         self.secret_key = secret_key
+        self.breaker = circuit_breaker or CircuitBreaker()
         from seaweedfs_tpu.gateway.iam_server import IdentityStore
         self._identities = IdentityStore(self.filer)
         self.http = HttpServer(host, port)
@@ -84,9 +140,66 @@ class S3Server:
             return True
         return bool(self._identities.load()["identities"])
 
+    @staticmethod
+    def _signing_key(secret: str, date: str, region: str,
+                     service: str) -> bytes:
+        k = ("AWS4" + secret).encode()
+        for msg in (date, region, service, "aws4_request"):
+            k = hmac.new(k, msg.encode(), hashlib.sha256).digest()
+        return k
+
+    @classmethod
+    def _sig_v4(cls, secret: str, date: str, region: str, service: str,
+                amz_date: str, method: str, path: str,
+                query: dict, headers, signed_headers: list[str],
+                payload_hash: str) -> str:
+        cq = "&".join(
+            f"{urllib.parse.quote(k, safe='~')}="
+            f"{urllib.parse.quote(v, safe='~')}"
+            for k, v in sorted(query.items()))
+        ch = "".join(f"{h}:{headers.get(h, '').strip()}\n"
+                     for h in signed_headers)
+        # `path` is the wire path, still percent-encoded exactly as the
+        # client signed it — use it verbatim (re-quoting double-encodes)
+        creq = "\n".join([method, path, cq, ch,
+                          ";".join(signed_headers), payload_hash])
+        scope = f"{date}/{region}/{service}/aws4_request"
+        sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                         hashlib.sha256(creq.encode()).hexdigest()])
+        k = cls._signing_key(secret, date, region, service)
+        return hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+
+    def _check_presigned(self, req: Request) -> Optional[Response]:
+        """Presigned-URL (query-string) SigV4, reference
+        auth_signature_v4.go doesPresignedSignatureMatch."""
+        try:
+            cred = req.query["X-Amz-Credential"].split("/")
+            akey, date, region, service = cred[0], cred[1], cred[2], cred[3]
+            secret = self._secret_for(akey)
+            if secret is None:
+                return _err("InvalidAccessKeyId", "unknown key", 403)
+            amz_date = req.query.get("X-Amz-Date", "")
+            expires = int(req.query.get("X-Amz-Expires", "900"))
+            t = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+            if time.time() - t > expires:
+                return _err("AccessDenied", "request has expired", 403)
+            signed_headers = req.query["X-Amz-SignedHeaders"].split(";")
+            query = {k: v for k, v in req.query.items()
+                     if k != "X-Amz-Signature"}
+            sig = self._sig_v4(secret, date, region, service, amz_date,
+                               req.method, req.path, query, req.headers,
+                               signed_headers, "UNSIGNED-PAYLOAD")
+            if not hmac.compare_digest(sig, req.query["X-Amz-Signature"]):
+                return _err("SignatureDoesNotMatch", "bad signature", 403)
+        except (KeyError, IndexError, ValueError):
+            return _err("AccessDenied", "malformed presigned request", 403)
+        return None
+
     def _check_auth(self, req: Request) -> Optional[Response]:
         if not self._auth_required():
             return None  # anonymous allowed
+        if "X-Amz-Signature" in req.query:
+            return self._check_presigned(req)
         auth = req.headers.get("Authorization", "")
         if not auth.startswith("AWS4-HMAC-SHA256 "):
             return _err("AccessDenied", "missing signature", 403)
@@ -99,29 +212,13 @@ class S3Server:
             if secret is None:
                 return _err("InvalidAccessKeyId", "unknown key", 403)
             signed_headers = parts["SignedHeaders"].split(";")
-            # canonical request
-            cq = "&".join(
-                f"{urllib.parse.quote(k, safe='~')}="
-                f"{urllib.parse.quote(v, safe='~')}"
-                for k, v in sorted(req.query.items()))
-            ch = "".join(f"{h}:{req.headers.get(h, '').strip()}\n"
-                         for h in signed_headers)
             payload_hash = req.headers.get("x-amz-content-sha256",
                                            "UNSIGNED-PAYLOAD")
-            creq = "\n".join([req.method, urllib.parse.quote(req.path),
-                              cq, ch, ";".join(signed_headers),
-                              payload_hash])
-            scope = f"{date}/{region}/{service}/aws4_request"
-            sts = "\n".join([
-                "AWS4-HMAC-SHA256",
-                req.headers.get("x-amz-date", ""),
-                scope,
-                hashlib.sha256(creq.encode()).hexdigest()])
-            k = ("AWS4" + secret).encode()
-            for msg in (date, region, service, "aws4_request"):
-                k = hmac.new(k, msg.encode(), hashlib.sha256).digest()
-            sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
-            if sig != parts["Signature"]:
+            sig = self._sig_v4(secret, date, region, service,
+                               req.headers.get("x-amz-date", ""),
+                               req.method, req.path, req.query, req.headers,
+                               signed_headers, payload_hash)
+            if not hmac.compare_digest(sig, parts["Signature"]):
                 return _err("SignatureDoesNotMatch", "bad signature", 403)
         except (KeyError, IndexError, ValueError):
             return _err("AccessDenied", "malformed authorization", 403)
@@ -145,10 +242,16 @@ class S3Server:
         return Response(_xml(root), content_type="application/xml")
 
     def _bucket_dispatch(self, req: Request) -> Response:
+        bucket = req.match.group(1)
+        if req.method == "POST" and "delete" not in req.query:
+            ctype = req.headers.get("Content-Type", "")
+            if ctype.startswith("multipart/form-data"):
+                # POST policy uploads authenticate via the signed policy
+                # document itself, not the Authorization header
+                return self._post_policy_upload(req, bucket, ctype)
         denied = self._check_auth(req)
         if denied:
             return denied
-        bucket = req.match.group(1)
         if req.method == "PUT":
             self.filer.mkdirs(f"{BUCKETS_PATH}/{bucket}")
             return Response(b"", content_type="application/xml")
@@ -164,17 +267,128 @@ class S3Server:
                 return _err("NoSuchBucket", bucket, 404)
             if req.method == "HEAD":
                 return Response(b"", content_type="application/xml")
+            if "location" in req.query:
+                root = ET.Element("LocationConstraint")
+                return Response(_xml(root), content_type="application/xml")
+            if "versioning" in req.query:
+                # unversioned, like the reference's stub
+                root = ET.Element("VersioningConfiguration")
+                return Response(_xml(root), content_type="application/xml")
+            if "acl" in req.query:
+                return self._acl_response()
+            if "uploads" in req.query:
+                return self._list_multipart_uploads(bucket)
             return self._list_objects(req, bucket)
         if req.method == "POST" and "delete" in req.query:
             return self._delete_objects(req, bucket)
         return _err("MethodNotAllowed", req.method, 405)
 
+    def _acl_response(self) -> Response:
+        """Canned FULL_CONTROL owner ACL — the reference's ACL handlers
+        are stubs too (s3api_bucket_handlers.go GetBucketAclHandler)."""
+        root = ET.Element("AccessControlPolicy")
+        owner = ET.SubElement(root, "Owner")
+        ET.SubElement(owner, "ID").text = "seaweedfs-tpu"
+        acl = ET.SubElement(root, "AccessControlList")
+        grant = ET.SubElement(acl, "Grant")
+        grantee = ET.SubElement(grant, "Grantee")
+        ET.SubElement(grantee, "ID").text = "seaweedfs-tpu"
+        ET.SubElement(grant, "Permission").text = "FULL_CONTROL"
+        return Response(_xml(root), content_type="application/xml")
+
+    def _list_multipart_uploads(self, bucket: str) -> Response:
+        root = ET.Element("ListMultipartUploadsResult")
+        ET.SubElement(root, "Bucket").text = bucket
+        try:
+            uploads = self.filer.list_entries(UPLOADS_PATH, limit=10000)
+        except FileNotFoundError:
+            uploads = []
+        for e in uploads:
+            meta = self.filer.find_entry(f"{UPLOADS_PATH}/{e.name}/.meta")
+            if meta is None or meta.extended.get("bucket") != bucket:
+                continue
+            u = ET.SubElement(root, "Upload")
+            ET.SubElement(u, "Key").text = meta.extended.get("key", "")
+            ET.SubElement(u, "UploadId").text = e.name
+            ET.SubElement(u, "Initiated").text = _iso(e.attr.crtime)
+        return Response(_xml(root), content_type="application/xml")
+
+    def _post_policy_upload(self, req: Request, bucket: str,
+                            ctype: str) -> Response:
+        """Browser POST form upload with policy (reference
+        s3api_object_handlers_postpolicy.go). Verifies the policy
+        signature (SigV4 over the base64 policy) then stores the file
+        field under the form's key."""
+        m = re.search(r'boundary="?([^";]+)"?', ctype)
+        if not m:
+            return _err("MalformedPOSTRequest", "no boundary", 400)
+        fields, file_data, file_name = _parse_multipart_form(
+            req.body, m.group(1).encode())
+        if self._auth_required():
+            policy = fields.get("policy", "")
+            akey_cred = fields.get("x-amz-credential", "")
+            sig = fields.get("x-amz-signature", "")
+            if not policy or not akey_cred:
+                return _err("AccessDenied", "missing policy", 403)
+            cred = akey_cred.split("/")
+            try:
+                akey, date, region, service = (cred[0], cred[1], cred[2],
+                                               cred[3])
+            except IndexError:
+                return _err("AccessDenied", "malformed credential", 403)
+            secret = self._secret_for(akey)
+            if secret is None:
+                return _err("InvalidAccessKeyId", "unknown key", 403)
+            k = self._signing_key(secret, date, region, service)
+            want = hmac.new(k, policy.encode(), hashlib.sha256).hexdigest()
+            if not hmac.compare_digest(want, sig):
+                return _err("SignatureDoesNotMatch", "bad signature", 403)
+            try:
+                pol = json.loads(base64.b64decode(policy))
+                exp = pol.get("expiration", "")
+                if exp:
+                    stamp = exp.rstrip("Z").split(".")[0]
+                    t = calendar.timegm(time.strptime(
+                        stamp, "%Y-%m-%dT%H:%M:%S"))
+                    if time.time() > t:
+                        return _err("AccessDenied", "policy expired", 403)
+            except (ValueError, KeyError):
+                return _err("MalformedPOSTRequest", "bad policy", 400)
+        else:
+            pol = None
+        key = fields.get("key", "")
+        if not key:
+            return _err("InvalidArgument", "missing key field", 400)
+        key = key.replace("${filename}", file_name or "file")
+        if file_data is None:
+            return _err("InvalidArgument", "missing file field", 400)
+        if pol is not None:
+            err = _check_policy_conditions(pol, bucket, key,
+                                           len(file_data), fields)
+            if err:
+                return _err("AccessDenied", err, 403)
+        resp, _etag = self._store_object(bucket, key, file_data,
+                                         fields.get("Content-Type", ""))
+        if resp is not None:
+            return resp
+        try:
+            status = int(fields.get("success_action_status", "204"))
+        except ValueError:
+            status = 204
+        if status not in (200, 201, 204):
+            status = 204
+        return Response(b"", status=status, content_type="application/xml")
+
     def _list_objects(self, req: Request, bucket: str) -> Response:
         prefix = req.query.get("prefix", "")
         delimiter = req.query.get("delimiter", "")
         max_keys = int(req.query.get("max-keys", 1000))
-        start_after = req.query.get("start-after",
-                                    req.query.get("continuation-token", ""))
+        v2 = req.query.get("list-type") == "2"
+        if v2:
+            start_after = req.query.get(
+                "start-after", req.query.get("continuation-token", ""))
+        else:
+            start_after = req.query.get("marker", "")
         base = f"{BUCKETS_PATH}/{bucket}"
 
         keys: list[tuple[str, Entry]] = []
@@ -186,12 +400,17 @@ class S3Server:
         ET.SubElement(root, "Name").text = bucket
         ET.SubElement(root, "Prefix").text = prefix
         ET.SubElement(root, "MaxKeys").text = str(max_keys)
-        ET.SubElement(root, "KeyCount").text = str(len(keys))
+        if v2:
+            ET.SubElement(root, "KeyCount").text = str(len(keys))
         truncated = len(keys) >= max_keys
         ET.SubElement(root, "IsTruncated").text = \
             "true" if truncated else "false"
         if truncated and keys:
-            ET.SubElement(root, "NextContinuationToken").text = keys[-1][0]
+            if v2:
+                ET.SubElement(root, "NextContinuationToken").text = \
+                    keys[-1][0]
+            else:
+                ET.SubElement(root, "NextMarker").text = keys[-1][0]
         for key, e in keys:
             c = ET.SubElement(root, "Contents")
             ET.SubElement(c, "Key").text = key
@@ -255,6 +474,16 @@ class S3Server:
         if denied:
             return denied
         bucket, key = req.match.group(1), req.match.group(2)
+        action = "Read" if req.method in ("GET", "HEAD") else "Write"
+        if not self.breaker.acquire(bucket, action):
+            return _err("TooManyRequests", "circuit breaker open", 503)
+        try:
+            return self._object_dispatch_inner(req, bucket, key)
+        finally:
+            self.breaker.release(bucket, action)
+
+    def _object_dispatch_inner(self, req: Request, bucket: str,
+                               key: str) -> Response:
         if "uploads" in req.query and req.method == "POST":
             return self._initiate_multipart(bucket, key)
         if "uploadId" in req.query:
@@ -264,8 +493,14 @@ class S3Server:
                 return self._complete_multipart(req, bucket, key)
             if req.method == "DELETE":
                 return self._abort_multipart(req, bucket, key)
+        if "tagging" in req.query:
+            return self._object_tagging(req, bucket, key)
+        if "acl" in req.query and req.method == "GET":
+            return self._acl_response()
         path = f"{BUCKETS_PATH}/{bucket}/{key}"
         if req.method == "PUT":
+            if req.headers.get("x-amz-copy-source"):
+                return self._copy_object(req, bucket, key)
             return self._put_object(req, bucket, key)
         if req.method in ("GET", "HEAD"):
             entry = self.filer.find_entry(path)
@@ -301,22 +536,115 @@ class S3Server:
         return _err("MethodNotAllowed", req.method, 405)
 
     def _put_object(self, req: Request, bucket: str, key: str) -> Response:
+        tags = _parse_tag_header(req.headers.get("x-amz-tagging", ""))
+        resp, etag = self._store_object(bucket, key, req.body,
+                                        req.headers.get("Content-Type", ""),
+                                        tags=tags)
+        if resp is not None:
+            return resp
+        return Response(b"", headers={"ETag": f'"{etag}"'})
+
+    def _store_object(self, bucket: str, key: str, data: bytes,
+                      mime: str, tags: Optional[dict] = None
+                      ) -> tuple[Optional[Response], str]:
+        """Create the object entry; returns (error Response or None,
+        etag hex)."""
         if self.filer.find_entry(f"{BUCKETS_PATH}/{bucket}") is None:
-            return _err("NoSuchBucket", bucket, 404)
-        data = req.body
+            return _err("NoSuchBucket", bucket, 404), ""
         md5 = hashlib.md5(data).digest()
         now = time.time()
         entry = Entry(
             full_path=f"{BUCKETS_PATH}/{bucket}/{key}",
-            attr=Attr(mtime=now, crtime=now,
-                      mime=req.headers.get("Content-Type", ""),
+            attr=Attr(mtime=now, crtime=now, mime=mime,
                       file_size=len(data), md5=md5, collection=bucket))
+        for k, v in (tags or {}).items():
+            entry.extended[TAG_PREFIX + k] = v
         if len(data) <= 2048:
             entry.content = data
         else:
             entry.chunks = self.fs._upload_chunks(data, bucket, "")
         self.filer.create_entry(entry)
-        return Response(b"", headers={"ETag": f'"{md5.hex()}"'})
+        return None, md5.hex()
+
+    def _copy_object(self, req: Request, bucket: str, key: str) -> Response:
+        """Server-side copy (reference s3api_object_copy_handlers.go
+        CopyObjectHandler: re-reads and re-writes data, so deleting the
+        source can never orphan the copy's chunks)."""
+        src = urllib.parse.unquote(req.headers["x-amz-copy-source"])
+        src = src.lstrip("/")
+        try:
+            src_bucket, src_key = src.split("/", 1)
+        except ValueError:
+            return _err("InvalidArgument", "bad copy source", 400)
+        src_entry = self.filer.find_entry(
+            f"{BUCKETS_PATH}/{src_bucket}/{src_key}")
+        if src_entry is None or src_entry.is_directory:
+            return _err("NoSuchKey", src, 404)
+        if self.filer.find_entry(f"{BUCKETS_PATH}/{bucket}") is None:
+            return _err("NoSuchBucket", bucket, 404)
+        now = time.time()
+        entry = Entry(
+            full_path=f"{BUCKETS_PATH}/{bucket}/{key}",
+            attr=Attr(mtime=now, crtime=now, mime=src_entry.attr.mime,
+                      file_size=src_entry.file_size(),
+                      md5=src_entry.attr.md5, collection=bucket))
+        if req.headers.get("x-amz-metadata-directive") == "REPLACE":
+            tags = _parse_tag_header(req.headers.get("x-amz-tagging", ""))
+            for k, v in tags.items():
+                entry.extended[TAG_PREFIX + k] = v
+        else:
+            entry.extended = dict(src_entry.extended)
+        if src_entry.content:
+            entry.content = src_entry.content
+        else:
+            # data is re-uploaded so source delete can't orphan the copy
+            data = self.fs._read_entry_bytes(src_entry)
+            if not entry.attr.md5:
+                # multipart-composed sources carry no plain md5
+                entry.attr.md5 = hashlib.md5(data).digest()
+            entry.chunks = self.fs._upload_chunks(data, bucket, "")
+        self.filer.create_entry(entry)
+        root = ET.Element("CopyObjectResult")
+        ET.SubElement(root, "ETag").text = f'"{entry.attr.md5.hex()}"'
+        ET.SubElement(root, "LastModified").text = _iso(now)
+        return Response(_xml(root), content_type="application/xml")
+
+    def _object_tagging(self, req: Request, bucket: str,
+                        key: str) -> Response:
+        """GET/PUT/DELETE ?tagging (reference
+        s3api_object_tagging_handlers.go; tags in extended attrs)."""
+        path = f"{BUCKETS_PATH}/{bucket}/{key}"
+        entry = self.filer.find_entry(path)
+        if entry is None or entry.is_directory:
+            return _err("NoSuchKey", key, 404)
+        if req.method == "GET":
+            root = ET.Element("Tagging")
+            tagset = ET.SubElement(root, "TagSet")
+            for k, v in sorted(entry.extended.items()):
+                if k.startswith(TAG_PREFIX):
+                    t = ET.SubElement(tagset, "Tag")
+                    ET.SubElement(t, "Key").text = k[len(TAG_PREFIX):]
+                    ET.SubElement(t, "Value").text = v
+            return Response(_xml(root), content_type="application/xml")
+        if req.method == "PUT":
+            body = ET.fromstring(req.body)
+            ns = body.tag.split("}")[0] + "}" if body.tag.startswith("{") \
+                else ""
+            entry.extended = {k: v for k, v in entry.extended.items()
+                              if not k.startswith(TAG_PREFIX)}
+            for tag in body.iter(f"{ns}Tag"):
+                k = tag.find(f"{ns}Key").text or ""
+                v = tag.find(f"{ns}Value").text or ""
+                entry.extended[TAG_PREFIX + k] = v
+            self.filer.update_entry(entry)
+            return Response(b"", content_type="application/xml")
+        if req.method == "DELETE":
+            entry.extended = {k: v for k, v in entry.extended.items()
+                              if not k.startswith(TAG_PREFIX)}
+            self.filer.update_entry(entry)
+            return Response(b"", status=204,
+                            content_type="application/xml")
+        return _err("MethodNotAllowed", req.method, 405)
 
     # ---- multipart ----
     def _initiate_multipart(self, bucket: str, key: str) -> Response:
@@ -406,6 +734,77 @@ class S3Server:
         except FileNotFoundError:
             return _err("NoSuchUpload", upload_id, 404)
         return Response(b"", status=204, content_type="application/xml")
+
+
+def _check_policy_conditions(pol: dict, bucket: str, key: str,
+                             size: int, fields: dict) -> str:
+    """Enforce the POST policy's conditions (reference
+    policy/post-policy.go): exact-match {"field": "value"} / ["eq", ...],
+    ["starts-with", "$field", prefix], ["content-length-range", lo, hi].
+    Returns an error string, or "" if every condition holds."""
+    actual = {k.lower(): v for k, v in fields.items()}
+    actual["bucket"] = bucket
+    actual["key"] = key
+    for cond in pol.get("conditions", []):
+        if isinstance(cond, dict):
+            for f, want in cond.items():
+                if actual.get(f.lower(), "") != str(want):
+                    return f"policy condition failed: {f}"
+        elif isinstance(cond, list) and cond:
+            op = str(cond[0]).lower()
+            if op == "content-length-range":
+                lo, hi = int(cond[1]), int(cond[2])
+                if not lo <= size <= hi:
+                    return "content-length out of policy range"
+            elif op in ("eq", "starts-with"):
+                f = str(cond[1]).lstrip("$").lower()
+                have = actual.get(f, "")
+                want = str(cond[2])
+                ok = (have == want if op == "eq"
+                      else have.startswith(want))
+                if not ok:
+                    return f"policy condition failed: {f}"
+    return ""
+
+
+def _parse_tag_header(header: str) -> dict:
+    """x-amz-tagging: url-encoded k=v&k=v."""
+    if not header:
+        return {}
+    return {k: v[0] for k, v in
+            urllib.parse.parse_qs(header, keep_blank_values=True).items()}
+
+
+def _parse_multipart_form(body: bytes, boundary: bytes
+                          ) -> tuple[dict, Optional[bytes], str]:
+    """Parse a multipart/form-data body. Returns (fields, file_bytes,
+    file_name); the part named "file" is the payload, everything else a
+    text field."""
+    fields: dict[str, str] = {}
+    file_data: Optional[bytes] = None
+    file_name = ""
+    delim = b"--" + boundary
+    for part in body.split(delim):
+        # trim exactly the delimiting CRLFs, never payload bytes
+        if part.startswith(b"\r\n"):
+            part = part[2:]
+        if part.endswith(b"\r\n"):
+            part = part[:-2]
+        if not part or part == b"--":
+            continue
+        header_blob, _, content = part.partition(b"\r\n\r\n")
+        headers = header_blob.decode("utf-8", "replace")
+        m = re.search(r'name="([^"]*)"', headers)
+        if not m:
+            continue
+        name = m.group(1)
+        if name == "file":
+            file_data = content
+            fm = re.search(r'filename="([^"]*)"', headers)
+            file_name = fm.group(1) if fm else ""
+        else:
+            fields[name] = content.decode("utf-8", "replace")
+    return fields, file_data, file_name
 
 
 def _iso(ts: float) -> str:

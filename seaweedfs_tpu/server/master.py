@@ -169,7 +169,11 @@ class MasterServer:
             self.url, self.peers,
             apply_fn=self._apply_raft_command,
             snapshot_fn=lambda: {"max_volume_id": self.topo.max_volume_id,
-                                 "sequence": self.sequencer.peek()},
+                                 # followers never mint ids, so their live
+                                 # counter is stale — the committed
+                                 # checkpoint is the durable floor
+                                 "sequence": max(self._seq_ckpt,
+                                                 self.sequencer.peek())},
             restore_fn=self._restore_raft_snapshot,
             state_path=state_path)
         self.raft.start()
@@ -483,6 +487,12 @@ class MasterServer:
     def _handle_grow(self, req: Request) -> Response:
         if not self.is_leader():
             return self._not_leader()
+        if self.raft is not None and not self.raft.is_ready():
+            # same barrier as assign_fid: a fresh leader must apply
+            # inherited max_volume_id commits before minting new vids
+            if not self.raft.wait_ready(timeout=2.0):
+                return Response({"error": "raft leader not ready"},
+                                status=503)
         count = int(req.query.get("count") or 1)
         collection = req.query.get("collection", "")
         replication = (req.query.get("replication")
