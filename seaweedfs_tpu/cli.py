@@ -86,6 +86,17 @@ def cmd_server(args):
     _wait_forever()
 
 
+def cmd_filer(args):
+    """Standalone filer server (reference command/filer.go)."""
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    fs = FilerServer(args.master, host=args.ip, port=args.port,
+                     store=args.store, store_dir=args.dir,
+                     default_replication=args.defaultReplication)
+    fs.start()
+    print(f"filer {fs.url} (store={args.store})")
+    _wait_forever()
+
+
 def cmd_upload(args):
     from seaweedfs_tpu.client import operation
     from seaweedfs_tpu.client.wdclient import MasterClient
@@ -297,6 +308,16 @@ def main(argv=None):
     s.add_argument("-s3", action="store_true")
     s.add_argument("-s3Port", type=int, default=8333)
     s.set_defaults(fn=cmd_server)
+
+    fl = sub.add_parser("filer", help="standalone filer (reference `weed filer`)")
+    fl.add_argument("-ip", default="127.0.0.1")
+    fl.add_argument("-port", type=int, default=8888)
+    fl.add_argument("-master", default="127.0.0.1:9333")
+    fl.add_argument("-store", default="memory",
+                    choices=["memory", "sqlite", "lsm"])
+    fl.add_argument("-dir", default=".", help="store/state directory")
+    fl.add_argument("-defaultReplication", default="")
+    fl.set_defaults(fn=cmd_filer)
 
     u = sub.add_parser("upload")
     u.add_argument("-master", default="127.0.0.1:9333")

@@ -18,17 +18,25 @@ class FileChunk:
     etag: str = ""
     cipher_key: bytes = b""
     is_compressed: bool = False
+    is_chunk_manifest: bool = False  # reference filer_pb FileChunk.is_chunk_manifest
 
     def to_dict(self) -> dict:
-        return {"fid": self.fid, "offset": self.offset, "size": self.size,
-                "mtime_ns": self.mtime_ns, "etag": self.etag,
-                "is_compressed": self.is_compressed}
+        d = {"fid": self.fid, "offset": self.offset, "size": self.size,
+             "mtime_ns": self.mtime_ns, "etag": self.etag,
+             "is_compressed": self.is_compressed}
+        if self.is_chunk_manifest:
+            d["is_chunk_manifest"] = True
+        if self.cipher_key:
+            d["cipher_key"] = self.cipher_key.hex()
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "FileChunk":
         return cls(fid=d["fid"], offset=d["offset"], size=d["size"],
                    mtime_ns=d.get("mtime_ns", 0), etag=d.get("etag", ""),
-                   is_compressed=d.get("is_compressed", False))
+                   is_compressed=d.get("is_compressed", False),
+                   is_chunk_manifest=d.get("is_chunk_manifest", False),
+                   cipher_key=bytes.fromhex(d.get("cipher_key", "")))
 
 
 @dataclasses.dataclass
@@ -97,7 +105,8 @@ class Entry:
             "full_path": self.full_path,
             "attr": self.attr.to_dict(),
             "chunks": [c.to_dict() for c in self.chunks],
-            "extended": {k: (v.hex() if isinstance(v, bytes) else v)
+            "extended": {k: ({"__bytes__": v.hex()}
+                             if isinstance(v, bytes) else v)
                          for k, v in self.extended.items()},
             "content": self.content.hex(),
             "hard_link_id": self.hard_link_id,
@@ -105,11 +114,14 @@ class Entry:
 
     @classmethod
     def from_dict(cls, d: dict) -> "Entry":
+        extended = {k: (bytes.fromhex(v["__bytes__"])
+                        if isinstance(v, dict) and "__bytes__" in v else v)
+                    for k, v in d.get("extended", {}).items()}
         return cls(
             full_path=d["full_path"],
             attr=Attr.from_dict(d.get("attr", {})),
             chunks=[FileChunk.from_dict(c) for c in d.get("chunks", [])],
-            extended=d.get("extended", {}),
+            extended=extended,
             content=bytes.fromhex(d.get("content", "")),
             hard_link_id=d.get("hard_link_id", ""),
         )

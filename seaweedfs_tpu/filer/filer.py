@@ -15,6 +15,8 @@ from typing import Callable, Iterator, Optional
 
 from seaweedfs_tpu.filer.entry import Attr, Entry, new_directory_entry
 from seaweedfs_tpu.filer.filerstore import FilerStore, MemoryStore
+from seaweedfs_tpu.filer.filerstore_hardlink import (HardLinkStore,
+                                                     new_hard_link_id)
 
 
 class MetaLogEvent:
@@ -139,10 +141,14 @@ class MetaLog:
 class Filer:
     def __init__(self, store: Optional[FilerStore] = None,
                  delete_chunks_fn: Optional[Callable[[list[str]], None]] = None,
-                 meta_log_dir: Optional[str] = None):
-        self.store = store or MemoryStore()
+                 meta_log_dir: Optional[str] = None,
+                 read_chunk_fn: Optional[Callable[[str], bytes]] = None):
+        # every store is wrapped for hard-link resolution (reference
+        # filer.go always wraps in FilerStoreWrapper + hardlink layer)
+        self.store = HardLinkStore(store or MemoryStore())
         self.meta_log = MetaLog(persist_dir=meta_log_dir)
         self.delete_chunks_fn = delete_chunks_fn
+        self.read_chunk_fn = read_chunk_fn  # to expand manifest chunks on GC
         self._lock = threading.RLock()
         root = self.store.find_entry("/")
         if root is None:
@@ -156,8 +162,8 @@ class Filer:
             if old is not None:
                 if o_excl:
                     raise FileExistsError(entry.full_path)
-                if not old.is_directory and old.chunks:
-                    self._gc_replaced_chunks(old, entry)
+                if not old.is_directory:
+                    self._gc_replaced_entry(old, entry)
             if old is not None and old.is_directory and not entry.is_directory:
                 raise IsADirectoryError(entry.full_path)
             self.store.insert_entry(entry)
@@ -188,9 +194,37 @@ class Filer:
             if children:
                 self._delete_children(full_path)
         self.store.delete_entry(full_path)
-        if entry.chunks and self.delete_chunks_fn:
-            self.delete_chunks_fn([c.fid for c in entry.chunks])
+        self._gc_entry_chunks(entry)
         self._notify(entry.dir_path, entry.to_dict(), None)
+
+    def _gc_entry_chunks(self, entry: Entry) -> None:
+        """GC an unlinked entry's chunks; a hard-linked entry's chunks
+        survive until the last name is removed."""
+        if entry.hard_link_id:
+            if self.store.unlink(entry.hard_link_id) > 0:
+                return
+        if entry.chunks and self.delete_chunks_fn:
+            self.delete_chunks_fn(self._collect_gc_fids(entry.chunks))
+
+    def _collect_gc_fids(self, chunks: list) -> list[str]:
+        """Fids to free for a chunk list: manifest blobs AND the leaf
+        chunks they reference (reference filer_delete_entry.go expands
+        manifests before queueing deletions)."""
+        import json as _json
+
+        from seaweedfs_tpu.filer.entry import FileChunk
+        fids: list[str] = []
+        for c in chunks:
+            fids.append(c.fid)
+            if c.is_chunk_manifest and self.read_chunk_fn is not None:
+                try:
+                    blob = self.read_chunk_fn(c.fid)
+                    nested = [FileChunk.from_dict(d)
+                              for d in _json.loads(blob)["chunks"]]
+                except Exception:
+                    continue  # manifest unreadable: free what we can
+                fids.extend(self._collect_gc_fids(nested))
+        return fids
 
     def _delete_children(self, dir_path: str) -> None:
         while True:
@@ -201,8 +235,7 @@ class Filer:
                 if child.is_directory:
                     self._delete_children(child.full_path)
                 self.store.delete_entry(child.full_path)
-                if child.chunks and self.delete_chunks_fn:
-                    self.delete_chunks_fn([c.fid for c in child.chunks])
+                self._gc_entry_chunks(child)
                 self._notify(dir_path, child.to_dict(), None)
 
     def list_entries(self, dir_path: str, start_name: str = "",
@@ -229,13 +262,47 @@ class Filer:
             self.store.delete_entry(old_path)
             entry.full_path = new_path
             self._ensure_parents(entry.dir_path)
-            self.store.insert_entry(entry)
+            # a rename moves an existing name: no link-count change
+            self.store.insert_entry(entry, count_link=False)
         self._notify(entry.dir_path, entry_dict_old, entry.to_dict())
         return entry
 
     def mkdirs(self, dir_path: str) -> None:
         with self._lock:
             self._ensure_parents(_norm(dir_path))
+
+    def add_hard_link(self, src_path: str, dst_path: str) -> Entry:
+        """Create dst as another name for src's data (reference
+        weedfs_link.go Link: assigns a HardLinkId on first link, then
+        inserts a pointer entry sharing the KV metadata record)."""
+        src_path, dst_path = _norm(src_path), _norm(dst_path)
+        with self._lock:
+            src = self.store.find_entry(src_path)
+            if src is None:
+                raise FileNotFoundError(src_path)
+            if src.is_directory:
+                raise IsADirectoryError(src_path)
+            if not src.hard_link_id:
+                # rebuild (never mutate the store's object) and re-save as
+                # a linked entry; its own name counts as link #1
+                src = Entry(full_path=src.full_path, attr=src.attr,
+                            chunks=list(src.chunks), content=src.content,
+                            extended=dict(src.extended),
+                            hard_link_id=new_hard_link_id())
+                self.store.insert_entry(src)
+            self._ensure_parents(dst_path.rsplit("/", 1)[0] or "/")
+            dst = Entry(full_path=dst_path, attr=src.attr,
+                        chunks=list(src.chunks), content=src.content,
+                        extended=dict(src.extended),
+                        hard_link_id=src.hard_link_id)
+            existing_dst = self.store.find_entry(dst_path)
+            if existing_dst is not None:
+                if existing_dst.is_directory:
+                    raise IsADirectoryError(dst_path)
+                self._gc_replaced_entry(existing_dst, dst)
+            self.store.insert_entry(dst)
+        self._notify(dst.dir_path, None, dst.to_dict())
+        return dst
 
     # ---- helpers ----
     def _ensure_parents(self, dir_path: str) -> None:
@@ -245,11 +312,16 @@ class Filer:
         self._ensure_parents(dir_path.rsplit("/", 1)[0] or "/")
         self.store.insert_entry(new_directory_entry(dir_path))
 
-    def _gc_replaced_chunks(self, old: Entry, new: Entry) -> None:
+    def _gc_replaced_entry(self, old: Entry, new: Entry) -> None:
+        """Overwriting a name: free the old data — unless other hard
+        links still reference it (then just drop this name's link)."""
+        if old.hard_link_id and old.hard_link_id != new.hard_link_id:
+            if self.store.unlink(old.hard_link_id) > 0:
+                return  # data lives on under other names
         keep = {c.fid for c in new.chunks}
-        doomed = [c.fid for c in old.chunks if c.fid not in keep]
+        doomed = [c for c in old.chunks if c.fid not in keep]
         if doomed and self.delete_chunks_fn:
-            self.delete_chunks_fn(doomed)
+            self.delete_chunks_fn(self._collect_gc_fids(doomed))
 
     def _notify(self, directory: str, old_entry: Optional[dict],
                 new_entry: Optional[dict]) -> None:

@@ -68,15 +68,18 @@ class MemoryStore(FilerStore):
         self._lock = threading.RLock()
 
     def insert_entry(self, entry: Entry) -> None:
+        # store by value (like every durable store, which serializes) so
+        # callers mutating returned entries can't corrupt the store
         with self._lock:
             if entry.full_path not in self._entries:
                 bisect.insort(self._sorted, entry.full_path)
-            self._entries[entry.full_path] = entry
+            self._entries[entry.full_path] = Entry.from_dict(entry.to_dict())
 
     update_entry = insert_entry
 
     def find_entry(self, full_path: str) -> Optional[Entry]:
-        return self._entries.get(full_path)
+        e = self._entries.get(full_path)
+        return Entry.from_dict(e.to_dict()) if e is not None else None
 
     def delete_entry(self, full_path: str) -> None:
         with self._lock:
@@ -114,7 +117,7 @@ class MemoryStore(FilerStore):
                         continue
                     if name == start_name and not include_start:
                         continue
-                out.append(self._entries[p])
+                out.append(Entry.from_dict(self._entries[p].to_dict()))
                 if len(out) >= limit:
                     break
         return out
@@ -217,4 +220,7 @@ STORES = {"memory": MemoryStore, "sqlite": SqliteStore}
 
 
 def make_store(name: str, **kwargs) -> FilerStore:
+    if name == "lsm":
+        from seaweedfs_tpu.filer.lsm_store import LsmStore
+        return LsmStore(**kwargs)
     return STORES[name](**kwargs)

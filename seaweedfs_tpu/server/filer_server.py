@@ -22,15 +22,36 @@ from typing import Optional
 from seaweedfs_tpu.client import operation
 from seaweedfs_tpu.client.wdclient import MasterClient
 from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
+from seaweedfs_tpu.filer.filechunk_manifest import (MANIFEST_BATCH,
+                                                    has_chunk_manifest,
+                                                    maybe_manifestize,
+                                                    resolve_chunk_manifest)
 from seaweedfs_tpu.filer.filechunks import (non_overlapping_visible_intervals,
                                             view_from_visibles)
 from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filer_conf import FilerConf, PathConf
 from seaweedfs_tpu.filer.filerstore import make_store
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call)
 
 CHUNK_SIZE = 4 * 1024 * 1024
 INLINE_LIMIT = 2048  # small content stored in the entry itself
+
+
+def _ttl_seconds(ttl: str) -> int:
+    """Parse '3m'/'4h'/'5d'/'6w'-style TTLs (reference needle/volume_ttl.go)."""
+    if not ttl:
+        return 0
+    units = {"s": 1, "m": 60, "h": 3600, "d": 86400, "w": 604800}
+    if ttl[-1] in units:
+        try:
+            return int(ttl[:-1]) * units[ttl[-1]]
+        except ValueError:
+            return 0
+    try:
+        return int(ttl) * 60
+    except ValueError:
+        return 0
 
 
 class FilerServer:
@@ -43,8 +64,12 @@ class FilerServer:
         kwargs = {}
         if store == "sqlite":
             kwargs["path"] = (store_dir or ".") + "/filer.db"
+        elif store == "lsm":
+            kwargs["path"] = (store_dir or ".") + "/filer_lsm"
         self.filer = Filer(make_store(store, **kwargs),
-                           delete_chunks_fn=self._delete_chunks)
+                           delete_chunks_fn=self._delete_chunks,
+                           read_chunk_fn=self._read_chunk_blob)
+        self.filer_conf = FilerConf.load(self.filer.store)
         self.default_replication = default_replication
         from seaweedfs_tpu.utils.chunk_cache import TieredChunkCache
         self.chunk_cache = TieredChunkCache()
@@ -95,6 +120,9 @@ class FilerServer:
     def _register_routes(self) -> None:
         r = self.http.add
         r("POST", "/__api/rename", self._api_rename)
+        r("POST", "/__api/hardlink", self._api_hardlink)
+        r("GET", "/__api/filer_conf", self._api_filer_conf_get)
+        r("POST", "/__api/filer_conf", self._api_filer_conf_set)
         r("GET", "/__api/meta_events", self._api_meta_events)
         for method in ("POST", "PUT"):
             r(method, "/.*", self._handle_write)
@@ -109,9 +137,15 @@ class FilerServer:
             self.filer.mkdirs(path)
             return Response({"path": path}, status=201)
         data = req.body
-        collection = req.query.get("collection", "")
-        replication = req.query.get("replication",
-                                    self.default_replication)
+        # per-path rules from filer.conf fill in what the request omits
+        rule = self.filer_conf.match_storage_rule(path)
+        if rule.read_only:
+            return Response({"error": f"{rule.location_prefix} is read-only"},
+                            status=403)
+        collection = req.query.get("collection", "") or rule.collection
+        replication = (req.query.get("replication", "")
+                       or rule.replication or self.default_replication)
+        ttl = req.query.get("ttl", "") or rule.ttl
         mime = (req.headers.get("Content-Type")
                 or "application/octet-stream")
         now = time.time()
@@ -119,11 +153,13 @@ class FilerServer:
                       attr=Attr(mtime=now, crtime=now, mime=mime,
                                 file_size=len(data),
                                 collection=collection,
+                                ttl_sec=_ttl_seconds(ttl),
                                 replication=replication))
         if len(data) <= INLINE_LIMIT:
             entry.content = data
         else:
-            entry.chunks = self._upload_chunks(data, collection, replication)
+            entry.chunks = self._upload_chunks(data, collection, replication,
+                                               ttl)
         try:
             self.filer.create_entry(entry)
         except IsADirectoryError:
@@ -131,21 +167,29 @@ class FilerServer:
         return Response({"name": entry.name, "size": len(data)}, status=201)
 
     def _upload_chunks(self, data: bytes, collection: str,
-                       replication: str) -> list[FileChunk]:
+                       replication: str, ttl: str = "") -> list[FileChunk]:
         """Split into CHUNK_SIZE pieces, assign + upload each
-        (reference filer_server_handlers_write_upload.go:32-140)."""
+        (reference filer_server_handlers_write_upload.go:32-140). Wide
+        chunk lists collapse into manifest chunks (filechunk_manifest.go)."""
         chunks = []
         for off in range(0, len(data), CHUNK_SIZE):
             piece = data[off:off + CHUNK_SIZE]
-            a = self.mc.assign(collection=collection,
-                               replication=replication)
-            if a.get("error"):
-                raise HttpError(500, a["error"].encode())
-            operation.upload_to(a["fid"], a["url"], piece)
-            chunks.append(FileChunk(fid=a["fid"], offset=off,
-                                    size=len(piece),
-                                    mtime_ns=time.time_ns()))
-        return chunks
+            chunks.append(self._save_chunk(piece, off, collection,
+                                           replication, ttl))
+        return maybe_manifestize(
+            lambda blob: self._save_chunk(blob, 0, collection,
+                                          replication, ttl).fid,
+            chunks)
+
+    def _save_chunk(self, piece: bytes, offset: int, collection: str,
+                    replication: str, ttl: str = "") -> FileChunk:
+        a = self.mc.assign(collection=collection, replication=replication,
+                           ttl=ttl)
+        if a.get("error"):
+            raise HttpError(500, a["error"].encode())
+        operation.upload_to(a["fid"], a["url"], piece)
+        return FileChunk(fid=a["fid"], offset=offset, size=len(piece),
+                         mtime_ns=time.time_ns())
 
     # ---- read ----
     def _handle_read(self, req: Request) -> Response:
@@ -169,27 +213,34 @@ class FilerServer:
                         headers={"Content-Disposition":
                                  f'inline; filename="{entry.name}"'})
 
+    def _read_chunk_blob(self, fid: str) -> bytes:
+        blob = self.chunk_cache.get(fid)
+        if blob is None:
+            for url in self.mc.lookup_file_id(fid):
+                try:
+                    status, body, _ = http_call("GET", url)
+                except ConnectionError:
+                    continue
+                if status == 200:
+                    blob = body
+                    self.chunk_cache.put(fid, blob)
+                    break
+        if blob is None:
+            raise HttpError(500, f"chunk {fid} unreachable".encode())
+        return blob
+
     def _read_entry_bytes(self, entry: Entry) -> bytes:
         if entry.content or not entry.chunks:
             return entry.content
+        chunks = entry.chunks
+        if has_chunk_manifest(chunks):
+            chunks = resolve_chunk_manifest(self._read_chunk_blob, chunks)
         size = entry.file_size()
-        visibles = non_overlapping_visible_intervals(entry.chunks)
+        visibles = non_overlapping_visible_intervals(chunks)
         views = view_from_visibles(visibles, 0, size)
         out = bytearray(size)
         for view in views:
-            blob = self.chunk_cache.get(view.fid)
-            if blob is None:
-                for url in self.mc.lookup_file_id(view.fid):
-                    try:
-                        status, body, _ = http_call("GET", url)
-                    except ConnectionError:
-                        continue
-                    if status == 200:
-                        blob = body
-                        self.chunk_cache.put(view.fid, blob)
-                        break
-            if blob is None:
-                raise HttpError(500, f"chunk {view.fid} unreachable".encode())
+            blob = self._read_chunk_blob(view.fid)
             piece = blob[view.offset_in_chunk:
                          view.offset_in_chunk + view.size]
             out[view.logic_offset:view.logic_offset + view.size] = piece
@@ -209,8 +260,19 @@ class FilerServer:
         }
 
     # ---- delete ----
+    def _check_writable(self, path: str) -> Optional[Response]:
+        rule = self.filer_conf.match_storage_rule(path)
+        if rule.read_only:
+            return Response(
+                {"error": f"{rule.location_prefix} is read-only"},
+                status=403)
+        return None
+
     def _handle_delete(self, req: Request) -> Response:
         path = req.path.rstrip("/") or "/"
+        denied = self._check_writable(path)
+        if denied:
+            return denied
         recursive = req.query.get("recursive") == "true"
         try:
             self.filer.delete_entry(path, recursive=recursive)
@@ -223,11 +285,43 @@ class FilerServer:
     # ---- api ----
     def _api_rename(self, req: Request) -> Response:
         b = req.json()
+        denied = (self._check_writable(b["from"])
+                  or self._check_writable(b["to"]))
+        if denied:
+            return denied
         try:
             entry = self.filer.rename_entry(b["from"], b["to"])
         except FileNotFoundError:
             return Response({"error": "not found"}, status=404)
         return Response({"path": entry.full_path})
+
+    def _api_hardlink(self, req: Request) -> Response:
+        b = req.json()
+        denied = self._check_writable(b["to"])
+        if denied:
+            return denied
+        try:
+            entry = self.filer.add_hard_link(b["from"], b["to"])
+        except FileNotFoundError:
+            return Response({"error": "not found"}, status=404)
+        except IsADirectoryError:
+            return Response({"error": "is a directory"}, status=409)
+        return Response({"path": entry.full_path,
+                         "hard_link_id": entry.hard_link_id})
+
+    def _api_filer_conf_get(self, req: Request) -> Response:
+        return Response({"locations": [r.to_dict()
+                                       for r in self.filer_conf.rules]})
+
+    def _api_filer_conf_set(self, req: Request) -> Response:
+        b = req.json()
+        if b.get("delete"):
+            self.filer_conf.delete_rule(b["location_prefix"])
+        else:
+            self.filer_conf.set_rule(PathConf.from_dict(b))
+        self.filer_conf.save(self.filer.store)
+        return Response({"locations": [r.to_dict()
+                                       for r in self.filer_conf.rules]})
 
     def _api_meta_events(self, req: Request) -> Response:
         since = int(req.query.get("since_ns", 0))

@@ -1,0 +1,298 @@
+"""Filer depth: LSM embedded store, manifest chunks, hard links, and
+per-path filer.conf rules (reference weed/filer/leveldb*,
+filechunk_manifest.go, filerstore_hardlink.go, filer_conf.go)."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import filer_conf as fc
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
+from seaweedfs_tpu.filer.filechunk_manifest import (maybe_manifestize,
+                                                    resolve_chunk_manifest)
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filerstore import MemoryStore, SqliteStore
+from seaweedfs_tpu.filer.lsm_store import LsmStore
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+# ---- store contract, now including the LSM store ----
+
+def _contract(s):
+    s.insert_entry(Entry("/a/b/file.txt", Attr(mtime=1.0, file_size=5)))
+    assert s.find_entry("/a/b/file.txt").attr.file_size == 5
+    s.insert_entry(Entry("/a/b/other.txt"))
+    s.insert_entry(Entry("/a/b/sub", Attr(is_directory=True)))
+    s.insert_entry(Entry("/a/b/sub/deep.txt"))
+    assert [x.name for x in s.list_directory_entries("/a/b")] == [
+        "file.txt", "other.txt", "sub"]
+    assert [x.name for x in s.list_directory_entries(
+        "/a/b", prefix="o")] == ["other.txt"]
+    assert [x.name for x in s.list_directory_entries(
+        "/a/b", start_name="file.txt")] == ["other.txt", "sub"]
+    assert [x.name for x in s.list_directory_entries(
+        "/a/b", start_name="file.txt", include_start=True)] == [
+        "file.txt", "other.txt", "sub"]
+    s.delete_entry("/a/b/other.txt")
+    assert s.find_entry("/a/b/other.txt") is None
+    s.delete_folder_children("/a/b")
+    assert s.list_directory_entries("/a/b") == []
+    assert s.find_entry("/a/b/sub/deep.txt") is None
+    s.kv_put(b"conf", b"xyz")
+    assert s.kv_get(b"conf") == b"xyz"
+    assert s.kv_get(b"missing") is None
+    s.kv_delete(b"conf")
+    assert s.kv_get(b"conf") is None
+
+
+@pytest.mark.parametrize("kind", ["memory", "sqlite", "lsm"])
+def test_store_contract_all_stores(kind, tmp_path):
+    if kind == "lsm":
+        s = LsmStore(str(tmp_path / "lsm"))
+    else:
+        s = {"memory": MemoryStore, "sqlite": SqliteStore}[kind]()
+    _contract(s)
+    s.close()
+
+
+def test_lsm_durability_and_compaction(tmp_path, monkeypatch):
+    import seaweedfs_tpu.filer.lsm_store as mod
+    monkeypatch.setattr(mod, "MEMTABLE_FLUSH_KEYS", 8)
+    monkeypatch.setattr(mod, "COMPACT_AT_SEGMENTS", 3)
+    path = str(tmp_path / "lsm")
+    s = LsmStore(path)
+    for i in range(100):
+        s.insert_entry(Entry(f"/d/f{i:03d}", Attr(file_size=i)))
+    for i in range(0, 100, 3):
+        s.delete_entry(f"/d/f{i:03d}")
+    # reopen WITHOUT close: WAL replay must recover the memtable tail
+    s2 = LsmStore(path)
+    assert s2.find_entry("/d/f001").attr.file_size == 1
+    assert s2.find_entry("/d/f000") is None  # tombstone survived
+    names = [e.name for e in s2.list_directory_entries("/d", limit=1000)]
+    assert len(names) == 100 - len(range(0, 100, 3))
+    s2.close()
+    # clean close flushes; a third open reads pure SSTables
+    s3 = LsmStore(path)
+    assert s3.find_entry("/d/f098").attr.file_size == 98
+    s3.close()
+
+
+# ---- manifest chunks ----
+
+def test_manifest_roundtrip():
+    blobs = {}
+
+    def save(blob):
+        fid = f"m,{len(blobs)}"
+        blobs[fid] = blob
+        return fid
+
+    leaves = [FileChunk(f"1,{i}", i * 10, 10, mtime_ns=i)
+              for i in range(257)]
+    packed = maybe_manifestize(save, list(leaves), batch=16)
+    assert len(packed) <= 16
+    assert any(c.is_chunk_manifest for c in packed)
+    resolved = resolve_chunk_manifest(lambda fid: blobs[fid], packed)
+    assert sorted(c.fid for c in resolved) == sorted(c.fid for c in leaves)
+    assert {(c.offset, c.size) for c in resolved} == {
+        (c.offset, c.size) for c in leaves}
+
+
+def test_manifestize_noop_when_narrow():
+    packed = maybe_manifestize(lambda b: "x", [FileChunk("1,a", 0, 5)])
+    assert [c.fid for c in packed] == ["1,a"]
+
+
+# ---- hard links ----
+
+def test_hard_links_share_data_until_last_unlink():
+    deleted = []
+    f = Filer(delete_chunks_fn=lambda fids: deleted.extend(fids))
+    e = Entry("/docs/a.txt", Attr(mtime=1.0))
+    e.chunks = [FileChunk("3,abc", 0, 100, mtime_ns=1)]
+    f.create_entry(e)
+
+    link = f.add_hard_link("/docs/a.txt", "/docs/b.txt")
+    assert link.hard_link_id
+    got = f.find_entry("/docs/b.txt")
+    assert [c.fid for c in got.chunks] == ["3,abc"]
+    # the original resolves through the shared record too
+    src = f.find_entry("/docs/a.txt")
+    assert src.hard_link_id == link.hard_link_id
+    assert [c.fid for c in src.chunks] == ["3,abc"]
+
+    # update through one name is visible through the other
+    src.chunks = [FileChunk("3,def", 0, 50, mtime_ns=2)]
+    f.update_entry(src)
+    assert [c.fid for c in f.find_entry("/docs/b.txt").chunks] == ["3,def"]
+
+    # a rename must not change the link count
+    f.rename_entry("/docs/b.txt", "/docs/c.txt")
+    assert f.find_entry("/docs/c.txt") is not None
+
+    f.delete_entry("/docs/a.txt")
+    assert deleted == []  # still one name left
+    assert [c.fid for c in f.find_entry("/docs/c.txt").chunks] == ["3,def"]
+    f.delete_entry("/docs/c.txt")
+    assert deleted == ["3,def"]  # last name gone -> chunks GC'd
+
+
+def test_hard_links_in_listing():
+    f = Filer()
+    e = Entry("/x/a", Attr(mtime=1.0))
+    e.chunks = [FileChunk("7,z", 0, 42, mtime_ns=1)]
+    f.create_entry(e)
+    f.add_hard_link("/x/a", "/x/b")
+    listed = {x.name: x for x in f.list_entries("/x")}
+    assert listed["b"].file_size() == 42
+
+
+def test_hardlink_overwrite_one_name_keeps_shared_data():
+    deleted = []
+    f = Filer(delete_chunks_fn=lambda fids: deleted.extend(fids))
+    e = Entry("/w/a", Attr(mtime=1.0))
+    e.chunks = [FileChunk("9,shared", 0, 10, mtime_ns=1)]
+    f.create_entry(e)
+    f.add_hard_link("/w/a", "/w/b")
+    # overwrite /w/a with new content: shared chunks must survive via /w/b
+    fresh = Entry("/w/a", Attr(mtime=2.0))
+    fresh.chunks = [FileChunk("9,new", 0, 5, mtime_ns=2)]
+    f.create_entry(fresh)
+    assert deleted == []
+    assert [c.fid for c in f.find_entry("/w/b").chunks] == ["9,shared"]
+    f.delete_entry("/w/b")
+    assert deleted == ["9,shared"]
+
+
+def test_manifest_chunks_gc_expands_leaves():
+    blobs = {}
+
+    def save(blob):
+        fid = f"m,{len(blobs)}"
+        blobs[fid] = blob
+        return fid
+
+    deleted = []
+    f = Filer(delete_chunks_fn=lambda fids: deleted.extend(fids),
+              read_chunk_fn=lambda fid: blobs[fid])
+    leaves = [FileChunk(f"5,{i}", i * 10, 10, mtime_ns=1) for i in range(20)]
+    packed = maybe_manifestize(save, leaves, batch=4)
+    e = Entry("/g/wide", Attr(mtime=1.0))
+    e.chunks = packed
+    f.create_entry(e)
+    f.delete_entry("/g/wide")
+    # every leaf AND every manifest blob is freed
+    assert {f"5,{i}" for i in range(20)} <= set(deleted)
+    assert set(blobs) <= set(deleted)
+
+
+def test_extended_attrs_survive_hardlink_and_roundtrip():
+    f = Filer()
+    e = Entry("/t/tagged", Attr(mtime=1.0))
+    e.extended = {"x-amz-tag": "v1", "raw": b"\x01\x02"}
+    e.chunks = [FileChunk("4,t", 0, 3, mtime_ns=1)]
+    f.create_entry(e)
+    f.add_hard_link("/t/tagged", "/t/alias")
+    got = f.find_entry("/t/alias")
+    assert got.extended["x-amz-tag"] == "v1"
+    assert got.extended["raw"] == b"\x01\x02"  # bytes survive the codec
+
+
+# ---- filer.conf ----
+
+def test_filer_conf_longest_prefix_merge():
+    conf = fc.FilerConf()
+    conf.set_rule(fc.PathConf("/buckets/", collection="", replication="001"))
+    conf.set_rule(fc.PathConf("/buckets/hot/", collection="hot",
+                              ttl="1h"))
+    conf.set_rule(fc.PathConf("/frozen/", read_only=True))
+    r = conf.match_storage_rule("/buckets/hot/obj")
+    assert r.collection == "hot" and r.replication == "001"
+    assert r.ttl == "1h"
+    assert conf.match_storage_rule("/frozen/f").read_only
+    assert not conf.match_storage_rule("/other").read_only
+    # persistence round-trip through a store's KV space
+    store = MemoryStore()
+    conf.save(store)
+    loaded = fc.FilerConf.load(store)
+    assert len(loaded.rules) == 3
+    loaded.delete_rule("/frozen/")
+    assert len(loaded.rules) == 2
+
+
+# ---- end-to-end over a live stack ----
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    time.sleep(0.2)
+    yield master, vs, fs
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_filer_conf_http_and_read_only(stack):
+    _, _, fs = stack
+    base = f"http://{fs.url}"
+    http_json("POST", f"{base}/__api/filer_conf",
+              {"location_prefix": "/frozen/", "read_only": True})
+    status, _, _ = http_call("POST", f"{base}/frozen/x", body=b"no")
+    assert status == 403
+    # read_only also gates delete / rename / hardlink
+    status, _, _ = http_call("DELETE", f"{base}/frozen/x")
+    assert status == 403
+    status, body, _ = http_call(
+        "POST", f"{base}/__api/rename",
+        body=b'{"from": "/frozen/x", "to": "/elsewhere/x"}')
+    assert status == 403
+    http_json("POST", f"{base}/__api/filer_conf",
+              {"location_prefix": "/frozen/", "delete": True})
+    status, _, _ = http_call("POST", f"{base}/frozen/x", body=b"yes")
+    assert status == 201
+    conf = http_json("GET", f"{base}/__api/filer_conf")
+    assert conf["locations"] == []
+
+
+def test_filer_manifest_end_to_end(stack, monkeypatch):
+    _, _, fs = stack
+    import seaweedfs_tpu.server.filer_server as mod
+    monkeypatch.setattr(mod, "CHUNK_SIZE", 1024)
+    monkeypatch.setattr(mod, "INLINE_LIMIT", 16)
+
+    # force manifestization with a tiny batch
+    orig = mod.maybe_manifestize
+    monkeypatch.setattr(mod, "maybe_manifestize",
+                        lambda save, chunks, batch=4: orig(save, chunks, 4))
+    base = f"http://{fs.url}"
+    data = bytes(range(256)) * 64  # 16KB -> 16 chunks -> manifests
+    status, _, _ = http_call("POST", f"{base}/m/wide.bin", body=data)
+    assert status == 201
+    entry = fs.filer.find_entry("/m/wide.bin")
+    assert any(c.is_chunk_manifest for c in entry.chunks)
+    assert len(entry.chunks) <= 4
+    status, body, _ = http_call("GET", f"{base}/m/wide.bin")
+    assert status == 200 and body == data
+
+
+def test_filer_hardlink_http(stack):
+    _, _, fs = stack
+    base = f"http://{fs.url}"
+    http_call("POST", f"{base}/h/orig.txt", body=b"shared bytes")
+    out = http_json("POST", f"{base}/__api/hardlink",
+                    {"from": "/h/orig.txt", "to": "/h/link.txt"})
+    assert out["hard_link_id"]
+    status, body, _ = http_call("GET", f"{base}/h/link.txt")
+    assert status == 200 and body == b"shared bytes"
+    http_call("DELETE", f"{base}/h/orig.txt")
+    status, body, _ = http_call("GET", f"{base}/h/link.txt")
+    assert status == 200 and body == b"shared bytes"
