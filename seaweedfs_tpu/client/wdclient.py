@@ -130,30 +130,37 @@ class MasterClient:
     def leader(self) -> str:
         return self._leader
 
-    def _call(self, method: str, path: str, body=None):
+    def _call(self, method: str, path: str, body=None, rounds: int = 3):
+        """Try the believed leader, then every master, following 409
+        leader hints; several rounds with backoff ride out an election
+        in progress (reference wdclient retries until a leader answers,
+        masterclient.go:135-146)."""
         last_err: Exception = RuntimeError("no masters")
-        candidates = [self._leader] + [u for u in self.master_urls
-                                       if u != self._leader]
-        for url in candidates:
-            try:
-                out = http_json(method, f"http://{url}{path}", body)
-                self._leader = url
-                return out
-            except HttpError as e:
-                # follower redirect: {"error": "not leader", "leader": url}
-                if e.status == 409:
-                    import json as _json
-                    try:
-                        hint = _json.loads(e.body).get("leader")
-                    except Exception:
-                        hint = None
-                    if hint and hint not in candidates:
-                        candidates.append(hint)
-                    if hint:
-                        self._leader = hint
-                last_err = e
-            except ConnectionError as e:
-                last_err = e
+        for attempt in range(rounds):
+            candidates = [self._leader] + [u for u in self.master_urls
+                                           if u != self._leader]
+            for url in candidates:
+                try:
+                    out = http_json(method, f"http://{url}{path}", body)
+                    self._leader = url
+                    return out
+                except HttpError as e:
+                    # follower redirect: {"error": "not leader", "leader": u}
+                    if e.status == 409:
+                        import json as _json
+                        try:
+                            hint = _json.loads(e.body).get("leader")
+                        except Exception:
+                            hint = None
+                        if hint and hint not in candidates:
+                            candidates.append(hint)
+                        if hint:
+                            self._leader = hint
+                    last_err = e
+                except ConnectionError as e:
+                    last_err = e
+            if attempt + 1 < rounds:
+                time.sleep(0.4 * (attempt + 1))
         raise last_err
 
     def lookup_volume(self, vid: int, collection: str = "") -> list[dict]:

@@ -72,6 +72,25 @@ class Attr:
 
 
 @dataclasses.dataclass
+class RemoteEntry:
+    """Cloud-sync state for a remote-mounted file (reference
+    filer_pb RemoteEntry, weed/filer/entry.go Remote field)."""
+    storage_name: str = ""
+    last_local_sync_ts: int = 0
+    remote_etag: str = ""
+    remote_mtime: int = 0
+    remote_size: int = 0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RemoteEntry":
+        return cls(**{k: v for k, v in d.items()
+                      if k in {f.name for f in dataclasses.fields(cls)}})
+
+
+@dataclasses.dataclass
 class Entry:
     full_path: str
     attr: Attr = dataclasses.field(default_factory=Attr)
@@ -79,6 +98,7 @@ class Entry:
     extended: dict = dataclasses.field(default_factory=dict)
     content: bytes = b""  # small files inlined
     hard_link_id: str = ""
+    remote: Optional[RemoteEntry] = None  # set when under a remote mount
 
     @property
     def is_directory(self) -> bool:
@@ -110,6 +130,7 @@ class Entry:
                          for k, v in self.extended.items()},
             "content": self.content.hex(),
             "hard_link_id": self.hard_link_id,
+            **({"remote": self.remote.to_dict()} if self.remote else {}),
         }
 
     @classmethod
@@ -124,6 +145,8 @@ class Entry:
             extended=extended,
             content=bytes.fromhex(d.get("content", "")),
             hard_link_id=d.get("hard_link_id", ""),
+            remote=(RemoteEntry.from_dict(d["remote"])
+                    if d.get("remote") else None),
         )
 
 

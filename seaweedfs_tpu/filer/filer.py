@@ -51,6 +51,7 @@ class MetaLog:
         self._cond = threading.Condition(self._lock)
         self.persist_dir = persist_dir
         self._seg_buf: list[str] = []
+        self.listeners: list[Callable[[MetaLogEvent], None]] = []
         if persist_dir:
             import os
             os.makedirs(persist_dir, exist_ok=True)
@@ -66,6 +67,11 @@ class MetaLog:
                 if len(self._seg_buf) >= self.SEGMENT_EVENTS:
                     self._flush_segment_locked()
             self._cond.notify_all()
+        for listener in list(self.listeners):
+            try:
+                listener(ev)
+            except Exception:
+                pass
 
     def _flush_segment_locked(self) -> None:
         import os

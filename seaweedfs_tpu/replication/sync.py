@@ -19,14 +19,19 @@ from seaweedfs_tpu.utils.httpd import HttpError, http_json
 
 def subscribe_meta_events(filer_url: str, since_ns: int = 0,
                           path_prefix: str = "/",
-                          poll_wait: float = 5.0):
-    """Generator of meta events from a filer, resuming from since_ns."""
+                          poll_wait: float = 5.0,
+                          aggregated: bool = False):
+    """Generator of meta events from a filer, resuming from since_ns.
+    With aggregated=True the filer serves its MetaAggregator's merged
+    cluster-wide stream (reference SubscribeMetadata) instead of its
+    local log (SubscribeLocalMetadata)."""
+    agg = "&aggregated=true" if aggregated else ""
     while True:
         try:
             out = http_json(
                 "GET",
                 f"http://{filer_url}/__api/meta_events?since_ns={since_ns}"
-                f"&prefix={path_prefix}&wait={poll_wait}",
+                f"&prefix={path_prefix}&wait={poll_wait}{agg}",
                 timeout=poll_wait + 30)
         except (ConnectionError, HttpError):
             time.sleep(1.0)
@@ -86,12 +91,14 @@ class FilerSync:
 
 def meta_tail(filer_url: str, path_prefix: str = "/", since_ns: int = 0,
               emit: Callable[[dict], None] = None,
-              max_events: Optional[int] = None) -> int:
+              max_events: Optional[int] = None,
+              aggregated: bool = False) -> int:
     """Print (or hand to `emit`) meta events as they happen
     (reference filer_meta_tail.go). Returns events seen."""
     emit = emit or (lambda ev: print(json.dumps(ev)))
     seen = 0
-    for ev in subscribe_meta_events(filer_url, since_ns, path_prefix):
+    for ev in subscribe_meta_events(filer_url, since_ns, path_prefix,
+                                    aggregated=aggregated):
         if ev is None:
             if max_events is not None:
                 break

@@ -70,6 +70,8 @@ class FilerServer:
                            delete_chunks_fn=self._delete_chunks,
                            read_chunk_fn=self._read_chunk_blob)
         self.filer_conf = FilerConf.load(self.filer.store)
+        from seaweedfs_tpu.filer.remote_mount import RemoteMounts
+        self.remote_mounts = RemoteMounts(self.filer)
         self.default_replication = default_replication
         from seaweedfs_tpu.utils.chunk_cache import TieredChunkCache
         self.chunk_cache = TieredChunkCache()
@@ -80,6 +82,19 @@ class FilerServer:
         self.http.start()
         self._announce_stop = threading.Event()
         threading.Thread(target=self._announce_loop, daemon=True).start()
+        # merged view of every peer filer's change log (reference
+        # filer/meta_aggregator.go; peers from master cluster membership)
+        from seaweedfs_tpu.filer.meta_aggregator import MetaAggregator
+        self.meta_aggregator = MetaAggregator(
+            self.url, self._list_peer_filers, self.filer.meta_log)
+        self.meta_aggregator.start()
+
+    def _list_peer_filers(self) -> list[str]:
+        from seaweedfs_tpu.utils.httpd import http_json
+        out = http_json(
+            "GET", f"http://{self.master_url}/cluster/nodes?type=filer",
+            timeout=5)
+        return [n["url"] for n in out.get("cluster_nodes", [])]
 
     def _announce_loop(self) -> None:
         from seaweedfs_tpu.utils.httpd import http_json
@@ -99,6 +114,8 @@ class FilerServer:
     def stop(self) -> None:
         if hasattr(self, "_announce_stop"):
             self._announce_stop.set()
+        if hasattr(self, "meta_aggregator"):
+            self.meta_aggregator.stop()
         self.http.stop()
         self.filer.close()
 
@@ -124,6 +141,15 @@ class FilerServer:
         r("GET", "/__api/filer_conf", self._api_filer_conf_get)
         r("POST", "/__api/filer_conf", self._api_filer_conf_set)
         r("GET", "/__api/meta_events", self._api_meta_events)
+        r("GET", "/__api/remote/status", self._api_remote_status)
+        r("POST", "/__api/remote/configure", self._api_remote_configure)
+        r("POST", "/__api/remote/mount", self._api_remote_mount)
+        r("POST", "/__api/remote/unmount", self._api_remote_unmount)
+        r("POST", "/__api/remote/pull", self._api_remote_pull)
+        r("POST", "/__api/remote/cache", self._api_remote_cache)
+        r("POST", "/__api/remote/uncache", self._api_remote_uncache)
+        r("POST", "/__api/remote/writeback", self._api_remote_writeback)
+        r("POST", "/__api/remote/rm", self._api_remote_rm)
         for method in ("POST", "PUT"):
             r(method, "/.*", self._handle_write)
         r("GET", "/.*", self._handle_read)
@@ -230,6 +256,10 @@ class FilerServer:
         return blob
 
     def _read_entry_bytes(self, entry: Entry) -> bytes:
+        if not entry.content and not entry.chunks and entry.remote:
+            # remote-mounted, not cached locally: read through
+            # (reference filer/read_remote.go)
+            return self.remote_mounts.read_through(entry)
         if entry.content or not entry.chunks:
             return entry.content
         chunks = entry.chunks
@@ -323,10 +353,97 @@ class FilerServer:
         return Response({"locations": [r.to_dict()
                                        for r in self.filer_conf.rules]})
 
+    # ---- remote mounts (reference weed/filer remote_storage +
+    #      shell remote.* + command/filer_remote_sync.go) ----
+    def _api_remote_status(self, req: Request) -> Response:
+        return Response({
+            "remotes": [c.to_public_dict()
+                        for c in self.remote_mounts.list_confs().values()],
+            "mappings": self.remote_mounts.list_mappings()})
+
+    def _api_remote_configure(self, req: Request) -> Response:
+        from seaweedfs_tpu.remote_storage.remote_storage import RemoteConf
+        b = req.json()
+        if b.get("delete"):
+            self.remote_mounts.delete_conf(b["name"])
+        else:
+            self.remote_mounts.configure(RemoteConf.from_dict(b))
+        return self._api_remote_status(req)
+
+    def _api_remote_mount(self, req: Request) -> Response:
+        b = req.json()
+        try:
+            self.remote_mounts.mount(b["dir"], b["remote_name"],
+                                     b.get("remote_path", ""))
+        except KeyError as e:
+            return Response({"error": str(e)}, status=404)
+        return self._api_remote_status(req)
+
+    def _api_remote_unmount(self, req: Request) -> Response:
+        self.remote_mounts.unmount(req.json()["dir"])
+        return self._api_remote_status(req)
+
+    def _api_remote_pull(self, req: Request) -> Response:
+        try:
+            n = self.remote_mounts.pull_metadata(req.json()["dir"])
+        except KeyError as e:
+            return Response({"error": str(e)}, status=404)
+        return Response({"pulled": n})
+
+    def _remote_entry_or_error(self, req: Request):
+        path = req.json()["path"]
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return None, Response({"error": "not found"}, status=404)
+        return entry, None
+
+    def _api_remote_cache(self, req: Request) -> Response:
+        entry, err = self._remote_entry_or_error(req)
+        if err:
+            return err
+        # same placement rules as a normal write to this path
+        rule = self.filer_conf.match_storage_rule(entry.full_path)
+        replication = rule.replication or self.default_replication
+        entry = self.remote_mounts.cache_entry(
+            entry, lambda data: self._upload_chunks(
+                data, rule.collection, replication, rule.ttl))
+        return Response({"cached": entry.full_path,
+                         "chunks": len(entry.chunks)})
+
+    def _api_remote_uncache(self, req: Request) -> Response:
+        entry, err = self._remote_entry_or_error(req)
+        if err:
+            return err
+        self.remote_mounts.uncache_entry(entry)
+        return Response({"uncached": entry.full_path})
+
+    def _api_remote_writeback(self, req: Request) -> Response:
+        entry, err = self._remote_entry_or_error(req)
+        if err:
+            return err
+        data = self._read_entry_bytes(entry)
+        self.remote_mounts.write_back(entry, data)
+        return Response({"synced": entry.full_path, "size": len(data)})
+
+    def _api_remote_rm(self, req: Request) -> Response:
+        self.remote_mounts.delete_remote(req.json()["path"])
+        return Response({})
+
     def _api_meta_events(self, req: Request) -> Response:
         since = int(req.query.get("since_ns", 0))
         prefix = req.query.get("prefix", "/")
         wait = float(req.query.get("wait", 0))
+        if req.query.get("aggregated") == "true":
+            # reference SubscribeMetadata (cluster-wide) vs
+            # SubscribeLocalMetadata (this filer only)
+            log = getattr(self, "meta_aggregator", None)
+            if log is None:
+                return Response({"error": "aggregator not running"},
+                                status=503)
+            if wait > 0:
+                log.log.wait_for_events(since, timeout=min(wait, 30))
+            return Response(
+                {"events": log.log.read_since(since, prefix)})
         if wait > 0:
             self.filer.meta_log.wait_for_events(since, timeout=min(wait, 30))
         events = self.filer.meta_log.read_since(since, prefix)

@@ -9,6 +9,13 @@ from seaweedfs_tpu.shell.commands import ShellContext
 
 HELP = """commands:
   fs.ls/cat/rm/mkdir/mv/du/tree <path> [..]   filer namespace ops
+  fs.configure -locationPrefix /p [-collection C] [-ttl T] [-readOnly] [-delete]
+  remote.configure -name N [-type local] [-root DIR] | -delete N
+  remote.mount -dir /m -remote N [-path prefix]
+  remote.unmount -dir /m
+  remote.meta.sync -dir /m          pull remote listing into the filer
+  remote.cache/uncache -path /m/f   materialize / drop local chunk copy
+  remote.status
   volume.list                       show topology
   volume.fix.replication [-n]      re-replicate under-replicated volumes
   volume.vacuum [threshold]         compact garbage-heavy volumes
@@ -96,7 +103,55 @@ def run_command(sh: ShellContext, line: str):
             for line_ in fsc.tree(args[0] if args else "/"):
                 print(line_)
             return None
+        if op == "configure":
+            # per-path storage rules (reference command_fs_configure.go)
+            from seaweedfs_tpu.utils.httpd import http_json
+            body = {"location_prefix": flags.get("locationPrefix", "/")}
+            if "-delete" in args:
+                body["delete"] = True
+            for k_flag, k_body in (("collection", "collection"),
+                                   ("replication", "replication"),
+                                   ("ttl", "ttl"), ("disk", "disk_type")):
+                if k_flag in flags:
+                    body[k_body] = flags[k_flag]
+            if "-readOnly" in args:
+                body["read_only"] = True
+            return http_json(
+                "POST", f"http://{fsc.filer_url}/__api/filer_conf", body)
         raise ValueError(f"unknown fs command {op!r}")
+    if cmd.startswith("remote."):
+        # reference shell command_remote_*.go
+        from seaweedfs_tpu.utils.httpd import http_json
+        filer = _find_filer(sh)
+        base = f"http://{filer}/__api/remote"
+        op = cmd[len("remote."):]
+        if op == "configure":
+            if "delete" in flags:
+                return http_json("POST", f"{base}/configure",
+                                 {"name": flags["delete"], "delete": True})
+            return http_json("POST", f"{base}/configure", {
+                "name": flags["name"],
+                "type": flags.get("type", "local"),
+                "root": flags.get("root", ""),
+                "endpoint": flags.get("endpoint", "")})
+        if op == "mount":
+            return http_json("POST", f"{base}/mount", {
+                "dir": flags["dir"], "remote_name": flags["remote"],
+                "remote_path": flags.get("path", "")})
+        if op == "unmount":
+            return http_json("POST", f"{base}/unmount",
+                             {"dir": flags["dir"]})
+        if op == "meta.sync":
+            return http_json("POST", f"{base}/pull", {"dir": flags["dir"]})
+        if op == "cache":
+            return http_json("POST", f"{base}/cache",
+                             {"path": flags["path"]})
+        if op == "uncache":
+            return http_json("POST", f"{base}/uncache",
+                             {"path": flags["path"]})
+        if op == "status":
+            return http_json("GET", f"{base}/status")
+        raise ValueError(f"unknown remote command {op!r}")
     if cmd == "volume.list":
         return sh.volume_list()
     if cmd == "volume.fix.replication":

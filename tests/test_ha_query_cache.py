@@ -36,7 +36,12 @@ def test_multi_master_failover(tmp_path):
 
     vs = VolumeServer([str(tmp_path / "v")], urls, rack="r1")
     vs.start()
-    time.sleep(0.2)
+    # wait until the LEADER has the volume server registered (the
+    # heartbeat may first land on a follower during election churn)
+    deadline = time.time() + 15
+    while time.time() < deadline and not leader.topo.all_nodes():
+        time.sleep(0.1)
+    assert leader.topo.all_nodes(), "volume server never reached the leader"
     try:
         mc = MasterClient(urls)
         res = operation.upload_data(mc, b"ha payload")

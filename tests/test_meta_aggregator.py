@@ -1,0 +1,90 @@
+"""MetaAggregator: a filer group's merged change stream (reference
+weed/filer/meta_aggregator.go)."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.meta_aggregator import AggregatedLog
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+def test_aggregated_log_monotonic_and_filtered():
+    log = AggregatedLog(capacity=8)
+    for i in range(12):
+        log.append("peer:1", {"tsns": i, "directory": f"/d{i % 2}"})
+    assert len(log.events) == 8  # ring capped
+    ts = [e["tsns"] for e in log.events]
+    assert ts == sorted(ts) and len(set(ts)) == len(ts)  # strictly increasing
+    only_d0 = log.read_since(0, "/d0")
+    assert all(e["directory"] == "/d0" for e in only_d0)
+    # cursor resume: nothing before the cursor is re-delivered
+    cursor = log.events[3]["tsns"]
+    later = log.read_since(cursor)
+    assert all(e["tsns"] > cursor for e in later)
+
+
+@pytest.fixture
+def two_filers(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url)
+    vs.start()
+    f1 = FilerServer(master.url)
+    f1.start()
+    f2 = FilerServer(master.url)
+    f2.start()
+    # let both filers register with the master and discover each other
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        nodes = http_json(
+            "GET", f"http://{master.url}/cluster/nodes?type=filer")
+        if len(nodes.get("cluster_nodes", [])) >= 2 and \
+                f2.url in f1.meta_aggregator._followers and \
+                f1.url in f2.meta_aggregator._followers:
+            break
+        time.sleep(0.2)
+    yield master, f1, f2
+    f2.stop()
+    f1.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_cross_filer_aggregated_stream(two_filers):
+    master, f1, f2 = two_filers
+    # write on filer 1 and filer 2
+    http_call("POST", f"http://{f1.url}/a/on1.txt", body=b"one")
+    http_call("POST", f"http://{f2.url}/a/on2.txt", body=b"two")
+
+    def wait_events(filer, want_paths):
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            out = http_json(
+                "GET", f"http://{filer.url}/__api/meta_events"
+                       "?since_ns=0&aggregated=true")
+            paths = {(e["new_entry"] or {}).get("full_path")
+                     for e in out["events"]}
+            if want_paths <= paths:
+                return out["events"]
+            time.sleep(0.2)
+        raise AssertionError(
+            f"filer {filer.url} never aggregated {want_paths}; saw {paths}")
+
+    want = {"/a/on1.txt", "/a/on2.txt"}
+    ev1 = wait_events(f1, want)  # f1 sees f2's event
+    ev2 = wait_events(f2, want)  # f2 sees f1's event
+
+    # provenance: each event names its source filer
+    src1 = {e["source"] for e in ev1
+            if (e["new_entry"] or {}).get("full_path") in want}
+    assert src1 == {f1.url, f2.url}
+    # local-only stream stays local
+    local = http_json(
+        "GET", f"http://{f1.url}/__api/meta_events?since_ns=0")
+    local_paths = {(e["new_entry"] or {}).get("full_path")
+                   for e in local["events"]}
+    assert "/a/on2.txt" not in local_paths

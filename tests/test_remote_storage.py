@@ -1,0 +1,211 @@
+"""Remote storage SPI, filer remote mounts, read-through, cache/uncache,
+and filer.remote.sync (reference weed/remote_storage,
+weed/filer/remote_storage.go, command/filer_remote_sync.go)."""
+
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.remote_storage.remote_storage import (LocalDirRemote,
+                                                         RemoteConf,
+                                                         make_remote_client)
+from seaweedfs_tpu.replication.remote_sync import FilerRemoteSync
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+def test_local_remote_client(tmp_path):
+    root = str(tmp_path / "cloud")
+    c = make_remote_client(RemoteConf(name="r1", type="local", root=root))
+    assert isinstance(c, LocalDirRemote)
+    c.write_file("a/b.txt", b"hello")
+    assert c.read_file("a/b.txt") == b"hello"
+    assert c.read_file("a/b.txt", offset=1, size=3) == b"ell"
+    st = c.stat("a/b.txt")
+    assert st.size == 5 and st.etag
+    listing = list(c.traverse())
+    paths = {f.path for f in listing}
+    assert "a" in paths and "a/b.txt" in paths
+    assert next(f for f in listing if f.path == "a").is_directory
+    c.remove_file("a/b.txt")
+    assert c.stat("a/b.txt") is None
+    with pytest.raises(ValueError):
+        c.read_file("../escape")
+
+
+def test_unknown_remote_type_is_plug_point():
+    with pytest.raises(NotImplementedError):
+        make_remote_client(RemoteConf(name="x", type="s3"))
+
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    time.sleep(0.2)
+    yield master, vs, fs, tmp_path
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def _setup_mount(fs, tmp_path) -> str:
+    cloud = str(tmp_path / "cloud")
+    os.makedirs(cloud + "/photos", exist_ok=True)
+    with open(cloud + "/photos/cat.jpg", "wb") as f:
+        f.write(b"MEOW" * 100)
+    base = f"http://{fs.url}"
+    http_json("POST", f"{base}/__api/remote/configure",
+              {"name": "mycloud", "type": "local", "root": cloud})
+    http_json("POST", f"{base}/__api/remote/mount",
+              {"dir": "/cloud", "remote_name": "mycloud"})
+    return base
+
+
+def test_mount_pull_readthrough_cache_uncache(stack):
+    _, _, fs, tmp_path = stack
+    base = _setup_mount(fs, tmp_path)
+
+    out = http_json("POST", f"{base}/__api/remote/pull", {"dir": "/cloud"})
+    assert out["pulled"] == 1
+    # metadata only: no chunks, remote record present
+    entry = fs.filer.find_entry("/cloud/photos/cat.jpg")
+    assert entry.chunks == [] and entry.remote is not None
+    assert entry.remote.storage_name == "mycloud"
+    assert entry.attr.file_size == 400
+
+    # read-through
+    status, body, _ = http_call("GET", f"{base}/cloud/photos/cat.jpg")
+    assert status == 200 and body == b"MEOW" * 100
+
+    # cache -> local chunks materialized
+    out = http_json("POST", f"{base}/__api/remote/cache",
+                    {"path": "/cloud/photos/cat.jpg"})
+    assert out["chunks"] >= 1
+    entry = fs.filer.find_entry("/cloud/photos/cat.jpg")
+    assert entry.chunks and entry.remote.last_local_sync_ts > 0
+    status, body, _ = http_call("GET", f"{base}/cloud/photos/cat.jpg")
+    assert status == 200 and body == b"MEOW" * 100
+
+    # uncache -> back to metadata-only, still readable via remote
+    http_json("POST", f"{base}/__api/remote/uncache",
+              {"path": "/cloud/photos/cat.jpg"})
+    entry = fs.filer.find_entry("/cloud/photos/cat.jpg")
+    assert entry.chunks == []
+    status, body, _ = http_call("GET", f"{base}/cloud/photos/cat.jpg")
+    assert status == 200 and body == b"MEOW" * 100
+
+    # second pull with unchanged etag is a no-op
+    out = http_json("POST", f"{base}/__api/remote/pull", {"dir": "/cloud"})
+    assert out["pulled"] == 0
+
+
+def test_remote_sync_pushes_local_writes(stack):
+    _, _, fs, tmp_path = stack
+    base = _setup_mount(fs, tmp_path)
+    cloud = str(tmp_path / "cloud")
+
+    sync = FilerRemoteSync(fs.url, "/cloud")
+    cursor = sync.run_once(0)
+
+    # local write under the mount -> pushed to the remote
+    http_call("POST", f"{base}/cloud/new.txt", body=b"fresh local data")
+    cursor = sync.run_once(cursor)
+    assert sync.synced == 1
+    with open(cloud + "/new.txt", "rb") as f:
+        assert f.read() == b"fresh local data"
+    # the filer entry now carries the sync record
+    entry = fs.filer.find_entry("/cloud/new.txt")
+    assert entry.remote is not None
+    assert entry.remote.last_local_sync_ts > 0
+
+    # no echo: replaying the stream pushes nothing new
+    cursor = sync.run_once(cursor)
+    assert sync.synced == 1
+
+    # delete propagates
+    http_call("DELETE", f"{base}/cloud/new.txt")
+    cursor = sync.run_once(cursor)
+    assert sync.removed == 1
+    assert not os.path.exists(cloud + "/new.txt")
+
+
+def test_remote_sync_rename_removes_old_object(stack):
+    _, _, fs, tmp_path = stack
+    base = _setup_mount(fs, tmp_path)
+    cloud = str(tmp_path / "cloud")
+    sync = FilerRemoteSync(fs.url, "/cloud")
+    cursor = sync.run_once(0)
+
+    http_call("POST", f"{base}/cloud/old.txt", body=b"data")
+    cursor = sync.run_once(cursor)
+    assert os.path.exists(cloud + "/old.txt")
+
+    # rename within the mount: old object removed, new one written
+    http_json("POST", f"{base}/__api/rename",
+              {"from": "/cloud/old.txt", "to": "/cloud/new_name.txt"})
+    cursor = sync.run_once(cursor)
+    assert not os.path.exists(cloud + "/old.txt")
+    assert os.path.exists(cloud + "/new_name.txt")
+
+    # rename OUT of the mount: remote object removed, nothing re-pushed
+    http_json("POST", f"{base}/__api/rename",
+              {"from": "/cloud/new_name.txt", "to": "/elsewhere/x.txt"})
+    cursor = sync.run_once(cursor)
+    assert not os.path.exists(cloud + "/new_name.txt")
+
+
+def test_pull_never_clobbers_unsynced_local_write(stack):
+    _, _, fs, tmp_path = stack
+    base = _setup_mount(fs, tmp_path)
+    cloud = str(tmp_path / "cloud")
+    # same path exists remotely AND is written locally first (not synced)
+    with open(cloud + "/both.txt", "wb") as f:
+        f.write(b"remote version")
+    http_call("POST", f"{base}/cloud/both.txt", body=b"local version")
+    http_json("POST", f"{base}/__api/remote/pull", {"dir": "/cloud"})
+    status, body, _ = http_call("GET", f"{base}/cloud/both.txt")
+    assert status == 200 and body == b"local version"  # local survived
+
+
+def test_remote_status_masks_credentials(stack):
+    _, _, fs, _tmp = stack
+    base = f"http://{fs.url}"
+    http_json("POST", f"{base}/__api/remote/configure",
+              {"name": "cloudy", "type": "s3", "endpoint": "http://e",
+               "access_key": "AKIA123", "secret_key": "tops3cret"})
+    st = http_json("GET", f"{base}/__api/remote/status")
+    conf = next(r for r in st["remotes"] if r["name"] == "cloudy")
+    assert conf["access_key"] == "***" and conf["secret_key"] == "***"
+    assert "tops3cret" not in str(st)
+
+
+def test_remote_shell_commands(stack):
+    from seaweedfs_tpu.shell.commands import ShellContext
+    from seaweedfs_tpu.shell.repl import run_command
+    master, _, fs, tmp_path = stack
+    cloud = str(tmp_path / "cloud2")
+    os.makedirs(cloud, exist_ok=True)
+    with open(cloud + "/f.bin", "wb") as f:
+        f.write(b"xyz")
+    sh = ShellContext(master.url)
+    run_command(sh, f"remote.configure -name c2 -type local -root {cloud}")
+    run_command(sh, "remote.mount -dir /m2 -remote c2")
+    out = run_command(sh, "remote.meta.sync -dir /m2")
+    assert out["pulled"] == 1
+    st = run_command(sh, "remote.status")
+    assert "c2" in {r["name"] for r in st["remotes"]}
+    assert "/m2" in st["mappings"]
+    out = run_command(sh, "remote.cache -path /m2/f.bin")
+    assert out["chunks"] >= 0
+    run_command(sh, "remote.uncache -path /m2/f.bin")
+    run_command(sh, "remote.unmount -dir /m2")
+    st = run_command(sh, "remote.status")
+    assert "/m2" not in st["mappings"]
