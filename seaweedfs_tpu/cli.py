@@ -23,6 +23,10 @@ def _add_common_volume_args(p):
     p.add_argument("-dataCenter", default="")
     p.add_argument("-coder", default="cpu", choices=["cpu", "jax", "pallas"],
                    help="erasure coder backend (jax/pallas = TPU)")
+    p.add_argument("-index", default="memory", choices=["memory", "ldb"],
+                   help="needle map kind (reference -index flag)")
+    p.add_argument("-tcp", action="store_true",
+                   help="serve the raw TCP data path (reference -useTcp)")
 
 
 def cmd_master(args):
@@ -49,9 +53,12 @@ def cmd_volume(args):
     vs = VolumeServer(dirs, args.mserver, host=args.ip, port=args.port,
                       rack=args.rack, data_center=args.dataCenter,
                       coder=make_coder(args.coder),
-                      max_volume_counts=[args.max] * len(dirs))
+                      max_volume_counts=[args.max] * len(dirs),
+                      needle_map_kind=args.index,
+                      tcp_port=0 if args.tcp else -1)
     vs.start()
-    print(f"volume server listening on {vs.url}, master {args.mserver}")
+    tcp = f", tcp {vs.tcp_server.port}" if vs.tcp_server else ""
+    print(f"volume server listening on {vs.url}{tcp}, master {args.mserver}")
     _wait_forever()
 
 
@@ -66,7 +73,9 @@ def cmd_server(args):
     dirs = args.dir.split(",")
     vs = VolumeServer(dirs, ms.url, host=args.ip, port=args.port,
                       coder=make_coder(args.coder),
-                      max_volume_counts=[args.max] * len(dirs))
+                      max_volume_counts=[args.max] * len(dirs),
+                      needle_map_kind=args.index,
+                      tcp_port=0 if args.tcp else -1)
     vs.start()
     print(f"master {ms.url}; volume {vs.url}")
     extra = []
@@ -229,15 +238,41 @@ def cmd_benchmark(args):
     rng = np.random.default_rng(0)
     payload = rng.integers(0, 256, args.size, dtype=np.uint8).tobytes()
 
+    tcp_clients = {}
+    tcp_lock = __import__("threading").Lock()
+
+    def tcp_client_for(url: str):
+        """One persistent TCP connection per (volume server, thread)."""
+        import threading as _th
+        from seaweedfs_tpu.server.volume_tcp import TcpClient
+        from seaweedfs_tpu.utils.httpd import http_json
+        key = (url, _th.get_ident())
+        with tcp_lock:
+            c = tcp_clients.get(key)
+            if c is None:
+                st = http_json("GET", f"http://{url}/status")
+                if "TcpPort" not in st:
+                    raise SystemExit(
+                        f"{url} has no TCP port; start volume with -tcp")
+                host = url.rsplit(":", 1)[0]
+                c = TcpClient(host, st["TcpPort"])
+                tcp_clients[key] = c
+        return c
+
     fids = []
     t0 = time.perf_counter()
     lat = []
 
     def write_one(i):
         s = time.perf_counter()
-        res = operation.upload_data(mc, payload, name=f"bench{i}")
+        if args.useTcp:
+            a = mc.assign()
+            tcp_client_for(a["url"]).write(a["fid"], payload)
+            fid = a["fid"]
+        else:
+            fid = operation.upload_data(mc, payload, name=f"bench{i}").fid
         lat.append(time.perf_counter() - s)
-        return res.fid
+        return fid
 
     with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
         fids = list(ex.map(write_one, range(args.n)))
@@ -250,7 +285,12 @@ def cmd_benchmark(args):
     def read_one(_):
         fid = random.choice(fids)
         s = time.perf_counter()
-        data = operation.read_data(mc, fid)
+        if args.useTcp:
+            vid = int(fid.split(",")[0])
+            url = mc.lookup_volume(vid)[0]["url"]
+            data = tcp_client_for(url).read(fid)
+        else:
+            data = operation.read_data(mc, fid)
         lat.append(time.perf_counter() - s)
         assert len(data) == args.size
 
@@ -258,6 +298,8 @@ def cmd_benchmark(args):
         list(ex.map(read_one, range(args.n)))
     dt = time.perf_counter() - t0
     _report("read", args.n, args.size, dt, lat)
+    for c in tcp_clients.values():
+        c.close()
 
 
 def _report(op, n, size, dt, lat):
@@ -386,6 +428,8 @@ def main(argv=None):
     b.add_argument("-n", type=int, default=1000)
     b.add_argument("-size", type=int, default=1024)
     b.add_argument("-concurrency", type=int, default=16)
+    b.add_argument("-useTcp", action="store_true",
+                   help="use the raw TCP data path (reference -useTcp)")
     b.set_defaults(fn=cmd_benchmark)
 
     args = p.parse_args(argv)

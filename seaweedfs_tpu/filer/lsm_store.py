@@ -1,203 +1,32 @@
-"""Embedded log-structured (LSM) filer store, built from scratch.
+"""Embedded log-structured (LSM) filer store.
 
 The reference ships LevelDB-family embedded stores (weed/filer/leveldb,
 leveldb2, leveldb3 — `leveldb_store.go`) as its default durable metadata
-backends. Those lean on the LevelDB library; this module is the same
-component re-implemented from first principles so the framework has a
-dependency-free durable embedded store with the same structure:
+backends. This is the same component over our from-scratch LSM engine
+(`utils/lsm.py` — WAL + memtable + SSTables + compaction) instead of a
+linked library. The key encoding makes one directory a contiguous key
+range, mirroring the reference's `genKey(dirPath, fileName)` scheme
+(weed/filer/leveldb/leveldb_store.go:103-110):
 
-  - write-ahead log (WAL) for durability of the active memtable
-  - sorted in-memory memtable, flushed to immutable SSTable segments
-  - SSTables merged by a size-tiered compaction when the count grows
-  - point reads check memtable then SSTables newest-first
-  - directory listings are a k-way merge range scan (the key encoding
-    below makes one directory a contiguous key range, mirroring the
-    reference's `genKey(dirPath, fileName)` scheme in
-    weed/filer/leveldb/leveldb_store.go:103-110)
-
-Key encoding:
   entry:  b"E" + dir + b"\\x00" + name   -> entry JSON
   kv:     b"K" + user key                -> raw value
-A tombstone is a record with value length 0xFFFFFFFF.
 """
 
 from __future__ import annotations
 
-import bisect
 import json
-import os
-import struct
-import threading
-from typing import Iterator, Optional
+from typing import Optional
 
 from seaweedfs_tpu.filer.entry import Entry
 from seaweedfs_tpu.filer.filerstore import FilerStore
-
-_TOMB = 0xFFFFFFFF
-_REC = struct.Struct("<II")  # key_len, val_len (or _TOMB)
-
-MEMTABLE_FLUSH_KEYS = 4096
-COMPACT_AT_SEGMENTS = 6
-
-
-def _pack(key: bytes, val: Optional[bytes]) -> bytes:
-    if val is None:
-        return _REC.pack(len(key), _TOMB) + key
-    return _REC.pack(len(key), len(val)) + key + val
-
-
-def _iter_records(blob: bytes) -> Iterator[tuple[bytes, Optional[bytes]]]:
-    pos, n = 0, len(blob)
-    while pos + _REC.size <= n:
-        klen, vlen = _REC.unpack_from(blob, pos)
-        pos += _REC.size
-        key = blob[pos:pos + klen]
-        pos += klen
-        if vlen == _TOMB:
-            yield key, None
-        else:
-            yield key, blob[pos:pos + vlen]
-            pos += vlen
-
-
-class _SSTable:
-    """Immutable sorted segment; full key index kept in memory (the
-    segments are metadata-sized, so a sparse index buys nothing here)."""
-
-    def __init__(self, path: str):
-        self.path = path
-        self.keys: list[bytes] = []
-        self.vals: list[Optional[bytes]] = []
-        with open(path, "rb") as f:
-            blob = f.read()
-        for key, val in _iter_records(blob):
-            self.keys.append(key)
-            self.vals.append(val)
-
-    def get(self, key: bytes) -> tuple[bool, Optional[bytes]]:
-        i = bisect.bisect_left(self.keys, key)
-        if i < len(self.keys) and self.keys[i] == key:
-            return True, self.vals[i]
-        return False, None
-
-    def scan(self, lo: bytes, hi: bytes) -> Iterator[tuple[bytes, Optional[bytes]]]:
-        i = bisect.bisect_left(self.keys, lo)
-        while i < len(self.keys) and self.keys[i] < hi:
-            yield self.keys[i], self.vals[i]
-            i += 1
+from seaweedfs_tpu.utils.lsm import LsmKv
 
 
 class LsmStore(FilerStore):
     name = "lsm"
 
-    def __init__(self, path: str):
-        self.dir = path
-        os.makedirs(path, exist_ok=True)
-        self._lock = threading.RLock()
-        self._mem: dict[bytes, Optional[bytes]] = {}
-        self._mem_sorted: list[bytes] = []
-        self._tables: list[_SSTable] = []  # oldest first
-        self._next_seg = 0
-        for name in sorted(os.listdir(path)):
-            if name.endswith(".sst"):
-                self._tables.append(_SSTable(os.path.join(path, name)))
-                self._next_seg = max(self._next_seg,
-                                     int(name.split(".")[0]) + 1)
-        self._wal_path = os.path.join(path, "wal.log")
-        self._replay_wal()
-        self._wal = open(self._wal_path, "ab")
-
-    # ---- WAL / memtable / segments ----
-    def _replay_wal(self) -> None:
-        try:
-            with open(self._wal_path, "rb") as f:
-                blob = f.read()
-        except OSError:
-            return
-        for key, val in _iter_records(blob):
-            self._mem_put(key, val)
-
-    def _mem_put(self, key: bytes, val: Optional[bytes]) -> None:
-        if key not in self._mem:
-            bisect.insort(self._mem_sorted, key)
-        self._mem[key] = val
-
-    def _put(self, key: bytes, val: Optional[bytes]) -> None:
-        with self._lock:
-            self._wal.write(_pack(key, val))
-            self._wal.flush()
-            os.fsync(self._wal.fileno())
-            self._mem_put(key, val)
-            if len(self._mem) >= MEMTABLE_FLUSH_KEYS:
-                self._flush_memtable()
-
-    def _flush_memtable(self) -> None:
-        if not self._mem:
-            return
-        seg = os.path.join(self.dir, f"{self._next_seg:08d}.sst")
-        self._next_seg += 1
-        with open(seg + ".tmp", "wb") as f:
-            for key in self._mem_sorted:
-                f.write(_pack(key, self._mem[key]))
-            f.flush()
-            os.fsync(f.fileno())
-        os.rename(seg + ".tmp", seg)
-        self._tables.append(_SSTable(seg))
-        self._mem.clear()
-        self._mem_sorted.clear()
-        self._wal.close()
-        self._wal = open(self._wal_path, "wb")
-        if len(self._tables) >= COMPACT_AT_SEGMENTS:
-            self._compact()
-
-    def _compact(self) -> None:
-        """Merge every segment into one; newest value wins, tombstones
-        dropped (nothing older than a full merge can resurrect)."""
-        merged: dict[bytes, Optional[bytes]] = {}
-        for table in self._tables:  # oldest -> newest
-            for key, val in zip(table.keys, table.vals):
-                merged[key] = val
-        seg = os.path.join(self.dir, f"{self._next_seg:08d}.sst")
-        self._next_seg += 1
-        with open(seg + ".tmp", "wb") as f:
-            for key in sorted(merged):
-                if merged[key] is not None:
-                    f.write(_pack(key, merged[key]))
-            f.flush()
-            os.fsync(f.fileno())
-        os.rename(seg + ".tmp", seg)
-        old = self._tables
-        self._tables = [_SSTable(seg)]
-        for t in old:
-            try:
-                os.remove(t.path)
-            except OSError:
-                pass
-
-    def _get(self, key: bytes) -> Optional[bytes]:
-        with self._lock:
-            if key in self._mem:
-                return self._mem[key]
-            for table in reversed(self._tables):
-                hit, val = table.get(key)
-                if hit:
-                    return val
-        return None
-
-    def _scan(self, lo: bytes, hi: bytes) -> list[tuple[bytes, bytes]]:
-        """Merged view of [lo, hi): memtable shadows newer tables shadow
-        older ones."""
-        with self._lock:
-            merged: dict[bytes, Optional[bytes]] = {}
-            for table in self._tables:
-                for key, val in table.scan(lo, hi):
-                    merged[key] = val
-            i = bisect.bisect_left(self._mem_sorted, lo)
-            while i < len(self._mem_sorted) and self._mem_sorted[i] < hi:
-                key = self._mem_sorted[i]
-                merged[key] = self._mem[key]
-                i += 1
-        return sorted((k, v) for k, v in merged.items() if v is not None)
+    def __init__(self, path: str, **kv_opts):
+        self.kv = LsmKv(path, **kv_opts)
 
     # ---- key encoding ----
     @staticmethod
@@ -210,31 +39,31 @@ class LsmStore(FilerStore):
 
     # ---- FilerStore SPI ----
     def insert_entry(self, entry: Entry) -> None:
-        self._put(self._entry_key(entry.full_path),
-                  json.dumps(entry.to_dict()).encode())
+        self.kv.put(self._entry_key(entry.full_path),
+                    json.dumps(entry.to_dict()).encode())
 
     update_entry = insert_entry
 
     def find_entry(self, full_path: str) -> Optional[Entry]:
-        val = self._get(self._entry_key(full_path))
+        val = self.kv.get(self._entry_key(full_path))
         return Entry.from_dict(json.loads(val)) if val is not None else None
 
     def delete_entry(self, full_path: str) -> None:
-        self._put(self._entry_key(full_path), None)
+        self.kv.put(self._entry_key(full_path), None)
 
     def delete_folder_children(self, full_path: str) -> None:
         base = full_path.rstrip("/") or "/"
         lo = b"E" + base.encode() + b"\x00"
         hi = b"E" + base.encode() + b"\x01"
-        for key, _ in self._scan(lo, hi):
-            self._put(key, None)
+        for key, _ in self.kv.scan(lo, hi):
+            self.kv.put(key, None)
         # grandchildren: any dir key beginning with "<base>/" (for the
         # root, every dir string starts with "/", so scan all of them)
         stem = b"" if base == "/" else base.encode()
         lo2 = b"E" + stem + b"/"
         hi2 = b"E" + stem + b"0"  # '0' = '/'+1
-        for key, _ in self._scan(lo2, hi2):
-            self._put(key, None)
+        for key, _ in self.kv.scan(lo2, hi2):
+            self.kv.put(key, None)
 
     def list_directory_entries(self, dir_path: str, start_name: str = "",
                                include_start: bool = False,
@@ -246,7 +75,7 @@ class LsmStore(FilerStore):
             lo = b"E" + base + b"\x00" + start_name.encode()
         hi = b"E" + base + b"\x01"
         out: list[Entry] = []
-        for key, val in self._scan(lo, hi):
+        for key, val in self.kv.scan(lo, hi):
             name = key.split(b"\x00", 1)[1].decode()
             if prefix and not name.startswith(prefix):
                 if name > prefix:
@@ -263,15 +92,13 @@ class LsmStore(FilerStore):
         return out
 
     def kv_put(self, key: bytes, value: bytes) -> None:
-        self._put(b"K" + key, value)
+        self.kv.put(b"K" + key, value)
 
     def kv_get(self, key: bytes) -> Optional[bytes]:
-        return self._get(b"K" + key) or None
+        return self.kv.get(b"K" + key) or None
 
     def kv_delete(self, key: bytes) -> None:
-        self._put(b"K" + key, None)
+        self.kv.put(b"K" + key, None)
 
     def close(self) -> None:
-        with self._lock:
-            self._flush_memtable()
-            self._wal.close()
+        self.kv.close()

@@ -42,7 +42,10 @@ class VolumeServer:
                  public_url: str = "", rack: str = "", data_center: str = "",
                  coder: Optional[ErasureCoder] = None,
                  max_volume_counts: Optional[list[int]] = None,
-                 jwt_signing_key: str = ""):
+                 jwt_signing_key: str = "", needle_map_kind: str = "memory",
+                 tcp_port: int = -1):
+        """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
+        reference volume_server_tcp_handlers_write.go)."""
         urls = (master_url.split(",") if isinstance(master_url, str)
                 else list(master_url))
         self.master_urls = [u.strip() for u in urls if u.strip()]
@@ -53,6 +56,9 @@ class VolumeServer:
         self._rack = rack
         self._dc = data_center
         self._coder = coder
+        self._needle_map_kind = needle_map_kind
+        self._tcp_port = tcp_port
+        self.tcp_server = None
         self._public_url = public_url
         self.store: Optional[Store] = None
         self._stop = threading.Event()
@@ -73,9 +79,15 @@ class VolumeServer:
             self._store_dirs, self._max_volume_counts,
             ip=self.http.host, port=self.http.port,
             public_url=self._public_url or f"{self.http.host}:{self.http.port}",
-            rack=self._rack, data_center=self._dc, coder=self._coder)
+            rack=self._rack, data_center=self._dc, coder=self._coder,
+            needle_map_kind=self._needle_map_kind)
         self.store.load_existing_volumes()
         self.store.remote_shard_reader = self._remote_shard_reader
+        if self._tcp_port >= 0:
+            from seaweedfs_tpu.server.volume_tcp import TcpDataServer
+            self.tcp_server = TcpDataServer(self.store, self.http.host,
+                                            self._tcp_port)
+            self.tcp_server.start()
         self._register_routes()
         self.heartbeat_once()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
@@ -84,6 +96,8 @@ class VolumeServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.tcp_server is not None:
+            self.tcp_server.stop()
         self.http.stop()
         if self.store:
             self.store.close()
@@ -416,7 +430,10 @@ class VolumeServer:
 
     def _handle_status(self, req: Request) -> Response:
         hb = self.store.collect_heartbeat()
-        return Response({"Version": "seaweedfs-tpu 0.1", **hb})
+        extra = {}
+        if self.tcp_server is not None:
+            extra["TcpPort"] = self.tcp_server.port
+        return Response({"Version": "seaweedfs-tpu 0.1", **extra, **hb})
 
     # ---- admin ----
     def _admin_allocate_volume(self, req: Request) -> Response:

@@ -28,11 +28,22 @@ DEFAULT_BATCH_SIZE = 4 * 1024 * 1024
 
 def write_sorted_ecx(base_file_name: str, ext: str = ".ecx") -> None:
     """Generate .ecx (entries ascending by needle id) from .idx
-    (reference ec_encoder.go:27-54)."""
-    db = MemDb.load_from_idx(base_file_name + ".idx")
+    (reference ec_encoder.go:27-54). The .ecx format is fixed at 16-byte
+    entries (the EC read path binary-searches that stride), so a
+    wide-offset (5-byte) volume's .idx is parsed at its own stride and
+    rejected if any offset cannot fit 4 bytes — EC-eligible volumes are
+    capped well below 32GB by the master's volume size limit anyway."""
+    from seaweedfs_tpu.storage.maintenance import detect_offset_bytes
+    width = detect_offset_bytes(base_file_name)
+    db = MemDb.load_from_idx(base_file_name + ".idx", width)
     with open(base_file_name + ext, "wb") as f:
-        db.ascending_visit(
-            lambda key, off, size: f.write(t.pack_entry(key, off, size)))
+        def emit(key, off, size):
+            if off >= 1 << 32:
+                raise ValueError(
+                    f"needle {key:x} offset {off} exceeds the 4-byte .ecx "
+                    "entry format; volume too large to EC-encode")
+            f.write(t.pack_entry(key, off, size))
+        db.ascending_visit(emit)
 
 
 def _read_block(f, offset: int, length: int) -> np.ndarray:

@@ -14,30 +14,31 @@ from typing import BinaryIO, Callable, Iterator
 from seaweedfs_tpu.storage import types as t
 
 
-def iter_index(f: BinaryIO | bytes | str) -> Iterator[tuple[int, int, int]]:
+def iter_index(f: BinaryIO | bytes | str,
+               offset_bytes: int = 4) -> Iterator[tuple[int, int, int]]:
     """Yield (key, offset_units, size) for every entry."""
     if isinstance(f, str):
         with open(f, "rb") as fh:
-            yield from iter_index(fh)
+            yield from iter_index(fh, offset_bytes)
         return
     if isinstance(f, (bytes, bytearray)):
         f = io.BytesIO(f)
+    esize = t.entry_size(offset_bytes)
     while True:
-        buf = f.read(t.NEEDLE_MAP_ENTRY_SIZE * 1024)
+        buf = f.read(esize * 1024)
         if not buf:
             return
-        for off in range(0, len(buf) - t.NEEDLE_MAP_ENTRY_SIZE + 1,
-                         t.NEEDLE_MAP_ENTRY_SIZE):
-            yield t.unpack_entry(buf, off)
+        for off in range(0, len(buf) - esize + 1, esize):
+            yield t.unpack_entry(buf, off, offset_bytes)
 
 
 def walk_index_file(path: str, fn: Callable[[int, int, int], None],
-                    start_from: int = 0) -> None:
+                    start_from: int = 0, offset_bytes: int = 4) -> None:
     with open(path, "rb") as f:
-        f.seek(start_from * t.NEEDLE_MAP_ENTRY_SIZE)
-        for key, off, size in iter_index(f):
+        f.seek(start_from * t.entry_size(offset_bytes))
+        for key, off, size in iter_index(f, offset_bytes):
             fn(key, off, size)
 
 
-def index_entry_count(path: str) -> int:
-    return os.path.getsize(path) // t.NEEDLE_MAP_ENTRY_SIZE
+def index_entry_count(path: str, offset_bytes: int = 4) -> int:
+    return os.path.getsize(path) // t.entry_size(offset_bytes)

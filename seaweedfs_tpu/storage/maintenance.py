@@ -24,7 +24,10 @@ def scan_volume_file(dat_path: str,
     size = os.path.getsize(dat_path)
     with open(dat_path, "rb") as f:
         sb = SuperBlock.parse(f.read(super_len := 8 + 65536)[:8 + 65536])
-        offset = sb.block_size
+        # needle records are 8-byte aligned; a superblock with extra
+        # bytes (e.g. the wide-offset marker) ends unaligned
+        offset = (sb.block_size + t.NEEDLE_PADDING_SIZE - 1) \
+            // t.NEEDLE_PADDING_SIZE * t.NEEDLE_PADDING_SIZE
         version = sb.version
         while offset + t.NEEDLE_HEADER_SIZE <= size:
             f.seek(offset)
@@ -48,17 +51,30 @@ def scan_volume_file(dat_path: str,
             offset += record_len
 
 
+def detect_offset_bytes(base_path: str) -> int:
+    """Offset width of a volume from its superblock marker (volumes
+    created with offset_bytes=5 carry b"5BO" in the extra field)."""
+    from seaweedfs_tpu.storage.volume import Volume
+    try:
+        with open(base_path + ".dat", "rb") as f:
+            sb = SuperBlock.parse(f.read(8 + 65536))
+        return 5 if sb.extra == Volume._WIDE_OFFSET_MARKER else 4
+    except (OSError, ValueError):
+        return 4
+
+
 def fix_volume(base_path: str) -> int:
     """Rebuild <base>.idx from <base>.dat (reference command/fix.go:62).
     Returns number of live entries written."""
     from seaweedfs_tpu.storage.needle_map import MemDb
+    width = detect_offset_bytes(base_path)
     db = MemDb()
     for offset, n in scan_volume_file(base_path + ".dat"):
         if n.size > 0:
             db.set(n.id, t.actual_to_offset(offset), n.size)
         else:
             db.delete(n.id)
-    db.save_to_idx(base_path + ".idx")
+    db.save_to_idx(base_path + ".idx", offset_bytes=width)
     return len(db)
 
 
@@ -68,7 +84,8 @@ def export_volume(base_path: str, out_dir: str,
     Returns file count."""
     from seaweedfs_tpu.storage.needle_map import MemDb
     os.makedirs(out_dir, exist_ok=True)
-    live = MemDb.load_from_idx(base_path + ".idx") \
+    live = MemDb.load_from_idx(base_path + ".idx",
+                               detect_offset_bytes(base_path)) \
         if os.path.exists(base_path + ".idx") else None
     count = 0
     for offset, n in scan_volume_file(base_path + ".dat"):

@@ -51,7 +51,7 @@ class MemDb:
             yield key, off, size
 
     @classmethod
-    def load_from_idx(cls, idx_path: str) -> "MemDb":
+    def load_from_idx(cls, idx_path: str, offset_bytes: int = 4) -> "MemDb":
         """Replay an .idx log: later entries win; tombstones delete
         (reference ec_encoder.go readNeedleMap)."""
         db = cls()
@@ -60,13 +60,13 @@ class MemDb:
                 db.set(key, off, size)
             else:
                 db.delete(key)
-        idxmod.walk_index_file(idx_path, visit)
+        idxmod.walk_index_file(idx_path, visit, offset_bytes=offset_bytes)
         return db
 
-    def save_to_idx(self, path: str) -> None:
+    def save_to_idx(self, path: str, offset_bytes: int = 4) -> None:
         buf = io.BytesIO()
         for key, off, size in self.items_ascending():
-            buf.write(t.pack_entry(key, off, size))
+            buf.write(t.pack_entry(key, off, size, offset_bytes))
         with open(path, "wb") as f:
             f.write(buf.getvalue())
 
@@ -83,7 +83,8 @@ class CompactMap:
 
     def __init__(self):
         self._keys = np.empty(0, dtype=np.uint64)
-        self._offsets = np.empty(0, dtype=np.uint32)
+        # uint64 offsets so 5-byte-offset volumes (8TB) fit too
+        self._offsets = np.empty(0, dtype=np.uint64)
         self._sizes = np.empty(0, dtype=np.int32)
         self._overlay: dict[int, tuple[int, int]] = {}
         self.file_count = 0
@@ -99,7 +100,7 @@ class CompactMap:
         ok = np.fromiter(self._overlay.keys(), dtype=np.uint64,
                          count=len(self._overlay))
         ov = list(self._overlay.values())
-        oo = np.array([v[0] for v in ov], dtype=np.uint32)
+        oo = np.array([v[0] for v in ov], dtype=np.uint64)
         os_ = np.array([v[1] for v in ov], dtype=np.int32)
         keys = np.concatenate([self._keys, ok])
         offs = np.concatenate([self._offsets, oo])

@@ -30,14 +30,17 @@ class Store:
                  max_volume_counts: Optional[list[int]] = None,
                  ip: str = "localhost", port: int = 8080,
                  public_url: str = "", rack: str = "", data_center: str = "",
-                 coder: Optional[ErasureCoder] = None):
+                 coder: Optional[ErasureCoder] = None,
+                 needle_map_kind: str = "memory"):
         self.ip = ip
+        self.needle_map_kind = needle_map_kind
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
         self.rack = rack
         self.data_center = data_center
         self.locations = [
-            DiskLocation(d, (max_volume_counts or [8] * len(directories))[i])
+            DiskLocation(d, (max_volume_counts or [8] * len(directories))[i],
+                         needle_map_kind=needle_map_kind)
             for i, d in enumerate(directories)]
         self.coder = coder or make_coder("cpu")
         self.remote_shard_reader: Optional[RemoteShardReader] = None
@@ -61,7 +64,8 @@ class Store:
             loc = min(self.locations, key=lambda l: l.volumes_len())
             vol = Volume(loc.directory, collection, vid,
                          ReplicaPlacement.parse(replica_placement),
-                         TTL.parse(ttl))
+                         TTL.parse(ttl),
+                         needle_map_kind=self.needle_map_kind)
             loc.add_volume(vol)
             self.new_volumes.append(self.volume_info(vol))
             return vol

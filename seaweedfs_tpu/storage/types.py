@@ -28,6 +28,7 @@ TTL_BYTES_LENGTH = 2
 LAST_MODIFIED_BYTES_LENGTH = 5
 
 _ENTRY = struct.Struct(">QIi")
+_ENTRY5 = struct.Struct(">QBIi")  # 5-byte offset: high byte + low uint32
 
 
 def size_is_deleted(size: int) -> bool:
@@ -39,7 +40,7 @@ def size_is_valid(size: int) -> bool:
 
 
 def offset_to_actual(offset_units: int) -> int:
-    """Stored 4-byte offset (units of 8) -> byte offset."""
+    """Stored offset (units of 8) -> byte offset."""
     return offset_units * NEEDLE_PADDING_SIZE
 
 
@@ -48,12 +49,31 @@ def actual_to_offset(actual: int) -> int:
     return actual // NEEDLE_PADDING_SIZE
 
 
-def pack_entry(key: int, offset_units: int, size: int) -> bytes:
-    """16-byte needle-map/index entry."""
+def entry_size(offset_bytes: int = 4) -> int:
+    """Index entry width: 16 bytes with 4-byte offsets, 17 with 5-byte
+    (reference build tag 5BytesOffset, offset_5bytes.go:15)."""
+    return NEEDLE_ID_SIZE + offset_bytes + SIZE_SIZE
+
+
+def max_volume_size(offset_bytes: int = 4) -> int:
+    """4-byte offsets address 32GB (units of 8); 5-byte address 8TB."""
+    return NEEDLE_PADDING_SIZE * (1 << (8 * offset_bytes))
+
+
+def pack_entry(key: int, offset_units: int, size: int,
+               offset_bytes: int = 4) -> bytes:
+    """Needle-map/index entry (16B or, for 5-byte offsets, 17B)."""
+    if offset_bytes == 5:
+        return _ENTRY5.pack(key, (offset_units >> 32) & 0xFF,
+                            offset_units & 0xFFFFFFFF, size)
     return _ENTRY.pack(key, offset_units & 0xFFFFFFFF, size)
 
 
-def unpack_entry(buf: bytes, off: int = 0) -> tuple[int, int, int]:
+def unpack_entry(buf: bytes, off: int = 0,
+                 offset_bytes: int = 4) -> tuple[int, int, int]:
+    if offset_bytes == 5:
+        key, hi, lo, size = _ENTRY5.unpack_from(buf, off)
+        return key, (hi << 32) | lo, size
     return _ENTRY.unpack_from(buf, off)
 
 

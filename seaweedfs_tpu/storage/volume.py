@@ -36,13 +36,26 @@ class CookieMismatchError(Exception):
 
 
 class Volume:
+    # superblock `extra` marker for wide-offset volumes (the reference
+    # fixes offset width at compile time via the 5BytesOffset build tag,
+    # offset_5bytes.go:15; we record it per volume so both widths coexist)
+    _WIDE_OFFSET_MARKER = b"5BO"
+
     def __init__(self, directory: str, collection: str, volume_id: int,
                  replica_placement: Optional[ReplicaPlacement] = None,
-                 ttl: Optional[TTL] = None, version: int = CURRENT_VERSION):
+                 ttl: Optional[TTL] = None, version: int = CURRENT_VERSION,
+                 needle_map_kind: str = "memory", offset_bytes: int = 4):
+        """needle_map_kind selects the index structure (reference
+        NeedleMapKind, weed/storage/needle_map.go:13-19):
+        "memory" = CompactMap, "ldb" = disk-backed LSM map (the LevelDB
+        analogue), "sorted" = readonly sorted-file map.
+        offset_bytes=5 gives 8TB volumes (17-byte index entries)."""
         self.directory = directory
         self.collection = collection
         self.id = volume_id
-        self.read_only = False
+        self.read_only = needle_map_kind == "sorted"
+        self.needle_map_kind = needle_map_kind
+        self.offset_bytes = offset_bytes
         self._lock = threading.RLock()
         self.last_append_at_ns = 0
         self.is_compacting = False
@@ -52,15 +65,28 @@ class Volume:
         if exists:
             self._load()
         else:
+            if needle_map_kind == "sorted":
+                raise ValueError("sorted needle map requires an existing "
+                                 "volume (it serves sealed volumes)")
+            assert offset_bytes in (4, 5), offset_bytes
             self.super_block = SuperBlock(
                 version=version,
                 replica_placement=replica_placement or ReplicaPlacement(),
-                ttl=ttl or TTL())
+                ttl=ttl or TTL(),
+                extra=(self._WIDE_OFFSET_MARKER if offset_bytes == 5
+                       else b""))
             self._dat = open(base + ".dat", "w+b")
             self._dat.write(self.super_block.to_bytes())
             self._dat.flush()
             self._idx = open(base + ".idx", "a+b")
-            self.nm = CompactMap()
+            self.nm = self._fresh_nm()
+
+    def _fresh_nm(self):
+        if self.needle_map_kind == "ldb":
+            from seaweedfs_tpu.storage.needle_map_disk import LdbNeedleMap
+            return LdbNeedleMap(self.file_name() + ".ldb",
+                                offset_bytes=self.offset_bytes)
+        return CompactMap()
 
     # ---- naming ----
     def file_name(self) -> str:
@@ -79,16 +105,39 @@ class Volume:
         self._dat.seek(0)
         head = self._dat.read(super_block_probe_len())
         self.super_block = SuperBlock.parse(head)
+        # the superblock marker is authoritative for offset width — a
+        # caller-supplied width that disagrees would mis-stride the .idx
+        self.offset_bytes = (5 if self.super_block.extra
+                             == self._WIDE_OFFSET_MARKER else 4)
         self._idx = open(base + ".idx", "a+b")
-        self.nm = CompactMap()
-        if os.path.exists(base + ".idx"):
-            def visit(key, off, size):
-                if off != 0 and size != t.TOMBSTONE_FILE_SIZE:
-                    self.nm.set(key, off, size)
-                    self.nm.file_count += 1
-                elif self.nm.delete(key):
-                    self.nm.deleted_count += 1
-            idxmod.walk_index_file(base + ".idx", visit)
+        if self.needle_map_kind == "ldb":
+            from seaweedfs_tpu.storage.needle_map_disk import LdbNeedleMap
+            self.nm = LdbNeedleMap(base + ".ldb", idx_path=base + ".idx",
+                                   offset_bytes=self.offset_bytes)
+        elif self.needle_map_kind == "sorted":
+            from seaweedfs_tpu.storage.needle_map_disk import \
+                SortedFileNeedleMap
+            # reuse an up-to-date .sdx: rebuilding would both redo O(n)
+            # work and resurrect needles tombstoned in-place in the .sdx
+            sdx, idxp = base + ".sdx", base + ".idx"
+            if os.path.exists(sdx) and \
+                    os.path.getmtime(sdx) >= os.path.getmtime(idxp):
+                self.nm = SortedFileNeedleMap(
+                    sdx, offset_bytes=self.offset_bytes)
+            else:
+                self.nm = SortedFileNeedleMap.build_from_idx(
+                    idxp, sdx, offset_bytes=self.offset_bytes)
+        else:
+            self.nm = CompactMap()
+            if os.path.exists(base + ".idx"):
+                def visit(key, off, size):
+                    if off != 0 and size != t.TOMBSTONE_FILE_SIZE:
+                        self.nm.set(key, off, size)
+                        self.nm.file_count += 1
+                    elif self.nm.delete(key):
+                        self.nm.deleted_count += 1
+                idxmod.walk_index_file(base + ".idx", visit,
+                                       offset_bytes=self.offset_bytes)
 
     # ---- write ----
     def write_needle(self, n: Needle) -> int:
@@ -104,14 +153,15 @@ class Volume:
             if offset % t.NEEDLE_PADDING_SIZE != 0:
                 offset += (-offset) % t.NEEDLE_PADDING_SIZE
                 self._dat.seek(offset)
-            if offset >= t.MAX_POSSIBLE_VOLUME_SIZE:
+            if offset >= t.max_volume_size(self.offset_bytes):
                 raise IOError(f"volume {self.id} exceeds max size")
             rec = n.to_bytes(self.version)
             self._dat.write(rec)
             self.last_append_at_ns = n.append_at_ns
             off_units = t.actual_to_offset(offset)
             self.nm.set(n.id, off_units, n.size)
-            self._idx.write(t.pack_entry(n.id, off_units, n.size))
+            self._idx.write(t.pack_entry(n.id, off_units, n.size,
+                                         self.offset_bytes))
             # push both appends to the OS page cache so they survive
             # process death (the Go reference's unbuffered writes do —
             # Python's buffered writers would silently drop them)
@@ -166,7 +216,8 @@ class Volume:
             self.nm.delete(needle_id)
             self.nm.deleted_count += 1
             self.nm.deleted_bytes += size
-            self._idx.write(t.pack_entry(needle_id, 0, t.TOMBSTONE_FILE_SIZE))
+            self._idx.write(t.pack_entry(needle_id, 0, t.TOMBSTONE_FILE_SIZE,
+                                         self.offset_bytes))
             self._dat.flush()
             self._idx.flush()
             return size
@@ -221,10 +272,18 @@ class Volume:
                         new_off = dat.tell()
                         dat.write(blob)
                         idxf.write(t.pack_entry(
-                            key, t.actual_to_offset(new_off), size))
+                            key, t.actual_to_offset(new_off), size,
+                            self.offset_bytes))
             with self._lock:
                 self._dat.close()
                 self._idx.close()
+                self._close_nm()
+                if self.needle_map_kind == "ldb":
+                    # compaction permutes offsets even when the new .idx
+                    # is the same size — a stale watermark would keep
+                    # pre-compact offsets; force a full rebuild
+                    import shutil
+                    shutil.rmtree(base + ".ldb", ignore_errors=True)
                 os.replace(base + ".cpd", base + ".dat")
                 os.replace(base + ".cpx", base + ".idx")
                 self._load()
@@ -239,9 +298,11 @@ class Volume:
         idx_size = os.path.getsize(base + ".idx")
         if idx_size == 0:
             return True
+        esize = t.entry_size(self.offset_bytes)
         with open(base + ".idx", "rb") as f:
-            f.seek(idx_size - t.NEEDLE_MAP_ENTRY_SIZE)
-            key, off, size = t.unpack_entry(f.read(t.NEEDLE_MAP_ENTRY_SIZE))
+            f.seek(idx_size - esize)
+            key, off, size = t.unpack_entry(f.read(esize), 0,
+                                            self.offset_bytes)
         if off == 0 or size == t.TOMBSTONE_FILE_SIZE:
             return True
         try:
@@ -259,6 +320,14 @@ class Volume:
             self._idx.flush()
             os.fsync(self._idx.fileno())
 
+    def _close_nm(self) -> None:
+        close = getattr(self.nm, "close", None)
+        if close is not None:
+            if hasattr(self.nm, "mark_watermark") and \
+                    os.path.exists(self.file_name() + ".idx"):
+                self.nm.mark_watermark(self.file_name() + ".idx")
+            close()
+
     def close(self) -> None:
         with self._lock:
             try:
@@ -267,13 +336,17 @@ class Volume:
             finally:
                 self._dat.close()
                 self._idx.close()
+                self._close_nm()
 
     def destroy(self) -> None:
         self.close()
         base = self.file_name()
-        for ext in (".dat", ".idx", ".vif", ".note"):
+        for ext in (".dat", ".idx", ".vif", ".note", ".sdx"):
             if os.path.exists(base + ext):
                 os.remove(base + ext)
+        if os.path.isdir(base + ".ldb"):
+            import shutil
+            shutil.rmtree(base + ".ldb", ignore_errors=True)
 
 
 def super_block_probe_len() -> int:

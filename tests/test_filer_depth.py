@@ -58,12 +58,9 @@ def test_store_contract_all_stores(kind, tmp_path):
     s.close()
 
 
-def test_lsm_durability_and_compaction(tmp_path, monkeypatch):
-    import seaweedfs_tpu.filer.lsm_store as mod
-    monkeypatch.setattr(mod, "MEMTABLE_FLUSH_KEYS", 8)
-    monkeypatch.setattr(mod, "COMPACT_AT_SEGMENTS", 3)
+def test_lsm_durability_and_compaction(tmp_path):
     path = str(tmp_path / "lsm")
-    s = LsmStore(path)
+    s = LsmStore(path, flush_keys=8, compact_at=3)
     for i in range(100):
         s.insert_entry(Entry(f"/d/f{i:03d}", Attr(file_size=i)))
     for i in range(0, 100, 3):

@@ -59,7 +59,7 @@ def make_cluster(n, tmp_path=None, compact_threshold=10 ** 9):
     return net, nodes, applied
 
 
-def wait_leader(nodes, net=None, timeout=10.0):
+def wait_leader(nodes, net=None, timeout=30.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
         alive = [n for n in nodes

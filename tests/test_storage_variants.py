@@ -1,0 +1,286 @@
+"""Storage-engine variants: disk-backed + sorted-file needle maps,
+5-byte offsets, and the raw TCP data path (reference
+weed/storage/needle_map_leveldb.go, needle_map_sorted_file.go,
+offset_5bytes.go, volume_server_tcp_handlers_write.go)."""
+
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.needle_map_disk import (LdbNeedleMap,
+                                                   SortedFileNeedleMap)
+from seaweedfs_tpu.storage.volume import (DeletedError, NotFoundError,
+                                          Volume)
+
+
+def _put(vol, key, data, cookie=7):
+    n = Needle(id=key, cookie=cookie, data=data)
+    n.set_flags_from_fields()
+    vol.write_needle(n)
+
+
+# ---- LDB (LSM-backed) needle map ----
+
+def test_ldb_volume_roundtrip_and_reopen(tmp_path):
+    d = str(tmp_path)
+    vol = Volume(d, "", 1, needle_map_kind="ldb")
+    for i in range(1, 51):
+        _put(vol, i, f"payload {i}".encode())
+    vol.delete_needle(20)
+    assert vol.read_needle(7).data == b"payload 7"
+    with pytest.raises((NotFoundError, DeletedError)):
+        vol.read_needle(20)
+    vol.close()
+    assert os.path.isdir(os.path.join(d, "1.ldb"))
+
+    # clean reopen: watermark skips the full .idx replay but state matches
+    vol2 = Volume(d, "", 1, needle_map_kind="ldb")
+    assert vol2.read_needle(7).data == b"payload 7"
+    assert vol2.nm.get(20) is None
+    assert vol2.file_count() == 49
+    vol2.close()
+
+    # a "memory" open of the same volume agrees (same .idx)
+    vol3 = Volume(d, "", 1, needle_map_kind="memory")
+    assert vol3.read_needle(33).data == b"payload 33"
+    assert vol3.nm.get(20) is None
+    vol3.close()
+
+
+def test_ldb_map_survives_vacuum(tmp_path):
+    d = str(tmp_path)
+    vol = Volume(d, "", 2, needle_map_kind="ldb")
+    for i in range(1, 21):
+        _put(vol, i, b"x" * 100)
+    for i in range(1, 11):
+        vol.delete_needle(i)
+    assert vol.garbage_level() > 0.3
+    vol.compact()
+    assert vol.file_count() == 10
+    assert vol.read_needle(15).data == b"x" * 100
+    assert vol.nm.get(5) is None
+    vol.close()
+    # reopen after vacuum: the wiped+rebuilt LSM map still agrees
+    vol2 = Volume(d, "", 2, needle_map_kind="ldb")
+    assert vol2.read_needle(15).data == b"x" * 100
+    assert vol2.nm.get(5) is None
+    vol2.close()
+
+
+def test_ldb_crash_recovery_replays_idx_tail(tmp_path):
+    d = str(tmp_path)
+    vol = Volume(d, "", 3, needle_map_kind="ldb")
+    _put(vol, 1, b"first")
+    vol.nm.mark_watermark(vol.file_name() + ".idx")
+    # writes after the watermark, then a crash (no close)
+    _put(vol, 2, b"second")
+    _put(vol, 3, b"third")
+    vol._dat.flush()
+    vol._idx.flush()
+    vol2 = Volume(d, "", 3, needle_map_kind="ldb")
+    assert vol2.read_needle(2).data == b"second"
+    assert vol2.read_needle(3).data == b"third"
+    vol2.close()
+
+
+# ---- sorted-file needle map ----
+
+def test_sorted_file_map_serves_sealed_volume(tmp_path):
+    d = str(tmp_path)
+    vol = Volume(d, "", 4)
+    keys = [9, 3, 127, 45, 2, 88]
+    for k in keys:
+        _put(vol, k, f"n{k}".encode())
+    vol.delete_needle(45)
+    vol.close()
+
+    svol = Volume(d, "", 4, needle_map_kind="sorted")
+    assert svol.read_only
+    assert os.path.exists(os.path.join(d, "4.sdx"))
+    for k in sorted(set(keys) - {45}):
+        assert svol.read_needle(k).data == f"n{k}".encode()
+    assert svol.nm.get(45) is None
+    assert svol.nm.get(999) is None
+    with pytest.raises(PermissionError):
+        _put(svol, 1000, b"nope")
+    # the map itself supports in-place tombstoning (EC-journal style)
+    assert svol.nm.delete(88) is True
+    assert svol.nm.get(88) is None
+    svol.close()
+
+
+# ---- 5-byte offsets ----
+
+def test_entry_codec_widths():
+    for off in (0, 1, 0xFFFFFFFF, 0x1FFFFFFFF, (1 << 40) - 1):
+        blob = t.pack_entry(123, off, 456, offset_bytes=5)
+        assert len(blob) == 17
+        assert t.unpack_entry(blob, 0, offset_bytes=5) == (123, off, 456)
+    blob = t.pack_entry(123, 0xFFFFFFFF, 456)
+    assert len(blob) == 16
+    assert t.unpack_entry(blob) == (123, 0xFFFFFFFF, 456)
+    assert t.max_volume_size(4) == 32 * (1 << 30)
+    assert t.max_volume_size(5) == 8 * (1 << 40)
+
+
+def test_wide_offset_volume_addresses_past_32gb(tmp_path):
+    d = str(tmp_path)
+    vol = Volume(d, "", 5, offset_bytes=5)
+    _put(vol, 1, b"early")
+    # sparse-extend the .dat past the 4-byte limit, then append
+    vol._dat.seek(33 * (1 << 30) - 8)
+    vol._dat.write(b"\0" * 8)
+    _put(vol, 2, b"beyond 32GB")
+    assert vol.read_needle(2).data == b"beyond 32GB"
+    vol.close()
+    # reopen: the superblock marker restores 5-byte mode
+    vol2 = Volume(d, "", 5)
+    assert vol2.offset_bytes == 5
+    assert vol2.read_needle(1).data == b"early"
+    assert vol2.read_needle(2).data == b"beyond 32GB"
+    vol2.close()
+
+
+def test_narrow_volume_rejects_past_32gb(tmp_path):
+    vol = Volume(str(tmp_path), "", 6)
+    vol._dat.seek(33 * (1 << 30) - 8)
+    vol._dat.write(b"\0" * 8)
+    with pytest.raises(IOError):
+        _put(vol, 1, b"too far")
+    vol.close()
+
+
+def test_ldb_map_correct_after_equal_size_compaction(tmp_path):
+    """Compaction can permute offsets while leaving .idx the same size;
+    the LSM map must not keep pre-compact offsets."""
+    d = str(tmp_path)
+    vol = Volume(d, "", 7, needle_map_kind="ldb")
+    # out-of-ascending-order keys, no deletes: compaction reorders by key
+    for k in (5, 3, 9, 1):
+        _put(vol, k, f"val-{k}".encode() + bytes(50 - k))
+    vol.compact()
+    for k in (5, 3, 9, 1):
+        assert vol.read_needle(k).data == f"val-{k}".encode() + bytes(50 - k)
+    vol.close()
+    vol2 = Volume(d, "", 7, needle_map_kind="ldb")
+    for k in (5, 3, 9, 1):
+        assert vol2.read_needle(k).data == f"val-{k}".encode() + bytes(50 - k)
+    vol2.close()
+
+
+def test_sorted_map_reopen_keeps_tombstones(tmp_path):
+    d = str(tmp_path)
+    vol = Volume(d, "", 8)
+    for k in (1, 2, 3):
+        _put(vol, k, f"k{k}".encode())
+    vol.close()
+    svol = Volume(d, "", 8, needle_map_kind="sorted")
+    svol.nm.delete(2)  # in-place .sdx tombstone
+    svol.close()
+    # reopen must NOT rebuild .sdx from .idx and resurrect needle 2
+    svol2 = Volume(d, "", 8, needle_map_kind="sorted")
+    assert svol2.nm.get(2) is None
+    assert svol2.read_needle(1).data == b"k1"
+    svol2.close()
+
+
+def test_wide_volume_fix_and_export(tmp_path):
+    from seaweedfs_tpu.storage.maintenance import (detect_offset_bytes,
+                                                   export_volume, fix_volume)
+    d = str(tmp_path)
+    vol = Volume(d, "", 9, offset_bytes=5)
+    n = Needle(id=42, cookie=1, data=b"wide data", name=b"wide.txt")
+    n.set_flags_from_fields()
+    vol.write_needle(n)
+    vol.close()
+    base = os.path.join(d, "9")
+    assert detect_offset_bytes(base) == 5
+    # fix rebuilds the .idx at the right stride
+    os.remove(base + ".idx")
+    assert fix_volume(base) == 1
+    vol2 = Volume(d, "", 9)
+    assert vol2.offset_bytes == 5
+    assert vol2.read_needle(42).data == b"wide data"
+    vol2.close()
+    out = str(tmp_path / "export")
+    assert export_volume(base, out) == 1
+    with open(os.path.join(out, "wide.txt"), "rb") as f:
+        assert f.read() == b"wide data"
+
+
+def test_open_with_wrong_width_is_corrected_by_superblock(tmp_path):
+    d = str(tmp_path)
+    vol = Volume(d, "", 10)  # 4-byte volume
+    _put(vol, 1, b"narrow")
+    vol.close()
+    # caller lies about the width: the superblock wins
+    vol2 = Volume(d, "", 10, offset_bytes=5)
+    assert vol2.offset_bytes == 4
+    assert vol2.read_needle(1).data == b"narrow"
+    vol2.close()
+
+
+# ---- raw TCP data path ----
+
+@pytest.fixture
+def tcp_stack(tmp_path):
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url, tcp_port=0)
+    vs.start()
+    time.sleep(0.2)
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def test_tcp_write_read_delete(tcp_stack):
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.volume_tcp import TcpClient
+    from seaweedfs_tpu.utils.httpd import http_call, http_json
+    master, vs = tcp_stack
+    assert vs.tcp_server is not None
+    st = http_json("GET", f"http://{vs.url}/status")
+    assert st["TcpPort"] == vs.tcp_server.port
+
+    mc = MasterClient(master.url)
+    a = mc.assign()
+    c = TcpClient("127.0.0.1", vs.tcp_server.port)
+    payload = os.urandom(4096)
+    c.write(a["fid"], payload)
+    assert c.read(a["fid"]) == payload
+    # HTTP sees the same needle (one store, two transports)
+    status, body, _ = http_call("GET", f"http://{vs.url}/{a['fid']}")
+    assert status == 200 and body == payload
+
+    c.delete(a["fid"])
+    with pytest.raises(IOError):
+        c.read(a["fid"])
+    # errors keep the connection usable
+    b = mc.assign()
+    c.write(b["fid"], b"second life")
+    assert c.read(b["fid"]) == b"second life"
+    c.close()
+
+
+def test_tcp_bad_fid_and_wrong_cookie(tcp_stack):
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.volume_tcp import TcpClient
+    master, vs = tcp_stack
+    mc = MasterClient(master.url)
+    a = mc.assign()
+    c = TcpClient("127.0.0.1", vs.tcp_server.port)
+    c.write(a["fid"], b"data")
+    vid, rest = a["fid"].split(",", 1)
+    wrong = f"{vid},{int(rest, 16) ^ 0xFF:x}"
+    with pytest.raises(IOError):
+        c.read(wrong)
+    with pytest.raises(IOError):
+        c.read("garbage")
+    assert c.read(a["fid"]) == b"data"  # still alive
+    c.close()
