@@ -508,7 +508,7 @@ class S3Server:
                 return _err("NoSuchKey", key, 404)
             if req.method == "HEAD":
                 return Response(b"", headers={
-                    "Content-Length-Hint": str(entry.file_size()),
+                    "Content-Length": str(entry.file_size()),
                     "ETag": f'"{entry.attr.md5.hex()}"',
                     "Last-Modified": _http_date(entry.attr.mtime),
                 })

@@ -137,6 +137,8 @@ class FilerServer:
     def _register_routes(self) -> None:
         r = self.http.add
         r("POST", "/__api/rename", self._api_rename)
+        r("POST", "/__api/entry", self._api_put_entry)
+        r("GET", "/__api/entry", self._api_get_entry)
         r("POST", "/__api/hardlink", self._api_hardlink)
         r("GET", "/__api/filer_conf", self._api_filer_conf_get)
         r("POST", "/__api/filer_conf", self._api_filer_conf_set)
@@ -324,6 +326,23 @@ class FilerServer:
         except FileNotFoundError:
             return Response({"error": "not found"}, status=404)
         return Response({"path": entry.full_path})
+
+    def _api_put_entry(self, req: Request) -> Response:
+        """Write a raw entry record (metadata import: fs.meta.load,
+        filer.sync sinks — reference filer_pb CreateEntry)."""
+        entry = Entry.from_dict(req.json()["entry"])
+        denied = self._check_writable(entry.full_path)
+        if denied:
+            return denied
+        self.filer.create_entry(entry)
+        return Response({"path": entry.full_path}, status=201)
+
+    def _api_get_entry(self, req: Request) -> Response:
+        """Full entry metadata incl. chunks (reference LookupDirectoryEntry)."""
+        entry = self.filer.find_entry(req.query["path"])
+        if entry is None:
+            return Response({"error": "not found"}, status=404)
+        return Response({"entry": entry.to_dict()})
 
     def _api_hardlink(self, req: Request) -> Response:
         b = req.json()

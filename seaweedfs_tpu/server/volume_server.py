@@ -220,6 +220,12 @@ class VolumeServer:
         r("POST", "/admin/sync", self._admin_sync)
         r("POST", "/admin/copy_volume", self._admin_copy_volume)
         r("GET", "/admin/volume_file", self._admin_volume_file)
+        r("POST", "/admin/tier_upload", self._admin_tier_upload)
+        r("POST", "/admin/tier_download", self._admin_tier_download)
+        r("GET", "/admin/volume_digest", self._admin_volume_digest)
+        r("GET", "/admin/needle", self._admin_needle)
+        r("GET", "/admin/needle_blob", self._admin_needle_blob)
+        r("POST", "/admin/write_needle_blob", self._admin_write_needle_blob)
         # EC rpcs
         r("POST", "/admin/ec/generate", self._ec_generate)
         r("POST", "/admin/ec/rebuild", self._ec_rebuild)
@@ -499,6 +505,94 @@ class VolumeServer:
         loc.add_volume(vol)
         self.store.new_volumes.append(self.store.volume_info(vol))
         self._push_deltas()
+        return Response({})
+
+    def _admin_tier_upload(self, req: Request) -> Response:
+        """Move a sealed volume's .dat to an S3-compatible tier
+        (reference volume_grpc_tier_upload.go)."""
+        b = req.json()
+        v = self.store.find_volume(b["volume_id"])
+        if v is None:
+            return Response({"error": "volume not found"}, status=404)
+        try:
+            info = v.tier_to(b["endpoint"], b["bucket"],
+                             keep_local=b.get("keep_local", False))
+        except (ValueError, IOError) as e:
+            return Response({"error": str(e)}, status=409)
+        return Response({"tiered": v.id, "remote": info.get("remote")})
+
+    def _admin_tier_download(self, req: Request) -> Response:
+        """Pull a tiered volume's .dat back to local disk
+        (reference volume_grpc_tier_download.go)."""
+        b = req.json()
+        v = self.store.find_volume(b["volume_id"])
+        if v is None:
+            return Response({"error": "volume not found"}, status=404)
+        try:
+            v.untier()
+        except (ValueError, IOError) as e:
+            return Response({"error": str(e)}, status=409)
+        return Response({"downloaded": v.id})
+
+    def _admin_volume_digest(self, req: Request) -> Response:
+        """Live (key,size) inventory + digest of one volume replica, for
+        volume.check.disk (reference command_volume_check_disk.go
+        compares replicas' idx contents)."""
+        import hashlib
+        vid = int(req.query["volumeId"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return Response({"error": "volume not found"}, status=404)
+        entries: list[tuple[int, int]] = []
+        v.nm.ascending_visit(
+            lambda k, o, s: entries.append((k, s)) if s > 0 else None)
+        entries.sort()
+        h = hashlib.md5()
+        for k, s in entries:
+            h.update(k.to_bytes(8, "big") + s.to_bytes(4, "big", signed=True))
+        return Response({"volume_id": vid, "file_count": len(entries),
+                         "digest": h.hexdigest(),
+                         "keys": [[k, s] for k, s in entries]})
+
+    def _admin_needle(self, req: Request) -> Response:
+        """Fetch one needle's full record fields by key — the transfer
+        unit of volume.check.disk -fix (reference readSourceNeedleBlob)."""
+        vid = int(req.query["volumeId"])
+        key = int(req.query["key"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return Response({"error": "volume not found"}, status=404)
+        try:
+            n = v.read_needle(key)
+        except Exception as e:
+            return Response({"error": str(e)}, status=404)
+        return Response({"key": key, "cookie": n.cookie,
+                         "data": n.data.hex(),
+                         "name": n.name.decode(errors="replace"),
+                         "mime": n.mime.decode(errors="replace")})
+
+    def _admin_needle_blob(self, req: Request) -> Response:
+        """Raw needle record for lossless replica repair."""
+        vid = int(req.query["volumeId"])
+        key = int(req.query["key"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return Response({"error": "volume not found"}, status=404)
+        try:
+            blob, size = v.read_needle_blob(key)
+        except Exception as e:
+            return Response({"error": str(e)}, status=404)
+        return Response({"size": size, "blob": blob.hex()})
+
+    def _admin_write_needle_blob(self, req: Request) -> Response:
+        b = req.json()
+        v = self.store.find_volume(b["volume_id"])
+        if v is None:
+            return Response({"error": "volume not found"}, status=404)
+        try:
+            v.write_needle_blob(bytes.fromhex(b["blob"]), b["size"])
+        except Exception as e:
+            return Response({"error": str(e)}, status=409)
         return Response({})
 
     def _admin_volume_file(self, req: Request) -> Response:

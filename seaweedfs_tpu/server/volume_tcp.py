@@ -20,6 +20,7 @@ import struct
 import threading
 from typing import Optional
 
+from seaweedfs_tpu.storage.file_id import FileId
 from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.volume import (CookieMismatchError, DeletedError,
                                           NotFoundError)
@@ -36,12 +37,6 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("peer closed")
         buf.extend(chunk)
     return bytes(buf)
-
-
-def _parse_fid(fid: str) -> tuple[int, int, int]:
-    vid_s, rest = fid.split(",", 1)
-    key_cookie = int(rest, 16)
-    return int(vid_s), key_cookie >> 32, key_cookie & 0xFFFFFFFF
 
 
 class TcpDataServer:
@@ -98,7 +93,8 @@ class TcpDataServer:
     def _dispatch(self, op: str, fid: str, body: bytes
                   ) -> tuple[int, bytes]:
         try:
-            vid, key, cookie = _parse_fid(fid)
+            f = FileId.parse(fid)
+            vid, key, cookie = f.volume_id, f.key, f.cookie
         except (ValueError, IndexError):
             return 1, b"bad fid"
         try:

@@ -97,6 +97,91 @@ class ShellContext:
                             compacted.append(v["id"])
         return compacted
 
+    def _volume_locations(self) -> tuple[dict, dict]:
+        """vid -> [node urls], vid -> volume info, from the topology."""
+        topo = self.topology()
+        replicas: dict[int, list[str]] = defaultdict(list)
+        vinfos: dict[int, dict] = {}
+        for dc in topo.get("data_centers", []):
+            for rack in dc.get("racks", []):
+                for n in rack.get("nodes", []):
+                    for v in n.get("volumes", []):
+                        replicas[v["id"]].append(n["id"])
+                        vinfos[v["id"]] = v
+        return replicas, vinfos
+
+    def volume_check_disk(self, vid: Optional[int] = None,
+                          fix: bool = False) -> list[dict]:
+        """Compare replicas of each volume by live needle inventory; with
+        fix=True, copy missing needles from the replica that has them
+        (reference command_volume_check_disk.go)."""
+        replicas, _ = self._volume_locations()
+        reports = []
+        for v, owners in sorted(replicas.items()):
+            if vid is not None and v != vid:
+                continue
+            if len(owners) < 2:
+                continue  # nothing to cross-check
+            digests = {}
+            for node in owners:
+                digests[node] = http_json(
+                    "GET",
+                    f"http://{node}/admin/volume_digest?volumeId={v}")
+            if len({d["digest"] for d in digests.values()}) == 1:
+                continue  # replicas agree
+            keysets = {node: {k: s for k, s in d["keys"]}
+                       for node, d in digests.items()}
+            report = {"vid": v, "nodes": {n: d["file_count"]
+                                          for n, d in digests.items()},
+                      "fixed": 0}
+            if fix:
+                union: dict[int, str] = {}
+                for node, ks in keysets.items():
+                    for k in ks:
+                        union.setdefault(k, node)
+                for node, ks in keysets.items():
+                    for k, src in union.items():
+                        if k in ks or src == node:
+                            continue
+                        # copy the raw record so every field (name, mime,
+                        # flags, ttl, cookie) survives the repair
+                        blob = http_json(
+                            "GET", f"http://{src}/admin/needle_blob"
+                                   f"?volumeId={v}&key={k}")
+                        out = self._vs(node, "/admin/write_needle_blob",
+                                       {"volume_id": v,
+                                        "size": blob["size"],
+                                        "blob": blob["blob"]})
+                        if "error" not in out:
+                            report["fixed"] += 1
+            reports.append(report)
+        return reports
+
+    def volume_tier_upload(self, vid: int, endpoint: str, bucket: str,
+                           keep_local: bool = False) -> dict:
+        """Move a volume's .dat to an S3-compatible tier (reference shell
+        volume.tier.upload); the volume keeps serving reads through it."""
+        replicas, vinfos = self._volume_locations()
+        if vid not in replicas:
+            raise LookupError(f"volume {vid} not found")
+        out = {}
+        for node in replicas[vid]:
+            out[node] = self._vs(node, "/admin/tier_upload",
+                                 {"volume_id": vid, "endpoint": endpoint,
+                                  "bucket": bucket,
+                                  "keep_local": keep_local})
+        return out
+
+    def volume_tier_download(self, vid: int) -> dict:
+        """Pull a tiered volume's .dat back (reference shell
+        volume.tier.download)."""
+        replicas, _ = self._volume_locations()
+        if vid not in replicas:
+            raise LookupError(f"volume {vid} not found")
+        return {node: self._vs(node, "/admin/tier_download",
+                               {"volume_id": vid})
+                for node in replicas[vid]}
+
     def volume_move(self, vid: int, source: str, target: str,
                     collection: str = "") -> None:
         """Move a volume: copy to target then delete on source

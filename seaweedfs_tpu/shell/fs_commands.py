@@ -134,3 +134,47 @@ def filer_download(filer_url: str, filer_path: str, local_dir: str) -> int:
                 f.write(fs.cat(e["FullPath"]))
             count += 1
     return count
+
+
+def fs_meta_save(filer_url: str, root: str, out_path: str) -> int:
+    """Dump the filer metadata tree below `root` to a JSONL file
+    (reference shell fs.meta.save / command_fs_meta_save.go; entries
+    carry their chunk lists, not the data). Returns entries written."""
+    import json
+
+    fs = FsContext(filer_url)
+    count = 0
+    with open(out_path, "w") as out:
+        stack = [("/" + root.strip("/")) or "/"]
+        while stack:
+            path = stack.pop()
+            try:
+                entries = fs.ls(path, limit=1 << 20)
+            except NotADirectoryError:
+                entries = []
+            for e in entries:
+                full = http_json(
+                    "GET", f"http://{filer_url}/__api/entry"
+                           f"?path={urllib.parse.quote(e['FullPath'])}")
+                out.write(json.dumps(full["entry"]) + "\n")
+                count += 1
+                if e["IsDirectory"]:
+                    stack.append(e["FullPath"])
+    return count
+
+
+def fs_meta_load(filer_url: str, in_path: str) -> int:
+    """Recreate entries from an fs.meta.save dump (reference shell
+    fs.meta.load). Chunk fids must still resolve in the target cluster
+    (same semantics as the reference: metadata only)."""
+    import json
+
+    count = 0
+    with open(in_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            http_json("POST", f"http://{filer_url}/__api/entry",
+                      {"entry": json.loads(line)})
+            count += 1
+    return count

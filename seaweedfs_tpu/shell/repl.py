@@ -16,8 +16,13 @@ HELP = """commands:
   remote.meta.sync -dir /m          pull remote listing into the filer
   remote.cache/uncache -path /m/f   materialize / drop local chunk copy
   remote.status
+  fs.meta.save [-root /p] [-o file] / fs.meta.load -i file / fs.meta.tail
+  s3.bucket.list / s3.bucket.create -name B / s3.bucket.delete -name B
   volume.list                       show topology
   volume.fix.replication [-n]      re-replicate under-replicated volumes
+  volume.check.disk [-volumeId N] [-fix]   cross-check replica contents
+  volume.tier.upload -volumeId N -endpoint URL -bucket B [-keepLocal]
+  volume.tier.download -volumeId N
   volume.vacuum [threshold]         compact garbage-heavy volumes
   ec.encode [-volumeId N] [-collection C]
   ec.rebuild [-n]
@@ -103,6 +108,24 @@ def run_command(sh: ShellContext, line: str):
             for line_ in fsc.tree(args[0] if args else "/"):
                 print(line_)
             return None
+        if op == "meta.save":
+            from seaweedfs_tpu.shell.fs_commands import fs_meta_save
+            n = fs_meta_save(fsc.filer_url, flags.get("root", "/"),
+                             flags.get("o", "filer_meta.jsonl"))
+            return {"saved": n, "file": flags.get("o", "filer_meta.jsonl")}
+        if op == "meta.load":
+            from seaweedfs_tpu.shell.fs_commands import fs_meta_load
+            src = flags.get("i")
+            if not src:
+                raise ValueError("usage: fs.meta.load -i <dump.jsonl>")
+            return {"loaded": fs_meta_load(fsc.filer_url, src)}
+        if op == "meta.tail":
+            from seaweedfs_tpu.replication.sync import meta_tail
+            n = meta_tail(fsc.filer_url,
+                          path_prefix=flags.get("pathPrefix", "/"),
+                          max_events=int(flags.get("n", 16)),
+                          aggregated="-aggregated" in args)
+            return {"events": n}
         if op == "configure":
             # per-path storage rules (reference command_fs_configure.go)
             from seaweedfs_tpu.utils.httpd import http_json
@@ -154,6 +177,41 @@ def run_command(sh: ShellContext, line: str):
         raise ValueError(f"unknown remote command {op!r}")
     if cmd == "volume.list":
         return sh.volume_list()
+    if cmd == "volume.check.disk":
+        vid = int(flags["volumeId"]) if "volumeId" in flags else None
+        return sh.volume_check_disk(vid=vid, fix="-fix" in args)
+    if cmd == "volume.tier.upload":
+        return sh.volume_tier_upload(
+            int(flags["volumeId"]), flags["endpoint"], flags["bucket"],
+            keep_local="-keepLocal" in args)
+    if cmd == "volume.tier.download":
+        return sh.volume_tier_download(int(flags["volumeId"]))
+    if cmd.startswith("s3.bucket."):
+        # reference shell command_s3_bucket_*.go: buckets are dirs under
+        # /buckets with collection=<bucket>
+        from seaweedfs_tpu.shell.fs_commands import FsContext
+        from seaweedfs_tpu.utils.httpd import http_json
+        fsc = FsContext(_find_filer(sh))
+        op = cmd[len("s3.bucket."):]
+        if op == "list":
+            try:
+                return [e["FullPath"].rsplit("/", 1)[-1]
+                        for e in fsc.ls("/buckets")]
+            except NotADirectoryError:
+                return []
+        if op == "create":
+            fsc.mkdir(f"/buckets/{flags['name']}")
+            return {"created": flags["name"]}
+        if op == "delete":
+            fsc.rm(f"/buckets/{flags['name']}", recursive=True)
+            # drop the bucket's collection so volumes are reclaimed
+            try:
+                http_json("POST", f"http://{sh.master_url}/col/delete"
+                                  f"?collection={flags['name']}")
+            except Exception:
+                pass
+            return {"deleted": flags["name"]}
+        raise ValueError(f"unknown s3.bucket command {op!r}")
     if cmd == "volume.fix.replication":
         return sh.volume_fix_replication(apply=apply)
     if cmd == "volume.balance":

@@ -121,10 +121,15 @@ class S3BackendFile(BackendStorageFile):
     def size(self) -> int:
         if self._size is None:
             from seaweedfs_tpu.utils.httpd import http_call
-            status, body, _ = http_call("GET", self._url())
-            if status >= 400:
-                raise IOError(f"s3 stat: HTTP {status}")
-            self._size = len(body)
+            status, _, headers = http_call("HEAD", self._url())
+            length = headers.get("Content-Length") if status < 400 else None
+            if length is not None:
+                self._size = int(length)
+            else:  # endpoint without HEAD support: fall back to a GET
+                status, body, _ = http_call("GET", self._url())
+                if status >= 400:
+                    raise IOError(f"s3 stat: HTTP {status}")
+                self._size = len(body)
         return self._size
 
     def upload(self, local_path: str) -> None:

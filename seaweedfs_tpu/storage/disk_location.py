@@ -34,12 +34,22 @@ class DiskLocation:
         with self._lock:
             for name in sorted(os.listdir(self.directory)):
                 m = _DAT_RE.match(name)
+                if not m and name.endswith(".vif"):
+                    # cloud-tiered volume: no local .dat, .vif records
+                    # the remote tier (reference volume_tier.go)
+                    m = _DAT_RE.match(name[:-4] + ".dat")
+                    if m:
+                        from seaweedfs_tpu.storage.backend import \
+                            load_volume_info
+                        base_path = os.path.join(self.directory, name[:-4])
+                        if os.path.exists(base_path + ".dat") or \
+                                "remote" not in load_volume_info(base_path):
+                            m = None  # not tiered (or .dat scan handles it)
                 if m:
                     vid = int(m.group("vid"))
                     col = m.group("col") or ""
-                    base = os.path.join(
-                        self.directory,
-                        name[:-4])
+                    base = os.path.join(self.directory,
+                                        f"{col}_{vid}" if col else str(vid))
                     if not os.path.exists(base + ".idx"):
                         continue
                     if vid not in self.volumes:

@@ -61,10 +61,12 @@ class Volume:
         self.is_compacting = False
 
         base = self.file_name()
-        exists = os.path.exists(base + ".dat")
+        exists = (os.path.exists(base + ".dat")
+                  or os.path.exists(base + ".vif"))  # tiered: .vif only
         if exists:
             self._load()
         else:
+            self._backend = None
             if needle_map_kind == "sorted":
                 raise ValueError("sorted needle map requires an existing "
                                  "volume (it serves sealed volumes)")
@@ -101,9 +103,24 @@ class Volume:
     # ---- load ----
     def _load(self):
         base = self.file_name()
-        self._dat = open(base + ".dat", "r+b")
-        self._dat.seek(0)
-        head = self._dat.read(super_block_probe_len())
+        self._backend = None
+        if not os.path.exists(base + ".dat"):
+            # cloud-tiered: the .dat lives on a remote tier recorded in
+            # the .vif sidecar (reference volume_tier.go LoadedVolume)
+            from seaweedfs_tpu.storage.backend import open_backend_for_volume
+            self._backend = open_backend_for_volume(base)
+            self._dat = None
+            self.read_only = True
+            head = self._backend.read_at(0, super_block_probe_len())
+        else:
+            self._dat = open(base + ".dat", "r+b")
+            self._dat.seek(0)
+            head = self._dat.read(super_block_probe_len())
+            from seaweedfs_tpu.storage.backend import load_volume_info
+            if "remote" in load_volume_info(base):
+                # tiered with keep_local: the remote copy would silently
+                # go stale if this replica kept accepting writes
+                self.read_only = True
         self.super_block = SuperBlock.parse(head)
         # the superblock marker is authoritative for offset width — a
         # caller-supplied width that disagrees would mis-stride the .idx
@@ -187,7 +204,30 @@ class Volume:
                 f"cookie mismatch for needle {needle_id:x}")
         return n
 
+    def read_needle_blob(self, needle_id: int) -> tuple[bytes, int]:
+        """Raw on-disk record bytes + stored size — the lossless transfer
+        unit for replica repair (reference readSourceNeedleBlob,
+        command_volume_check_disk.go)."""
+        with self._lock:
+            nv = self.nm.get(needle_id)
+            if nv is None:
+                raise NotFoundError(f"needle {needle_id:x} not found")
+            off_units, size = nv
+            if not t.size_is_valid(size):
+                raise DeletedError(f"needle {needle_id:x} deleted")
+            return self._read_at(
+                t.offset_to_actual(off_units),
+                t.get_actual_size(size, self.version)), size
+
+    def write_needle_blob(self, blob: bytes, size: int) -> None:
+        """Append a record copied verbatim from a peer replica (every
+        field — name/mime/flags/ttl/cookie — preserved)."""
+        n = Needle.from_bytes(blob, size, self.version)
+        self.write_needle(n)
+
     def _read_at(self, offset: int, length: int) -> bytes:
+        if self._backend is not None:
+            return self._backend.read_at(offset, length)
         self._dat.seek(offset)
         return self._dat.read(length)
 
@@ -224,8 +264,60 @@ class Volume:
 
     # ---- stats ----
     def content_size(self) -> int:
+        if self._backend is not None:
+            return self._backend.size()
         self._dat.seek(0, os.SEEK_END)
         return self._dat.tell()
+
+    @property
+    def is_tiered(self) -> bool:
+        return self._backend is not None
+
+    # ---- cloud tier (reference volume_tier.go, volume_grpc_tier_*.go) --
+    def tier_to(self, endpoint: str, bucket: str,
+                keep_local: bool = False) -> dict:
+        """Seal and move the .dat to an S3-compatible tier; keep serving
+        reads through it."""
+        from seaweedfs_tpu.storage.backend import tier_volume_to_s3
+        with self._lock:
+            if self._backend is not None:
+                raise ValueError(f"volume {self.id} is already tiered")
+            self.read_only = True
+            self.sync()
+            self._dat.close()
+            info = tier_volume_to_s3(self.file_name(), endpoint, bucket,
+                                     keep_local=keep_local)
+            if keep_local:
+                self._dat = open(self.file_name() + ".dat", "r+b")
+            else:
+                from seaweedfs_tpu.storage.backend import \
+                    open_backend_for_volume
+                self._dat = None
+                self._backend = open_backend_for_volume(self.file_name())
+            return info
+
+    def untier(self) -> None:
+        """Pull the .dat back from the tier and serve locally again
+        (reference volume_grpc_tier_download.go)."""
+        from seaweedfs_tpu.storage.backend import (load_volume_info,
+                                                   save_volume_info)
+        with self._lock:
+            if self._backend is None:
+                raise ValueError(f"volume {self.id} is not tiered")
+            size = self._backend.size()
+            base = self.file_name()
+            with open(base + ".dat.tmp", "wb") as f:
+                step = 64 * 1024 * 1024
+                for off in range(0, size, step):
+                    f.write(self._backend.read_at(off,
+                                                  min(step, size - off)))
+            os.rename(base + ".dat.tmp", base + ".dat")
+            info = load_volume_info(base)
+            info.pop("remote", None)
+            save_volume_info(base, info)
+            self._backend = None
+            self._dat = open(base + ".dat", "r+b")
+            self.read_only = self.needle_map_kind == "sorted"
 
     def file_count(self) -> int:
         return len(self.nm)
@@ -247,6 +339,9 @@ class Volume:
         """Rewrite live needles to .cpd/.cpx then atomically commit
         (reference volume_vacuum.go Compact2/CommitCompact)."""
         with self._lock:
+            if self._backend is not None:
+                raise ValueError(
+                    f"volume {self.id} is cloud-tiered; download it first")
             self.is_compacting = True
         try:
             base = self.file_name()
@@ -269,6 +364,11 @@ class Volume:
                         blob = self._read_at(
                             t.offset_to_actual(off_units),
                             t.get_actual_size(size, self.version))
+                        # records are 8-byte aligned; the superblock may
+                        # end unaligned (wide-offset marker extra bytes)
+                        pad = (-dat.tell()) % t.NEEDLE_PADDING_SIZE
+                        if pad:
+                            dat.write(b"\0" * pad)
                         new_off = dat.tell()
                         dat.write(blob)
                         idxf.write(t.pack_entry(
@@ -315,8 +415,9 @@ class Volume:
 
     def sync(self) -> None:
         with self._lock:
-            self._dat.flush()
-            os.fsync(self._dat.fileno())
+            if self._dat is not None:
+                self._dat.flush()
+                os.fsync(self._dat.fileno())
             self._idx.flush()
             os.fsync(self._idx.fileno())
 
@@ -331,10 +432,12 @@ class Volume:
     def close(self) -> None:
         with self._lock:
             try:
-                self._dat.flush()
+                if self._dat is not None:
+                    self._dat.flush()
                 self._idx.flush()
             finally:
-                self._dat.close()
+                if self._dat is not None:
+                    self._dat.close()
                 self._idx.close()
                 self._close_nm()
 

@@ -111,7 +111,11 @@ class HttpServer:
                 try:
                     self.send_response(resp.status)
                     self.send_header("Content-Type", resp.content_type)
-                    self.send_header("Content-Length", str(len(resp.body)))
+                    if "Content-Length" not in resp.headers:
+                        # HEAD handlers set it to the entity size; the
+                        # wire body is still suppressed below
+                        self.send_header("Content-Length",
+                                         str(len(resp.body)))
                     for k, v in resp.headers.items():
                         self.send_header(k, v)
                     self.end_headers()

@@ -1,0 +1,159 @@
+"""Shell/tool parity: volume.check.disk, volume.tier.*, s3.bucket.*,
+fs.meta.save/load (reference weed/shell command_volume_check_disk.go,
+command_volume_tier_*.go, command_s3_bucket_*.go, command_fs_meta_*.go)."""
+
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.client import operation
+from seaweedfs_tpu.client.wdclient import MasterClient
+from seaweedfs_tpu.gateway.s3_server import S3Server
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.commands import ShellContext
+from seaweedfs_tpu.shell.repl import run_command
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64, default_replication="001")
+    master.start()
+    vs1 = VolumeServer([str(tmp_path / "v1")], master.url, rack="r1")
+    vs2 = VolumeServer([str(tmp_path / "v2")], master.url, rack="r1")
+    vs1.start()
+    vs2.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    s3 = S3Server(fs)
+    s3.start()
+    time.sleep(0.3)
+    yield master, vs1, vs2, fs, s3
+    s3.stop()
+    fs.stop()
+    vs2.stop()
+    vs1.stop()
+    master.stop()
+
+
+def test_volume_check_disk_detects_and_fixes_divergence(cluster):
+    master, vs1, vs2, _, _ = cluster
+    mc = MasterClient(master.url)
+    fids = [operation.upload_data(mc, f"payload {i}".encode()).fid
+            for i in range(6)]
+    sh = ShellContext(master.url)
+    assert sh.volume_check_disk() == []  # replicas agree
+
+    # damage one replica: delete a needle on vs2 only (bypass replication)
+    vid = int(fids[0].split(",")[0])
+    victim = vs2 if vs2.store.find_volume(vid) else vs1
+    key = int(fids[0].split(",")[1], 16) >> 32
+    victim.store.find_volume(vid).delete_needle(key)
+
+    reports = sh.volume_check_disk()
+    assert len(reports) == 1 and reports[0]["vid"] == vid
+
+    fixed = sh.volume_check_disk(fix=True)
+    assert fixed[0]["fixed"] == 1
+    assert sh.volume_check_disk() == []  # back in sync
+    n = victim.store.find_volume(vid).read_needle(key)
+    assert n.data == b"payload 0"
+
+
+def test_volume_tier_upload_download_through_own_s3(cluster):
+    master, vs1, vs2, fs, s3 = cluster
+    mc = MasterClient(master.url)
+    res = operation.upload_data(mc, b"tiered bytes", replication="000")
+    vid = int(res.fid.split(",")[0])
+
+    # make the tier bucket in our own S3 gateway
+    status, _, _ = http_call("PUT", f"http://{s3.url}/tierbucket")
+    assert status < 400
+
+    sh = ShellContext(master.url)
+    out = sh.volume_tier_upload(vid, f"http://{s3.url}", "tierbucket")
+    assert all("error" not in r for r in out.values())
+
+    owner = vs1 if vs1.store.find_volume(vid) else vs2
+    vol = owner.store.find_volume(vid)
+    assert vol.is_tiered and not os.path.exists(vol.file_name() + ".dat")
+
+    # reads still work, served THROUGH the S3 tier
+    assert operation.read_data(mc, res.fid) == b"tiered bytes"
+    # writes are rejected (sealed)
+    status, _, _ = http_call("POST", f"http://{owner.url}/{res.fid}",
+                             body=b"nope")
+    assert status >= 400
+
+    sh.volume_tier_download(vid)
+    assert not vol.is_tiered and os.path.exists(vol.file_name() + ".dat")
+    assert operation.read_data(mc, res.fid) == b"tiered bytes"
+
+
+def test_tiered_volume_survives_restart(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vdir = str(tmp_path / "v")
+    vs = VolumeServer([vdir], master.url)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    s3 = S3Server(fs)
+    s3.start()
+    time.sleep(0.3)
+    try:
+        mc = MasterClient(master.url)
+        res = operation.upload_data(mc, b"persist me")
+        vid = int(res.fid.split(",")[0])
+        http_call("PUT", f"http://{s3.url}/tb")
+        http_json("POST", f"http://{vs.url}/admin/tier_upload",
+                  {"volume_id": vid, "endpoint": f"http://{s3.url}",
+                   "bucket": "tb"})
+        vs.stop()
+        # a fresh volume server scans the dir: .vif-only volume loads
+        vs2 = VolumeServer([vdir], master.url)
+        vs2.start()
+        time.sleep(0.3)
+        vol = vs2.store.find_volume(vid)
+        assert vol is not None and vol.is_tiered
+        assert operation.read_data(mc, res.fid) == b"persist me"
+        vs2.stop()
+    finally:
+        s3.stop()
+        fs.stop()
+        master.stop()
+
+
+def test_s3_bucket_shell_commands(cluster):
+    master, _, _, fs, _ = cluster
+    sh = ShellContext(master.url)
+    out = run_command(sh, "s3.bucket.create -name photos")
+    assert out == {"created": "photos"}
+    assert "photos" in run_command(sh, "s3.bucket.list")
+    out = run_command(sh, "s3.bucket.delete -name photos")
+    assert out == {"deleted": "photos"}
+    assert "photos" not in run_command(sh, "s3.bucket.list")
+
+
+def test_fs_meta_save_load_roundtrip(cluster, tmp_path):
+    master, _, _, fs, _ = cluster
+    base = f"http://{fs.url}"
+    http_call("POST", f"{base}/m/a.txt", body=b"alpha")
+    http_call("POST", f"{base}/m/sub/b.txt", body=b"beta " * 2000)
+    sh = ShellContext(master.url)
+    dump = str(tmp_path / "meta.jsonl")
+    out = run_command(sh, f"fs.meta.save -root /m -o {dump}")
+    assert out["saved"] >= 3  # a.txt, sub, sub/b.txt
+
+    # wipe metadata only (chunks still live on volume servers)
+    fs.filer.store.delete_entry("/m/a.txt")
+    fs.filer.store.delete_entry("/m/sub/b.txt")
+    assert http_call("GET", f"{base}/m/a.txt")[0] == 404
+
+    out = run_command(sh, f"fs.meta.load -i {dump}")
+    assert out["loaded"] >= 3
+    assert http_call("GET", f"{base}/m/a.txt")[1] == b"alpha"
+    assert http_call("GET", f"{base}/m/sub/b.txt")[1] == b"beta " * 2000
