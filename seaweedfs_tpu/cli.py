@@ -100,9 +100,16 @@ def cmd_filer(args):
     from seaweedfs_tpu.server.filer_server import FilerServer
     fs = FilerServer(args.master, host=args.ip, port=args.port,
                      store=args.store, store_dir=args.dir,
-                     default_replication=args.defaultReplication)
+                     default_replication=args.defaultReplication,
+                     cipher=args.encryptVolumeData)
     fs.start()
-    print(f"filer {fs.url} (store={args.store})")
+    extra = " cipher" if args.encryptVolumeData else ""
+    if args.ftp:
+        from seaweedfs_tpu.gateway.ftp_server import FtpServer
+        ftp = FtpServer(fs, host=args.ip, port=args.ftpPort)
+        ftp.start()
+        extra += f", ftp {ftp.url}"
+    print(f"filer {fs.url} (store={args.store}){extra}")
     _wait_forever()
 
 
@@ -359,6 +366,10 @@ def main(argv=None):
                     choices=["memory", "sqlite", "lsm"])
     fl.add_argument("-dir", default=".", help="store/state directory")
     fl.add_argument("-defaultReplication", default="")
+    fl.add_argument("-encryptVolumeData", action="store_true",
+                    help="AES-256-GCM encrypt chunks (reference flag)")
+    fl.add_argument("-ftp", action="store_true", help="serve FTP gateway")
+    fl.add_argument("-ftpPort", type=int, default=0)
     fl.set_defaults(fn=cmd_filer)
 
     u = sub.add_parser("upload")

@@ -21,8 +21,9 @@ from seaweedfs_tpu.filer.entry import FileChunk
 # smaller here — each of our chunk records is a few hundred JSON bytes.
 MANIFEST_BATCH = 1000
 
-SaveFn = Callable[[bytes], str]  # blob -> fid
-ReadFn = Callable[[str], bytes]  # fid -> blob
+# stores a blob, returns the saved chunk (fid + cipher_key if encrypted)
+SaveFn = Callable[[bytes], FileChunk]
+ReadFn = Callable[[FileChunk], bytes]  # chunk -> plaintext blob
 
 
 def has_chunk_manifest(chunks: list[FileChunk]) -> bool:
@@ -44,11 +45,12 @@ def maybe_manifestize(save_fn: SaveFn, chunks: list[FileChunk],
                 continue
             blob = json.dumps(
                 {"chunks": [c.to_dict() for c in group]}).encode()
-            fid = save_fn(blob)
+            saved = save_fn(blob)
             offset = min(c.offset for c in group)
             stop = max(c.offset + c.size for c in group)
             packed.append(FileChunk(
-                fid=fid, offset=offset, size=stop - offset,
+                fid=saved.fid, offset=offset, size=stop - offset,
+                cipher_key=saved.cipher_key,
                 mtime_ns=max(c.mtime_ns for c in group),
                 is_chunk_manifest=True))
         chunks = packed
@@ -64,7 +66,7 @@ def resolve_chunk_manifest(read_fn: ReadFn,
         if not c.is_chunk_manifest:
             out.append(c)
             continue
-        blob = read_fn(c.fid)
+        blob = read_fn(c)
         nested = [FileChunk.from_dict(d)
                   for d in json.loads(blob)["chunks"]]
         out.extend(resolve_chunk_manifest(read_fn, nested))

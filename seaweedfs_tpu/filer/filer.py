@@ -13,7 +13,8 @@ import threading
 import time
 from typing import Callable, Iterator, Optional
 
-from seaweedfs_tpu.filer.entry import Attr, Entry, new_directory_entry
+from seaweedfs_tpu.filer.entry import (Attr, Entry, FileChunk,
+                                       new_directory_entry)
 from seaweedfs_tpu.filer.filerstore import FilerStore, MemoryStore
 from seaweedfs_tpu.filer.filerstore_hardlink import (HardLinkStore,
                                                      new_hard_link_id)
@@ -148,7 +149,10 @@ class Filer:
     def __init__(self, store: Optional[FilerStore] = None,
                  delete_chunks_fn: Optional[Callable[[list[str]], None]] = None,
                  meta_log_dir: Optional[str] = None,
-                 read_chunk_fn: Optional[Callable[[str], bytes]] = None):
+                 read_chunk_fn: "Optional[Callable[[FileChunk], bytes]]"
+                 = None):
+        # read_chunk_fn takes a FileChunk and returns its PLAINTEXT bytes
+        # (filechunk_manifest.ReadFn) — used to expand manifests on GC
         # every store is wrapped for hard-link resolution (reference
         # filer.go always wraps in FilerStoreWrapper + hardlink layer)
         self.store = HardLinkStore(store or MemoryStore())
@@ -224,7 +228,7 @@ class Filer:
             fids.append(c.fid)
             if c.is_chunk_manifest and self.read_chunk_fn is not None:
                 try:
-                    blob = self.read_chunk_fn(c.fid)
+                    blob = self.read_chunk_fn(c)
                     nested = [FileChunk.from_dict(d)
                               for d in _json.loads(blob)["chunks"]]
                 except Exception:

@@ -187,7 +187,7 @@ class S3Server:
             query = {k: v for k, v in req.query.items()
                      if k != "X-Amz-Signature"}
             sig = self._sig_v4(secret, date, region, service, amz_date,
-                               req.method, req.path, query, req.headers,
+                               req.method, req.raw_path, query, req.headers,
                                signed_headers, "UNSIGNED-PAYLOAD")
             if not hmac.compare_digest(sig, req.query["X-Amz-Signature"]):
                 return _err("SignatureDoesNotMatch", "bad signature", 403)
@@ -216,7 +216,7 @@ class S3Server:
                                            "UNSIGNED-PAYLOAD")
             sig = self._sig_v4(secret, date, region, service,
                                req.headers.get("x-amz-date", ""),
-                               req.method, req.path, req.query, req.headers,
+                               req.method, req.raw_path, req.query, req.headers,
                                signed_headers, payload_hash)
             if not hmac.compare_digest(sig, parts["Signature"]):
                 return _err("SignatureDoesNotMatch", "bad signature", 403)

@@ -58,7 +58,11 @@ class FilerServer:
     def __init__(self, master_url: str, host: str = "127.0.0.1",
                  port: int = 0, store: str = "memory",
                  store_dir: Optional[str] = None,
-                 default_replication: str = ""):
+                 default_replication: str = "", cipher: bool = False):
+        # cipher=True encrypts every chunk (AES-256-GCM, per-chunk key in
+        # the chunk metadata) so volume servers hold only ciphertext
+        # (reference `weed filer -encryptVolumeData`)
+        self.cipher = cipher
         self.master_url = master_url
         self.mc = MasterClient(master_url)
         kwargs = {}
@@ -68,7 +72,7 @@ class FilerServer:
             kwargs["path"] = (store_dir or ".") + "/filer_lsm"
         self.filer = Filer(make_store(store, **kwargs),
                            delete_chunks_fn=self._delete_chunks,
-                           read_chunk_fn=self._read_chunk_blob)
+                           read_chunk_fn=self._read_chunk)
         self.filer_conf = FilerConf.load(self.filer.store)
         from seaweedfs_tpu.filer.remote_mount import RemoteMounts
         self.remote_mounts = RemoteMounts(self.filer)
@@ -183,7 +187,7 @@ class FilerServer:
                                 collection=collection,
                                 ttl_sec=_ttl_seconds(ttl),
                                 replication=replication))
-        if len(data) <= INLINE_LIMIT:
+        if len(data) <= INLINE_LIMIT and not self.cipher:
             entry.content = data
         else:
             entry.chunks = self._upload_chunks(data, collection, replication,
@@ -206,7 +210,7 @@ class FilerServer:
                                            replication, ttl))
         return maybe_manifestize(
             lambda blob: self._save_chunk(blob, 0, collection,
-                                          replication, ttl).fid,
+                                          replication, ttl),
             chunks)
 
     def _save_chunk(self, piece: bytes, offset: int, collection: str,
@@ -215,9 +219,15 @@ class FilerServer:
                            ttl=ttl)
         if a.get("error"):
             raise HttpError(500, a["error"].encode())
-        operation.upload_to(a["fid"], a["url"], piece)
+        key = b""
+        if self.cipher:
+            from seaweedfs_tpu.utils import cipher as _cipher
+            blob, key = _cipher.encrypt(piece)
+        else:
+            blob = piece
+        operation.upload_to(a["fid"], a["url"], blob)
         return FileChunk(fid=a["fid"], offset=offset, size=len(piece),
-                         mtime_ns=time.time_ns())
+                         cipher_key=key, mtime_ns=time.time_ns())
 
     # ---- read ----
     def _handle_read(self, req: Request) -> Response:
@@ -242,6 +252,8 @@ class FilerServer:
                                  f'inline; filename="{entry.name}"'})
 
     def _read_chunk_blob(self, fid: str) -> bytes:
+        """Raw stored bytes of a chunk (ciphertext when encrypted);
+        cached as stored."""
         blob = self.chunk_cache.get(fid)
         if blob is None:
             for url in self.mc.lookup_file_id(fid):
@@ -257,6 +269,15 @@ class FilerServer:
             raise HttpError(500, f"chunk {fid} unreachable".encode())
         return blob
 
+    def _read_chunk(self, chunk: FileChunk) -> bytes:
+        """Plaintext bytes of a chunk (decrypts with the per-chunk key
+        from the metadata — reference util/cipher.go Decrypt)."""
+        blob = self._read_chunk_blob(chunk.fid)
+        if chunk.cipher_key:
+            from seaweedfs_tpu.utils import cipher as _cipher
+            blob = _cipher.decrypt(blob, chunk.cipher_key)
+        return blob
+
     def _read_entry_bytes(self, entry: Entry) -> bytes:
         if not entry.content and not entry.chunks and entry.remote:
             # remote-mounted, not cached locally: read through
@@ -266,13 +287,14 @@ class FilerServer:
             return entry.content
         chunks = entry.chunks
         if has_chunk_manifest(chunks):
-            chunks = resolve_chunk_manifest(self._read_chunk_blob, chunks)
+            chunks = resolve_chunk_manifest(self._read_chunk, chunks)
         size = entry.file_size()
         visibles = non_overlapping_visible_intervals(chunks)
         views = view_from_visibles(visibles, 0, size)
+        chunk_by_fid = {c.fid: c for c in chunks}
         out = bytearray(size)
         for view in views:
-            blob = self._read_chunk_blob(view.fid)
+            blob = self._read_chunk(chunk_by_fid[view.fid])
             piece = blob[view.offset_in_chunk:
                          view.offset_in_chunk + view.size]
             out[view.logic_offset:view.logic_offset + view.size] = piece

@@ -25,7 +25,11 @@ class Request:
         self.handler = handler
         self.method = handler.command
         parsed = urllib.parse.urlparse(handler.path)
-        self.path = parsed.path
+        # percent-decode like every mainstream HTTP server: a client
+        # PUTting /a%20b and one GETting "/a b" name the same resource.
+        # raw_path keeps the wire form (SigV4 canonical URIs sign it).
+        self.path = urllib.parse.unquote(parsed.path)
+        self.raw_path = parsed.path
         self.query = {k: v[0] for k, v in
                       urllib.parse.parse_qs(
                           parsed.query, keep_blank_values=True).items()}
@@ -94,7 +98,8 @@ class HttpServer:
             def _dispatch(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
-                path = urllib.parse.urlparse(self.path).path
+                path = urllib.parse.unquote(
+                    urllib.parse.urlparse(self.path).path)
                 for method, pattern, fn in routes:
                     if method != self.command:
                         continue

@@ -86,21 +86,22 @@ def test_manifest_roundtrip():
     def save(blob):
         fid = f"m,{len(blobs)}"
         blobs[fid] = blob
-        return fid
+        return FileChunk(fid=fid, offset=0, size=len(blob))
 
     leaves = [FileChunk(f"1,{i}", i * 10, 10, mtime_ns=i)
               for i in range(257)]
     packed = maybe_manifestize(save, list(leaves), batch=16)
     assert len(packed) <= 16
     assert any(c.is_chunk_manifest for c in packed)
-    resolved = resolve_chunk_manifest(lambda fid: blobs[fid], packed)
+    resolved = resolve_chunk_manifest(lambda c: blobs[c.fid], packed)
     assert sorted(c.fid for c in resolved) == sorted(c.fid for c in leaves)
     assert {(c.offset, c.size) for c in resolved} == {
         (c.offset, c.size) for c in leaves}
 
 
 def test_manifestize_noop_when_narrow():
-    packed = maybe_manifestize(lambda b: "x", [FileChunk("1,a", 0, 5)])
+    packed = maybe_manifestize(lambda b: FileChunk("x", 0, len(b)),
+                               [FileChunk("1,a", 0, 5)])
     assert [c.fid for c in packed] == ["1,a"]
 
 
@@ -171,11 +172,11 @@ def test_manifest_chunks_gc_expands_leaves():
     def save(blob):
         fid = f"m,{len(blobs)}"
         blobs[fid] = blob
-        return fid
+        return FileChunk(fid=fid, offset=0, size=len(blob))
 
     deleted = []
     f = Filer(delete_chunks_fn=lambda fids: deleted.extend(fids),
-              read_chunk_fn=lambda fid: blobs[fid])
+              read_chunk_fn=lambda c: blobs[c.fid])
     leaves = [FileChunk(f"5,{i}", i * 10, 10, mtime_ns=1) for i in range(20)]
     packed = maybe_manifestize(save, leaves, batch=4)
     e = Entry("/g/wide", Attr(mtime=1.0))
