@@ -113,6 +113,31 @@ def cmd_filer(args):
     _wait_forever()
 
 
+def cmd_gateway(args):
+    """Standalone S3 / WebDAV / FTP gateway attached to a REMOTE filer
+    (reference command/s3.go, webdav.go: gateways dial the filer; here
+    metadata flows through filer/remote_store.py, data through the
+    master/volume servers directly)."""
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    fs = FilerServer(args.master, store="remote", store_dir=args.filer,
+                     announce=False)
+    fs.start()  # local HTTP surface (FTP STOR path rides it)
+    started = [f"filer-view {fs.url} -> {args.filer}"]
+    if args.cmd == "s3":
+        from seaweedfs_tpu.gateway.s3_server import S3Server
+        gw = S3Server(fs, host=args.ip, port=args.port)
+    elif args.cmd == "webdav":
+        from seaweedfs_tpu.gateway.webdav_server import WebDavServer
+        gw = WebDavServer(fs, host=args.ip, port=args.port)
+    else:
+        from seaweedfs_tpu.gateway.ftp_server import FtpServer
+        gw = FtpServer(fs, host=args.ip, port=args.port)
+    gw.start()
+    started.append(f"{args.cmd} {gw.url}")
+    print("; ".join(started))
+    _wait_forever()
+
+
 def cmd_upload(args):
     from seaweedfs_tpu.client import operation
     from seaweedfs_tpu.client.wdclient import MasterClient
@@ -371,6 +396,18 @@ def main(argv=None):
     fl.add_argument("-ftp", action="store_true", help="serve FTP gateway")
     fl.add_argument("-ftpPort", type=int, default=0)
     fl.set_defaults(fn=cmd_filer)
+
+    for gw_name, default_port in (("s3", 8333), ("webdav", 7333),
+                                  ("ftp", 2121)):
+        g = sub.add_parser(
+            gw_name,
+            help=f"standalone {gw_name} gateway over a remote filer")
+        g.add_argument("-ip", default="127.0.0.1")
+        g.add_argument("-port", type=int, default=default_port)
+        g.add_argument("-filer", default="127.0.0.1:8888",
+                       help="filer address holding the metadata")
+        g.add_argument("-master", default="127.0.0.1:9333")
+        g.set_defaults(fn=cmd_gateway)
 
     u = sub.add_parser("upload")
     u.add_argument("-master", default="127.0.0.1:9333")

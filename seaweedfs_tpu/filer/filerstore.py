@@ -223,4 +223,7 @@ def make_store(name: str, **kwargs) -> FilerStore:
     if name == "lsm":
         from seaweedfs_tpu.filer.lsm_store import LsmStore
         return LsmStore(**kwargs)
+    if name == "remote":
+        from seaweedfs_tpu.filer.remote_store import RemoteFilerStore
+        return RemoteFilerStore(**kwargs)
     return STORES[name](**kwargs)

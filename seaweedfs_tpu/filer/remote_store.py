@@ -1,0 +1,83 @@
+"""RemoteFilerStore: a FilerStore backed by another filer's HTTP API.
+
+This is what lets gateways run as standalone processes attached to an
+existing filer — `weed-tpu s3 -filer=<addr>`, `webdav`, `ftp` — the way
+the reference's gateways dial a remote filer over filer_pb gRPC
+(weed/command/s3.go, webdav.go). The adapter speaks the filer's
+row-level metadata endpoints (/__api/entry meta_only/raw, /__api/list,
+/__api/kv), so exactly one hard-link/GC layer runs (the local wrapper in
+the gateway's Filer); the remote filer's own clients see the same rows
+and shared KV records.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+from typing import Optional
+
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.filer.filerstore import FilerStore
+from seaweedfs_tpu.utils.httpd import HttpError, http_json
+
+
+class RemoteFilerStore(FilerStore):
+    name = "remote"
+
+    def __init__(self, filer_addr: str):
+        self.addr = filer_addr
+        self.base = f"http://{filer_addr}/__api"
+
+    def insert_entry(self, entry: Entry) -> None:
+        http_json("POST", f"{self.base}/entry",
+                  {"entry": entry.to_dict(), "meta_only": True})
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Optional[Entry]:
+        q = urllib.parse.quote(full_path)
+        try:
+            out = http_json("GET", f"{self.base}/entry?path={q}&raw=true")
+        except HttpError as e:
+            if e.status == 404:
+                return None
+            raise
+        return Entry.from_dict(out["entry"])
+
+    def delete_entry(self, full_path: str) -> None:
+        # http_json raises on errors — a swallowed failure here would let
+        # the caller GC chunks while the remote row survives
+        q = urllib.parse.quote(full_path)
+        http_json("DELETE", f"{self.base}/entry?path={q}")
+
+    def delete_folder_children(self, full_path: str) -> None:
+        q = urllib.parse.quote(full_path)
+        http_json("DELETE", f"{self.base}/entry?path={q}&children=true")
+
+    def list_directory_entries(self, dir_path: str, start_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        qs = urllib.parse.urlencode({
+            "dir": dir_path, "start": start_name,
+            "include_start": "true" if include_start else "false",
+            "limit": str(limit), "prefix": prefix})
+        out = http_json("GET", f"{self.base}/list?{qs}")
+        return [Entry.from_dict(d) for d in out["entries"]]
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        http_json("POST", f"{self.base}/kv",
+                  {"key": key.decode(), "value": value.hex()})
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        q = urllib.parse.quote(key.decode())
+        try:
+            out = http_json("GET", f"{self.base}/kv?key={q}")
+        except HttpError as e:
+            if e.status == 404:
+                return None
+            raise
+        return bytes.fromhex(out["value"])
+
+    def kv_delete(self, key: bytes) -> None:
+        http_json("POST", f"{self.base}/kv",
+                  {"key": key.decode(), "delete": True})

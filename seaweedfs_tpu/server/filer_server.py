@@ -58,11 +58,15 @@ class FilerServer:
     def __init__(self, master_url: str, host: str = "127.0.0.1",
                  port: int = 0, store: str = "memory",
                  store_dir: Optional[str] = None,
-                 default_replication: str = "", cipher: bool = False):
+                 default_replication: str = "", cipher: bool = False,
+                 announce: bool = True):
         # cipher=True encrypts every chunk (AES-256-GCM, per-chunk key in
         # the chunk metadata) so volume servers hold only ciphertext
         # (reference `weed filer -encryptVolumeData`)
         self.cipher = cipher
+        # announce=False: gateway mode (remote metadata store) — don't
+        # register as a filer or aggregate peers
+        self.announce = announce
         self.master_url = master_url
         self.mc = MasterClient(master_url)
         kwargs = {}
@@ -70,10 +74,15 @@ class FilerServer:
             kwargs["path"] = (store_dir or ".") + "/filer.db"
         elif store == "lsm":
             kwargs["path"] = (store_dir or ".") + "/filer_lsm"
+        elif store == "remote":
+            # gateway mode: metadata lives on another filer
+            # (filer/remote_store.py); store_dir carries its address
+            kwargs["filer_addr"] = store_dir
         self.filer = Filer(make_store(store, **kwargs),
                            delete_chunks_fn=self._delete_chunks,
                            read_chunk_fn=self._read_chunk)
         self.filer_conf = FilerConf.load(self.filer.store)
+        self._filer_conf_loaded = time.time()
         from seaweedfs_tpu.filer.remote_mount import RemoteMounts
         self.remote_mounts = RemoteMounts(self.filer)
         self.default_replication = default_replication
@@ -84,6 +93,8 @@ class FilerServer:
 
     def start(self) -> None:
         self.http.start()
+        if not self.announce:
+            return
         self._announce_stop = threading.Event()
         threading.Thread(target=self._announce_loop, daemon=True).start()
         # merged view of every peer filer's change log (reference
@@ -143,6 +154,10 @@ class FilerServer:
         r("POST", "/__api/rename", self._api_rename)
         r("POST", "/__api/entry", self._api_put_entry)
         r("GET", "/__api/entry", self._api_get_entry)
+        r("DELETE", "/__api/entry", self._api_delete_entry_row)
+        r("GET", "/__api/list", self._api_list_entries)
+        r("GET", "/__api/kv", self._api_kv_get)
+        r("POST", "/__api/kv", self._api_kv_put)
         r("POST", "/__api/hardlink", self._api_hardlink)
         r("GET", "/__api/filer_conf", self._api_filer_conf_get)
         r("POST", "/__api/filer_conf", self._api_filer_conf_set)
@@ -314,8 +329,23 @@ class FilerServer:
         }
 
     # ---- delete ----
+    FILER_CONF_TTL = 5.0
+
+    def _current_filer_conf(self) -> FilerConf:
+        """Rules are shared multi-process state (KV in the store, which
+        may itself be remote); re-read on a short TTL so gateways and
+        peers observe fs.configure changes."""
+        now = time.time()
+        if now - self._filer_conf_loaded > self.FILER_CONF_TTL:
+            try:
+                self.filer_conf = FilerConf.load(self.filer.store)
+            except Exception:
+                pass  # keep the last-known rules on transient errors
+            self._filer_conf_loaded = now
+        return self.filer_conf
+
     def _check_writable(self, path: str) -> Optional[Response]:
-        rule = self.filer_conf.match_storage_rule(path)
+        rule = self._current_filer_conf().match_storage_rule(path)
         if rule.read_only:
             return Response(
                 {"error": f"{rule.location_prefix} is read-only"},
@@ -350,21 +380,86 @@ class FilerServer:
         return Response({"path": entry.full_path})
 
     def _api_put_entry(self, req: Request) -> Response:
-        """Write a raw entry record (metadata import: fs.meta.load,
-        filer.sync sinks — reference filer_pb CreateEntry)."""
-        entry = Entry.from_dict(req.json()["entry"])
+        """Write an entry record (metadata import: fs.meta.load,
+        filer.sync sinks — reference filer_pb CreateEntry). meta_only
+        writes the row verbatim at the store level, bypassing chunk GC
+        and hard-link accounting (remote store adapters own those)."""
+        b = req.json()
+        entry = Entry.from_dict(b["entry"])
         denied = self._check_writable(entry.full_path)
         if denied:
             return denied
-        self.filer.create_entry(entry)
+        if b.get("meta_only"):
+            # row-level write, but STILL logged: sync/backup/mount
+            # subscribers must see gateway-written entries (reference
+            # CreateEntry always notifies)
+            old = self.filer.store.inner.find_entry(entry.full_path)
+            self.filer.store.inner.insert_entry(entry)
+            self.filer._notify(entry.dir_path,
+                               old.to_dict() if old else None,
+                               entry.to_dict())
+        else:
+            self.filer.create_entry(entry)
         return Response({"path": entry.full_path}, status=201)
 
     def _api_get_entry(self, req: Request) -> Response:
-        """Full entry metadata incl. chunks (reference LookupDirectoryEntry)."""
-        entry = self.filer.find_entry(req.query["path"])
+        """Full entry metadata incl. chunks (reference
+        LookupDirectoryEntry). raw=true returns the unresolved store row."""
+        if req.query.get("raw") == "true":
+            entry = self.filer.store.inner.find_entry(req.query["path"])
+        else:
+            entry = self.filer.find_entry(req.query["path"])
         if entry is None:
             return Response({"error": "not found"}, status=404)
         return Response({"entry": entry.to_dict()})
+
+    def _api_delete_entry_row(self, req: Request) -> Response:
+        """Metadata-row delete (no chunk GC — the caller owns it). The
+        surface a remote FilerStore adapter needs (filer/remote_store.py).
+        Deletions are logged so subscribers see them."""
+        path = req.query["path"]
+        denied = self._check_writable(path)
+        if denied:
+            return denied
+        inner = self.filer.store.inner
+        if req.query.get("children") == "true":
+            doomed = inner.list_directory_entries(path, limit=1 << 20)
+            inner.delete_folder_children(path)
+            for child in doomed:
+                self.filer._notify(path, child.to_dict(), None)
+        else:
+            old = inner.find_entry(path)
+            inner.delete_entry(path)
+            if old is not None:
+                self.filer._notify(old.dir_path, old.to_dict(), None)
+        return Response({})
+
+    def _api_list_entries(self, req: Request) -> Response:
+        """Full RAW entry rows of one directory (listing JSON on GET
+        <dir> is trimmed for humans; store adapters resolve hard links
+        themselves — same contract as entry?raw=true)."""
+        entries = self.filer.store.inner.list_directory_entries(
+            req.query["dir"],
+            start_name=req.query.get("start", ""),
+            include_start=req.query.get("include_start") == "true",
+            limit=int(req.query.get("limit", 1024)),
+            prefix=req.query.get("prefix", ""))
+        return Response({"entries": [e.to_dict() for e in entries]})
+
+    def _api_kv_get(self, req: Request) -> Response:
+        val = self.filer.store.kv_get(req.query["key"].encode())
+        if val is None:
+            return Response({"error": "not found"}, status=404)
+        return Response({"value": val.hex()})
+
+    def _api_kv_put(self, req: Request) -> Response:
+        b = req.json()
+        if b.get("delete"):
+            self.filer.store.kv_delete(b["key"].encode())
+        else:
+            self.filer.store.kv_put(b["key"].encode(),
+                                    bytes.fromhex(b["value"]))
+        return Response({})
 
     def _api_hardlink(self, req: Request) -> Response:
         b = req.json()
