@@ -51,8 +51,10 @@ class FilerStore(abc.ABC):
     @abc.abstractmethod
     def kv_get(self, key: bytes) -> Optional[bytes]: ...
 
+    @abc.abstractmethod
     def kv_delete(self, key: bytes) -> None:
-        self.kv_put(key, b"")
+        """Remove the key. b"" is a legitimate stored value, not a
+        deletion marker — every backend deletes for real."""
 
     def close(self) -> None:
         pass
@@ -126,7 +128,10 @@ class MemoryStore(FilerStore):
         self._kv[key] = value
 
     def kv_get(self, key: bytes) -> Optional[bytes]:
-        return self._kv.get(key) or None
+        return self._kv.get(key)
+
+    def kv_delete(self, key: bytes) -> None:
+        self._kv.pop(key, None)
 
 
 class SqliteStore(FilerStore):
@@ -210,7 +215,12 @@ class SqliteStore(FilerStore):
         with self._lock:
             row = self._conn.execute(
                 "SELECT v FROM kv WHERE k=?", (key,)).fetchone()
-        return row[0] if row and row[0] else None
+        return row[0] if row else None
+
+    def kv_delete(self, key: bytes) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE k=?", (key,))
+            self._conn.commit()
 
     def close(self) -> None:
         self._conn.close()

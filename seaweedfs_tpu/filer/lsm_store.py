@@ -95,7 +95,7 @@ class LsmStore(FilerStore):
         self.kv.put(b"K" + key, value)
 
     def kv_get(self, key: bytes) -> Optional[bytes]:
-        return self.kv.get(b"K" + key) or None
+        return self.kv.get(b"K" + key)
 
     def kv_delete(self, key: bytes) -> None:
         self.kv.put(b"K" + key, None)

@@ -83,6 +83,7 @@ class FilerServer:
                            read_chunk_fn=self._read_chunk)
         self.filer_conf = FilerConf.load(self.filer.store)
         self._filer_conf_loaded = time.time()
+        self._filer_conf_write_lock = threading.Lock()
         from seaweedfs_tpu.filer.remote_mount import RemoteMounts
         self.remote_mounts = RemoteMounts(self.filer)
         self.default_replication = default_replication
@@ -185,7 +186,7 @@ class FilerServer:
             return Response({"path": path}, status=201)
         data = req.body
         # per-path rules from filer.conf fill in what the request omits
-        rule = self.filer_conf.match_storage_rule(path)
+        rule = self._current_filer_conf().match_storage_rule(path)
         if rule.read_only:
             return Response({"error": f"{rule.location_prefix} is read-only"},
                             status=403)
@@ -477,15 +478,23 @@ class FilerServer:
 
     def _api_filer_conf_get(self, req: Request) -> Response:
         return Response({"locations": [r.to_dict()
-                                       for r in self.filer_conf.rules]})
+                                       for r in self._current_filer_conf().rules]})
 
     def _api_filer_conf_set(self, req: Request) -> Response:
         b = req.json()
-        if b.get("delete"):
-            self.filer_conf.delete_rule(b["location_prefix"])
-        else:
-            self.filer_conf.set_rule(PathConf.from_dict(b))
-        self.filer_conf.save(self.filer.store)
+        # serialize load->mutate->save per process, and mutate a
+        # freshly-loaded conf so we never clobber rules a peer wrote
+        # since our last TTL refresh (cross-process races remain, as in
+        # the reference's read-modify-write of /etc/seaweedfs/filer.conf)
+        with self._filer_conf_write_lock:
+            conf = FilerConf.load(self.filer.store)
+            if b.get("delete"):
+                conf.delete_rule(b["location_prefix"])
+            else:
+                conf.set_rule(PathConf.from_dict(b))
+            conf.save(self.filer.store)
+            self.filer_conf = conf
+            self._filer_conf_loaded = time.time()
         return Response({"locations": [r.to_dict()
                                        for r in self.filer_conf.rules]})
 
@@ -538,7 +547,7 @@ class FilerServer:
         if err:
             return err
         # same placement rules as a normal write to this path
-        rule = self.filer_conf.match_storage_rule(entry.full_path)
+        rule = self._current_filer_conf().match_storage_rule(entry.full_path)
         replication = rule.replication or self.default_replication
         entry = self.remote_mounts.cache_entry(
             entry, lambda data: self._upload_chunks(

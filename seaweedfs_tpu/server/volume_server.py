@@ -543,10 +543,7 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is None:
             return Response({"error": "volume not found"}, status=404)
-        entries: list[tuple[int, int]] = []
-        v.nm.ascending_visit(
-            lambda k, o, s: entries.append((k, s)) if s > 0 else None)
-        entries.sort()
+        entries = v.live_entries()
         h = hashlib.md5()
         for k, s in entries:
             h.update(k.to_bytes(8, "big") + s.to_bytes(4, "big", signed=True))

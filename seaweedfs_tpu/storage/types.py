@@ -28,7 +28,10 @@ TTL_BYTES_LENGTH = 2
 LAST_MODIFIED_BYTES_LENGTH = 5
 
 _ENTRY = struct.Struct(">QIi")
-_ENTRY5 = struct.Struct(">QBIi")  # 5-byte offset: high byte + low uint32
+# 5-byte offset, matching the reference's offset_5bytes.go OffsetToBytes:
+# bytes[0..3] hold the low 32 bits big-endian (b3..b0), bytes[4] the high
+# byte (b4) — i.e. low uint32 first, then the 5th (high) byte.
+_ENTRY5 = struct.Struct(">QIBi")
 
 
 def size_is_deleted(size: int) -> bool:
@@ -64,15 +67,15 @@ def pack_entry(key: int, offset_units: int, size: int,
                offset_bytes: int = 4) -> bytes:
     """Needle-map/index entry (16B or, for 5-byte offsets, 17B)."""
     if offset_bytes == 5:
-        return _ENTRY5.pack(key, (offset_units >> 32) & 0xFF,
-                            offset_units & 0xFFFFFFFF, size)
+        return _ENTRY5.pack(key, offset_units & 0xFFFFFFFF,
+                            (offset_units >> 32) & 0xFF, size)
     return _ENTRY.pack(key, offset_units & 0xFFFFFFFF, size)
 
 
 def unpack_entry(buf: bytes, off: int = 0,
                  offset_bytes: int = 4) -> tuple[int, int, int]:
     if offset_bytes == 5:
-        key, hi, lo, size = _ENTRY5.unpack_from(buf, off)
+        key, lo, hi, size = _ENTRY5.unpack_from(buf, off)
         return key, (hi << 32) | lo, size
     return _ENTRY.unpack_from(buf, off)
 

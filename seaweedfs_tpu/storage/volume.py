@@ -156,6 +156,16 @@ class Volume:
                 idxmod.walk_index_file(base + ".idx", visit,
                                        offset_bytes=self.offset_bytes)
 
+    def live_entries(self) -> list[tuple[int, int]]:
+        """Thread-safe (key, size) snapshot of live needles, sorted by
+        key — the comparison unit of volume.check.disk."""
+        entries: list[tuple[int, int]] = []
+        with self._lock:
+            self.nm.ascending_visit(
+                lambda k, o, s: entries.append((k, s)) if s > 0 else None)
+        entries.sort()
+        return entries
+
     # ---- write ----
     def write_needle(self, n: Needle) -> int:
         """Append; returns stored size (reference volume_write.go:109-162).

@@ -34,16 +34,27 @@ def _pack(key: bytes, val: Optional[bytes]) -> bytes:
 
 
 def _iter_records(blob: bytes) -> Iterator[tuple[bytes, Optional[bytes]]]:
+    for key, val, _end in _iter_records_pos(blob):
+        yield key, val
+
+
+def _iter_records_pos(blob: bytes
+                      ) -> Iterator[tuple[bytes, Optional[bytes], int]]:
+    """Yields (key, val, end_offset); stops before a torn tail record
+    (crash mid-append) so replay can truncate at the last good byte."""
     pos, n = 0, len(blob)
     while pos + _REC.size <= n:
         klen, vlen = _REC.unpack_from(blob, pos)
+        body = klen + (0 if vlen == _TOMB else vlen)
+        if pos + _REC.size + body > n:
+            break  # torn tail record — drop it
         pos += _REC.size
         key = blob[pos:pos + klen]
         pos += klen
         if vlen == _TOMB:
-            yield key, None
+            yield key, None, pos
         else:
-            yield key, blob[pos:pos + vlen]
+            yield key, blob[pos:pos + vlen], pos + vlen
             pos += vlen
 
 
@@ -108,8 +119,16 @@ class LsmKv:
                 blob = f.read()
         except OSError:
             return
-        for key, val in _iter_records(blob):
+        good = 0
+        for key, val, end in _iter_records_pos(blob):
             self._mem_put(key, val)
+            good = end
+        if good < len(blob):
+            # cut the torn tail NOW: the WAL reopens in append mode, and
+            # appending after torn bytes would let the dropped record
+            # resurrect (half-merged with the new one) on a later replay
+            with open(self._wal_path, "r+b") as f:
+                f.truncate(good)
 
     def _mem_put(self, key: bytes, val: Optional[bytes]) -> None:
         if key not in self._mem:

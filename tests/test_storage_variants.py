@@ -284,3 +284,15 @@ def test_tcp_bad_fid_and_wrong_cookie(tcp_stack):
         c.read("garbage")
     assert c.read(a["fid"]) == b"data"  # still alive
     c.close()
+
+
+def test_entry5_byte_layout_matches_reference():
+    """The 5-byte offset field stores the low uint32 big-endian in
+    bytes[0..3] and the high byte at bytes[4] (reference
+    offset_5bytes.go OffsetToBytes: bytes[0]=b3 .. bytes[3]=b0,
+    bytes[4]=b4)."""
+    off = 0xAB12345678  # high byte 0xAB, low word 0x12345678
+    blob = t.pack_entry(1, off, 2, offset_bytes=5)
+    field = blob[8:13]
+    assert field[0:4] == bytes([0x12, 0x34, 0x56, 0x78])
+    assert field[4] == 0xAB
