@@ -58,6 +58,9 @@ def _load():
         lib.gf_apply.restype = None
         lib.crc32c.argtypes = [ctypes.c_uint32, ctypes.c_void_p, ctypes.c_int64]
         lib.crc32c.restype = ctypes.c_uint32
+        lib.gf_force_impl.argtypes = [ctypes.c_int]
+        lib.gf_force_impl.restype = ctypes.c_int
+        lib.gf_impl_name.restype = ctypes.c_char_p
         _lib = lib
         return _lib
 
@@ -78,6 +81,24 @@ def gf_apply(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
     out = np.zeros((m, n), dtype=np.uint8)
     lib.gf_apply(mat.ctypes.data, m, k, data.ctypes.data, out.ctypes.data, n)
     return out
+
+
+IMPL_AUTO, IMPL_SCALAR, IMPL_AVX2, IMPL_GFNI = 0, 1, 2, 3
+
+
+def force_impl(which: int) -> int:
+    """Pin the GF kernel tier (IMPL_*); returns the tier that will run.
+    Benchmarks use this to measure each tier honestly."""
+    lib = _load()
+    assert lib is not None
+    return int(lib.gf_force_impl(which))
+
+
+def impl_name() -> str:
+    """Name of the GF kernel tier currently selected."""
+    lib = _load()
+    assert lib is not None
+    return lib.gf_impl_name().decode()
 
 
 def crc32c(data: bytes | np.ndarray, crc: int = 0) -> int:

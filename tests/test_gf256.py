@@ -119,3 +119,30 @@ def test_crc32c():
     rng = np.random.default_rng(3)
     buf = rng.integers(0, 256, 300, dtype=np.uint8).tobytes()
     assert crc32c(buf) == _crc32c_py(buf)
+
+
+def test_native_simd_tiers_match_reference():
+    """Every native GF kernel tier (SWAR / AVX2-pshufb / GFNI) must agree
+    with the pure-python table codec, across vector-stride boundaries and
+    tails. Unsupported tiers resolve to a supported one, so this is safe
+    on any CPU."""
+    import numpy as np
+    from seaweedfs_tpu.native import rs_native as rn
+    from seaweedfs_tpu.ops import gf256 as g
+    if not rn.available():
+        import pytest
+        pytest.skip("no native codec")
+    rng = np.random.default_rng(7)
+    try:
+        for m, k in ((4, 10), (10, 14)):
+            mat = rng.integers(0, 256, (m, k), dtype=np.uint8)
+            for n in (1, 63, 64, 127, 128, 129, 4096 + 5):
+                data = rng.integers(0, 256, (k, n), dtype=np.uint8)
+                want = np.asarray(g.gf_matmul(mat, data), dtype=np.uint8)
+                for impl in (rn.IMPL_SCALAR, rn.IMPL_AVX2, rn.IMPL_GFNI):
+                    rn.force_impl(impl)
+                    got = rn.gf_apply(mat, data)
+                    assert np.array_equal(got, want), (m, k, n, impl,
+                                                       rn.impl_name())
+    finally:
+        rn.force_impl(rn.IMPL_AUTO)
