@@ -23,6 +23,7 @@
 //
 // Exposed via ctypes (see rs_native.py); no pybind11 dependency.
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -98,6 +99,9 @@ static void gf_tail(const uint8_t* mat, int64_t m, int64_t k,
 
 static void gf_apply_scalar(const uint8_t* mat, int64_t m, int64_t k,
                             const uint8_t* data, uint8_t* out, int64_t n) {
+    // the doubling-chain tables assume m <= 64 (uint64 row bitmask) and
+    // k <= 256; anything bigger runs the unbounded table path
+    if (m > 64 || k > 256) { gf_tail(mat, m, k, data, out, n, 0); return; }
     const int64_t nw = n / 8;
     // per (j, bit): bitmask over i of parities that need this doubled
     // version (m <= 64)
@@ -195,6 +199,11 @@ static void gf_apply_avx2(const uint8_t* mat, int64_t m, int64_t k,
     gf_tail(mat, m, k, data, out, n, pos);
 }
 
+#if defined(__clang__) || (defined(__GNUC__) && __GNUC__ >= 10)
+#define RS_HAVE_GFNI 1
+#endif
+
+#ifdef RS_HAVE_GFNI
 // ------------------------------------------------------- GFNI affine path
 
 // 8x8 bit-matrix A_c with A_c . x = c*x over GF(2^8)/0x11D, in the layout
@@ -246,6 +255,7 @@ static void gf_apply_gfni(const uint8_t* mat, int64_t m, int64_t k,
     _mm_free(mt);
     gf_tail(mat, m, k, data, out, n, pos);
 }
+#endif  // RS_HAVE_GFNI
 
 #endif  // RS_X86
 
@@ -255,7 +265,8 @@ enum GfImpl { GF_AUTO = 0, GF_SCALAR = 1, GF_AVX2 = 2, GF_GFNI = 3 };
 
 static std::mutex g_impl_mu;
 static int g_forced = GF_AUTO;
-static int g_selected = 0;  // resolved tier, 0 = not yet probed
+static int g_selected = 0;            // resolved tier, 0 = not yet probed
+static std::atomic<int> g_fast{0};    // lock-free mirror for the hot path
 
 typedef void (*gf_fn)(const uint8_t*, int64_t, int64_t,
                       const uint8_t*, uint8_t*, int64_t);
@@ -284,11 +295,13 @@ static bool self_test(gf_fn fn) {
 // capability + self-test probe for one tier; GF_SCALAR always passes
 static bool tier_usable(int which) {
     switch (which) {
-#ifdef RS_X86
+#if defined(RS_X86) && defined(RS_HAVE_GFNI)
         case GF_GFNI:
             return __builtin_cpu_supports("gfni") &&
                    __builtin_cpu_supports("avx512bw") &&
                    self_test(gf_apply_gfni);
+#endif
+#ifdef RS_X86
         case GF_AVX2:
             return __builtin_cpu_supports("avx2") &&
                    self_test(gf_apply_avx2);
@@ -299,16 +312,23 @@ static bool tier_usable(int which) {
 }
 
 static int resolve_impl() {
+    int fast = g_fast.load(std::memory_order_acquire);
+    if (fast) return fast;  // settled — no lock on the hot path
     std::lock_guard<std::mutex> lk(g_impl_mu);
-    if (g_forced != GF_AUTO) return g_forced;
-    if (g_selected) return g_selected;
-    gf_init();
+    if (g_forced != GF_AUTO) {
+        g_fast.store(g_forced, std::memory_order_release);
+        return g_forced;
+    }
+    if (!g_selected) {
+        gf_init();
 #ifdef RS_X86
-    __builtin_cpu_init();
+        __builtin_cpu_init();
 #endif
-    if (tier_usable(GF_GFNI)) g_selected = GF_GFNI;
-    else if (tier_usable(GF_AVX2)) g_selected = GF_AVX2;
-    else g_selected = GF_SCALAR;
+        if (tier_usable(GF_GFNI)) g_selected = GF_GFNI;
+        else if (tier_usable(GF_AVX2)) g_selected = GF_AVX2;
+        else g_selected = GF_SCALAR;
+    }
+    g_fast.store(g_selected, std::memory_order_release);
     return g_selected;
 }
 
@@ -320,8 +340,10 @@ extern "C" {
 void gf_apply(const uint8_t* mat, int64_t m, int64_t k,
               const uint8_t* data, uint8_t* out, int64_t n) {
     switch (resolve_impl()) {
-#ifdef RS_X86
+#if defined(RS_X86) && defined(RS_HAVE_GFNI)
         case GF_GFNI: gf_apply_gfni(mat, m, k, data, out, n); break;
+#endif
+#ifdef RS_X86
         case GF_AVX2: gf_apply_avx2(mat, m, k, data, out, n); break;
 #endif
         default:      gf_apply_scalar(mat, m, k, data, out, n); break;
@@ -342,6 +364,7 @@ int gf_force_impl(int which) {
         if (which != GF_AUTO && !tier_usable(which)) which = GF_AUTO;
         g_forced = which;
         g_selected = 0;
+        g_fast.store(0, std::memory_order_release);
     }
     return resolve_impl();
 }

@@ -38,6 +38,21 @@ def _build() -> bool:
             return False
 
 
+def _bind(lib) -> None:
+    """Declare ctypes signatures; raises AttributeError on a stale .so
+    missing newer symbols."""
+    lib.gf_apply.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.gf_apply.restype = None
+    lib.crc32c.argtypes = [ctypes.c_uint32, ctypes.c_void_p, ctypes.c_int64]
+    lib.crc32c.restype = ctypes.c_uint32
+    lib.gf_force_impl.argtypes = [ctypes.c_int]
+    lib.gf_force_impl.restype = ctypes.c_int
+    lib.gf_impl_name.restype = ctypes.c_char_p
+
+
 def _load():
     global _lib, _tried
     with _lock:
@@ -47,22 +62,22 @@ def _load():
         if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
             if not _build():
                 return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
-            return None
-        lib.gf_apply.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-        ]
-        lib.gf_apply.restype = None
-        lib.crc32c.argtypes = [ctypes.c_uint32, ctypes.c_void_p, ctypes.c_int64]
-        lib.crc32c.restype = ctypes.c_uint32
-        lib.gf_force_impl.argtypes = [ctypes.c_int]
-        lib.gf_force_impl.restype = ctypes.c_int
-        lib.gf_impl_name.restype = ctypes.c_char_p
-        _lib = lib
-        return _lib
+        for attempt in range(2):
+            try:
+                lib = ctypes.CDLL(_SO)
+                _bind(lib)
+            except OSError:
+                return None
+            except AttributeError:
+                # stale cached .so (e.g. copied with preserved mtimes)
+                # predating a symbol — rebuild once, then give up so
+                # callers fall back to pure Python
+                if attempt or not _build():
+                    return None
+                continue
+            _lib = lib
+            return _lib
+        return None
 
 
 def available() -> bool:

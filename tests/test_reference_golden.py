@@ -1,0 +1,259 @@
+"""Golden cross-validation against the reference's committed artifacts.
+
+The reference ships real fixtures produced by its own Go implementation
+(weed/storage/erasure_coding/1.dat + 1.idx, exercised by ec_test.go:21-187).
+These tests parse those artifacts with this repo's codecs — any drift in the
+needle/idx/superblock formats or in the EC construction fails loudly:
+
+- the .idx walker and needle codec must read every record the reference
+  wrote, byte-for-byte, CRC-verified;
+- EC-encoding the reference .dat must produce byte-identical shards to the
+  SHA-256 goldens committed below (and the jax coder must match the CPU
+  coder on the same input);
+- GF(256) products and the RS(10,4) Vandermonde matrix are pinned against
+  an independent bit-by-bit implementation written in this file, i.e. the
+  mathematical definition klauspost/reedsolomon (reference go.mod:61)
+  implements for polynomial 0x11D.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+REF_EC_DIR = "/root/reference/weed/storage/erasure_coding"
+REF_DAT = os.path.join(REF_EC_DIR, "1.dat")
+REF_IDX = os.path.join(REF_EC_DIR, "1.idx")
+
+needs_fixture = pytest.mark.skipif(
+    not (os.path.exists(REF_DAT) and os.path.exists(REF_IDX)),
+    reason="reference fixtures not present")
+
+# SHA-256 of each shard produced by EC-encoding the reference 1.dat
+# (RS(10,4), 1GB/1MB two-tier rows, zero-fill past EOF). 1.dat is
+# 2,590,912 bytes; one small-block row of 10x1MB covers it, so shards
+# .ec03-.ec09 are all zeros — that repeated hash IS the hash of 1MB of
+# zeros, which is itself a layout assertion.
+GOLDEN_SHARDS = [
+    "f903381561f727c7509b5c286d5941075c18cf4ea07bb70925ca126c11271564",
+    "901b0032551fb544331ee2055d63fa690c0eab4955b412cb30339d1232a210c0",
+    "a8d8e087c6ec15732e9155bd579673ddb64208c71286afb5ad99bacdb5416059",
+    "30e14955ebf1352266dc2ff8067e68104607e750abb9d3b36582b8af909fcb58",
+    "30e14955ebf1352266dc2ff8067e68104607e750abb9d3b36582b8af909fcb58",
+    "30e14955ebf1352266dc2ff8067e68104607e750abb9d3b36582b8af909fcb58",
+    "30e14955ebf1352266dc2ff8067e68104607e750abb9d3b36582b8af909fcb58",
+    "30e14955ebf1352266dc2ff8067e68104607e750abb9d3b36582b8af909fcb58",
+    "30e14955ebf1352266dc2ff8067e68104607e750abb9d3b36582b8af909fcb58",
+    "30e14955ebf1352266dc2ff8067e68104607e750abb9d3b36582b8af909fcb58",
+    "a166e4d73956621adb4cd48f28f5573fb9662a1b82e24b48d6d12634b10e3f2b",
+    "f13c9dc568f01b5cc7555c8493c5a75cdc6e3046d0eed57a18dde63870f55a84",
+    "e37532ebfc5827d2a89ffd4a4bcc319758fe73d66864d03126db1d09f557e6bc",
+    "b8455ba4d5755c1e613c8265180ac556d8b56bd3eae28deccfcd12c87238ebd3",
+]
+GOLDEN_ECX = "a05edac0e528e0e5360839f0bc0b39d5cc7664519d06888ab19e4a1cecdb2ae0"
+
+
+# ---- an independent GF(2^8)/0x11D implementation for cross-checks ----
+
+def _gf_mul_bitwise(a: int, b: int) -> int:
+    """Carry-less multiply then reduce by x^8+x^4+x^3+x^2+1 (0x11D) —
+    no tables, no shared code with seaweedfs_tpu.ops.gf256."""
+    p = 0
+    for bit in range(8):
+        if (b >> bit) & 1:
+            p ^= a << bit
+    for bit in range(15, 7, -1):
+        if (p >> bit) & 1:
+            p ^= 0x11D << (bit - 8)
+    return p
+
+
+def _gf_pow_bitwise(a: int, n: int) -> int:
+    r = 1
+    for _ in range(n):
+        r = _gf_mul_bitwise(r, a)
+    return r
+
+
+def _gf_inv_matrix_bitwise(m: list[list[int]]) -> list[list[int]]:
+    """Gauss-Jordan over GF(256) using only the bitwise helpers."""
+    n = len(m)
+    aug = [row[:] + [1 if i == j else 0 for j in range(n)]
+           for i, row in enumerate(m)]
+    for col in range(n):
+        piv = next(r for r in range(col, n) if aug[r][col])
+        aug[col], aug[piv] = aug[piv], aug[col]
+        # scale pivot row to 1: multiply by inverse (brute force)
+        inv = next(x for x in range(1, 256)
+                   if _gf_mul_bitwise(aug[col][col], x) == 1)
+        aug[col] = [_gf_mul_bitwise(v, inv) for v in aug[col]]
+        for r in range(n):
+            if r != col and aug[r][col]:
+                f = aug[r][col]
+                aug[r] = [aug[r][c] ^ _gf_mul_bitwise(f, aug[col][c])
+                          for c in range(2 * n)]
+    return [row[n:] for row in aug]
+
+
+def test_gf_products_match_bitwise_definition():
+    from seaweedfs_tpu.ops import gf256
+    # hand-derivable anchors for poly 0x11D
+    assert _gf_mul_bitwise(0x80, 2) == 0x1D       # x^7 * x = poly tail
+    assert _gf_mul_bitwise(3, 3) == 5             # (x+1)^2 = x^2+1
+    assert _gf_mul_bitwise(2, 2) == 4
+    assert _gf_mul_bitwise(0xFF, 1) == 0xFF
+    for a, b in [(2, 0x80), (3, 3), (0x53, 0xB6), (255, 255), (29, 29),
+                 (7, 200), (123, 45)]:
+        want = _gf_mul_bitwise(a, b)
+        assert int(gf256.MUL_TABLE[a][b]) == want, (a, b)
+        assert int(gf256.gf_mul(a, b)) == want, (a, b)
+    # exp/log consistency: alpha = 2 generates the multiplicative group
+    seen = set()
+    x = 1
+    for _ in range(255):
+        seen.add(x)
+        x = _gf_mul_bitwise(x, 2)
+    assert len(seen) == 255 and x == 1
+
+
+def test_rs_matrix_rows_match_independent_construction():
+    """Rebuild the systematic Vandermonde RS(10,4) matrix from scratch with
+    the bitwise field ops and pin the parity rows as literal goldens."""
+    from seaweedfs_tpu.ops import gf256
+    k, total = 10, 14
+    vm = [[_gf_pow_bitwise(r, c) for c in range(k)] for r in range(total)]
+    top_inv = _gf_inv_matrix_bitwise([row[:] for row in vm[:k]])
+    mat = [[0] * k for _ in range(total)]
+    for r in range(total):
+        for c in range(k):
+            acc = 0
+            for t_ in range(k):
+                acc ^= _gf_mul_bitwise(vm[r][t_], top_inv[t_][c])
+            mat[r][c] = acc
+    got = np.asarray(gf256.rs_matrix(k, total))
+    assert np.array_equal(got, np.array(mat, dtype=np.uint8))
+    # systematic top, and the parity rows pinned literally
+    assert np.array_equal(got[:k], np.eye(k, dtype=np.uint8))
+    assert got[k:].tolist() == [
+        [129, 150, 175, 184, 210, 196, 254, 232, 3, 2],
+        [150, 129, 184, 175, 196, 210, 232, 254, 2, 3],
+        [191, 214, 98, 10, 6, 111, 223, 183, 5, 4],
+        [214, 191, 10, 98, 111, 6, 183, 223, 4, 5],
+    ]
+
+
+# ---- artifact parsing ----
+
+@needs_fixture
+def test_reference_superblock_parses():
+    from seaweedfs_tpu.storage.super_block import SuperBlock
+    with open(REF_DAT, "rb") as f:
+        sb = SuperBlock.parse(f.read(8))
+    assert sb.version == 3
+    assert sb.block_size == 8
+
+
+@needs_fixture
+def test_reference_idx_walks_and_needles_read():
+    """Every entry the reference's Go code wrote into 1.idx must resolve to
+    a CRC-valid needle in 1.dat via this repo's codecs."""
+    from seaweedfs_tpu.storage import idx as idxmod
+    from seaweedfs_tpu.storage import types as t
+    from seaweedfs_tpu.storage.needle import Needle
+
+    entries: list[tuple[int, int, int]] = []
+    idxmod.walk_index_file(REF_IDX, lambda k, o, s: entries.append((k, o, s)))
+    assert len(entries) == os.path.getsize(REF_IDX) // 16 == 298
+    # first entry, hand-read from the hex dump of the fixture
+    assert entries[0] == (8, 1, 0x2031)
+
+    dat = open(REF_DAT, "rb").read()
+    live = 0
+    for key, off, size in entries:
+        if t.size_is_deleted(size):
+            continue
+        byte_off = t.offset_to_actual(off)
+        record = dat[byte_off:byte_off + t.get_actual_size(size, 3)]
+        n = Needle.from_bytes(record, size, version=3, check_crc=True)
+        assert n.id == key
+        live += 1
+    assert live > 0
+
+
+@needs_fixture
+def test_reference_dat_ec_encode_matches_goldens(tmp_path):
+    """EC-encode the reference-produced volume; shards must match the
+    committed SHA-256 goldens byte-for-byte, for BOTH coders. Any change
+    to the layout math, padding semantics, matrix, or GF tables trips
+    this test."""
+    from seaweedfs_tpu.models.coder import make_coder
+    from seaweedfs_tpu.storage.erasure_coding import encoder
+
+    base = str(tmp_path / "1")
+    shutil.copy(REF_DAT, base + ".dat")
+    shutil.copy(REF_IDX, base + ".idx")
+    encoder.write_ec_files(base, coder=make_coder("cpu"))
+    encoder.write_sorted_ecx(base)
+    for i in range(14):
+        digest = hashlib.sha256(
+            open(base + f".ec{i:02d}", "rb").read()).hexdigest()
+        assert digest == GOLDEN_SHARDS[i], f"shard {i} drifted"
+    assert hashlib.sha256(
+        open(base + ".ecx", "rb").read()).hexdigest() == GOLDEN_ECX
+
+    # jax coder: same bytes on the same input
+    base2 = str(tmp_path / "2")
+    shutil.copy(REF_DAT, base2 + ".dat")
+    encoder.write_ec_files(base2, coder=make_coder("jax"))
+    for i in range(14):
+        digest = hashlib.sha256(
+            open(base2 + f".ec{i:02d}", "rb").read()).hexdigest()
+        assert digest == GOLDEN_SHARDS[i], f"jax shard {i} drifted"
+
+
+@needs_fixture
+def test_reference_needles_survive_ec_roundtrip(tmp_path):
+    """Mirror of the reference's ec_test.go end-to-end assertion: encode,
+    drop 4 shards, reconstruct, and read needles byte-identically from
+    the rebuilt data."""
+    from seaweedfs_tpu.models.coder import make_coder
+    from seaweedfs_tpu.storage import types as t
+    from seaweedfs_tpu.storage.erasure_coding import encoder, layout
+    from seaweedfs_tpu.storage import idx as idxmod
+
+    base = str(tmp_path / "1")
+    shutil.copy(REF_DAT, base + ".dat")
+    shutil.copy(REF_IDX, base + ".idx")
+    encoder.write_ec_files(base)
+    shard_len = os.path.getsize(base + ".ec00")
+    shards: list = [open(base + f".ec{i:02d}", "rb").read()
+                    for i in range(14)]
+    for drop in (0, 2, 11, 13):
+        shards[drop] = None
+    coder = make_coder("cpu")
+    rebuilt = coder.reconstruct(shards)
+
+    dat = open(REF_DAT, "rb").read()
+    entries: list[tuple[int, int, int]] = []
+    idxmod.walk_index_file(REF_IDX, lambda k, o, s: entries.append((k, o, s)))
+    dat_size = os.path.getsize(REF_DAT)
+    checked = 0
+    for key, off, size in entries[:40]:
+        if t.size_is_deleted(size):
+            continue
+        byte_off = t.offset_to_actual(off)
+        length = t.get_actual_size(size, 3)
+        got = bytearray()
+        for iv in layout.locate_data(layout.LARGE_BLOCK_SIZE,
+                                     layout.SMALL_BLOCK_SIZE,
+                                     dat_size, byte_off, length):
+            sid, soff = iv.to_shard_id_and_offset()
+            got += rebuilt[sid][soff:soff + iv.size]
+        assert bytes(got) == dat[byte_off:byte_off + length], hex(key)
+        checked += 1
+    assert checked > 10
+    assert shard_len == len(rebuilt[0])
