@@ -117,13 +117,16 @@ def register_coder(name: str):
 
 
 def make_coder(name: str = "cpu", scheme: RSScheme = DEFAULT_SCHEME) -> ErasureCoder:
-    """Factory: 'cpu' (default, like the reference), 'jax', 'pallas'."""
+    """Factory: 'cpu' (default, like the reference), 'jax', 'pallas',
+    'mxu' (measurement kernel — see ops/rs_mxu.py)."""
     # import for registration side effects
     from seaweedfs_tpu.ops import rs_cpu  # noqa: F401
-    if name in ("jax", "tpu", "pallas"):
+    if name in ("jax", "tpu", "pallas", "mxu"):
         from seaweedfs_tpu.ops import rs_jax  # noqa: F401
     if name == "pallas":
         from seaweedfs_tpu.ops import rs_pallas  # noqa: F401
+    if name == "mxu":
+        from seaweedfs_tpu.ops import rs_mxu  # noqa: F401
     if name not in _REGISTRY:
         raise KeyError(f"unknown coder {name!r}; known: {sorted(_REGISTRY)}")
     return _REGISTRY[name](scheme)

@@ -24,18 +24,21 @@ _tried = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", _SO, _SRC]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return True
-    except (OSError, subprocess.SubprocessError):
-        try:  # retry without -march=native (portable)
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
-                check=True, capture_output=True, timeout=120)
+    """Compile to a temp file, then atomically replace the cached .so.
+    Building in place would rewrite an inode that may already be mmapped
+    by this process (stale-symbol retry path) — dlopen would then dedup to
+    the corrupted old mapping; a fresh inode gives a fresh mapping."""
+    tmp = _SO + ".build"
+    for flags in (["-O3", "-march=native"], ["-O3"]):
+        try:
+            subprocess.run(["g++", *flags, "-shared", "-fPIC",
+                            "-o", tmp, _SRC],
+                           check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _SO)
             return True
         except (OSError, subprocess.SubprocessError):
-            return False
+            continue
+    return False
 
 
 def _bind(lib) -> None:

@@ -1,0 +1,140 @@
+"""Fused MXU bitplane RS kernel — built, measured, and NOT the default.
+
+GF(256) multiplication is GF(2)-linear, so the whole RS(10,4) parity
+transform factors into one binary matrix B (8m x 8k) acting on bitplanes:
+bit r of parity byte i at position t = XOR over (j,b) of
+B[8i+r, 8j+b] & (bit b of data byte j at t), with
+B[8i+r, 8j+b] = bit r of (M[i,j] * 2^b).
+
+This kernel fuses, per VMEM tile: uint8 -> 8 bitplane unpack (VPU), a
+bf16 (32 x 80) @ (80 x TILE) matmul on the MXU (sums <= 80 are exact in
+bf16), mod-2 via the result's LSB, and bitplane -> byte repack (VPU).
+
+Measured on v5e (32MB shards, parity materialized to HBM):
+    fused MXU bitplane (this kernel):   ~7.6 GB/s of input
+    XLA-fused flat-row Horner (rs_jax): ~193  GB/s of input
+Two structural reasons, with the arithmetic:
+  1. The MXU runs a 32x80 stationary matrix on a 128x128 systolic array —
+     15.6% utilization, capping the matmul path near ~60 GB/s of input
+     even if unpack/pack were free.
+  2. Unpack/pack 8x the data through int32 lanes plus the (80, TILE)
+     relayout is far more VPU work than the Horner chain it replaces; the
+     VPU is the bottleneck, not the MXU.
+The VPU Horner path is HBM-bandwidth-bound (~270 GB/s of traffic), so no
+MXU formulation of this transform can beat it on this part. Kept as a
+registered coder ("mxu") for the measurement to stay reproducible; see
+PERF.md.
+
+Bit-identity with the CPU coder is tested in interpret mode on the CPU
+mesh (tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from seaweedfs_tpu.models.coder import (DEFAULT_SCHEME, RSScheme,
+                                        register_coder)
+from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops.rs_jax import (JaxCoder, interpret_mode,
+                                      pad_rows_to_multiple)
+
+DEFAULT_TILE = 4096  # bytes per row block (VMEM bound: 80 int32 planes)
+
+
+def bitplane_matrix(mat: np.ndarray) -> np.ndarray:
+    """The (8m, 8k) GF(2) matrix equivalent to byte matrix `mat`."""
+    m, k = mat.shape
+    B = np.zeros((8 * m, 8 * k), dtype=np.float32)
+    for i in range(m):
+        for j in range(k):
+            for b in range(8):
+                prod = int(gf256.gf_mul(int(mat[i, j]), 1 << b))
+                for r in range(8):
+                    if (prod >> r) & 1:
+                        B[8 * i + r, 8 * j + b] = 1.0
+    return B
+
+
+def _make_kernel(m: int, k: int):
+    def kernel(*refs):
+        bref = refs[0]
+        ins, outs = refs[1:1 + k], refs[1 + k:1 + k + m]
+        B = bref[:]
+        planes = []
+        for j in range(k):
+            d = ins[j][:].astype(jnp.int32)
+            for b in range(8):
+                planes.append(((d >> b) & 1).astype(jnp.bfloat16))
+        X = jnp.stack(planes)                      # (8k, TILE)
+        Y = jax.lax.dot_general(B, X, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        Yi = Y.astype(jnp.int32) & 1               # mod 2
+        for i in range(m):
+            acc = Yi[8 * i]
+            for r in range(1, 8):
+                acc = acc | (Yi[8 * i + r] << r)
+            outs[i][:] = acc.astype(jnp.uint8)
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def mxu_apply_fn(mat_key: tuple[tuple[int, ...], ...],
+                 tile: int = DEFAULT_TILE):
+    """jitted (k flat uint8 rows) -> tuple of m flat uint8 rows via the
+    fused bitplane MXU kernel. Row length must be a multiple of `tile`."""
+    mat = np.array(mat_key, dtype=np.uint8)
+    m, k = mat.shape
+    B = jnp.asarray(bitplane_matrix(mat), jnp.bfloat16)
+    kernel = _make_kernel(m, k)
+    interpret = interpret_mode()
+
+    @jax.jit
+    def run(*rows):
+        n = rows[0].shape[0]
+        grid = (n // tile,)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[pl.BlockSpec((8 * m, 8 * k), lambda i: (0, 0),
+                                   memory_space=pltpu.VMEM)] +
+                     [pl.BlockSpec((tile,), lambda i: (i,),
+                                   memory_space=pltpu.VMEM)] * k,
+            out_specs=[pl.BlockSpec((tile,), lambda i: (i,),
+                                    memory_space=pltpu.VMEM)] * m,
+            out_shape=[jax.ShapeDtypeStruct((n,), jnp.uint8)] * m,
+            interpret=interpret,
+        )(B, *rows)
+
+    return run
+
+
+@register_coder("mxu")
+class MxuCoder(JaxCoder):
+    """JaxCoder with the parity transform on the fused MXU bitplane kernel.
+    Registered for reproducible measurement; slower than the default —
+    see module docstring."""
+
+    def __init__(self, scheme: RSScheme = DEFAULT_SCHEME,
+                 tile: int = DEFAULT_TILE):
+        super().__init__(scheme)
+        self.tile = tile
+        pm = np.asarray(gf256.parity_matrix(scheme.data_shards,
+                                            scheme.parity_shards))
+        self._mxu_parity = mxu_apply_fn(
+            tuple(tuple(int(x) for x in row) for row in pm), tile)
+        self._parity_fn = self._parity_rows
+
+    def _parity_rows(self, *rows):
+        # rows arrive as uint32 words (JaxCoder convention); the bitplane
+        # kernel works on bytes
+        arr = np.stack([np.asarray(r) for r in rows]).view(np.uint8)
+        arr, n = pad_rows_to_multiple(arr, self.tile)
+        outs = self._mxu_parity(*[arr[i] for i in range(arr.shape[0])])
+        return tuple(np.asarray(o)[:n].view(np.uint32) for o in outs)

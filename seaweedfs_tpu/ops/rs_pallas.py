@@ -1,10 +1,17 @@
 """Pallas TPU kernel for the RS parity/decode GF(256) transform.
 
-The jnp path (ops/rs_jax.py) leaves scheduling to XLA; this kernel tiles
-the stripe into VMEM blocks and runs the whole unrolled doubling-chain in
-one fused pass per tile — one HBM read of the data shards, one HBM write
-of the parity, everything else stays in VMEM registers. Grid iterates over
-the word dimension; the (k x tile) block auto-pipelines HBM<->VMEM DMA.
+Same Horner-form math as ops/rs_jax.py, but with explicit VMEM tiling:
+each grid step DMAs one (TILE,)-word block of every flat shard row into
+VMEM, runs the unrolled bitplane-Horner transform, and writes the parity
+blocks back — one HBM read of the data, one HBM write of the parity.
+
+Measured on v5e (32MB shards, parity materialized to HBM): this explicit
+tiling reaches ~117 GB/s of input, LOSING to the plain XLA-fused jnp path
+(~193 GB/s) — XLA pipelines the 14 HBM streams across grid steps better
+than the hand-written block spec. The kernel is kept because (a) it is the
+natural home for future fusion with streaming DMA (host->HBM prefetch
+rings), and (b) it documents the measured design space (see PERF.md). The
+production default remains rs_jax.JaxCoder.
 
 Falls back to interpreter mode off-TPU so tests validate bit-identity on
 the CPU mesh.
@@ -23,39 +30,24 @@ from jax.experimental.pallas import tpu as pltpu
 from seaweedfs_tpu.models.coder import (DEFAULT_SCHEME, RSScheme,
                                         register_coder)
 from seaweedfs_tpu.ops import gf256
-from seaweedfs_tpu.ops.rs_jax import JaxCoder, _mat_to_tuple
+from seaweedfs_tpu.ops.rs_jax import (JaxCoder, _apply_matrix_rows,
+                                      _mat_to_tuple, interpret_mode,
+                                      pad_rows_to_multiple)
 
-_LOW7 = np.uint32(0x7F7F7F7F)
-_HIGH1 = np.uint32(0x80808080)
-
-DEFAULT_TILE = 64 * 1024  # uint32 words per grid step (256KB block)
-
-
-def _xtime(v):
-    # multiply form measures ~40% faster than a shift/xor chain on v5e
-    hi = v & _HIGH1
-    lo = (v & _LOW7) << 1
-    return lo ^ ((hi >> 7) * np.uint32(0x1D))
+# 64K uint32 words = 256KB per row block; 14 blocks * double buffering
+# stays under the 16MB VMEM budget.
+DEFAULT_TILE = 64 * 1024
 
 
 def _make_kernel(mat: tuple[tuple[int, ...], ...]):
-    m = len(mat)
-    k = len(mat[0])
+    m, k = len(mat), len(mat[0])
 
-    def kernel(data_ref, out_ref):
-        acc = [None] * m
-        for j in range(k):
-            d = data_ref[pl.ds(j, 1), :]
-            for b in range(8):
-                for i in range(m):
-                    if (mat[i][j] >> b) & 1:
-                        acc[i] = d if acc[i] is None else acc[i] ^ d
-                if b < 7 and any((mat[i][j] >> (b + 1)) for i in range(m)):
-                    d = _xtime(d)
+    def kernel(*refs):
+        ins, outs = refs[:k], refs[k:]
+        rows = [r[:] for r in ins]
+        parity = _apply_matrix_rows(rows, mat)
         for i in range(m):
-            row = acc[i] if acc[i] is not None else \
-                jnp.zeros_like(out_ref[pl.ds(i, 1), :])
-            out_ref[pl.ds(i, 1), :] = row
+            outs[i][:] = parity[i]
 
     return kernel, m, k
 
@@ -63,42 +55,34 @@ def _make_kernel(mat: tuple[tuple[int, ...], ...]):
 @functools.lru_cache(maxsize=None)
 def pallas_apply_fn(mat: tuple[tuple[int, ...], ...],
                     tile: int = DEFAULT_TILE):
-    """jitted (k, nw) uint32 -> (m, nw) uint32 running the GF matrix as a
-    Pallas kernel. nw must be a multiple of `tile`."""
+    """jitted (k flat uint32 rows) -> tuple of m flat uint32 rows, running
+    the GF matrix as a Pallas kernel. Row length must be a multiple of
+    `tile`."""
     kernel, m, k = _make_kernel(mat)
-    interpret = jax.default_backend() not in ("tpu", "axon")
+    interpret = interpret_mode()
 
     @jax.jit
-    def run(words):
-        nw = words.shape[1]
+    def run(*rows):
+        nw = rows[0].shape[0]
         grid = (nw // tile,)
         return pl.pallas_call(
             kernel,
             grid=grid,
-            in_specs=[pl.BlockSpec((k, tile), lambda i: (0, i),
-                                   memory_space=pltpu.VMEM)],
-            out_specs=pl.BlockSpec((m, tile), lambda i: (0, i),
-                                   memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((m, nw), jnp.uint32),
+            in_specs=[pl.BlockSpec((tile,), lambda i: (i,),
+                                   memory_space=pltpu.VMEM)] * k,
+            out_specs=[pl.BlockSpec((tile,), lambda i: (i,),
+                                    memory_space=pltpu.VMEM)] * m,
+            out_shape=[jax.ShapeDtypeStruct((nw,), jnp.uint32)] * m,
             interpret=interpret,
-        )(words)
+        )(*rows)
 
     return run
 
 
-def _pad_to_tile(words: np.ndarray, tile: int) -> tuple[np.ndarray, int]:
-    nw = words.shape[1]
-    pad = (-nw) % tile
-    if pad:
-        words = np.concatenate(
-            [words, np.zeros((words.shape[0], pad), dtype=words.dtype)],
-            axis=1)
-    return words, nw
-
-
 @register_coder("pallas")
 class PallasCoder(JaxCoder):
-    """JaxCoder with the parity/decode transform lowered through Pallas."""
+    """JaxCoder with the parity transform lowered through an explicit
+    Pallas VMEM-tiled kernel (decode stays on the jnp path)."""
 
     def __init__(self, scheme: RSScheme = DEFAULT_SCHEME,
                  tile: int = DEFAULT_TILE):
@@ -106,17 +90,11 @@ class PallasCoder(JaxCoder):
         self.tile = tile
         pm = gf256.parity_matrix(scheme.data_shards, scheme.parity_shards)
         self._pallas_parity = pallas_apply_fn(_mat_to_tuple(pm), tile)
-        # route the JaxCoder entry points through the pallas kernel
-        self._parity_fn = self._parity_padded
+        # route the JaxCoder parity entry points through the pallas kernel
+        self._parity_fn = self._parity_rows
 
-    def _parity_padded(self, words):
-        arr = np.asarray(words)
-        padded, nw = _pad_to_tile(arr, self.tile)
-        out = self._pallas_parity(padded)
-        return out[:, :nw]
-
-    def encode_array(self, data: np.ndarray) -> np.ndarray:
-        assert data.shape[1] % 4 == 0
-        words = np.ascontiguousarray(data).view(np.uint32)
-        parity = np.asarray(jax.device_get(self._parity_padded(words)))
-        return parity.view(np.uint8)
+    def _parity_rows(self, *rows):
+        arr = np.stack([np.asarray(r) for r in rows])
+        padded, nw = pad_rows_to_multiple(arr, self.tile)
+        outs = self._pallas_parity(*[padded[i] for i in range(padded.shape[0])])
+        return tuple(o[:nw] for o in outs)

@@ -33,7 +33,7 @@ def pipelined_encode_file(base_file_name: str,
 
     from seaweedfs_tpu.ops.rs_jax import parity_fn
 
-    fn = parity_fn(scheme)
+    fn = parity_fn(scheme)  # row-based: fn(*rows) -> tuple of parity rows
     k = scheme.data_shards
     total = scheme.total_shards
     dat_path = base_file_name + ".dat"
@@ -76,7 +76,8 @@ def pipelined_encode_file(base_file_name: str,
             if item is None:
                 break
             words = item.view(np.uint32)
-            parity = fn(jax.device_put(words))  # async dispatch
+            rows = [jax.device_put(words[i]) for i in range(k)]
+            parity = fn(*rows)  # async dispatch, flat-row layout
             inflight.append((item, parity))
             if len(inflight) > prefetch:
                 self_drain(inflight, outs, k)
@@ -90,11 +91,10 @@ def pipelined_encode_file(base_file_name: str,
 
 def self_drain(inflight, outs, k):
     data, parity = inflight.pop(0)
-    p = np.asarray(parity).view(np.uint8)
     for i in range(k):
         outs[i].write(data[i].tobytes())
-    for i in range(p.shape[0]):
-        outs[k + i].write(p[i].tobytes())
+    for i, prow in enumerate(parity):
+        outs[k + i].write(np.asarray(prow).view(np.uint8).tobytes())
 
 
 def batch_encode_volumes(data_batch: np.ndarray,
@@ -106,7 +106,7 @@ def batch_encode_volumes(data_batch: np.ndarray,
     volumes)."""
     import jax
 
-    from seaweedfs_tpu.ops.rs_jax import parity_fn
+    from seaweedfs_tpu.ops.rs_jax import parity_words_fn
 
     B, k, n = data_batch.shape
     assert k == scheme.data_shards and n % 4 == 0
@@ -114,6 +114,6 @@ def batch_encode_volumes(data_batch: np.ndarray,
         from seaweedfs_tpu.parallel.distributed import distributed_encode
         return distributed_encode(scheme, mesh, data_batch)
     words = np.ascontiguousarray(data_batch).view(np.uint32)
-    fn = jax.jit(jax.vmap(parity_fn(scheme)))
+    fn = jax.jit(jax.vmap(parity_words_fn(scheme)))
     out = np.asarray(jax.device_get(fn(words)))
     return out.view(np.uint8)

@@ -26,3 +26,17 @@ def test_pallas_unaligned_and_bytes_api():
     # reconstruct path (inherited jnp decode) still bit-identical
     shards = [None if i in (0, 13) else a[i] for i in range(14)]
     assert pal.reconstruct(shards) == cpu.reconstruct(list(shards))
+
+
+def test_mxu_bitplane_coder_matches_cpu():
+    """The fused MXU bitplane kernel (interpret mode on CPU) is
+    bit-identical to the CPU coder — the measurement in ops/rs_mxu.py's
+    docstring is of a correct kernel."""
+    rng = np.random.default_rng(2)
+    cpu = make_coder("cpu")
+    mxu = make_coder("mxu")
+    data = rng.integers(0, 256, (10, 8192), dtype=np.uint8)
+    assert np.array_equal(mxu.encode_array(data), cpu.encode_array(data))
+    data2 = [rng.integers(0, 256, 5001, dtype=np.uint8).tobytes()
+             for _ in range(10)]
+    assert cpu.encode(data2) == mxu.encode(data2)
