@@ -28,17 +28,25 @@ def _build() -> bool:
     Building in place would rewrite an inode that may already be mmapped
     by this process (stale-symbol retry path) — dlopen would then dedup to
     the corrupted old mapping; a fresh inode gives a fresh mapping."""
-    tmp = _SO + ".build"
-    for flags in (["-O3", "-march=native"], ["-O3"]):
-        try:
-            subprocess.run(["g++", *flags, "-shared", "-fPIC",
-                            "-o", tmp, _SRC],
-                           check=True, capture_output=True, timeout=120)
-            os.replace(tmp, _SO)
-            return True
-        except (OSError, subprocess.SubprocessError):
-            continue
-    return False
+    tmp = _SO + f".build.{os.getpid()}"  # unique per process: two
+    # concurrent builders must not truncate each other's half-written file
+    try:
+        for flags in (["-O3", "-march=native"], ["-O3"]):
+            try:
+                subprocess.run(["g++", *flags, "-shared", "-fPIC",
+                                "-o", tmp, _SRC],
+                               check=True, capture_output=True, timeout=120)
+                os.replace(tmp, _SO)
+                return True
+            except (OSError, subprocess.SubprocessError):
+                continue
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def _bind(lib) -> None:
