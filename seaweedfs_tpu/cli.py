@@ -27,6 +27,9 @@ def _add_common_volume_args(p):
                    help="needle map kind (reference -index flag)")
     p.add_argument("-tcp", action="store_true",
                    help="serve the raw TCP data path (reference -useTcp)")
+    p.add_argument("-grpc", action="store_true",
+                   help="serve the volume_server_pb gRPC admin plane on "
+                        "port+10000")
 
 
 def cmd_master(args):
@@ -55,10 +58,13 @@ def cmd_volume(args):
                       coder=make_coder(args.coder),
                       max_volume_counts=[args.max] * len(dirs),
                       needle_map_kind=args.index,
-                      tcp_port=0 if args.tcp else -1)
+                      tcp_port=0 if args.tcp else -1,
+                      grpc_port=args.port + 10000 if args.grpc else None)
     vs.start()
     tcp = f", tcp {vs.tcp_server.port}" if vs.tcp_server else ""
-    print(f"volume server listening on {vs.url}{tcp}, master {args.mserver}")
+    g = f", grpc {vs.grpc_port}" if vs.grpc_port else ""
+    print(f"volume server listening on {vs.url}{tcp}{g}, "
+          f"master {args.mserver}")
     _wait_forever()
 
 
@@ -75,7 +81,8 @@ def cmd_server(args):
                       coder=make_coder(args.coder),
                       max_volume_counts=[args.max] * len(dirs),
                       needle_map_kind=args.index,
-                      tcp_port=0 if args.tcp else -1)
+                      tcp_port=0 if args.tcp else -1,
+                      grpc_port=args.port + 10000 if args.grpc else None)
     vs.start()
     print(f"master {ms.url}; volume {vs.url}")
     extra = []
@@ -101,7 +108,8 @@ def cmd_filer(args):
     fs = FilerServer(args.master, host=args.ip, port=args.port,
                      store=args.store, store_dir=args.dir,
                      default_replication=args.defaultReplication,
-                     cipher=args.encryptVolumeData)
+                     cipher=args.encryptVolumeData,
+                     grpc_port=args.port + 10000 if args.grpc else None)
     fs.start()
     extra = " cipher" if args.encryptVolumeData else ""
     if args.ftp:
@@ -109,6 +117,8 @@ def cmd_filer(args):
         ftp = FtpServer(fs, host=args.ip, port=args.ftpPort)
         ftp.start()
         extra += f", ftp {ftp.url}"
+    if fs.grpc_port:
+        extra += f", grpc {fs.grpc_port}"
     print(f"filer {fs.url} (store={args.store}){extra}")
     _wait_forever()
 
@@ -395,6 +405,8 @@ def main(argv=None):
                     help="AES-256-GCM encrypt chunks (reference flag)")
     fl.add_argument("-ftp", action="store_true", help="serve FTP gateway")
     fl.add_argument("-ftpPort", type=int, default=0)
+    fl.add_argument("-grpc", action="store_true",
+                    help="serve the filer_pb gRPC plane on port+10000")
     fl.set_defaults(fn=cmd_filer)
 
     for gw_name, default_port in (("s3", 8333), ("webdav", 7333),

@@ -138,6 +138,13 @@ class MetaLog:
                 continue
         return out
 
+    def latest_tsns(self) -> int:
+        """Newest event timestamp in the ring (0 when empty) — lets
+        prefix-filtered subscribers advance their cursor past
+        non-matching events instead of re-scanning them forever."""
+        with self._lock:
+            return self.events[-1].tsns if self.events else 0
+
     def wait_for_events(self, tsns: int, timeout: float = 10.0) -> bool:
         with self._cond:
             if any(e.tsns > tsns for e in self.events):

@@ -17,14 +17,103 @@ from seaweedfs_tpu.replication.sink import Replicator, ReplicationSink
 from seaweedfs_tpu.utils.httpd import HttpError, http_json
 
 
+def _probe_filer_grpc(filer_url: str):
+    """GrpcFilerClient if the filer serves its gRPC plane (port+10000
+    convention), else None."""
+    try:
+        import grpc as _grpc
+
+        from seaweedfs_tpu.server.filer_grpc import GrpcFilerClient
+        ip, port = filer_url.rsplit(":", 1)
+        addr = f"{ip}:{int(port) + 10000}"
+        ch = _grpc.insecure_channel(addr)
+        _grpc.channel_ready_future(ch).result(timeout=0.5)
+        ch.close()
+        return GrpcFilerClient(addr)
+    except Exception:
+        return None
+
+
+def _pb_event_to_dict(resp) -> dict:
+    from seaweedfs_tpu.server.filer_grpc import _entry_from_pb
+    ev = {"tsns": resp.ts_ns, "directory": resp.directory}
+    en = resp.event_notification
+    ev["old_entry"] = (_entry_from_pb(resp.directory,
+                                      en.old_entry).to_dict()
+                       if en.HasField("old_entry") else None)
+    ev["new_entry"] = (_entry_from_pb(resp.directory,
+                                      en.new_entry).to_dict()
+                       if en.HasField("new_entry") else None)
+    return ev
+
+
+def _grpc_event_stream(client, since_ns: int, path_prefix: str,
+                       idle_tick: float = 5.0):
+    """Adapt the filer_pb SubscribeMetadata stream to the event-dict shape
+    the HTTP long-poll yields — including the None idle ticks consumers
+    use to stop cleanly. A pump thread feeds a queue; stream errors
+    re-raise in the consumer."""
+    import queue as _queue
+
+    call = client.subscribe_metadata(since_ns=since_ns,
+                                     path_prefix=path_prefix)
+    q: "_queue.Queue" = _queue.Queue()
+
+    def pump():
+        try:
+            for resp in call:
+                q.put(("ev", resp))
+            q.put(("end", None))
+        except Exception as e:
+            q.put(("err", e))
+
+    threading.Thread(target=pump, daemon=True).start()
+    try:
+        while True:
+            try:
+                kind, item = q.get(timeout=idle_tick)
+            except _queue.Empty:
+                yield None  # idle tick (parity with the HTTP long-poll)
+                continue
+            if kind == "ev":
+                yield _pb_event_to_dict(item)
+            elif kind == "err":
+                raise item
+            else:
+                return
+    finally:
+        call.cancel()
+
+
 def subscribe_meta_events(filer_url: str, since_ns: int = 0,
                           path_prefix: str = "/",
                           poll_wait: float = 5.0,
-                          aggregated: bool = False):
+                          aggregated: bool = False,
+                          use_grpc: bool = True):
     """Generator of meta events from a filer, resuming from since_ns.
-    With aggregated=True the filer serves its MetaAggregator's merged
+    Speaks the filer's gRPC SubscribeMetadata stream when it is up
+    (local-log subscription), else the HTTP long-poll. With
+    aggregated=True the filer serves its MetaAggregator's merged
     cluster-wide stream (reference SubscribeMetadata) instead of its
-    local log (SubscribeLocalMetadata)."""
+    local log (SubscribeLocalMetadata) — HTTP only."""
+    cursor = since_ns
+    while use_grpc and not aggregated:
+        client = _probe_filer_grpc(filer_url)
+        if client is None:
+            break  # no gRPC plane: fall through to the HTTP long-poll
+        try:
+            for ev in _grpc_event_stream(client, cursor, path_prefix):
+                if ev is not None:
+                    cursor = max(cursor, ev["tsns"])
+                yield ev
+            return  # server closed the stream cleanly
+        except Exception:
+            # mid-stream failure (e.g. filer restart): resume from the
+            # cursor — re-probe gRPC, or drop to HTTP if it stays gone
+            time.sleep(1.0)
+        finally:
+            client.close()
+    since_ns = cursor if use_grpc and not aggregated else since_ns
     agg = "&aggregated=true" if aggregated else ""
     while True:
         try:

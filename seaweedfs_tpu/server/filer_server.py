@@ -59,7 +59,7 @@ class FilerServer:
                  port: int = 0, store: str = "memory",
                  store_dir: Optional[str] = None,
                  default_replication: str = "", cipher: bool = False,
-                 announce: bool = True):
+                 announce: bool = True, grpc_port: Optional[int] = None):
         # cipher=True encrypts every chunk (AES-256-GCM, per-chunk key in
         # the chunk metadata) so volume servers hold only ciphertext
         # (reference `weed filer -encryptVolumeData`)
@@ -67,6 +67,9 @@ class FilerServer:
         # announce=False: gateway mode (remote metadata store) — don't
         # register as a filer or aggregate peers
         self.announce = announce
+        self._grpc_port_arg = grpc_port
+        self._grpc_server = None
+        self.grpc_port: Optional[int] = None
         self.master_url = master_url
         self.mc = MasterClient(master_url)
         kwargs = {}
@@ -94,6 +97,10 @@ class FilerServer:
 
     def start(self) -> None:
         self.http.start()
+        if self._grpc_port_arg is not None:
+            from seaweedfs_tpu.server.filer_grpc import start_filer_grpc
+            self._grpc_server, self.grpc_port = start_filer_grpc(
+                self, self.http.host, self._grpc_port_arg)
         if not self.announce:
             return
         self._announce_stop = threading.Event()
@@ -132,6 +139,8 @@ class FilerServer:
             self._announce_stop.set()
         if hasattr(self, "meta_aggregator"):
             self.meta_aggregator.stop()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(0)
         self.http.stop()
         self.filer.close()
 

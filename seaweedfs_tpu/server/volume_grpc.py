@@ -1,0 +1,582 @@
+"""gRPC plane for the volume server (reference weed/pb/volume_server.proto).
+
+Serves the admin RPC surface — allocation, vacuum, copy, tiering, the nine
+EC RPCs, streaming CopyFile/VolumeEcShardRead, and BatchDelete — over
+grpc generic method handlers (same pattern as server/master_grpc.py). The
+unary RPCs dispatch in-process to the SAME handler bodies the HTTP admin
+plane uses (via LocalRequest), so both wires share one implementation;
+streams read files/shards in chunks directly.
+
+Runs next to the HTTP plane: the public data path (GET/POST /fid) stays
+HTTP like the reference, the control plane can speak either.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from concurrent import futures
+from typing import Iterator
+
+import grpc
+
+from seaweedfs_tpu.pb import volume_server_pb2 as pb
+from seaweedfs_tpu.storage.file_id import FileId
+from seaweedfs_tpu.storage.volume import DeletedError, NotFoundError
+from seaweedfs_tpu.utils.httpd import LocalRequest
+
+SERVICE = "volume_server_pb.VolumeServer"
+STREAM_CHUNK = 256 * 1024
+
+
+class _RpcError(Exception):
+    def __init__(self, code: grpc.StatusCode, msg: str):
+        super().__init__(msg)
+        self.code = code
+        self.msg = msg
+
+
+def _check(resp) -> dict:
+    """Unwrap a handler Response; map HTTP-ish errors to grpc codes."""
+    body = json.loads(resp.body) if resp.body else {}
+    if resp.status >= 400:
+        code = (grpc.StatusCode.NOT_FOUND if resp.status == 404
+                else grpc.StatusCode.INVALID_ARGUMENT if resp.status == 400
+                else grpc.StatusCode.INTERNAL)
+        raise _RpcError(code, body.get("error", f"status {resp.status}"))
+    return body
+
+
+def _guard(fn):
+    def wrapped(self, request, context):
+        try:
+            return fn(self, request, context)
+        except _RpcError as e:
+            context.abort(e.code, e.msg)
+        except FileNotFoundError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except Exception as e:  # surface the message, not a hung stream
+            context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+    return wrapped
+
+
+class VolumeGrpc:
+    def __init__(self, vs):
+        self.vs = vs
+
+    # ---- unary RPCs via the shared handler bodies ----
+    @_guard
+    def allocate_volume(self, request, context):
+        _check(self.vs._admin_allocate_volume(LocalRequest({
+            "volume_id": request.volume_id,
+            "collection": request.collection,
+            "replication": request.replication or "000",
+            "ttl": request.ttl})))
+        return pb.AllocateVolumeResponse()
+
+    @_guard
+    def volume_delete(self, request, context):
+        body = _check(self.vs._admin_delete_volume(
+            LocalRequest({"volume_id": request.volume_id})))
+        return pb.VolumeDeleteResponse(deleted=bool(body.get("deleted")))
+
+    @_guard
+    def volume_mark_readonly(self, request, context):
+        _check(self.vs._admin_mark_readonly(LocalRequest(
+            {"volume_id": request.volume_id,
+             "read_only": request.read_only})))
+        return pb.VolumeMarkReadonlyResponse()
+
+    @_guard
+    def vacuum_volume_check(self, request, context):
+        body = _check(self.vs._admin_vacuum(LocalRequest(
+            {"volume_id": request.volume_id, "check_only": True})))
+        return pb.VacuumVolumeCheckResponse(
+            garbage_ratio=body.get("garbage_ratio", 0.0))
+
+    @_guard
+    def vacuum_volume_compact(self, request, context):
+        body = _check(self.vs._admin_vacuum(LocalRequest(
+            {"volume_id": request.volume_id})))
+        return pb.VacuumVolumeCompactResponse(
+            garbage_ratio=body.get("garbage_ratio", 0.0),
+            compacted=bool(body.get("compacted")))
+
+    @_guard
+    def volume_sync(self, request, context):
+        _check(self.vs._admin_sync(LocalRequest(
+            {"volume_id": request.volume_id})))
+        return pb.VolumeSyncResponse()
+
+    @_guard
+    def volume_copy(self, request, context):
+        _check(self.vs._admin_copy_volume(LocalRequest(
+            {"volume_id": request.volume_id,
+             "source_data_node": request.source_data_node,
+             "collection": request.collection})))
+        return pb.VolumeCopyResponse()
+
+    @_guard
+    def volume_tier_to_remote(self, request, context):
+        body = _check(self.vs._admin_tier_upload(LocalRequest(
+            {"volume_id": request.volume_id,
+             "endpoint": request.destination_backend_name,
+             "bucket": request.bucket,
+             "keep_local": request.keep_local_dat_file})))
+        return pb.VolumeTierMoveDatToRemoteResponse(
+            remote_key=str(body.get("remote", "")))
+
+    @_guard
+    def volume_tier_from_remote(self, request, context):
+        _check(self.vs._admin_tier_download(LocalRequest(
+            {"volume_id": request.volume_id})))
+        return pb.VolumeTierMoveDatFromRemoteResponse()
+
+    @_guard
+    def volume_digest(self, request, context):
+        body = _check(self.vs._admin_volume_digest(LocalRequest(
+            query={"volumeId": str(request.volume_id)}, method="GET")))
+        resp = pb.VolumeDigestResponse(file_count=body["file_count"],
+                                       digest=body["digest"])
+        for key, size in body.get("keys", []):
+            resp.keys.add(key=key, size=size)
+        return resp
+
+    @_guard
+    def read_needle_blob(self, request, context):
+        v = self.vs.store.find_volume(request.volume_id)
+        if v is None:
+            raise _RpcError(grpc.StatusCode.NOT_FOUND, "volume not found")
+        blob, size = v.read_needle_blob(request.needle_id)
+        return pb.ReadNeedleBlobResponse(needle_blob=blob, size=size)
+
+    @_guard
+    def write_needle_blob(self, request, context):
+        _check(self.vs._admin_write_needle_blob(LocalRequest(
+            {"volume_id": request.volume_id, "key": request.needle_id,
+             "size": request.size,
+             "blob": request.needle_blob.hex()})))
+        return pb.WriteNeedleBlobResponse()
+
+    @_guard
+    def batch_delete(self, request, context):
+        """Reference volume_grpc_batch_delete.go: local deletes only (no
+        replica fan-out — the caller addresses each replica)."""
+        resp = pb.BatchDeleteResponse()
+        for fid in request.file_ids:
+            r = resp.results.add(file_id=fid)
+            try:
+                f = FileId.parse(fid)
+            except (ValueError, KeyError):
+                r.status, r.error = 400, "malformed file id"
+                continue
+            try:
+                cookie = None if request.skip_cookie_check else f.cookie
+                size = self.vs.store.delete_volume_needle(
+                    f.volume_id, f.key, cookie)
+                r.status, r.size = 202, size
+            except (NotFoundError, DeletedError) as e:
+                r.status, r.error = 404, str(e) or "not found"
+            except PermissionError as e:
+                r.status, r.error = 403, str(e)
+            except Exception as e:
+                r.status, r.error = 500, f"{type(e).__name__}: {e}"
+        return resp
+
+    @_guard
+    def volume_server_status(self, request, context):
+        resp = pb.VolumeServerStatusResponse(version="seaweedfs-tpu")
+        for loc in self.vs.store.locations:
+            for v in loc.volumes.values():
+                resp.volumes.add(id=v.id, collection=v.collection,
+                                 file_count=v.nm.file_count,
+                                 size=v.content_size(),
+                                 read_only=v.read_only)
+        return resp
+
+    # ---- EC unary RPCs ----
+    @_guard
+    def ec_generate(self, request, context):
+        body = _check(self.vs._ec_generate(LocalRequest(
+            {"volume_id": request.volume_id,
+             "collection": request.collection})))
+        return pb.VolumeEcShardsGenerateResponse(base=body.get("base", ""))
+
+    @_guard
+    def ec_rebuild(self, request, context):
+        body = _check(self.vs._ec_rebuild(LocalRequest(
+            {"volume_id": request.volume_id,
+             "collection": request.collection})))
+        return pb.VolumeEcShardsRebuildResponse(
+            rebuilt_shard_ids=body.get("rebuilt_shard_ids", []))
+
+    @_guard
+    def ec_copy(self, request, context):
+        _check(self.vs._ec_copy(LocalRequest(
+            {"volume_id": request.volume_id,
+             "collection": request.collection,
+             "shard_ids": list(request.shard_ids),
+             "copy_ecx_file": request.copy_ecx_file,
+             "source_data_node": request.source_data_node})))
+        return pb.VolumeEcShardsCopyResponse()
+
+    @_guard
+    def ec_delete(self, request, context):
+        _check(self.vs._ec_delete_shards(LocalRequest(
+            {"volume_id": request.volume_id,
+             "collection": request.collection,
+             "shard_ids": list(request.shard_ids)})))
+        return pb.VolumeEcShardsDeleteResponse()
+
+    @_guard
+    def ec_mount(self, request, context):
+        _check(self.vs._ec_mount(LocalRequest(
+            {"volume_id": request.volume_id,
+             "collection": request.collection,
+             "shard_ids": list(request.shard_ids)})))
+        return pb.VolumeEcShardsMountResponse()
+
+    @_guard
+    def ec_unmount(self, request, context):
+        _check(self.vs._ec_unmount(LocalRequest(
+            {"volume_id": request.volume_id,
+             "shard_ids": list(request.shard_ids)})))
+        return pb.VolumeEcShardsUnmountResponse()
+
+    @_guard
+    def ec_blob_delete(self, request, context):
+        _check(self.vs._ec_blob_delete(LocalRequest(
+            {"volume_id": request.volume_id,
+             "collection": request.collection,
+             "needle_id": request.file_key})))
+        return pb.VolumeEcBlobDeleteResponse()
+
+    @_guard
+    def ec_to_volume(self, request, context):
+        _check(self.vs._ec_to_volume(LocalRequest(
+            {"volume_id": request.volume_id,
+             "collection": request.collection})))
+        return pb.VolumeEcShardsToVolumeResponse()
+
+    # ---- streams ----
+    @_guard
+    def copy_file(self, request, context) -> Iterator[pb.CopyFileResponse]:
+        """Streaming file pull (reference CopyFile): volume .dat/.idx or
+        EC shard/index files."""
+        if request.is_ec_volume:
+            base = self.vs._ec_base_name(request.volume_id,
+                                         request.collection)
+            path = base + request.ext
+        else:
+            v = self.vs.store.find_volume(request.volume_id)
+            if v is None:
+                raise _RpcError(grpc.StatusCode.NOT_FOUND,
+                                "volume not found")
+            if request.ext not in (".dat", ".idx"):
+                raise _RpcError(grpc.StatusCode.INVALID_ARGUMENT, "bad ext")
+            v.sync()
+            path = v.file_name() + request.ext
+        if not os.path.exists(path):
+            raise _RpcError(grpc.StatusCode.NOT_FOUND, path)
+        with open(path, "rb") as f:
+            while chunk := f.read(STREAM_CHUNK):
+                yield pb.CopyFileResponse(file_content=chunk)
+
+    @_guard
+    def ec_shard_read(self, request, context
+                      ) -> Iterator[pb.VolumeEcShardReadResponse]:
+        ev = self.vs.store.find_ec_volume(request.volume_id)
+        if ev is None or request.shard_id not in ev.shards:
+            raise _RpcError(grpc.StatusCode.NOT_FOUND, "shard not found")
+        if request.file_key and ev.is_deleted(request.file_key):
+            yield pb.VolumeEcShardReadResponse(is_deleted=True)
+            return
+        shard = ev.shards[request.shard_id]
+        off, remaining = request.offset, request.size
+        while remaining > 0:
+            n = min(STREAM_CHUNK, remaining)
+            data = shard.read_at(off, n)
+            if not data:
+                break
+            yield pb.VolumeEcShardReadResponse(data=data)
+            off += len(data)
+            remaining -= len(data)
+
+    # ---- registration ----
+    def handlers(self) -> grpc.GenericRpcHandler:
+        def unary(fn, req_cls, resp_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString)
+
+        def ustream(fn, req_cls, resp_cls):
+            return grpc.unary_stream_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString)
+
+        rpcs = {
+            "AllocateVolume": unary(self.allocate_volume,
+                                    pb.AllocateVolumeRequest,
+                                    pb.AllocateVolumeResponse),
+            "VolumeDelete": unary(self.volume_delete,
+                                  pb.VolumeDeleteRequest,
+                                  pb.VolumeDeleteResponse),
+            "VolumeMarkReadonly": unary(self.volume_mark_readonly,
+                                        pb.VolumeMarkReadonlyRequest,
+                                        pb.VolumeMarkReadonlyResponse),
+            "VacuumVolumeCheck": unary(self.vacuum_volume_check,
+                                       pb.VacuumVolumeCheckRequest,
+                                       pb.VacuumVolumeCheckResponse),
+            "VacuumVolumeCompact": unary(self.vacuum_volume_compact,
+                                         pb.VacuumVolumeCompactRequest,
+                                         pb.VacuumVolumeCompactResponse),
+            "VolumeSync": unary(self.volume_sync, pb.VolumeSyncRequest,
+                                pb.VolumeSyncResponse),
+            "VolumeCopy": unary(self.volume_copy, pb.VolumeCopyRequest,
+                                pb.VolumeCopyResponse),
+            "CopyFile": ustream(self.copy_file, pb.CopyFileRequest,
+                                pb.CopyFileResponse),
+            "VolumeTierMoveDatToRemote": unary(
+                self.volume_tier_to_remote,
+                pb.VolumeTierMoveDatToRemoteRequest,
+                pb.VolumeTierMoveDatToRemoteResponse),
+            "VolumeTierMoveDatFromRemote": unary(
+                self.volume_tier_from_remote,
+                pb.VolumeTierMoveDatFromRemoteRequest,
+                pb.VolumeTierMoveDatFromRemoteResponse),
+            "VolumeDigest": unary(self.volume_digest,
+                                  pb.VolumeDigestRequest,
+                                  pb.VolumeDigestResponse),
+            "ReadNeedleBlob": unary(self.read_needle_blob,
+                                    pb.ReadNeedleBlobRequest,
+                                    pb.ReadNeedleBlobResponse),
+            "WriteNeedleBlob": unary(self.write_needle_blob,
+                                     pb.WriteNeedleBlobRequest,
+                                     pb.WriteNeedleBlobResponse),
+            "BatchDelete": unary(self.batch_delete, pb.BatchDeleteRequest,
+                                 pb.BatchDeleteResponse),
+            "VolumeServerStatus": unary(self.volume_server_status,
+                                        pb.VolumeServerStatusRequest,
+                                        pb.VolumeServerStatusResponse),
+            "VolumeEcShardsGenerate": unary(
+                self.ec_generate, pb.VolumeEcShardsGenerateRequest,
+                pb.VolumeEcShardsGenerateResponse),
+            "VolumeEcShardsRebuild": unary(
+                self.ec_rebuild, pb.VolumeEcShardsRebuildRequest,
+                pb.VolumeEcShardsRebuildResponse),
+            "VolumeEcShardsCopy": unary(
+                self.ec_copy, pb.VolumeEcShardsCopyRequest,
+                pb.VolumeEcShardsCopyResponse),
+            "VolumeEcShardsDelete": unary(
+                self.ec_delete, pb.VolumeEcShardsDeleteRequest,
+                pb.VolumeEcShardsDeleteResponse),
+            "VolumeEcShardsMount": unary(
+                self.ec_mount, pb.VolumeEcShardsMountRequest,
+                pb.VolumeEcShardsMountResponse),
+            "VolumeEcShardsUnmount": unary(
+                self.ec_unmount, pb.VolumeEcShardsUnmountRequest,
+                pb.VolumeEcShardsUnmountResponse),
+            "VolumeEcShardRead": ustream(
+                self.ec_shard_read, pb.VolumeEcShardReadRequest,
+                pb.VolumeEcShardReadResponse),
+            "VolumeEcBlobDelete": unary(
+                self.ec_blob_delete, pb.VolumeEcBlobDeleteRequest,
+                pb.VolumeEcBlobDeleteResponse),
+            "VolumeEcShardsToVolume": unary(
+                self.ec_to_volume, pb.VolumeEcShardsToVolumeRequest,
+                pb.VolumeEcShardsToVolumeResponse),
+        }
+        return grpc.method_handlers_generic_handler(SERVICE, rpcs)
+
+
+def start_volume_grpc(vs, host: str = "127.0.0.1",
+                      port: int = 0) -> tuple[grpc.Server, int]:
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
+    server.add_generic_rpc_handlers((VolumeGrpc(vs).handlers(),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    server.start()
+    return server, bound
+
+
+class GrpcVolumeClient:
+    """Typed client for the volume admin plane; also exposes call(path,
+    body) with the HTTP-admin path names so the shell applier can use one
+    transport-neutral call site."""
+
+    def __init__(self, address: str):
+        self.channel = grpc.insecure_channel(address)
+
+    def _unary(self, method: str, request, resp_cls,
+               timeout: float = 300):
+        fn = self.channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString)
+        return fn(request, timeout=timeout)
+
+    def copy_file(self, volume_id: int, ext: str, collection: str = "",
+                  is_ec: bool = False) -> bytes:
+        fn = self.channel.unary_stream(
+            f"/{SERVICE}/CopyFile",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.CopyFileResponse.FromString)
+        out = bytearray()
+        for chunk in fn(pb.CopyFileRequest(volume_id=volume_id, ext=ext,
+                                           collection=collection,
+                                           is_ec_volume=is_ec),
+                        timeout=600):
+            out += chunk.file_content
+        return bytes(out)
+
+    def ec_shard_read(self, volume_id: int, shard_id: int, offset: int,
+                      size: int, file_key: int = 0) -> tuple[bytes, bool]:
+        fn = self.channel.unary_stream(
+            f"/{SERVICE}/VolumeEcShardRead",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.VolumeEcShardReadResponse.FromString)
+        out = bytearray()
+        for chunk in fn(pb.VolumeEcShardReadRequest(
+                volume_id=volume_id, shard_id=shard_id, offset=offset,
+                size=size, file_key=file_key), timeout=120):
+            if chunk.is_deleted:
+                return b"", True
+            out += chunk.data
+        return bytes(out), False
+
+    def batch_delete(self, file_ids: list[str],
+                     skip_cookie_check: bool = False) -> pb.BatchDeleteResponse:
+        return self._unary("BatchDelete",
+                           pb.BatchDeleteRequest(
+                               file_ids=file_ids,
+                               skip_cookie_check=skip_cookie_check),
+                           pb.BatchDeleteResponse)
+
+    # HTTP-admin-path compatible dispatch used by the shell applier.
+    # Returns a dict shaped like the HTTP JSON body.
+    def call(self, path: str, body: dict, timeout: float = 300) -> dict:
+        def un(method, request, resp_cls):
+            return self._unary(method, request, resp_cls, timeout=timeout)
+        return self._call_mapped(path, body or {}, un)
+
+    def _call_mapped(self, path: str, b: dict, un) -> dict:
+        if path == "/admin/allocate_volume":
+            un("AllocateVolume", pb.AllocateVolumeRequest(
+                volume_id=b["volume_id"],
+                collection=b.get("collection", ""),
+                replication=b.get("replication", "000"),
+                ttl=b.get("ttl", "")), pb.AllocateVolumeResponse)
+            return {}
+        if path == "/admin/delete_volume":
+            r = un("VolumeDelete", pb.VolumeDeleteRequest(
+                volume_id=b["volume_id"]), pb.VolumeDeleteResponse)
+            return {"deleted": r.deleted}
+        if path == "/admin/mark_readonly":
+            un("VolumeMarkReadonly", pb.VolumeMarkReadonlyRequest(
+                volume_id=b["volume_id"],
+                read_only=b.get("read_only", True)),
+                pb.VolumeMarkReadonlyResponse)
+            return {}
+        if path == "/admin/vacuum":
+            if b.get("check_only"):
+                r = un("VacuumVolumeCheck",
+                                pb.VacuumVolumeCheckRequest(
+                                    volume_id=b["volume_id"]),
+                                pb.VacuumVolumeCheckResponse)
+                return {"garbage_ratio": r.garbage_ratio}
+            r = un("VacuumVolumeCompact",
+                            pb.VacuumVolumeCompactRequest(
+                                volume_id=b["volume_id"]),
+                            pb.VacuumVolumeCompactResponse)
+            return {"garbage_ratio": r.garbage_ratio,
+                    "compacted": r.compacted}
+        if path == "/admin/sync":
+            un("VolumeSync", pb.VolumeSyncRequest(
+                volume_id=b.get("volume_id", 0)), pb.VolumeSyncResponse)
+            return {}
+        if path == "/admin/copy_volume":
+            un("VolumeCopy", pb.VolumeCopyRequest(
+                volume_id=b["volume_id"],
+                source_data_node=b["source_data_node"],
+                collection=b.get("collection", "")), pb.VolumeCopyResponse)
+            return {}
+        if path == "/admin/tier_upload":
+            r = un("VolumeTierMoveDatToRemote",
+                            pb.VolumeTierMoveDatToRemoteRequest(
+                                volume_id=b["volume_id"],
+                                destination_backend_name=b["endpoint"],
+                                bucket=b["bucket"],
+                                keep_local_dat_file=b.get("keep_local",
+                                                          False)),
+                            pb.VolumeTierMoveDatToRemoteResponse)
+            return {"tiered": b["volume_id"], "remote": r.remote_key}
+        if path == "/admin/tier_download":
+            un("VolumeTierMoveDatFromRemote",
+                        pb.VolumeTierMoveDatFromRemoteRequest(
+                            volume_id=b["volume_id"]),
+                        pb.VolumeTierMoveDatFromRemoteResponse)
+            return {}
+        if path == "/admin/write_needle_blob":
+            un("WriteNeedleBlob", pb.WriteNeedleBlobRequest(
+                volume_id=b["volume_id"], needle_id=b["key"],
+                size=b["size"], needle_blob=bytes.fromhex(b["blob"])),
+                pb.WriteNeedleBlobResponse)
+            return {}
+        if path == "/admin/ec/generate":
+            r = un("VolumeEcShardsGenerate",
+                            pb.VolumeEcShardsGenerateRequest(
+                                volume_id=b["volume_id"],
+                                collection=b.get("collection", "")),
+                            pb.VolumeEcShardsGenerateResponse)
+            return {"base": r.base}
+        if path == "/admin/ec/rebuild":
+            r = un("VolumeEcShardsRebuild",
+                            pb.VolumeEcShardsRebuildRequest(
+                                volume_id=b["volume_id"],
+                                collection=b.get("collection", "")),
+                            pb.VolumeEcShardsRebuildResponse)
+            return {"rebuilt_shard_ids": list(r.rebuilt_shard_ids)}
+        if path == "/admin/ec/copy":
+            un("VolumeEcShardsCopy", pb.VolumeEcShardsCopyRequest(
+                volume_id=b["volume_id"], collection=b.get("collection", ""),
+                shard_ids=b.get("shard_ids", []),
+                copy_ecx_file=b.get("copy_ecx_file", True),
+                source_data_node=b["source_data_node"]),
+                pb.VolumeEcShardsCopyResponse)
+            return {}
+        if path == "/admin/ec/delete_shards":
+            un("VolumeEcShardsDelete",
+                        pb.VolumeEcShardsDeleteRequest(
+                            volume_id=b["volume_id"],
+                            collection=b.get("collection", ""),
+                            shard_ids=b.get("shard_ids", [])),
+                        pb.VolumeEcShardsDeleteResponse)
+            return {}
+        if path == "/admin/ec/mount":
+            un("VolumeEcShardsMount", pb.VolumeEcShardsMountRequest(
+                volume_id=b["volume_id"], collection=b.get("collection", ""),
+                shard_ids=b.get("shard_ids", [])),
+                pb.VolumeEcShardsMountResponse)
+            return {}
+        if path == "/admin/ec/unmount":
+            un("VolumeEcShardsUnmount",
+                        pb.VolumeEcShardsUnmountRequest(
+                            volume_id=b["volume_id"],
+                            shard_ids=b.get("shard_ids", [])),
+                        pb.VolumeEcShardsUnmountResponse)
+            return {}
+        if path == "/admin/ec/blob_delete":
+            un("VolumeEcBlobDelete", pb.VolumeEcBlobDeleteRequest(
+                volume_id=b["volume_id"], collection=b.get("collection", ""),
+                file_key=b["needle_id"]), pb.VolumeEcBlobDeleteResponse)
+            return {}
+        if path == "/admin/ec/to_volume":
+            un("VolumeEcShardsToVolume",
+                        pb.VolumeEcShardsToVolumeRequest(
+                            volume_id=b["volume_id"],
+                            collection=b.get("collection", "")),
+                        pb.VolumeEcShardsToVolumeResponse)
+            return {}
+        raise KeyError(f"no gRPC mapping for {path}")
+
+    def close(self):
+        self.channel.close()

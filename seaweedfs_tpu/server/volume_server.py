@@ -43,9 +43,10 @@ class VolumeServer:
                  coder: Optional[ErasureCoder] = None,
                  max_volume_counts: Optional[list[int]] = None,
                  jwt_signing_key: str = "", needle_map_kind: str = "memory",
-                 tcp_port: int = -1):
+                 tcp_port: int = -1, grpc_port: Optional[int] = None):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
-        reference volume_server_tcp_handlers_write.go)."""
+        reference volume_server_tcp_handlers_write.go). grpc_port starts
+        the volume_server_pb gRPC admin plane (0 = ephemeral)."""
         urls = (master_url.split(",") if isinstance(master_url, str)
                 else list(master_url))
         self.master_urls = [u.strip() for u in urls if u.strip()]
@@ -59,6 +60,9 @@ class VolumeServer:
         self._needle_map_kind = needle_map_kind
         self._tcp_port = tcp_port
         self.tcp_server = None
+        self._grpc_port_arg = grpc_port
+        self._grpc_server = None
+        self.grpc_port: Optional[int] = None
         self._public_url = public_url
         self.store: Optional[Store] = None
         self._stop = threading.Event()
@@ -88,6 +92,10 @@ class VolumeServer:
             self.tcp_server = TcpDataServer(self.store, self.http.host,
                                             self._tcp_port)
             self.tcp_server.start()
+        if self._grpc_port_arg is not None:
+            from seaweedfs_tpu.server.volume_grpc import start_volume_grpc
+            self._grpc_server, self.grpc_port = start_volume_grpc(
+                self, self.http.host, self._grpc_port_arg)
         self._register_routes()
         self.heartbeat_once()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
@@ -98,6 +106,8 @@ class VolumeServer:
         self._stop.set()
         if self.tcp_server is not None:
             self.tcp_server.stop()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(0)
         self.http.stop()
         if self.store:
             self.store.close()

@@ -18,15 +18,59 @@ from seaweedfs_tpu.utils.httpd import HttpError, http_json
 
 
 class ShellContext:
-    def __init__(self, master_url: str):
+    def __init__(self, master_url: str, use_grpc: bool = True):
         self.master_url = master_url
+        # volume-server gRPC admin plane: probed per node (port+10000
+        # convention, like the master), HTTP fallback kept — the
+        # reference's shell is gRPC-first the same way
+        self.use_grpc = use_grpc
+        self._grpc_clients: dict = {}
 
     # ---- helpers ----
     def topology(self) -> dict:
         return http_json(
             "GET", f"http://{self.master_url}/dir/status")["Topology"]
 
+    def _grpc_client(self, node: str):
+        """GrpcVolumeClient for node 'ip:port', or None (probed once)."""
+        if node in self._grpc_clients:
+            return self._grpc_clients[node]
+        client = None
+        try:
+            import grpc as _grpc
+
+            from seaweedfs_tpu.server.volume_grpc import GrpcVolumeClient
+            ip, port = node.rsplit(":", 1)
+            addr = f"{ip}:{int(port) + 10000}"
+            ch = _grpc.insecure_channel(addr)
+            _grpc.channel_ready_future(ch).result(timeout=0.5)
+            ch.close()
+            client = GrpcVolumeClient(addr)
+        except Exception:
+            client = None
+        self._grpc_clients[node] = client
+        return client
+
     def _vs(self, node: str, path: str, body: dict, timeout: float = 300):
+        if self.use_grpc:
+            client = self._grpc_client(node)
+            if client is not None:
+                import grpc as _grpc
+                try:
+                    return client.call(path, body, timeout=timeout)
+                except KeyError:
+                    pass  # RPC not mapped -> HTTP
+                except _grpc.RpcError as e:
+                    code = e.code()
+                    if code == _grpc.StatusCode.UNAVAILABLE:
+                        self._grpc_clients[node] = None  # node plane gone
+                    else:
+                        status = {
+                            _grpc.StatusCode.NOT_FOUND: 404,
+                            _grpc.StatusCode.INVALID_ARGUMENT: 400,
+                        }.get(code, 500)
+                        raise HttpError(
+                            status, (e.details() or "").encode()) from e
         return http_json("POST", f"http://{node}{path}", body,
                          timeout=timeout)
 

@@ -41,6 +41,27 @@ class Request:
         return json.loads(self.body) if self.body else None
 
 
+class LocalRequest:
+    """Duck-typed Request for in-process dispatch (the gRPC planes reuse
+    the HTTP handler bodies without a socket)."""
+
+    def __init__(self, body: Any = None, query: Optional[dict] = None,
+                 method: str = "POST", path: str = "/",
+                 headers: Optional[dict] = None):
+        self.method = method
+        self.path = path
+        self.raw_path = path
+        self.query = query or {}
+        self.body = (json.dumps(body).encode()
+                     if isinstance(body, (dict, list)) else (body or b""))
+        self.headers = headers or {}
+        self.match = None
+        self.handler = None
+
+    def json(self) -> Any:
+        return json.loads(self.body) if self.body else None
+
+
 class Response:
     def __init__(self, body: Any = None, status: int = 200,
                  content_type: str = "application/json",

@@ -1,0 +1,330 @@
+"""Volume-server and filer gRPC planes (reference volume_server.proto /
+filer.proto): typed RPCs, streams, shell-applier transport, filer.sync
+subscription."""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.pb import filer_pb2 as fpb
+from seaweedfs_tpu.server.filer_grpc import GrpcFilerClient
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_grpc import GrpcVolumeClient
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url, grpc_port=0)
+    vs.start()
+    vclient = GrpcVolumeClient(f"127.0.0.1:{vs.grpc_port}")
+    yield master, vs, vclient
+    vclient.close()
+    vs.stop()
+    master.stop()
+
+
+def _upload(master, data: bytes, collection: str = "") -> str:
+    q = f"?collection={collection}" if collection else ""
+    a = http_json("GET", f"http://{master.url}/dir/assign{q}")
+    status, body, _ = http_call(
+        "POST", f"http://{a['url']}/{a['fid']}", body=data)
+    assert status < 300, body
+    return a["fid"]
+
+
+def test_volume_grpc_unary_suite(cluster):
+    master, vs, client = cluster
+    fid = _upload(master, b"grpc-bytes-1")
+    vid = int(fid.split(",")[0])
+
+    # status lists the volume
+    import seaweedfs_tpu.pb.volume_server_pb2 as vpb
+    st = client._unary("VolumeServerStatus", vpb.VolumeServerStatusRequest(),
+                       vpb.VolumeServerStatusResponse)
+    assert any(v.id == vid for v in st.volumes)
+
+    # vacuum check via the path-compatible dispatch
+    out = client.call("/admin/vacuum", {"volume_id": vid,
+                                        "check_only": True})
+    assert out["garbage_ratio"] == 0.0
+
+    # digest matches the HTTP plane's
+    d_grpc = client._unary("VolumeDigest",
+                           vpb.VolumeDigestRequest(volume_id=vid),
+                           vpb.VolumeDigestResponse)
+    d_http = http_json(
+        "GET", f"http://{vs.url}/admin/volume_digest?volumeId={vid}")
+    assert d_grpc.digest == d_http["digest"]
+    assert d_grpc.file_count == d_http["file_count"] == 1
+
+    # errors map to grpc codes
+    import grpc
+    with pytest.raises(grpc.RpcError) as ei:
+        client.call("/admin/vacuum", {"volume_id": 424242,
+                                      "check_only": True})
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_volume_grpc_copy_file_stream(cluster):
+    master, vs, client = cluster
+    fid = _upload(master, b"x" * 5000)
+    vid = int(fid.split(",")[0])
+    got = client.copy_file(vid, ".dat")
+    v = vs.store.find_volume(vid)
+    v.sync()
+    with open(v.file_name() + ".dat", "rb") as f:
+        assert got == f.read()
+    assert len(got) > 5000
+
+
+def test_volume_grpc_batch_delete(cluster):
+    master, vs, client = cluster
+    fids = [_upload(master, f"bd-{i}".encode()) for i in range(5)]
+    resp = client.batch_delete(fids + ["bogus", "7,deadbeef01"])
+    by_fid = {r.file_id: r for r in resp.results}
+    for fid in fids:
+        assert by_fid[fid].status == 202, by_fid[fid]
+    assert by_fid["bogus"].status == 400
+    assert by_fid["7,deadbeef01"].status == 404
+    # deleted for real
+    status, _, _ = http_call("GET", f"http://{vs.url}/{fids[0]}")
+    assert status == 404
+
+
+def test_volume_grpc_ec_lifecycle_and_shard_read(cluster, tmp_path):
+    master, vs, client = cluster
+    data = b"E" * 3000
+    fid = _upload(master, data)
+    vid = int(fid.split(",")[0])
+
+    client.call("/admin/mark_readonly", {"volume_id": vid})
+    out = client.call("/admin/ec/generate", {"volume_id": vid})
+    assert out["base"]
+    client.call("/admin/ec/mount",
+                {"volume_id": vid, "shard_ids": list(range(14))})
+
+    # stream a shard range and compare against the shard file
+    base = vs._ec_base_name(vid)
+    with open(base + ".ec00", "rb") as f:
+        want = f.read(4096)
+    got, deleted = client.ec_shard_read(vid, 0, 0, 4096)
+    assert not deleted and got == want
+
+    client.call("/admin/ec/unmount",
+                {"volume_id": vid, "shard_ids": list(range(14))})
+    client.call("/admin/ec/delete_shards",
+                {"volume_id": vid, "shard_ids": list(range(14))})
+
+
+def test_shell_applier_uses_grpc(tmp_path):
+    """ShellContext._vs routes through the gRPC plane when the node
+    serves it on the port+10000 convention."""
+    import socket
+
+    from seaweedfs_tpu.shell.commands import ShellContext
+    # find a free port whose +10000 twin is also free
+    for base_port in range(21500, 21600):
+        try:
+            s1 = socket.socket(); s1.bind(("127.0.0.1", base_port))
+            s2 = socket.socket(); s2.bind(("127.0.0.1", base_port + 10000))
+            s1.close(); s2.close()
+            break
+        except OSError:
+            continue
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url, port=base_port,
+                      grpc_port=base_port + 10000)
+    vs.start()
+    try:
+        fid = _upload(master, b"via-shell")
+        vid = int(fid.split(",")[0])
+        ctx = ShellContext(master.url)
+        out = ctx._vs(vs.url, "/admin/vacuum",
+                      {"volume_id": vid, "check_only": True})
+        assert out == {"garbage_ratio": 0.0}
+        assert ctx._grpc_clients[vs.url] is not None  # went over gRPC
+        # unmapped admin path falls back to HTTP transparently
+        out2 = ctx._vs(vs.url, "/admin/sync", {"volume_id": vid})
+        assert out2 == {}
+    finally:
+        vs.stop()
+        master.stop()
+
+
+@pytest.fixture
+def filer_cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    fs = FilerServer(master.url, store="memory", grpc_port=0)
+    fs.start()
+    fclient = GrpcFilerClient(f"127.0.0.1:{fs.grpc_port}")
+    yield master, vs, fs, fclient
+    fclient.close()
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_filer_grpc_entry_crud_and_rename(filer_cluster):
+    master, vs, fs, client = filer_cluster
+    # create via HTTP (content upload), read via gRPC
+    http_call("POST", f"http://{fs.url}/docs/a.txt", body=b"hello filer")
+    e = client.lookup("/docs", "a.txt")
+    assert e.name == "a.txt" and e.attributes.file_size == 11
+
+    # create a pure-metadata entry via gRPC
+    entry = fpb.Entry(name="b.txt", content=b"inline-bytes")
+    entry.attributes.file_size = 12
+    client.create_entry("/docs", entry)
+    status, body, _ = http_call("GET", f"http://{fs.url}/docs/b.txt")
+    assert status == 200 and body == b"inline-bytes"
+
+    # list
+    names = {e.name for e in client.list_entries("/docs")}
+    assert names == {"a.txt", "b.txt"}
+
+    # rename + delete
+    client.rename("/docs", "b.txt", "/docs", "c.txt")
+    assert {e.name for e in client.list_entries("/docs")} == \
+        {"a.txt", "c.txt"}
+    client.delete_entry("/docs", "c.txt")
+    status, _, _ = http_call("GET", f"http://{fs.url}/docs/c.txt")
+    assert status == 404
+
+    # kv
+    client.kv_put(b"k1", b"v1")
+    assert client.kv_get(b"k1") == b"v1"
+    assert client.kv_get(b"absent") is None
+
+
+def test_filer_grpc_subscribe_metadata_stream(filer_cluster):
+    master, vs, fs, client = filer_cluster
+    got: list = []
+    call = client.subscribe_metadata(since_ns=0, path_prefix="/sub")
+
+    def consume():
+        try:
+            for resp in call:
+                got.append(resp)
+                if len(got) >= 2:
+                    call.cancel()
+                    return
+        except Exception:
+            pass
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    http_call("POST", f"http://{fs.url}/sub/one.txt", body=b"1")
+    http_call("POST", f"http://{fs.url}/sub/two.txt", body=b"22")
+    t.join(timeout=10)
+    assert len(got) >= 2
+    names = {r.event_notification.new_entry.name for r in got}
+    assert {"one.txt", "two.txt"} <= names
+    assert all(r.ts_ns > 0 for r in got)
+
+
+def test_filer_sync_subscription_over_grpc(tmp_path):
+    """subscribe_meta_events speaks the gRPC stream when the filer serves
+    it on port+10000 (the transport filer.sync/meta.tail ride)."""
+    import socket
+
+    from seaweedfs_tpu.replication.sync import (_probe_filer_grpc,
+                                                subscribe_meta_events)
+    for base_port in range(22500, 22600):
+        try:
+            s1 = socket.socket(); s1.bind(("127.0.0.1", base_port))
+            s2 = socket.socket(); s2.bind(("127.0.0.1", base_port + 10000))
+            s1.close(); s2.close()
+            break
+        except OSError:
+            continue
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    fs = FilerServer(master.url, store="memory", port=base_port,
+                     grpc_port=base_port + 10000)
+    fs.start()
+    try:
+        assert _probe_filer_grpc(fs.url) is not None
+        http_call("POST", f"http://{fs.url}/g/x.txt", body=b"gsync")
+        events = []
+        gen = subscribe_meta_events(fs.url, since_ns=0, path_prefix="/g")
+        for ev in gen:
+            if ev is not None:
+                events.append(ev)
+            if events:
+                gen.close()
+                break
+        assert events[0]["new_entry"]["full_path"] == "/g/x.txt"
+        assert events[0]["tsns"] > 0
+    finally:
+        fs.stop()
+        vs.stop()
+        master.stop()
+
+
+def test_volume_grpc_ec_rebuild_reports_shard_ids(cluster, tmp_path):
+    """ec.rebuild over gRPC must report the actually-rebuilt shard ids
+    (the shell mounts exactly these)."""
+    import os
+
+    master, vs, client = cluster
+    fid = _upload(master, b"R" * 2000)
+    vid = int(fid.split(",")[0])
+    client.call("/admin/mark_readonly", {"volume_id": vid})
+    client.call("/admin/ec/generate", {"volume_id": vid})
+    base = vs._ec_base_name(vid)
+    os.remove(base + ".ec02")
+    os.remove(base + ".ec12")
+    out = client.call("/admin/ec/rebuild", {"volume_id": vid})
+    assert sorted(out["rebuilt_shard_ids"]) == [2, 12]
+    assert os.path.exists(base + ".ec02")
+
+
+def test_grpc_subscribe_idle_ticks_and_prefix_no_spin(tmp_path):
+    """The gRPC event stream yields None idle ticks (so meta_tail with
+    max_events terminates) and a never-matching prefix doesn't hang or
+    spin the server."""
+    import socket
+
+    from seaweedfs_tpu.replication.sync import subscribe_meta_events
+    for base_port in range(23500, 23600):
+        try:
+            s1 = socket.socket(); s1.bind(("127.0.0.1", base_port))
+            s2 = socket.socket(); s2.bind(("127.0.0.1", base_port + 10000))
+            s1.close(); s2.close()
+            break
+        except OSError:
+            continue
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    fs = FilerServer(master.url, store="memory", port=base_port,
+                     grpc_port=base_port + 10000)
+    fs.start()
+    try:
+        # events exist, but none match the prefix -> idle tick, not spin
+        http_call("POST", f"http://{fs.url}/other/a.txt", body=b"x")
+        gen = subscribe_meta_events(fs.url, since_ns=0,
+                                    path_prefix="/nevermatches")
+        t0 = time.time()
+        first = next(gen)
+        assert first is None  # idle tick after ~idle_tick seconds
+        assert time.time() - t0 < 30
+        gen.close()
+    finally:
+        fs.stop()
+        vs.stop()
+        master.stop()
