@@ -24,9 +24,10 @@ def _probe_filer_grpc(filer_url: str):
         import grpc as _grpc
 
         from seaweedfs_tpu.server.filer_grpc import GrpcFilerClient
+        from seaweedfs_tpu.utils.tls import make_channel
         ip, port = filer_url.rsplit(":", 1)
         addr = f"{ip}:{int(port) + 10000}"
-        ch = _grpc.insecure_channel(addr)
+        ch = make_channel(addr)  # honors security.toml mTLS
         _grpc.channel_ready_future(ch).result(timeout=0.5)
         ch.close()
         return GrpcFilerClient(addr)

@@ -237,10 +237,16 @@ class FilerGrpc:
 
 
 def start_filer_grpc(filer_server, host: str = "127.0.0.1",
-                     port: int = 0) -> tuple[grpc.Server, int]:
+                     port: int = 0, tls="auto") -> tuple[grpc.Server, int]:
+    from seaweedfs_tpu.utils import tls as tlsmod
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
     server.add_generic_rpc_handlers((FilerGrpc(filer_server).handlers(),))
-    bound = server.add_insecure_port(f"{host}:{port}")
+    cfg = tlsmod.load_tls_config("filer") if tls == "auto" else tls
+    if cfg is not None:
+        bound = server.add_secure_port(
+            f"{host}:{port}", tlsmod.server_credentials(cfg))
+    else:
+        bound = server.add_insecure_port(f"{host}:{port}")
     server.start()
     return server, bound
 
@@ -248,8 +254,9 @@ def start_filer_grpc(filer_server, host: str = "127.0.0.1",
 class GrpcFilerClient:
     """Client for the filer gRPC plane (filer.sync, mount meta cache)."""
 
-    def __init__(self, address: str):
-        self.channel = grpc.insecure_channel(address)
+    def __init__(self, address: str, tls="auto"):
+        from seaweedfs_tpu.utils.tls import make_channel
+        self.channel = make_channel(address, role="client", tls=tls)
 
     def _unary(self, method: str, request, resp_cls, timeout: float = 30):
         fn = self.channel.unary_unary(

@@ -276,14 +276,30 @@ class FilerServer:
                         headers={"Content-Disposition":
                                  f'inline; filename="{entry.name}"'})
 
+    def _read_jwt_for(self, fid: str) -> str:
+        """Sign a read token with the shared jwt.signing.read key when
+        configured (reference security.toml; volume servers verify)."""
+        if not hasattr(self, "_jwt_read_key"):
+            from seaweedfs_tpu.utils import config as _cfg
+            conf = _cfg.load_configuration("security")
+            self._jwt_read_key = _cfg.get(conf, "jwt.signing.read.key",
+                                          "") or ""
+        if not self._jwt_read_key:
+            return ""
+        from seaweedfs_tpu.utils.security import gen_jwt
+        return gen_jwt(self._jwt_read_key, fid)
+
     def _read_chunk_blob(self, fid: str) -> bytes:
         """Raw stored bytes of a chunk (ciphertext when encrypted);
         cached as stored."""
         blob = self.chunk_cache.get(fid)
         if blob is None:
+            jwt = self._read_jwt_for(fid)
             for url in self.mc.lookup_file_id(fid):
                 try:
-                    status, body, _ = http_call("GET", url)
+                    sep = "&" if "?" in url else "?"
+                    status, body, _ = http_call(
+                        "GET", url + (f"{sep}jwt={jwt}" if jwt else ""))
                 except ConnectionError:
                     continue
                 if status == 200:

@@ -390,10 +390,16 @@ class VolumeGrpc:
 
 
 def start_volume_grpc(vs, host: str = "127.0.0.1",
-                      port: int = 0) -> tuple[grpc.Server, int]:
+                      port: int = 0, tls="auto") -> tuple[grpc.Server, int]:
+    from seaweedfs_tpu.utils import tls as tlsmod
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
     server.add_generic_rpc_handlers((VolumeGrpc(vs).handlers(),))
-    bound = server.add_insecure_port(f"{host}:{port}")
+    cfg = tlsmod.load_tls_config("volume") if tls == "auto" else tls
+    if cfg is not None:
+        bound = server.add_secure_port(
+            f"{host}:{port}", tlsmod.server_credentials(cfg))
+    else:
+        bound = server.add_insecure_port(f"{host}:{port}")
     server.start()
     return server, bound
 
@@ -403,8 +409,9 @@ class GrpcVolumeClient:
     body) with the HTTP-admin path names so the shell applier can use one
     transport-neutral call site."""
 
-    def __init__(self, address: str):
-        self.channel = grpc.insecure_channel(address)
+    def __init__(self, address: str, tls="auto"):
+        from seaweedfs_tpu.utils.tls import make_channel
+        self.channel = make_channel(address, role="client", tls=tls)
 
     def _unary(self, method: str, request, resp_cls,
                timeout: float = 300):

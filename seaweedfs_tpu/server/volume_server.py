@@ -42,7 +42,8 @@ class VolumeServer:
                  public_url: str = "", rack: str = "", data_center: str = "",
                  coder: Optional[ErasureCoder] = None,
                  max_volume_counts: Optional[list[int]] = None,
-                 jwt_signing_key: str = "", needle_map_kind: str = "memory",
+                 jwt_signing_key: str = "", jwt_read_key: str = "",
+                 needle_map_kind: str = "memory",
                  tcp_port: int = -1, grpc_port: Optional[int] = None):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
@@ -69,6 +70,14 @@ class VolumeServer:
         self._hb_thread: Optional[threading.Thread] = None
         self.volume_size_limit = 0
         self.jwt_signing_key = jwt_signing_key
+        # read JWT (reference jwt.signing.read): when a read key is set —
+        # explicitly or in security.toml — GETs require a token signed
+        # with it (the filer signs its own chunk reads; same shared key)
+        if not jwt_read_key:
+            from seaweedfs_tpu.utils import config as _cfg
+            conf = _cfg.load_configuration("security")
+            jwt_read_key = _cfg.get(conf, "jwt.signing.read.key", "") or ""
+        self.jwt_read_key = jwt_read_key
         from seaweedfs_tpu.utils.metrics import Registry
         self.metrics = Registry()
         self._m_req = self.metrics.counter(
@@ -286,6 +295,17 @@ class VolumeServer:
             return Response({"error": "unauthorized"}, status=401)
         return None
 
+    def _check_read_jwt(self, req: Request) -> Optional[Response]:
+        if not self.jwt_read_key:
+            return None
+        from seaweedfs_tpu.utils.security import verify_jwt
+        auth = req.headers.get("Authorization", "")
+        token = auth[7:] if auth.startswith("Bearer ") else             req.query.get("jwt", "")
+        fid = f"{req.match.group(1)},{req.match.group(2)}"
+        if not verify_jwt(self.jwt_read_key, token, fid):
+            return Response({"error": "unauthorized"}, status=401)
+        return None
+
     # ---- public data path ----
     def _parse_fid(self, req: Request) -> tuple[int, int, int]:
         vid = int(req.match.group(1))
@@ -333,6 +353,9 @@ class VolumeServer:
                         status=201)
 
     def _handle_read(self, req: Request) -> Response:
+        denied = self._check_read_jwt(req)
+        if denied:
+            return denied
         self._m_req.inc("read")
         vid, key, cookie = self._parse_fid(req)
         try:

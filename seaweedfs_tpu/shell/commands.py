@@ -40,9 +40,10 @@ class ShellContext:
             import grpc as _grpc
 
             from seaweedfs_tpu.server.volume_grpc import GrpcVolumeClient
+            from seaweedfs_tpu.utils.tls import make_channel
             ip, port = node.rsplit(":", 1)
             addr = f"{ip}:{int(port) + 10000}"
-            ch = _grpc.insecure_channel(addr)
+            ch = make_channel(addr)  # honors security.toml mTLS
             _grpc.channel_ready_future(ch).result(timeout=0.5)
             ch.close()
             client = GrpcVolumeClient(addr)

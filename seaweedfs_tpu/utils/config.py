@@ -25,6 +25,14 @@ expires_after_seconds = 10
 ui = false
 # ip whitelist, e.g. ["10.0.0.0/8", "127.0.0.1"]
 white_list = []
+
+# Mutual TLS for every gRPC plane + HTTP admin (reference security/tls.go).
+# Set all three to enable; per-role sections ([grpc.master], [grpc.volume],
+# [grpc.filer], [grpc.client]) override.
+[grpc]
+ca = ""
+cert = ""
+key = ""
 """,
     "master": """\
 # master.toml

@@ -1,0 +1,160 @@
+"""mTLS control plane (reference weed/security/tls.go) + read JWT."""
+
+import grpc
+import pytest
+
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.master_grpc import (GrpcMasterClient,
+                                              start_master_grpc)
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils import tls as tlsmod
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    return tlsmod.generate_self_signed(
+        str(tmp_path_factory.mktemp("certs")))
+
+
+def test_mtls_master_rejects_unauthenticated_and_serves_mutual(certs,
+                                                               tmp_path):
+    master = MasterServer()
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    server, port = start_master_grpc(master, tls=certs["master"])
+    addr = f"127.0.0.1:{port}"
+    try:
+        # no client cert: the TLS handshake itself must fail
+        bad = GrpcMasterClient(addr, tls=None)  # insecure channel
+        with pytest.raises(grpc.RpcError) as ei:
+            bad.assign(count=1)
+        assert ei.value.code() in (grpc.StatusCode.UNAVAILABLE,
+                                   grpc.StatusCode.INTERNAL)
+        bad.close()
+
+        # client cert from a DIFFERENT CA: rejected too
+        import tempfile
+        other = tlsmod.generate_self_signed(tempfile.mkdtemp(),
+                                            roles=("client",))
+        rogue_cfg = tlsmod.TlsConfig(
+            ca_file=certs["client"].ca_file,       # trusts the server
+            cert_file=other["client"].cert_file,   # but wrong identity CA
+            key_file=other["client"].key_file)
+        rogue = GrpcMasterClient(addr, tls=rogue_cfg)
+        with pytest.raises(grpc.RpcError):
+            rogue.assign(count=1)
+        rogue.close()
+
+        # proper mutual TLS: works
+        good = GrpcMasterClient(addr, tls=certs["client"])
+        res = good.assign(count=1)
+        assert res.fid and not res.error
+        good.close()
+    finally:
+        server.stop(0)
+        vs.stop()
+        master.stop()
+
+
+def test_mtls_volume_and_filer_planes(certs, tmp_path):
+    from seaweedfs_tpu.server.filer_grpc import (GrpcFilerClient,
+                                                 start_filer_grpc)
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.volume_grpc import (GrpcVolumeClient,
+                                                  start_volume_grpc)
+    master = MasterServer()
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    fs = FilerServer(master.url, store="memory")
+    fs.start()
+    vsrv, vport = start_volume_grpc(vs, tls=certs["volume"])
+    fsrv, fport = start_filer_grpc(fs, tls=certs["filer"])
+    try:
+        vc = GrpcVolumeClient(f"127.0.0.1:{vport}", tls=certs["client"])
+        import seaweedfs_tpu.pb.volume_server_pb2 as vpb
+        st = vc._unary("VolumeServerStatus", vpb.VolumeServerStatusRequest(),
+                       vpb.VolumeServerStatusResponse)
+        assert st.version
+        vc.close()
+
+        fc = GrpcFilerClient(f"127.0.0.1:{fport}", tls=certs["client"])
+        fc.kv_put(b"tlsk", b"tlsv")
+        assert fc.kv_get(b"tlsk") == b"tlsv"
+        fc.close()
+
+        # and unauthenticated clients bounce off both
+        for port in (vport, fport):
+            ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+            with pytest.raises(grpc.FutureTimeoutError):
+                grpc.channel_ready_future(ch).result(timeout=1.5)
+            ch.close()
+    finally:
+        vsrv.stop(0)
+        fsrv.stop(0)
+        fs.stop()
+        vs.stop()
+        master.stop()
+
+
+def test_http_admin_mtls(certs, tmp_path):
+    """The HTTP admin listener can require client certs too."""
+    import ssl
+    import urllib.request
+
+    from seaweedfs_tpu.utils.httpd import HttpServer, Response
+    srv = HttpServer()
+    srv.add("GET", "/ping", lambda req: Response({"pong": True}))
+    srv.start()
+    tlsmod.wrap_http_server(srv, certs["master"])
+    url = f"https://127.0.0.1:{srv.port}/ping"
+
+    # client WITH cert succeeds
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_verify_locations(certs["client"].ca_file)
+    ctx.load_cert_chain(certs["client"].cert_file, certs["client"].key_file)
+    ctx.check_hostname = False
+    with urllib.request.urlopen(url, context=ctx, timeout=5) as r:
+        assert b"pong" in r.read()
+
+    # client WITHOUT cert is refused during handshake
+    noctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    noctx.load_verify_locations(certs["client"].ca_file)
+    noctx.check_hostname = False
+    with pytest.raises(Exception):
+        urllib.request.urlopen(url, context=noctx, timeout=5).read()
+    srv.stop()
+
+
+def test_read_jwt_guards_volume_gets(tmp_path):
+    """With a read key set, GETs need a fid-scoped token (reference
+    jwt.signing.read); the filer signs its own chunk reads."""
+    from seaweedfs_tpu.utils.security import gen_jwt
+    master = MasterServer()
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url,
+                      jwt_read_key="read-secret")
+    vs.start()
+    try:
+        a = http_json("GET", f"http://{master.url}/dir/assign")
+        status, _, _ = http_call("POST", f"http://{a['url']}/{a['fid']}",
+                                 body=b"guarded")
+        assert status < 300
+        # bare read: 401
+        status, _, _ = http_call("GET", f"http://{a['url']}/{a['fid']}")
+        assert status == 401
+        # token for the WRONG fid: 401
+        wrong = gen_jwt("read-secret", "9,deadbeef")
+        status, _, _ = http_call(
+            "GET", f"http://{a['url']}/{a['fid']}?jwt={wrong}")
+        assert status == 401
+        # proper token: 200 + bytes
+        tok = gen_jwt("read-secret", a["fid"])
+        status, body, _ = http_call(
+            "GET", f"http://{a['url']}/{a['fid']}?jwt={tok}")
+        assert status == 200 and body == b"guarded"
+    finally:
+        vs.stop()
+        master.stop()
