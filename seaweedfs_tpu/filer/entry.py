@@ -115,10 +115,13 @@ class Entry:
 
     def file_size(self) -> int:
         if self.content:
-            return len(self.content)
+            return max(len(self.content), self.attr.file_size)
         if not self.chunks:
             return self.attr.file_size
-        return max((c.offset + c.size for c in self.chunks), default=0)
+        # attr.file_size can exceed the chunk extent for sparse tails
+        # (truncate-up); truncate-down clamps chunks so max() is right
+        return max(self.attr.file_size,
+                   max((c.offset + c.size for c in self.chunks), default=0))
 
     def to_dict(self) -> dict:
         return {

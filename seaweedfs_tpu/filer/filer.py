@@ -243,6 +243,26 @@ class Filer:
                 fids.extend(self._collect_gc_fids(nested))
         return fids
 
+    def _collect_fids_strict(self, chunks: list) -> list[str]:
+        """Like _collect_gc_fids but RAISES on an unreadable manifest —
+        for computing keep-sets, where an incomplete answer would let
+        live leaf chunks be deleted."""
+        import json as _json
+
+        from seaweedfs_tpu.filer.entry import FileChunk
+        fids: list[str] = []
+        for c in chunks:
+            fids.append(c.fid)
+            if c.is_chunk_manifest:
+                if self.read_chunk_fn is None:
+                    raise RuntimeError("no read_chunk_fn to expand "
+                                       "manifest")
+                blob = self.read_chunk_fn(c)
+                nested = [FileChunk.from_dict(d)
+                          for d in _json.loads(blob)["chunks"]]
+                fids.extend(self._collect_fids_strict(nested))
+        return fids
+
     def _delete_children(self, dir_path: str) -> None:
         while True:
             children = self.store.list_directory_entries(dir_path, limit=256)
@@ -327,18 +347,42 @@ class Filer:
         if dir_path == "/" or self.store.find_entry(dir_path) is not None:
             return
         self._ensure_parents(dir_path.rsplit("/", 1)[0] or "/")
-        self.store.insert_entry(new_directory_entry(dir_path))
+        entry = new_directory_entry(dir_path)
+        self.store.insert_entry(entry)
+        # announce the new directory so subscribers (mount meta caches,
+        # filer.sync peers) see implicitly-created parents too
+        self._notify(entry.dir_path, None, entry.to_dict())
 
     def _gc_replaced_entry(self, old: Entry, new: Entry) -> None:
         """Overwriting a name: free the old data — unless other hard
-        links still reference it (then just drop this name's link)."""
+        links still reference it (then just drop this name's link).
+        When manifests are involved, compare the fully-expanded fid
+        sets: a new manifest may reference leaf chunks that the old
+        entry's manifests also referenced, and those must survive."""
         if old.hard_link_id and old.hard_link_id != new.hard_link_id:
             if self.store.unlink(old.hard_link_id) > 0:
                 return  # data lives on under other names
-        keep = {c.fid for c in new.chunks}
+        has_manifest = any(c.is_chunk_manifest
+                           for c in (*old.chunks, *new.chunks))
+        if has_manifest:
+            # the keep-set must FAIL CLOSED: if the new entry's manifest
+            # can't be read we cannot know which leaves are live, so we
+            # skip GC entirely (leaking until vacuum beats deleting data
+            # the new entry still references)
+            try:
+                keep = set(self._collect_fids_strict(new.chunks))
+            except Exception:
+                return
+        else:
+            keep = {c.fid for c in new.chunks}
         doomed = [c for c in old.chunks if c.fid not in keep]
-        if doomed and self.delete_chunks_fn:
-            self.delete_chunks_fn(self._collect_gc_fids(doomed))
+        if not doomed or not self.delete_chunks_fn:
+            return
+        fids = (self._collect_gc_fids(doomed) if has_manifest
+                else [c.fid for c in doomed])
+        fids = [f for f in fids if f not in keep]
+        if fids:
+            self.delete_chunks_fn(fids)
 
     def _notify(self, directory: str, old_entry: Optional[dict],
                 new_entry: Optional[dict]) -> None:

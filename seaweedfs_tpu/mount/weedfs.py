@@ -1,23 +1,38 @@
 """WeedFS: the mount filesystem over the filer namespace.
 
 Functional equivalent of reference weed/mount/weedfs.go + inode_to_path.go:
-an inode<->path registry, attribute translation, and open-file write-back
-buffers that flush into the filer as chunked uploads on flush/release.
-Serves the Operations interface that fuse_kernel.FuseConnection dispatches
-into. Works against the Filer/FilerServer in process (the `weed-tpu mount`
-command connects one to a remote filer over HTTP using the same interface).
+an inode<->path registry, attribute translation, and per-handle
+write-back state. Unlike the pre-round-4 design (whole-file RAM buffer
+per handle), writes stream through a dirty-page UploadPipeline
+(page_writer.py — reference weed/mount/page_writer/upload_pipeline.go)
+with bounded memory and swap-file spill, and reads fetch only the chunk
+views they need; metadata lookups are served from a MetaCache
+subscribed to the filer change log (meta_cache.py — reference
+weed/mount/meta_cache/meta_cache_subscribe.go:14-45).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import errno
 import stat as statmod
 import threading
 import time
 from typing import Optional
 
-from seaweedfs_tpu.filer.entry import Attr, Entry
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
+from seaweedfs_tpu.filer.filechunk_manifest import (has_chunk_manifest,
+                                                    maybe_manifestize,
+                                                    resolve_chunk_manifest)
+from seaweedfs_tpu.filer.filechunks import (non_overlapping_visible_intervals,
+                                            view_from_visibles)
 from seaweedfs_tpu.mount.fuse_kernel import ROOT_ID, FileAttr
+from seaweedfs_tpu.mount.meta_cache import MetaCache, is_negative
+from seaweedfs_tpu.mount.page_writer import UploadPipeline
+
+# files at or below this size are stored inline in the entry, matching
+# the filer server's small-content threshold
+INLINE_LIMIT = 2048
 
 
 class InodeToPath:
@@ -43,11 +58,16 @@ class InodeToPath:
         return self._inode_to_path.get(ino)
 
     def move(self, old: str, new: str) -> None:
+        """Repoint old -> new, including every path UNDER old when a
+        directory moves (children keep their inodes, like the kernel)."""
+        prefix = old.rstrip("/") + "/"
         with self._lock:
-            ino = self._path_to_inode.pop(old, None)
-            if ino is not None:
-                self._path_to_inode[new] = ino
-                self._inode_to_path[ino] = new
+            for path in [p for p in self._path_to_inode
+                         if p == old or p.startswith(prefix)]:
+                ino = self._path_to_inode.pop(path)
+                moved = new + path[len(old):]
+                self._path_to_inode[moved] = ino
+                self._inode_to_path[ino] = moved
 
     def forget(self, path: str) -> None:
         with self._lock:
@@ -56,39 +76,228 @@ class InodeToPath:
                 self._inode_to_path.pop(ino, None)
 
 
-class OpenFile:
-    """Write-back buffer for one open handle (the reference uses dirty
-    pages + an upload pipeline, mount/page_writer.go; we buffer the whole
-    file and flush on flush/release)."""
+class FileHandle:
+    """State of one open file (reference mount/filehandle.go): a
+    snapshot of the entry's chunks at open time plus a lazily-created
+    write-back pipeline. Nothing is buffered whole."""
 
-    def __init__(self, path: str, data: bytearray, dirty: bool = False):
+    def __init__(self, path: str, entry: Optional[Entry], weedfs):
         self.path = path
-        self.data = data
-        self.dirty = dirty
-        self.lock = threading.Lock()
+        self.w = weedfs
+        self.lock = threading.RLock()
+        self.dirty = False
+        self.pipeline: Optional[UploadPipeline] = None
+        # resolved base state (manifests expanded once); chunk objects
+        # are COPIED — truncate mutates sizes in place and the originals
+        # are shared with the meta cache and other handles
+        self.content = entry.content if entry else b""
+        chunks = list(entry.chunks) if entry else []
+        if has_chunk_manifest(chunks):
+            chunks = resolve_chunk_manifest(weedfs.fs._read_chunk, chunks)
+        self.base_chunks = [dataclasses.replace(c) for c in chunks]
+        # chunks flushed during this handle's lifetime
+        self.flushed_chunks: list[FileChunk] = []
+        self.size = entry.file_size() if entry else 0
+        self.attr = entry.attr if entry else Attr()
+        self._vis_cache = None  # (key, visibles) for read-path reuse
+        self._gen = 0  # bumped whenever chunk lists mutate
+
+    # ---- write ----
+    def _ensure_pipeline(self) -> UploadPipeline:
+        if self.pipeline is None:
+            self.pipeline = UploadPipeline(
+                self.w._upload_one, swap_dir=self.w.swap_dir,
+                chunk_size=self.w.chunk_size,
+                mem_chunks=self.w.mem_chunks,
+                concurrency=self.w.upload_concurrency)
+            if self.content:
+                # inline content becomes dirty page 0 so the flushed
+                # entry is a consistent chunked (or re-inlined) file
+                self.pipeline.write(0, self.content)
+                self.content = b""
+        return self.pipeline
+
+    def write(self, offset: int, data: bytes) -> int:
+        with self.lock:
+            p = self._ensure_pipeline()
+            n = p.write(offset, data)
+            self.size = max(self.size, offset + n)
+            self.dirty = True
+            return n
+
+    # ---- read ----
+    def read(self, offset: int, size: int) -> bytes:
+        with self.lock:
+            limit = self.size
+            if offset >= limit:
+                return b""
+            size = min(size, limit - offset)
+            if self.pipeline is not None:
+                self.pipeline.wait_for_inflight(offset, offset + size)
+                uploaded = self.pipeline.uploaded_snapshot()
+            else:
+                uploaded = []
+            if self.content and not uploaded:
+                buf = bytearray(self.content[offset:offset + size])
+                buf.extend(b"\x00" * (size - len(buf)))
+            else:
+                chunks = self.base_chunks + self.flushed_chunks + uploaded
+                buf = self.w._read_chunks_range(
+                    chunks, offset, size, visibles=self._visibles(chunks))
+            if self.pipeline is not None:
+                self.pipeline.overlay(buf, offset)
+            return bytes(buf)
+
+    def _visibles(self, chunks: list[FileChunk]):
+        """Visible-interval view of the handle's chunk list, cached
+        between reads (the interval build is O(n log n) and a 128KB
+        FUSE read stream would otherwise redo it thousands of times;
+        the reference caches per file too, filehandle_read.go)."""
+        key = (self._gen, len(chunks))
+        if self._vis_cache is not None and self._vis_cache[0] == key:
+            return self._vis_cache[1]
+        vis = non_overlapping_visible_intervals(chunks)
+        self._vis_cache = (key, vis)
+        return vis
+
+    # ---- truncate ----
+    def truncate(self, new_size: int) -> None:
+        with self.lock:
+            if self.content and new_size > len(self.content):
+                # extending an inline file: content becomes page 0 so
+                # the hole past it reads as zeros
+                self._ensure_pipeline()
+            if self.pipeline is not None:
+                self.pipeline.truncate(new_size)
+            if new_size < self.size:
+                self.content = self.content[:new_size]
+                for group in (self.base_chunks, self.flushed_chunks):
+                    for fc in group:
+                        if fc.offset + fc.size > new_size:
+                            fc.size = max(0, new_size - fc.offset)
+                self.base_chunks = [c for c in self.base_chunks if c.size]
+                self.flushed_chunks = [c for c in self.flushed_chunks
+                                       if c.size]
+            self._gen += 1
+            self.size = new_size
+            self.dirty = True
+
+    # ---- flush ----
+    def flush(self) -> None:
+        with self.lock:
+            if not self.dirty:
+                return
+            now = time.time()
+            entry = Entry(
+                full_path=self.path,
+                attr=Attr(mtime=now, crtime=self.attr.crtime or now,
+                          mode=self.attr.mode or 0o644,
+                          mime=self.attr.mime, uid=self.attr.uid,
+                          gid=self.attr.gid, file_size=self.size))
+            if self.size <= INLINE_LIMIT and not self.base_chunks \
+                    and not self.flushed_chunks \
+                    and (self.pipeline is None
+                         or not self.pipeline.has_uploads()):
+                # tiny file: persist inline, upload NOTHING. The dirty
+                # pages stay live in the pipeline so later writes keep
+                # layering on them (no orphaned needles, no lost base).
+                buf = bytearray(self.content.ljust(self.size, b"\x00")
+                                [:self.size])
+                if self.pipeline is not None:
+                    self.pipeline.overlay(buf, 0)
+                entry.content = bytes(buf)
+            else:
+                uploaded = self.pipeline.flush() if self.pipeline else []
+                chunks = self.base_chunks + self.flushed_chunks + uploaded
+                entry.chunks = maybe_manifestize(
+                    lambda blob: self.w.fs._save_chunk(blob, 0, "", ""),
+                    chunks)
+                self.flushed_chunks = self.flushed_chunks + uploaded
+                self._gen += 1
+            self.w.filer.create_entry(entry)
+            self.attr = entry.attr
+            self.dirty = False
+
+    def close(self) -> None:
+        if self.pipeline is not None:
+            self.pipeline.close()
+            self.pipeline = None
 
 
 class WeedFS:
     """Operations implementation over a filer."""
 
-    def __init__(self, filer_server):
+    def __init__(self, filer_server, swap_dir: str = "/tmp",
+                 chunk_size: int = None, mem_chunks: int = None,
+                 upload_concurrency: int = None):
+        from seaweedfs_tpu.mount import page_writer as _pw
         self.fs = filer_server
         self.filer = filer_server.filer
         self.inodes = InodeToPath()
-        self._handles: dict[int, OpenFile] = {}
+        self._handles: dict[int, FileHandle] = {}
         self._next_fh = 1
         self._lock = threading.Lock()
+        self.swap_dir = swap_dir
+        self.chunk_size = chunk_size or _pw.DEFAULT_CHUNK_SIZE
+        self.mem_chunks = mem_chunks or _pw.DEFAULT_MEM_CHUNKS
+        self.upload_concurrency = (upload_concurrency
+                                   or _pw.DEFAULT_CONCURRENCY)
+        self.meta_cache = MetaCache()
+        self.meta_cache.attach(self.filer.meta_log)
 
     # ---- helpers ----
+    def _upload_one(self, data: bytes, logical_offset: int,
+                    mtime_ns: int) -> FileChunk:
+        fc = self.fs._save_chunk(data, logical_offset, "", "")
+        fc.mtime_ns = mtime_ns
+        return fc
+
+    def _read_chunks_range(self, chunks: list[FileChunk], offset: int,
+                           size: int, visibles=None) -> bytearray:
+        """Materialize [offset, offset+size) from a chunk list, reading
+        only the chunks that intersect the range (holes read as zeros)."""
+        buf = bytearray(size)
+        if visibles is None:
+            visibles = non_overlapping_visible_intervals(chunks)
+        chunk_by_fid = {c.fid: c for c in chunks}
+        for view in view_from_visibles(visibles, offset, size):
+            blob = self.fs._read_chunk(chunk_by_fid[view.fid])
+            piece = blob[view.offset_in_chunk:view.offset_in_chunk
+                         + view.size]
+            buf[view.logic_offset - offset:
+                view.logic_offset - offset + view.size] = piece
+        return buf
+
+    def _find_entry(self, path: str) -> Optional[Entry]:
+        cached = self.meta_cache.get(path)
+        if cached is not None:
+            return None if is_negative(cached) else cached
+        seq = self.meta_cache.event_seq
+        entry = self.filer.find_entry(path)
+        if entry is not None:
+            self.meta_cache.seed(entry, as_of=seq)
+        return entry
+
     def _entry_attr(self, entry: Entry) -> FileAttr:
         ino = self.inodes.lookup(entry.full_path)
-        return FileAttr(ino=ino, size=entry.file_size(),
+        size = entry.file_size()
+        # an open dirty handle knows the freshest size (it may also be
+        # SMALLER than the entry's after an un-flushed truncate)
+        dirty_sizes = [h.size for h in self._handles_for(entry.full_path)
+                       if h.dirty]
+        if dirty_sizes:
+            size = max(dirty_sizes)
+        return FileAttr(ino=ino, size=size,
                         mtime=entry.attr.mtime or time.time(),
                         mode=(statmod.S_IFDIR | 0o755) if entry.is_directory
                         else (statmod.S_IFREG | (entry.attr.mode & 0o777
                                                  or 0o644)),
                         is_dir=entry.is_directory,
                         uid=entry.attr.uid, gid=entry.attr.gid)
+
+    def _handles_for(self, path: str) -> list[FileHandle]:
+        with self._lock:
+            return [h for h in self._handles.values() if h.path == path]
 
     def _child_path(self, parent_ino: int, name: str) -> Optional[str]:
         parent = self.inodes.path(parent_ino)
@@ -102,7 +311,7 @@ class WeedFS:
         path = self._child_path(parent_ino, name)
         if path is None:
             return None
-        entry = self.filer.find_entry(path)
+        entry = self._find_entry(path)
         if entry is None:
             return None
         return self._entry_attr(entry)
@@ -111,7 +320,7 @@ class WeedFS:
         path = self.inodes.path(ino)
         if path is None:
             return None
-        entry = self.filer.find_entry(path)
+        entry = self._find_entry(path)
         if entry is None:
             return None
         return self._entry_attr(entry)
@@ -121,26 +330,23 @@ class WeedFS:
         path = self.inodes.path(ino)
         if path is None:
             return None
-        entry = self.filer.find_entry(path)
+        entry = self._find_entry(path)
         if entry is None:
             return None
         FATTR_SIZE = 1 << 3
         if valid & FATTR_SIZE:
-            of = self._handles.get(fh)
-            if of is not None:
-                with of.lock:
-                    if size < len(of.data):
-                        del of.data[size:]
-                    else:
-                        of.data.extend(b"\x00" * (size - len(of.data)))
-                    of.dirty = True
+            h = self._handles.get(fh)
+            if h is None:
+                handles = self._handles_for(path)
+                h = handles[0] if handles else None
+            if h is not None:
+                h.truncate(size)
             else:
-                data = bytearray(self.fs._read_entry_bytes(entry))
-                if size < len(data):
-                    del data[size:]
-                else:
-                    data.extend(b"\x00" * (size - len(data)))
-                self._write_back(path, bytes(data), entry)
+                # no open handle: rewrite the entry truncated
+                h = FileHandle(path, entry, self)
+                h.truncate(size)
+                h.flush()
+                h.close()
                 entry = self.filer.find_entry(path)
         return self._entry_attr(entry)
 
@@ -162,7 +368,7 @@ class WeedFS:
 
     def rmdir(self, parent_ino: int, name: str) -> int:
         path = self._child_path(parent_ino, name)
-        entry = self.filer.find_entry(path)
+        entry = self._find_entry(path)
         if entry is None:
             return errno.ENOENT
         if not entry.is_directory:
@@ -185,20 +391,29 @@ class WeedFS:
         except FileNotFoundError:
             return errno.ENOENT
         self.inodes.move(old, new)
+        # repoint open handles on the file AND under a renamed directory
+        # so un-flushed writes land at the new path
+        prefix = old.rstrip("/") + "/"
+        with self._lock:
+            for h in self._handles.values():
+                if h.path == old:
+                    h.path = new
+                elif h.path.startswith(prefix):
+                    h.path = new + h.path[len(old):]
         return 0
 
     def open(self, ino: int) -> Optional[int]:
         path = self.inodes.path(ino)
         if path is None:
             return None
-        entry = self.filer.find_entry(path)
+        entry = self._find_entry(path)
         if entry is None or entry.is_directory:
             return None
-        data = bytearray(self.fs._read_entry_bytes(entry))
+        h = FileHandle(path, entry, self)
         with self._lock:
             fh = self._next_fh
             self._next_fh += 1
-            self._handles[fh] = OpenFile(path, data)
+            self._handles[fh] = h
         return fh
 
     def create(self, parent_ino: int, name: str,
@@ -209,72 +424,60 @@ class WeedFS:
                       attr=Attr(mtime=now, crtime=now,
                                 mode=mode & 0o777, file_size=0))
         self.filer.create_entry(entry)
+        h = FileHandle(path, entry, self)
+        h.dirty = True
         with self._lock:
             fh = self._next_fh
             self._next_fh += 1
-            self._handles[fh] = OpenFile(path, bytearray(), dirty=True)
+            self._handles[fh] = h
         return self._entry_attr(entry), fh
 
     def read(self, ino: int, fh: int, offset: int,
              size: int) -> Optional[bytes]:
-        of = self._handles.get(fh)
-        if of is None:
+        h = self._handles.get(fh)
+        if h is None:
             return None
-        with of.lock:
-            return bytes(of.data[offset:offset + size])
+        return h.read(offset, size)
 
     def write(self, ino: int, fh: int, offset: int,
               data: bytes) -> Optional[int]:
-        of = self._handles.get(fh)
-        if of is None:
+        h = self._handles.get(fh)
+        if h is None:
             return None
-        with of.lock:
-            if offset > len(of.data):
-                of.data.extend(b"\x00" * (offset - len(of.data)))
-            of.data[offset:offset + len(data)] = data
-            of.dirty = True
-        return len(data)
+        return h.write(offset, data)
 
     def flush(self, ino: int, fh: int) -> None:
-        of = self._handles.get(fh)
-        if of is None or not of.dirty:
-            return
-        with of.lock:
-            entry = self.filer.find_entry(of.path)
-            self._write_back(of.path, bytes(of.data), entry)
-            of.dirty = False
+        h = self._handles.get(fh)
+        if h is not None:
+            h.flush()
 
     def release(self, ino: int, fh: int) -> None:
-        self.flush(ino, fh)
-        with self._lock:
-            self._handles.pop(fh, None)
+        h = self._handles.get(fh)
+        try:
+            if h is not None:
+                try:
+                    h.flush()
+                finally:
+                    h.close()
+        finally:
+            # always drop the handle — a failed flush must not leave a
+            # dead dirty handle pinning stale sizes in _entry_attr
+            with self._lock:
+                self._handles.pop(fh, None)
 
     def readdir(self, ino: int) -> list[tuple[str, FileAttr]]:
         path = self.inodes.path(ino)
         if path is None:
             return []
-        out = [(".", FileAttr(ino=ino, is_dir=True, mode=statmod.S_IFDIR | 0o755)),
+        out = [(".", FileAttr(ino=ino, is_dir=True,
+                              mode=statmod.S_IFDIR | 0o755)),
                ("..", FileAttr(ino=ROOT_ID, is_dir=True,
                                mode=statmod.S_IFDIR | 0o755))]
-        for e in self.filer.list_entries(path, limit=1 << 20):
+        entries = self.meta_cache.listing(path)
+        if entries is None:
+            seq = self.meta_cache.event_seq
+            entries = self.filer.list_entries(path, limit=1 << 20)
+            self.meta_cache.seed_listing(path, entries, as_of=seq)
+        for e in entries:
             out.append((e.name, self._entry_attr(e)))
         return out
-
-    # ---- write-back ----
-    def _write_back(self, path: str, data: bytes,
-                    old_entry: Optional[Entry]) -> None:
-        now = time.time()
-        entry = Entry(full_path=path,
-                      attr=Attr(mtime=now,
-                                crtime=old_entry.attr.crtime
-                                if old_entry else now,
-                                mode=old_entry.attr.mode
-                                if old_entry else 0o644,
-                                mime=old_entry.attr.mime
-                                if old_entry else "",
-                                file_size=len(data)))
-        if len(data) <= 2048:
-            entry.content = data
-        else:
-            entry.chunks = self.fs._upload_chunks(data, "", "")
-        self.filer.create_entry(entry)
