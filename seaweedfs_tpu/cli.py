@@ -27,6 +27,14 @@ def _add_common_volume_args(p):
                    help="needle map kind (reference -index flag)")
     p.add_argument("-tcp", action="store_true",
                    help="serve the raw TCP data path (reference -useTcp)")
+    p.add_argument("-concurrentUploadLimitMB", type=int, default=256,
+                   help="in-flight upload byte cap, 0=unlimited "
+                        "(reference -concurrentUploadLimitMB)")
+    p.add_argument("-concurrentDownloadLimitMB", type=int, default=256,
+                   help="in-flight download byte cap, 0=unlimited")
+    p.add_argument("-fileSizeLimitMB", type=int, default=256,
+                   help="reject single uploads over this size "
+                        "(reference -fileSizeLimitMB)")
     p.add_argument("-grpc", action="store_true",
                    help="serve the volume_server_pb gRPC admin plane on "
                         "port+10000")
@@ -59,7 +67,10 @@ def cmd_volume(args):
                       max_volume_counts=[args.max] * len(dirs),
                       needle_map_kind=args.index,
                       tcp_port=0 if args.tcp else -1,
-                      grpc_port=args.port + 10000 if args.grpc else None)
+                      grpc_port=args.port + 10000 if args.grpc else None,
+                      concurrent_upload_limit_mb=args.concurrentUploadLimitMB,
+                      concurrent_download_limit_mb=args.concurrentDownloadLimitMB,
+                      file_size_limit_mb=args.fileSizeLimitMB)
     vs.start()
     tcp = f", tcp {vs.tcp_server.port}" if vs.tcp_server else ""
     g = f", grpc {vs.grpc_port}" if vs.grpc_port else ""
@@ -82,7 +93,10 @@ def cmd_server(args):
                       max_volume_counts=[args.max] * len(dirs),
                       needle_map_kind=args.index,
                       tcp_port=0 if args.tcp else -1,
-                      grpc_port=args.port + 10000 if args.grpc else None)
+                      grpc_port=args.port + 10000 if args.grpc else None,
+                      concurrent_upload_limit_mb=args.concurrentUploadLimitMB,
+                      concurrent_download_limit_mb=args.concurrentDownloadLimitMB,
+                      file_size_limit_mb=args.fileSizeLimitMB)
     vs.start()
     print(f"master {ms.url}; volume {vs.url}")
     extra = []

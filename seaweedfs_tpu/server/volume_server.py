@@ -44,10 +44,21 @@ class VolumeServer:
                  max_volume_counts: Optional[list[int]] = None,
                  jwt_signing_key: str = "", jwt_read_key: str = "",
                  needle_map_kind: str = "memory",
-                 tcp_port: int = -1, grpc_port: Optional[int] = None):
+                 tcp_port: int = -1, grpc_port: Optional[int] = None,
+                 concurrent_upload_limit_mb: int = 256,
+                 concurrent_download_limit_mb: int = 256,
+                 file_size_limit_mb: int = 256,
+                 inflight_timeout: float = 30.0):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
-        the volume_server_pb gRPC admin plane (0 = ephemeral)."""
+        the volume_server_pb gRPC admin plane (0 = ephemeral).
+
+        concurrent_upload/download_limit_mb cap the total request/
+        response payload bytes in flight at once; excess writers wait up
+        to inflight_timeout then get 429 (reference
+        weed/server/volume_server.go:23-30 + `weed volume
+        -concurrentUploadLimitMB`). file_size_limit_mb rejects a single
+        oversized upload with 413 (`-fileSizeLimitMB`). 0 = unlimited."""
         urls = (master_url.split(",") if isinstance(master_url, str)
                 else list(master_url))
         self.master_urls = [u.strip() for u in urls if u.strip()]
@@ -78,6 +89,13 @@ class VolumeServer:
             conf = _cfg.load_configuration("security")
             jwt_read_key = _cfg.get(conf, "jwt.signing.read.key", "") or ""
         self.jwt_read_key = jwt_read_key
+        from seaweedfs_tpu.utils.limiter import InFlightLimiter
+        self.file_size_limit = file_size_limit_mb * 1024 * 1024
+        self.upload_limiter = InFlightLimiter(
+            concurrent_upload_limit_mb * 1024 * 1024, inflight_timeout)
+        self.download_limiter = InFlightLimiter(
+            concurrent_download_limit_mb * 1024 * 1024, inflight_timeout)
+        self.http.body_gate = self._upload_gate
         from seaweedfs_tpu.utils.metrics import Registry
         self.metrics = Registry()
         self._m_req = self.metrics.counter(
@@ -307,6 +325,25 @@ class VolumeServer:
         return None
 
     # ---- public data path ----
+    def _upload_gate(self, path: str, length: int):
+        """Pre-body-read throttle for needle uploads (reference
+        volume_server_handlers.go:48-80): consulted by HttpServer with
+        the declared Content-Length BEFORE buffering the payload, so N
+        concurrent large PUTs wait at the socket instead of ballooning
+        RSS. Admin/EC transfers are internal and exempt, as in the
+        reference (their sizes are volume-bounded)."""
+        if path.startswith("/admin"):
+            return None
+        if self.file_size_limit > 0 and length > self.file_size_limit:
+            return Response({"error": f"file over the limit of "
+                             f"{self.file_size_limit} bytes"}, status=413)
+        if not self.upload_limiter.try_acquire(length):
+            self._m_req.inc("write_shed")
+            return Response(
+                {"error": "too many requests"}, status=429,
+                headers={"Retry-After": "2"})
+        return lambda: self.upload_limiter.release(length)
+
     def _parse_fid(self, req: Request) -> tuple[int, int, int]:
         vid = int(req.match.group(1))
         key, cookie = parse_needle_id_cookie(req.match.group(2))
@@ -352,7 +389,52 @@ class VolumeServer:
                          "size": len(req.body), "eTag": f"{n.checksum:x}"},
                         status=201)
 
+    def _peek_read_size(self, req: Request) -> int:
+        """Estimate a GET's payload from the needle map before touching
+        disk, for download byte accounting (the reference reads the map
+        entry first too: volume_read.go ReadNeedleDataInto)."""
+        try:
+            vid = int(req.match.group(1))
+            key, _ = parse_needle_id_cookie(req.match.group(2))
+        except (AttributeError, ValueError, IndexError):
+            return 0
+        vol = self.store.find_volume(vid)
+        if vol is None:
+            # EC-served volumes get accounted too (their reads
+            # materialize whole needles just the same)
+            ev = self.store.find_ec_volume(vid) \
+                if hasattr(self.store, "find_ec_volume") else None
+            if ev is not None:
+                try:
+                    _, size = ev.find_needle_from_ecx(key)
+                    return max(int(size), 0)
+                except Exception:
+                    return 0
+            return 0
+        nv = vol.nm.get(key)
+        if nv is None or nv[1] <= 0:
+            return 0
+        return int(nv[1])
+
     def _handle_read(self, req: Request) -> Response:
+        # byte-accounted backpressure only on the real HTTP socket path
+        # (gRPC/LocalRequest dispatch never fires on_sent)
+        est = self._peek_read_size(req) \
+            if getattr(req, "handler", None) is not None else 0
+        if est and not self.download_limiter.try_acquire(est):
+            self._m_req.inc("read_shed")
+            return Response({"error": "too many requests"}, status=429,
+                            headers={"Retry-After": "2"})
+        try:
+            resp = self._handle_read_inner(req)
+        except BaseException:
+            self.download_limiter.release(est)
+            raise
+        if est:
+            resp.on_sent = lambda: self.download_limiter.release(est)
+        return resp
+
+    def _handle_read_inner(self, req: Request) -> Response:
         denied = self._check_read_jwt(req)
         if denied:
             return denied

@@ -68,6 +68,8 @@ class Response:
                  headers: Optional[dict] = None):
         self.status = status
         self.headers = headers or {}
+        # invoked after the response hits the wire (in-flight accounting)
+        self.on_sent = None
         if isinstance(body, (dict, list)):
             self.body = json.dumps(body).encode()
             self.content_type = "application/json"
@@ -94,6 +96,13 @@ class HttpServer:
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # body_gate(path, content_length) is consulted BEFORE the request
+        # body is read from the socket: it returns a Response to reject
+        # the request unread (413/429 load shedding), a callable to be
+        # invoked once the response is fully sent (in-flight byte
+        # accounting), or None to proceed unthrottled (reference
+        # weed/server/volume_server_handlers.go inFlight*DataLimitCond).
+        self.body_gate = None
 
     def route(self, method: str, pattern: str):
         compiled = re.compile("^" + pattern + "$")
@@ -109,6 +118,7 @@ class HttpServer:
 
     def start(self) -> None:
         routes = self.routes
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -118,22 +128,59 @@ class HttpServer:
 
             def _dispatch(self):
                 length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
                 path = urllib.parse.unquote(
                     urllib.parse.urlparse(self.path).path)
-                for method, pattern, fn in routes:
-                    if method != self.command:
-                        continue
-                    m = pattern.match(path)
-                    if m:
+                on_sent = None
+                gate = server.body_gate
+                if gate is not None and length and \
+                        self.command in ("POST", "PUT"):
+                    verdict = gate(path, length)
+                    if isinstance(verdict, Response):
+                        # reject WITHOUT buffering the body: drain it in
+                        # discarded 64KB chunks (bounded memory) so the
+                        # client finishes sending and can actually read
+                        # the 413/429; truly huge payloads are cut off
+                        # after a few MB like Go's http server does
+                        remaining = min(length, 8 << 20)
                         try:
-                            resp = fn(Request(self, m, body))
-                        except Exception as e:  # surface as 500 JSON
-                            resp = Response({"error": f"{type(e).__name__}: {e}"},
-                                            status=500)
-                        break
-                else:
-                    resp = Response({"error": "not found"}, status=404)
+                            while remaining > 0:
+                                got = self.rfile.read(min(remaining, 65536))
+                                if not got:
+                                    break
+                                remaining -= len(got)
+                        except OSError:
+                            pass
+                        verdict.headers.setdefault("Connection", "close")
+                        self.close_connection = True
+                        self._send(verdict)
+                        return
+                    on_sent = verdict
+                resp = None
+                try:
+                    body = self.rfile.read(length) if length else b""
+                    for method, pattern, fn in routes:
+                        if method != self.command:
+                            continue
+                        m = pattern.match(path)
+                        if m:
+                            try:
+                                resp = fn(Request(self, m, body))
+                            except Exception as e:  # surface as 500 JSON
+                                resp = Response(
+                                    {"error": f"{type(e).__name__}: {e}"},
+                                    status=500)
+                            break
+                    else:
+                        resp = Response({"error": "not found"}, status=404)
+                    self._send(resp)
+                finally:
+                    if on_sent is not None:
+                        on_sent()
+                    cb = getattr(resp, "on_sent", None)
+                    if cb is not None:
+                        cb()
+
+            def _send(self, resp):
                 try:
                     self.send_response(resp.status)
                     self.send_header("Content-Type", resp.content_type)

@@ -1,0 +1,63 @@
+"""Byte-accounted in-flight transfer limiter.
+
+Redesign of reference weed/server/volume_server.go:23-30
+(inFlightUploadDataSize / inFlightDownloadDataSize + their sync.Cond
+backpressure, applied in volume_server_handlers.go): concurrent
+request payload bytes are accounted against a cap; a request that
+would exceed it waits until others drain, up to a timeout, after
+which the caller sheds load (HTTP 429)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class InFlightLimiter:
+    def __init__(self, limit_bytes: int, timeout: float = 30.0):
+        self.limit = limit_bytes  # <= 0 means unlimited
+        self.timeout = timeout
+        self._used = 0
+        self._waiters = 0
+        self._cond = threading.Condition()
+
+    def try_acquire(self, n: int, timeout: float = None) -> bool:
+        """Reserve n bytes; block while the cap is exceeded. Returns
+        False on timeout. A single request larger than the whole cap is
+        admitted once the pipe is empty (matching the reference, which
+        compares BEFORE adding: volume_server_handlers.go:62-75)."""
+        if self.limit <= 0 or n <= 0:
+            with self._cond:
+                self._used += max(n, 0)
+            return True
+        deadline = time.monotonic() + (self.timeout if timeout is None
+                                       else timeout)
+        with self._cond:
+            while self._used > 0 and self._used + n > self.limit:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._waiters += 1
+                try:
+                    self._cond.wait(remaining)
+                finally:
+                    self._waiters -= 1
+            self._used += n
+            return True
+
+    def release(self, n: int) -> None:
+        if n <= 0:
+            return
+        with self._cond:
+            self._used = max(0, self._used - n)
+            self._cond.notify_all()
+
+    @property
+    def in_flight(self) -> int:
+        with self._cond:
+            return self._used
+
+    @property
+    def waiters(self) -> int:
+        with self._cond:
+            return self._waiters
