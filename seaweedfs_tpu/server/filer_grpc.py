@@ -18,7 +18,7 @@ import grpc
 from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
 from seaweedfs_tpu.pb import filer_pb2 as pb
 
-SERVICE = "filer_pb.SeaweedFiler"
+SERVICE = "weedtpu_filer_pb.SeaweedFiler"
 
 
 def _entry_to_pb(e: Entry) -> pb.Entry:
@@ -186,9 +186,76 @@ class FilerGrpc:
                 log.wait_for_events(since, timeout=1.0)
         return
 
+    # ---- volume plane proxies (the pure-gRPC write path: reference
+    # filer.proto:36 AssignVolume + LookupVolume; a client assigns
+    # here, POSTs the payload to the returned url, then CreateEntry) ----
+    def assign_volume(self, request, context):
+        rule = None
+        if request.path:
+            try:
+                # _current_filer_conf reloads per-path rules on a TTL so
+                # fs.configure changes reach the gRPC path too
+                rule = self.fs._current_filer_conf().match_storage_rule(
+                    request.path)
+            except Exception:
+                rule = None
+        collection = request.collection or (rule.collection if rule else "")
+        replication = request.replication or \
+            (rule.replication if rule else "")
+        # TTL grammar has no seconds unit (reference needle.TTL:
+        # m/h/d/w/M/y) — round seconds up to whole minutes
+        ttl = f"{-(-request.ttl_sec // 60)}m" if request.ttl_sec else \
+            (rule.ttl if rule else "")
+        try:
+            a = self.fs.mc.assign(count=max(request.count, 1),
+                                  collection=collection,
+                                  replication=replication, ttl=ttl,
+                                  data_center=request.data_center)
+        except Exception as e:
+            return pb.AssignVolumeResponse(error=str(e))
+        if a.get("error"):
+            return pb.AssignVolumeResponse(error=a["error"])
+        return pb.AssignVolumeResponse(
+            file_id=a["fid"], url=a["url"],
+            public_url=a.get("publicUrl", a["url"]),
+            count=a.get("count", 1), collection=collection,
+            replication=replication)
+
+    def lookup_volume(self, request, context):
+        resp = pb.LookupVolumeResponse()
+        for vid_str in request.volume_ids:
+            try:
+                vid = int(vid_str.split(",")[0])
+            except ValueError:
+                continue
+            locs = pb.Locations()
+            for loc in self.fs.mc.lookup_volume(vid):
+                locs.locations.append(pb.Location(
+                    url=loc.get("url", ""),
+                    public_url=loc.get("publicUrl", loc.get("url", ""))))
+            resp.locations_map[vid_str].CopyFrom(locs)
+        return resp
+
     # ---- misc ----
     def statistics(self, request, context):
-        return pb.StatisticsResponse()
+        """Aggregate capacity from the master topology (reference
+        filer_grpc_server.go Statistics proxies to the master)."""
+        try:
+            topo = self.fs.mc.topology()
+        except Exception:
+            return pb.StatisticsResponse()
+        total_slots = used = files = 0
+        topology = topo.get("Topology", topo)
+        limit = topo.get("VolumeSizeLimitMB", 0) * 1024 * 1024
+        for dc in topology.get("data_centers", []):
+            for rack in dc.get("racks", []):
+                for dn in rack.get("nodes", []):
+                    for v in dn.get("volumes", []):
+                        used += v.get("size", 0)
+                        files += v.get("file_count", 0)
+                    total_slots += dn.get("max_volume_count", 0)
+        return pb.StatisticsResponse(total_size=total_slots * limit,
+                                     used_size=used, file_count=files)
 
     def get_configuration(self, request, context):
         return pb.GetFilerConfigurationResponse(
@@ -225,6 +292,12 @@ class FilerGrpc:
             "SubscribeMetadata": ustream(self.subscribe_metadata,
                                          pb.SubscribeMetadataRequest,
                                          pb.SubscribeMetadataResponse),
+            "AssignVolume": unary(self.assign_volume,
+                                  pb.AssignVolumeRequest,
+                                  pb.AssignVolumeResponse),
+            "LookupVolume": unary(self.lookup_volume,
+                                  pb.LookupVolumeRequest,
+                                  pb.LookupVolumeResponse),
             "KvGet": unary(self.kv_get, pb.KvGetRequest, pb.KvGetResponse),
             "KvPut": unary(self.kv_put, pb.KvPutRequest, pb.KvPutResponse),
             "Statistics": unary(self.statistics, pb.StatisticsRequest,
@@ -299,6 +372,32 @@ class GrpcFilerClient:
             old_directory=old_dir, old_name=old_name,
             new_directory=new_dir, new_name=new_name),
             pb.AtomicRenameEntryResponse)
+
+    def assign_volume(self, count: int = 1, collection: str = "",
+                      replication: str = "", ttl_sec: int = 0,
+                      path: str = "") -> pb.AssignVolumeResponse:
+        r = self._unary("AssignVolume", pb.AssignVolumeRequest(
+            count=count, collection=collection, replication=replication,
+            ttl_sec=ttl_sec, path=path), pb.AssignVolumeResponse)
+        if r.error:
+            raise RuntimeError(r.error)
+        return r
+
+    def lookup_volume(self, volume_ids: list[str]
+                      ) -> dict[str, list[str]]:
+        r = self._unary("LookupVolume", pb.LookupVolumeRequest(
+            volume_ids=volume_ids), pb.LookupVolumeResponse)
+        return {vid: [l.url for l in locs.locations]
+                for vid, locs in r.locations_map.items()}
+
+    def statistics(self) -> pb.StatisticsResponse:
+        return self._unary("Statistics", pb.StatisticsRequest(),
+                           pb.StatisticsResponse)
+
+    def get_configuration(self) -> pb.GetFilerConfigurationResponse:
+        return self._unary("GetFilerConfiguration",
+                           pb.GetFilerConfigurationRequest(),
+                           pb.GetFilerConfigurationResponse)
 
     def kv_get(self, key: bytes) -> Optional[bytes]:
         r = self._unary("KvGet", pb.KvGetRequest(key=key), pb.KvGetResponse)

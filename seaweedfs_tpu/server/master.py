@@ -482,6 +482,8 @@ class MasterServer:
 
     def _handle_dir_status(self, req: Request) -> Response:
         return Response({"Topology": self.topo.to_info(),
+                         "VolumeSizeLimitMB":
+                         self.topo.volume_size_limit // (1024 * 1024),
                          "Version": "seaweedfs-tpu 0.1"})
 
     def _handle_grow(self, req: Request) -> Response:

@@ -25,7 +25,7 @@ from seaweedfs_tpu.storage.file_id import FileId
 from seaweedfs_tpu.storage.volume import DeletedError, NotFoundError
 from seaweedfs_tpu.utils.httpd import LocalRequest
 
-SERVICE = "volume_server_pb.VolumeServer"
+SERVICE = "weedtpu_volume_server_pb.VolumeServer"
 STREAM_CHUNK = 256 * 1024
 
 
@@ -302,6 +302,224 @@ class VolumeGrpc:
             off += len(data)
             remaining -= len(data)
 
+    # ---- replica catch-up (reference volume_server.proto:31,64;
+    # volume_grpc_tail.go) ----
+    def _records_since(self, volume_id: int, since_ns: int,
+                       normalize_v3: bool = False):
+        """Yield (needle, raw_record) for every record appended after
+        since_ns, in log order. Deletion records are included — a
+        catching-up replica must replay those too.
+
+        The scan is header-only until a record qualifies: for v3 the
+        append_at_ns rides at a fixed position (header + size + crc),
+        so old records cost one 8-byte pread each instead of a full
+        body read — a periodic tail poll is O(records), not O(bytes)
+        (the reference seeks from a known offset, volume_grpc_tail.go;
+        without one this is the next best).
+
+        normalize_v3 re-serializes v1/v2 records as v3 so the receiving
+        side can parse one wire version."""
+        from seaweedfs_tpu.storage import types as t
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.storage.super_block import SuperBlock
+        v = self.vs.store.find_volume(volume_id)
+        if v is None:
+            raise _RpcError(grpc.StatusCode.NOT_FOUND, "volume not found")
+        v.sync()
+        path = v.file_name() + ".dat"
+        size_total = os.path.getsize(path)
+        with open(path, "rb") as f:
+            import struct
+            sb = SuperBlock.parse(f.read(8 + 65536)[:8 + 65536])
+            offset = (sb.block_size + t.NEEDLE_PADDING_SIZE - 1) \
+                // t.NEEDLE_PADDING_SIZE * t.NEEDLE_PADDING_SIZE
+            version = sb.version
+            if version < 3 and since_ns > 0:
+                # v1/v2 records carry no append timestamp; a cursor'd
+                # tail CANNOT be answered — failing loudly beats
+                # returning an empty stream the caller reads as
+                # "in sync" (use a full VolumeCopy instead)
+                raise _RpcError(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"volume version {version} has no append_at_ns; "
+                    "tail requires since_ns=0 or a full copy")
+            fd = f.fileno()
+            while offset + t.NEEDLE_HEADER_SIZE <= size_total:
+                header = os.pread(fd, t.NEEDLE_HEADER_SIZE, offset)
+                if len(header) < t.NEEDLE_HEADER_SIZE:
+                    break
+                hn = Needle.parse_header(header)
+                if hn.size < 0:
+                    break
+                record_len = t.get_actual_size(hn.size, version)
+                if offset + record_len > size_total:
+                    break
+                ts = 0
+                if version == 3:
+                    raw_ts = os.pread(
+                        fd, t.TIMESTAMP_SIZE,
+                        offset + t.NEEDLE_HEADER_SIZE + hn.size
+                        + t.NEEDLE_CHECKSUM_SIZE)
+                    if len(raw_ts) == t.TIMESTAMP_SIZE:
+                        ts, = struct.unpack(">Q", raw_ts)
+                if ts > since_ns or (version < 3 and since_ns == 0):
+                    blob = os.pread(fd, record_len, offset)
+                    try:
+                        n = Needle.from_bytes(blob, hn.size, version,
+                                              check_crc=False)
+                    except Exception:
+                        break
+                    if normalize_v3 and version != 3:
+                        blob = n.to_bytes(3)
+                    yield n, blob
+                offset += record_len
+
+    @_guard
+    def volume_incremental_copy(self, request, context
+                                ) -> Iterator["pb.VolumeIncrementalCopyResponse"]:
+        buf = bytearray()
+        for _, raw in self._records_since(request.volume_id,
+                                          request.since_ns):
+            buf.extend(raw)
+            while len(buf) >= STREAM_CHUNK:
+                yield pb.VolumeIncrementalCopyResponse(
+                    file_content=bytes(buf[:STREAM_CHUNK]))
+                del buf[:STREAM_CHUNK]
+        if buf:
+            yield pb.VolumeIncrementalCopyResponse(file_content=bytes(buf))
+
+    @_guard
+    def volume_tail_sender(self, request, context
+                           ) -> Iterator["pb.VolumeTailSenderResponse"]:
+        from seaweedfs_tpu.storage import types as t
+        for _, raw in self._records_since(request.volume_id,
+                                          request.since_ns,
+                                          normalize_v3=True):
+            header = raw[:t.NEEDLE_HEADER_SIZE]
+            body = raw[t.NEEDLE_HEADER_SIZE:]
+            # large needles stream in body pieces; the header rides the
+            # first message, is_last_chunk closes the record
+            first = True
+            pos = 0
+            while True:
+                piece = body[pos:pos + STREAM_CHUNK]
+                pos += len(piece)
+                last = pos >= len(body)
+                yield pb.VolumeTailSenderResponse(
+                    needle_header=header if first else b"",
+                    needle_body=piece, is_last_chunk=last)
+                first = False
+                if last:
+                    break
+
+    @_guard
+    def volume_tail_receiver(self, request, context):
+        """Pull a tail FROM a peer and apply it locally — the replica
+        catch-up entry point (reference volume_grpc_tail.go
+        VolumeTailReceiver)."""
+        v = self.vs.store.find_volume(request.volume_id)
+        if v is None:
+            raise _RpcError(grpc.StatusCode.NOT_FOUND, "volume not found")
+        client = GrpcVolumeClient(request.source_volume_server)
+        try:
+            applied = 0
+            for n in client.volume_tail_needles(request.volume_id,
+                                                request.since_ns):
+                if n.size == 0 and not n.data:
+                    v.delete_needle(n.id)
+                else:
+                    v.write_needle(n)
+                applied += 1
+            return pb.VolumeTailReceiverResponse()
+        finally:
+            client.close()
+
+    @_guard
+    def read_volume_file_status(self, request, context):
+        v = self.vs.store.find_volume(request.volume_id)
+        if v is None:
+            raise _RpcError(grpc.StatusCode.NOT_FOUND, "volume not found")
+        v.sync()
+        base = v.file_name()
+        resp = pb.ReadVolumeFileStatusResponse(
+            volume_id=request.volume_id,
+            collection=v.collection,
+            file_count=v.file_count(),
+            compaction_revision=getattr(v.super_block,
+                                        "compaction_revision", 0),
+            last_append_at_ns=v.last_append_at_ns)
+        for ext, ts_field, size_field in (
+                (".idx", "idx_file_timestamp_seconds", "idx_file_size"),
+                (".dat", "dat_file_timestamp_seconds", "dat_file_size")):
+            try:
+                st = os.stat(base + ext)
+                setattr(resp, ts_field, int(st.st_mtime))
+                setattr(resp, size_field, st.st_size)
+            except OSError:
+                pass
+        return resp
+
+    @_guard
+    def volume_needle_status(self, request, context):
+        try:
+            n = self.vs.store.read_volume_needle(request.volume_id,
+                                                 request.needle_id, None)
+        except (NotFoundError, DeletedError) as e:
+            raise _RpcError(grpc.StatusCode.NOT_FOUND, str(e))
+        return pb.VolumeNeedleStatusResponse(
+            needle_id=n.id, cookie=n.cookie, size=len(n.data),
+            last_modified=n.last_modified, crc=n.checksum,
+            ttl=n.ttl.hex() if n.ttl else "")
+
+    def ping(self, request, context):
+        import time as _time
+        start = _time.time_ns()
+        remote = start
+        if request.target:
+            from seaweedfs_tpu.utils.httpd import http_call
+            try:
+                http_call("GET", f"http://{request.target}/status",
+                          timeout=5)
+                remote = _time.time_ns()
+            except Exception as e:
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        return pb.PingResponse(start_time_ns=start,
+                               remote_time_ns=remote,
+                               stop_time_ns=_time.time_ns())
+
+    @_guard
+    def query(self, request, context) -> Iterator["pb.QueriedStripe"]:
+        """Server-side JSON scan over needles (reference Query rpc +
+        weed/query/json): projections/filter run where the data lives,
+        only matching rows cross the wire."""
+        from seaweedfs_tpu.query.json_query import (Predicate,
+                                                    query_json_lines)
+        preds = []
+        if request.HasField("filter") and request.filter.field:
+            val = request.filter.value
+            for cast in (int, float):
+                try:
+                    val = cast(request.filter.value)
+                    break
+                except ValueError:
+                    continue
+            preds = [Predicate(request.filter.field,
+                               request.filter.operand or "=", val)]
+        selections = list(request.selections)
+        for fid in request.from_file_ids:
+            f = FileId.parse(fid)
+            try:
+                n = self.vs.store.read_volume_needle(f.volume_id, f.key,
+                                                     f.cookie)
+            except (NotFoundError, DeletedError):
+                continue
+            out = []
+            for doc in query_json_lines(n.data, selections or None, preds):
+                out.append(json.dumps(doc))
+            if out:
+                yield pb.QueriedStripe(
+                    records=("\n".join(out) + "\n").encode())
+
     # ---- registration ----
     def handlers(self) -> grpc.GenericRpcHandler:
         def unary(fn, req_cls, resp_cls):
@@ -385,6 +603,26 @@ class VolumeGrpc:
             "VolumeEcShardsToVolume": unary(
                 self.ec_to_volume, pb.VolumeEcShardsToVolumeRequest,
                 pb.VolumeEcShardsToVolumeResponse),
+            "VolumeIncrementalCopy": ustream(
+                self.volume_incremental_copy,
+                pb.VolumeIncrementalCopyRequest,
+                pb.VolumeIncrementalCopyResponse),
+            "VolumeTailSender": ustream(
+                self.volume_tail_sender, pb.VolumeTailSenderRequest,
+                pb.VolumeTailSenderResponse),
+            "VolumeTailReceiver": unary(
+                self.volume_tail_receiver, pb.VolumeTailReceiverRequest,
+                pb.VolumeTailReceiverResponse),
+            "ReadVolumeFileStatus": unary(
+                self.read_volume_file_status,
+                pb.ReadVolumeFileStatusRequest,
+                pb.ReadVolumeFileStatusResponse),
+            "VolumeNeedleStatus": unary(
+                self.volume_needle_status, pb.VolumeNeedleStatusRequest,
+                pb.VolumeNeedleStatusResponse),
+            "Ping": unary(self.ping, pb.PingRequest, pb.PingResponse),
+            "Query": ustream(self.query, pb.QueryRequest,
+                             pb.QueriedStripe),
         }
         return grpc.method_handlers_generic_handler(SERVICE, rpcs)
 
@@ -457,6 +695,86 @@ class GrpcVolumeClient:
                                file_ids=file_ids,
                                skip_cookie_check=skip_cookie_check),
                            pb.BatchDeleteResponse)
+
+    # ---- replica catch-up ----
+    def volume_tail_needles(self, volume_id: int, since_ns: int = 0):
+        """Iterate needles a peer appended after since_ns (reassembled
+        from the VolumeTailSender stream)."""
+        from seaweedfs_tpu.storage.needle import Needle
+        fn = self.channel.unary_stream(
+            f"/{SERVICE}/VolumeTailSender",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.VolumeTailSenderResponse.FromString)
+        header = b""
+        body = bytearray()
+        for msg in fn(pb.VolumeTailSenderRequest(
+                volume_id=volume_id, since_ns=since_ns), timeout=600):
+            if msg.needle_header:
+                header, body = bytes(msg.needle_header), bytearray()
+            body += msg.needle_body
+            if msg.is_last_chunk:
+                raw = header + bytes(body)
+                n = Needle.parse_header(header)
+                yield Needle.from_bytes(raw, n.size, 3, check_crc=False)
+                header, body = b"", bytearray()
+
+    def volume_incremental_copy(self, volume_id: int,
+                                since_ns: int = 0) -> bytes:
+        """Raw appended record bytes since a timestamp (reference
+        VolumeIncrementalCopy: the caller appends them to its .dat)."""
+        fn = self.channel.unary_stream(
+            f"/{SERVICE}/VolumeIncrementalCopy",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.VolumeIncrementalCopyResponse.FromString)
+        out = bytearray()
+        for msg in fn(pb.VolumeIncrementalCopyRequest(
+                volume_id=volume_id, since_ns=since_ns), timeout=600):
+            out += msg.file_content
+        return bytes(out)
+
+    def volume_tail_receiver(self, volume_id: int, since_ns: int,
+                             source: str) -> None:
+        self._unary("VolumeTailReceiver", pb.VolumeTailReceiverRequest(
+            volume_id=volume_id, since_ns=since_ns,
+            source_volume_server=source), pb.VolumeTailReceiverResponse)
+
+    def read_volume_file_status(self, volume_id: int
+                                ) -> pb.ReadVolumeFileStatusResponse:
+        return self._unary("ReadVolumeFileStatus",
+                           pb.ReadVolumeFileStatusRequest(
+                               volume_id=volume_id),
+                           pb.ReadVolumeFileStatusResponse)
+
+    def volume_needle_status(self, volume_id: int, needle_id: int
+                             ) -> pb.VolumeNeedleStatusResponse:
+        return self._unary("VolumeNeedleStatus",
+                           pb.VolumeNeedleStatusRequest(
+                               volume_id=volume_id, needle_id=needle_id),
+                           pb.VolumeNeedleStatusResponse)
+
+    def ping(self, target: str = "", target_type: str = ""
+             ) -> pb.PingResponse:
+        return self._unary("Ping", pb.PingRequest(
+            target=target, target_type=target_type), pb.PingResponse,
+            timeout=10)
+
+    def query(self, file_ids: list[str], selections: list[str] = (),
+              filter_field: str = "", filter_op: str = "=",
+              filter_value: str = "") -> bytes:
+        fn = self.channel.unary_stream(
+            f"/{SERVICE}/Query",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.QueriedStripe.FromString)
+        req = pb.QueryRequest(selections=list(selections),
+                              from_file_ids=list(file_ids))
+        if filter_field:
+            req.filter.field = filter_field
+            req.filter.operand = filter_op
+            req.filter.value = filter_value
+        out = bytearray()
+        for stripe in fn(req, timeout=300):
+            out += stripe.records
+        return bytes(out)
 
     # HTTP-admin-path compatible dispatch used by the shell applier.
     # Returns a dict shaped like the HTTP JSON body.
