@@ -43,10 +43,12 @@ class RemoteConf:
     type: str = "local"
     # local backend
     root: str = ""
-    # s3-style backend plug point
+    # s3 backend (any S3-compatible endpoint, incl. our own gateway)
     endpoint: str = ""
     access_key: str = ""
     secret_key: str = ""
+    bucket: str = ""
+    region: str = "us-east-1"
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -159,6 +161,14 @@ def make_remote_client(conf: RemoteConf) -> RemoteStorageClient:
         if not conf.root:
             raise ValueError("local remote needs a root directory")
         return LocalDirRemote(conf.root)
+    if conf.type == "s3":
+        from seaweedfs_tpu.remote_storage.s3_client import S3Remote
+        if not conf.endpoint or not conf.bucket:
+            raise ValueError("s3 remote needs endpoint and bucket")
+        return S3Remote(conf.endpoint, conf.bucket,
+                        access_key=conf.access_key,
+                        secret_key=conf.secret_key, region=conf.region)
     raise NotImplementedError(
         f"remote type {conf.type!r}: cloud SDKs are not available in this "
-        "environment; implement a RemoteStorageClient and register it")
+        "environment (gcs/azure/b2 would each need their own dialect); "
+        "implement a RemoteStorageClient and register it here")

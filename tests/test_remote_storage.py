@@ -38,6 +38,9 @@ def test_local_remote_client(tmp_path):
 
 def test_unknown_remote_type_is_plug_point():
     with pytest.raises(NotImplementedError):
+        make_remote_client(RemoteConf(name="x", type="gcs"))
+    # s3 is a real client now; misconfiguration is a ValueError
+    with pytest.raises(ValueError):
         make_remote_client(RemoteConf(name="x", type="s3"))
 
 
