@@ -27,23 +27,29 @@ import numpy as np
 
 
 def bench_cpu(batch_bytes: int = 256 * 1024, n_batches: int = 32,
-              iters: int = 3) -> float:
+              iters: int = 7) -> float:
     """One-core CPU encode in the reference's own shape: 256KB per-shard
     batches (ec_encoder.go:162-192 encodes 10x256KB buffer batches), but
     cycling through n_batches distinct batches so the data streams through
     the cache hierarchy like a real volume encode instead of re-hitting
-    one L2-resident batch."""
+    one L2-resident batch.
+
+    The denominator is the MEDIAN of `iters` timed sweeps (round-3
+    verdict weak #7: 3 averaged sweeps drifted vs_baseline +-15%
+    between identical rounds; the median of 7 pins it)."""
     from seaweedfs_tpu.models.coder import RSScheme, make_coder
     coder = make_coder("cpu", RSScheme(10, 4))
     rng = np.random.default_rng(0)
     batches = [rng.integers(0, 256, (10, batch_bytes), dtype=np.uint8)
                for _ in range(n_batches)]
     coder.encode_array(batches[0])  # warm
-    t0 = time.perf_counter()
+    sweeps = []
     for _ in range(iters):
+        t0 = time.perf_counter()
         for b in batches:
             coder.encode_array(b)
-    dt = (time.perf_counter() - t0) / iters
+        sweeps.append(time.perf_counter() - t0)
+    dt = sorted(sweeps)[len(sweeps) // 2]
     return n_batches * 10 * batch_bytes / dt / 1e6
 
 

@@ -315,18 +315,53 @@ def cmd_benchmark(args):
                 tcp_clients[key] = c
         return c
 
+    class FidDispenser:
+        """Batch the assign plane: one master round-trip mints
+        `batch` sequential keys (same cookie, key+i), the documented
+        count=N semantics (reference operation/assign_file_id.go) —
+        so the write loop measures the DATA path."""
+
+        def __init__(self, mc, batch: int):
+            import threading as _th
+            self.mc = mc
+            self.batch = max(1, batch)
+            self.lock = _th.Lock()
+            self.queue: list[tuple[str, str]] = []
+
+        def next(self) -> tuple[str, str, str]:
+            from seaweedfs_tpu.storage.file_id import (
+                format_needle_id_cookie, parse_needle_id_cookie)
+            with self.lock:
+                if not self.queue:
+                    a = self.mc.assign(count=self.batch)
+                    if a.get("error"):
+                        raise SystemExit(a["error"])
+                    if a.get("auth") and self.batch > 1:
+                        # JWT-secured cluster: the token covers only the
+                        # base fid, so batched key derivation can't be
+                        # authorized — fall back to per-file assigns
+                        self.batch = 1
+                    vid, rest = a["fid"].split(",", 1)
+                    key, cookie = parse_needle_id_cookie(rest)
+                    count = 1 if a.get("auth") else a.get("count", 1)
+                    self.queue = [
+                        (f"{vid},{format_needle_id_cookie(key + i, cookie)}",
+                         a["url"], a.get("auth", ""))
+                        for i in range(count)]
+                return self.queue.pop()
+
+    dispenser = FidDispenser(mc, args.assignBatch)
     fids = []
     t0 = time.perf_counter()
     lat = []
 
     def write_one(i):
         s = time.perf_counter()
+        fid, url, auth = dispenser.next()
         if args.useTcp:
-            a = mc.assign()
-            tcp_client_for(a["url"]).write(a["fid"], payload)
-            fid = a["fid"]
+            tcp_client_for(url).write(fid, payload)
         else:
-            fid = operation.upload_data(mc, payload, name=f"bench{i}").fid
+            operation.upload_to(fid, url, payload, auth=auth)
         lat.append(time.perf_counter() - s)
         return fid
 
@@ -412,7 +447,7 @@ def main(argv=None):
     fl.add_argument("-port", type=int, default=8888)
     fl.add_argument("-master", default="127.0.0.1:9333")
     fl.add_argument("-store", default="memory",
-                    choices=["memory", "sqlite", "lsm"])
+                    choices=["memory", "sqlite", "lsm", "redis"])
     fl.add_argument("-dir", default=".", help="store/state directory")
     fl.add_argument("-defaultReplication", default="")
     fl.add_argument("-encryptVolumeData", action="store_true",
@@ -502,6 +537,8 @@ def main(argv=None):
     b.add_argument("-n", type=int, default=1000)
     b.add_argument("-size", type=int, default=1024)
     b.add_argument("-concurrency", type=int, default=16)
+    b.add_argument("-assignBatch", type=int, default=16,
+                   help="keys minted per master assign (count=N)")
     b.add_argument("-useTcp", action="store_true",
                    help="use the raw TCP data path (reference -useTcp)")
     b.set_defaults(fn=cmd_benchmark)

@@ -274,10 +274,15 @@ class Volume:
 
     # ---- stats ----
     def content_size(self) -> int:
-        if self._backend is not None:
-            return self._backend.size()
-        self._dat.seek(0, os.SEEK_END)
-        return self._dat.tell()
+        # MUST hold the lock: the heartbeat thread calls this while
+        # readers seek the same shared handle — an unlocked seek here
+        # lands a concurrent read at EOF (observed as empty-buffer
+        # parse failures under benchmark load)
+        with self._lock:
+            if self._backend is not None:
+                return self._backend.size()
+            self._dat.seek(0, os.SEEK_END)
+            return self._dat.tell()
 
     @property
     def is_tiered(self) -> bool:
@@ -430,6 +435,24 @@ class Volume:
                 os.fsync(self._dat.fileno())
             self._idx.flush()
             os.fsync(self._idx.fileno())
+
+    def configure_replication(self, replication: str) -> None:
+        """Rewrite the superblock's replica placement in place
+        (reference volume_super_block.go MaybeWriteSuperBlock /
+        shell command_volume_configure_replication.go): only byte 1 of
+        the 8-byte header changes."""
+        with self._lock:
+            if self._backend is not None:
+                raise PermissionError("tiered volume is read-only")
+            self.super_block.replica_placement = \
+                ReplicaPlacement.parse(replication)
+            self._dat.flush()
+            pos = self._dat.tell()
+            self._dat.seek(0)
+            self._dat.write(self.super_block.to_bytes()
+                            [:8])  # fixed header only, extra untouched
+            self._dat.flush()
+            self._dat.seek(pos)
 
     def _close_nm(self) -> None:
         close = getattr(self.nm, "close", None)

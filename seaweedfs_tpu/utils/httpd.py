@@ -122,6 +122,12 @@ class HttpServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # buffered response writes + no Nagle: headers and body
+            # coalesce into one segment instead of trickling out in
+            # tiny writes that collide with delayed ACKs (a flat
+            # +40ms/request on keep-alive connections otherwise)
+            wbufsize = 64 * 1024
+            disable_nagle_algorithm = True
 
             def log_message(self, *args):
                 pass
@@ -202,8 +208,40 @@ class HttpServer:
             do_OPTIONS = do_PROPFIND = do_PROPPATCH = _dispatch
             do_MKCOL = do_MOVE = do_COPY = do_LOCK = do_UNLOCK = _dispatch
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
-        self._httpd.daemon_threads = True
+        class Server(ThreadingHTTPServer):
+            """Tracks live per-connection sockets so stop() can sever
+            them. Without this, keep-alive clients (the pooled
+            http_call) keep riding ESTABLISHED sockets into a server
+            whose listener is closed but whose handler threads live on
+            — a stopped in-process master would keep answering
+            heartbeats like a zombie."""
+            daemon_threads = True
+
+            def __init__(self, *a, **k):
+                self.live_conns: set = set()
+                self._conn_lock = threading.Lock()
+                super().__init__(*a, **k)
+
+            def process_request(self, request, client_address):
+                with self._conn_lock:
+                    self.live_conns.add(request)
+                super().process_request(request, client_address)
+
+            def shutdown_request(self, request):
+                with self._conn_lock:
+                    self.live_conns.discard(request)
+                super().shutdown_request(request)
+
+            def close_all_connections(self):
+                with self._conn_lock:
+                    conns = list(self.live_conns)
+                for sock in conns:
+                    try:
+                        sock.shutdown(2)  # SHUT_RDWR: unblock handlers
+                    except OSError:
+                        pass
+
+        self._httpd = Server((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -212,6 +250,7 @@ class HttpServer:
     def stop(self) -> None:
         if self._httpd:
             self._httpd.shutdown()
+            self._httpd.close_all_connections()
             self._httpd.server_close()
             self._httpd = None
 
@@ -223,6 +262,55 @@ class HttpError(Exception):
         super().__init__(f"HTTP {status}: {body[:200]!r}")
 
 
+# Thread-local keep-alive connection pool: one persistent HTTP/1.1
+# connection per (thread, host). The data path makes millions of tiny
+# requests; per-request TCP setup/teardown (urllib's behavior) costs
+# more than the request itself and floods TIME_WAIT. The reference
+# leans on Go's pooled http.Transport the same way
+# (weed/util/http_util.go).
+_conn_local = threading.local()
+
+
+def _make_conn(netloc: str, timeout: float):
+    import http.client
+
+    class NoDelayConn(http.client.HTTPConnection):
+        def connect(self):
+            super().connect()
+            self.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+
+    return NoDelayConn(netloc, timeout=timeout)
+
+
+def _pooled_conn(netloc: str, timeout: float):
+    """Returns (conn, reused): `reused` is True when the socket was
+    already open from a previous request — the only case where an
+    automatic retry is safe (a stale kept-alive socket fails before the
+    server sees anything; a fresh connection that dies mid-response may
+    have EXECUTED the request, so replaying it is the caller's call)."""
+    pool = getattr(_conn_local, "conns", None)
+    if pool is None:
+        pool = _conn_local.conns = {}
+    conn = pool.get(netloc)
+    if conn is None:
+        conn = _make_conn(netloc, timeout)
+        pool[netloc] = conn
+        return conn, False
+    if conn.sock is None:
+        return conn, False
+    conn.sock.settimeout(timeout)
+    return conn, True
+
+
+def _drop_conn(netloc: str) -> None:
+    pool = getattr(_conn_local, "conns", None)
+    if pool is not None:
+        conn = pool.pop(netloc, None)
+        if conn is not None:
+            conn.close()
+
+
 def http_call(method: str, url: str, body: Optional[bytes] = None,
               json_body: Any = None, timeout: float = 30.0,
               headers: Optional[dict] = None) -> tuple[int, bytes, dict]:
@@ -230,15 +318,52 @@ def http_call(method: str, url: str, body: Optional[bytes] = None,
         body = json.dumps(json_body).encode()
         headers = dict(headers or {})
         headers["Content-Type"] = "application/json"
-    req = urllib.request.Request(url, data=body, method=method.upper(),
-                                 headers=headers or {})
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return r.status, r.read(), dict(r.headers)
-    except urllib.error.HTTPError as e:
-        return e.code, e.read(), dict(e.headers)
-    except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
-        raise ConnectionError(f"{method} {url}: {e}") from e
+    parsed = urllib.parse.urlsplit(url)
+    if parsed.scheme == "https":  # rare path: no pooling, plain urllib
+        req = urllib.request.Request(url, data=body, method=method.upper(),
+                                     headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, r.read(), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+        except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
+            raise ConnectionError(f"{method} {url}: {e}") from e
+    target = parsed.path or "/"
+    if parsed.query:
+        target += "?" + parsed.query
+    method = method.upper()
+    import http.client
+    last_err = None
+    for attempt in (0, 1):
+        conn, reused = _pooled_conn(parsed.netloc, timeout)
+        sent = False
+        try:
+            conn.request(method, target, body=body, headers=headers or {})
+            sent = True
+            r = conn.getresponse()
+            data = r.read()
+            resp_headers = dict(r.headers)
+            if r.will_close:
+                _drop_conn(parsed.netloc)
+            return r.status, data, resp_headers
+        except (http.client.HTTPException, BrokenPipeError,
+                ConnectionResetError, ConnectionRefusedError,
+                ConnectionAbortedError, socket.timeout, OSError) as e:
+            _drop_conn(parsed.netloc)
+            last_err = e
+            # Replay rules (Go http.Transport's): only on a REUSED
+            # kept-alive socket, and only when the request either
+            # failed during SEND (server closed it idle; it never
+            # executed) or is idempotent (GET/HEAD). A non-idempotent
+            # POST that died mid-response may have executed — surface
+            # the error rather than silently running it twice.
+            idempotent = method in ("GET", "HEAD")
+            if not reused or (sent and not idempotent) or \
+                    isinstance(e, (ConnectionRefusedError,
+                                   socket.timeout)):
+                break
+    raise ConnectionError(f"{method} {url}: {last_err}") from last_err
 
 
 def http_json(method: str, url: str, json_body: Any = None,
