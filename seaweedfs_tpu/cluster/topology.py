@@ -52,6 +52,7 @@ class DataNode:
         return {
             "id": self.id, "ip": self.ip, "port": self.port,
             "public_url": self.public_url,
+            "grpc_port": getattr(self, "grpc_port", 0),
             "max_volume_count": self.max_volume_count,
             "volumes": list(self.volumes.values()),
             "ec_shards": [
@@ -226,6 +227,7 @@ class Topology:
                 hb["ip"], hb["port"], hb.get("public_url", ""),
                 hb.get("max_volume_count", 8))
             node.last_seen = time.time()
+            node.grpc_port = hb.get("grpc_port", 0)
             prev_vids = set(node.volumes)
             prev_ec_vids = set(node.ec_shards)
 

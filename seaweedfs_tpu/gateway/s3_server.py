@@ -143,31 +143,20 @@ class S3Server:
     @staticmethod
     def _signing_key(secret: str, date: str, region: str,
                      service: str) -> bytes:
-        k = ("AWS4" + secret).encode()
-        for msg in (date, region, service, "aws4_request"):
-            k = hmac.new(k, msg.encode(), hashlib.sha256).digest()
-        return k
+        from seaweedfs_tpu.utils import sigv4
+        return sigv4.signing_key(secret, date, region, service)
 
-    @classmethod
-    def _sig_v4(cls, secret: str, date: str, region: str, service: str,
+    @staticmethod
+    def _sig_v4(secret: str, date: str, region: str, service: str,
                 amz_date: str, method: str, path: str,
                 query: dict, headers, signed_headers: list[str],
                 payload_hash: str) -> str:
-        cq = "&".join(
-            f"{urllib.parse.quote(k, safe='~')}="
-            f"{urllib.parse.quote(v, safe='~')}"
-            for k, v in sorted(query.items()))
-        ch = "".join(f"{h}:{headers.get(h, '').strip()}\n"
-                     for h in signed_headers)
-        # `path` is the wire path, still percent-encoded exactly as the
-        # client signed it — use it verbatim (re-quoting double-encodes)
-        creq = "\n".join([method, path, cq, ch,
-                          ";".join(signed_headers), payload_hash])
-        scope = f"{date}/{region}/{service}/aws4_request"
-        sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
-                         hashlib.sha256(creq.encode()).hexdigest()])
-        k = cls._signing_key(secret, date, region, service)
-        return hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+        # single shared canonicalization — the remote-storage S3 client
+        # signs with the SAME function (utils/sigv4.py)
+        from seaweedfs_tpu.utils import sigv4
+        return sigv4.signature(secret, date, region, service, amz_date,
+                               method, path, query, headers,
+                               signed_headers, payload_hash)
 
     def _check_presigned(self, req: Request) -> Optional[Response]:
         """Presigned-URL (query-string) SigV4, reference
@@ -549,8 +538,12 @@ class S3Server:
                       ) -> tuple[Optional[Response], str]:
         """Create the object entry; returns (error Response or None,
         etag hex)."""
-        if self.filer.find_entry(f"{BUCKETS_PATH}/{bucket}") is None:
+        bucket_entry = self.filer.find_entry(f"{BUCKETS_PATH}/{bucket}")
+        if bucket_entry is None:
             return _err("NoSuchBucket", bucket, 404), ""
+        denied = self._check_quota(bucket, bucket_entry, len(data))
+        if denied is not None:
+            return denied, ""
         md5 = hashlib.md5(data).digest()
         now = time.time()
         entry = Entry(
@@ -565,6 +558,45 @@ class S3Server:
             entry.chunks = self.fs._upload_chunks(data, bucket, "")
         self.filer.create_entry(entry)
         return None, md5.hex()
+
+    # bucket usage cache: bucket -> (expires, bytes). Quota checks walk
+    # the subtree at most every TTL; successful writes bump the cached
+    # figure so bursts can't overshoot by more than one TTL of writes.
+    QUOTA_USAGE_TTL = 5.0
+
+    def _check_quota(self, bucket: str, bucket_entry: Entry,
+                     incoming: int) -> Optional[Response]:
+        """Per-bucket size quota (reference
+        shell command_s3_bucket_quota.go + s3api quota enforcement):
+        quota_bytes rides the bucket entry's extended attrs."""
+        raw = bucket_entry.extended.get("quota_bytes", b"")
+        if isinstance(raw, bytes):
+            raw = raw.decode() if raw else ""
+        if not raw or int(raw) <= 0:
+            return None
+        quota = int(raw)
+        if not hasattr(self, "_usage_cache"):
+            self._usage_cache = {}
+        now = time.time()
+        hit = self._usage_cache.get(bucket)
+        if hit is None or hit[0] < now:
+            used = self._subtree_size(f"{BUCKETS_PATH}/{bucket}")
+            self._usage_cache[bucket] = [now + self.QUOTA_USAGE_TTL, used]
+        entry = self._usage_cache[bucket]
+        if entry[1] + incoming > quota:
+            return _err("QuotaExceeded",
+                        f"bucket quota of {quota} bytes exceeded", 403)
+        entry[1] += incoming
+        return None
+
+    def _subtree_size(self, path: str) -> int:
+        total = 0
+        for e in self.filer.list_entries(path, limit=1 << 20):
+            if e.is_directory:
+                total += self._subtree_size(e.full_path)
+            else:
+                total += e.file_size()
+        return total
 
     def _copy_object(self, req: Request, bucket: str, key: str) -> Response:
         """Server-side copy (reference s3api_object_copy_handlers.go

@@ -12,7 +12,6 @@ SPEAKS it as a client.
 from __future__ import annotations
 
 import hashlib
-import hmac
 import time
 import urllib.parse
 import xml.etree.ElementTree as ET
@@ -28,9 +27,9 @@ def _sha256(data: bytes) -> str:
 
 
 class SigV4Signer:
-    """Header-based AWS Signature Version 4 (the client half of the
-    algorithm gateway/s3_server.py verifies — same canonicalization,
-    so the two always agree)."""
+    """Header-based AWS Signature Version 4. Canonicalization and key
+    derivation live in utils/sigv4.py, shared with the gateway's
+    verifier — one copy, so the two can never drift."""
 
     def __init__(self, access_key: str, secret_key: str,
                  region: str = "us-east-1", service: str = "s3"):
@@ -41,6 +40,7 @@ class SigV4Signer:
 
     def signed_headers(self, method: str, host: str, path: str,
                        query: dict, body: bytes) -> dict:
+        from seaweedfs_tpu.utils import sigv4
         amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
         date = amz_date[:8]
         payload_hash = _sha256(body)
@@ -48,20 +48,10 @@ class SigV4Signer:
                    "x-amz-content-sha256": payload_hash}
         signed = ["host", "x-amz-content-sha256", "x-amz-date"]
         lower = {k.lower(): v for k, v in headers.items()}
-        cq = "&".join(
-            f"{urllib.parse.quote(k, safe='~')}="
-            f"{urllib.parse.quote(str(v), safe='~')}"
-            for k, v in sorted(query.items()))
-        ch = "".join(f"{h}:{lower.get(h, '').strip()}\n" for h in signed)
-        creq = "\n".join([method, path, cq, ch, ";".join(signed),
-                          payload_hash])
+        sig = sigv4.signature(self.secret_key, date, self.region,
+                              self.service, amz_date, method, path,
+                              query, lower, signed, payload_hash)
         scope = f"{date}/{self.region}/{self.service}/aws4_request"
-        sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
-                         _sha256(creq.encode())])
-        k = ("AWS4" + self.secret_key).encode()
-        for msg in (date, self.region, self.service, "aws4_request"):
-            k = hmac.new(k, msg.encode(), hashlib.sha256).digest()
-        sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
         headers["Authorization"] = (
             f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
             f"SignedHeaders={';'.join(signed)}, Signature={sig}")
@@ -83,8 +73,8 @@ class S3Remote(RemoteStorageClient):
 
     # ---- plumbing ----
     def _call(self, method: str, key: str, query: Optional[dict] = None,
-              body: bytes = b"", extra_headers: Optional[dict] = None,
-              ok=(200,)) -> tuple[int, bytes, dict]:
+              body: bytes = b"", extra_headers: Optional[dict] = None
+              ) -> tuple[int, bytes, dict]:
         query = query or {}
         path = "/" + urllib.parse.quote(
             f"{self.bucket}/{key.lstrip('/')}".rstrip("/"), safe="/~")

@@ -81,6 +81,15 @@ class FilerServer:
             # gateway mode: metadata lives on another filer
             # (filer/remote_store.py); store_dir carries its address
             kwargs["filer_addr"] = store_dir
+        elif store == "redis":
+            # store_dir carries the redis address "host:port"
+            # (reference filer.toml [redis2] address); a non-address
+            # value (e.g. the CLI's default -dir ".") means localhost
+            addr = store_dir if store_dir and ":" in store_dir \
+                else "127.0.0.1:6379"
+            r_host, _, r_port = addr.rpartition(":")
+            kwargs["host"] = r_host or "127.0.0.1"
+            kwargs["port"] = int(r_port)
         self.filer = Filer(make_store(store, **kwargs),
                            delete_chunks_fn=self._delete_chunks,
                            read_chunk_fn=self._read_chunk)
@@ -172,6 +181,7 @@ class FilerServer:
         r("GET", "/__api/filer_conf", self._api_filer_conf_get)
         r("POST", "/__api/filer_conf", self._api_filer_conf_set)
         r("GET", "/__api/meta_events", self._api_meta_events)
+        r("GET", r"/__api/chunk/(\S+)", self._api_chunk_blob)
         r("GET", "/__api/remote/status", self._api_remote_status)
         r("POST", "/__api/remote/configure", self._api_remote_configure)
         r("POST", "/__api/remote/mount", self._api_remote_mount)
@@ -598,6 +608,20 @@ class FilerServer:
     def _api_remote_rm(self, req: Request) -> Response:
         self.remote_mounts.delete_remote(req.json()["path"])
         return Response({})
+
+    def _api_chunk_blob(self, req: Request) -> Response:
+        """Plaintext bytes of one chunk by fid — lets admin tools
+        (volume.fsck) expand manifest chunks without reimplementing the
+        decrypt/cache path."""
+        from seaweedfs_tpu.filer.entry import FileChunk
+        fid = req.match.group(1)
+        key = bytes.fromhex(req.query.get("cipher_key", ""))
+        try:
+            blob = self._read_chunk(FileChunk(fid=fid, offset=0, size=0,
+                                              cipher_key=key))
+        except (ConnectionError, HttpError) as e:
+            return Response({"error": str(e)}, status=502)
+        return Response(blob, content_type="application/octet-stream")
 
     def _api_meta_events(self, req: Request) -> Response:
         since = int(req.query.get("since_ns", 0))

@@ -247,6 +247,7 @@ class MasterServer:
         r("GET", "/metrics", self._handle_metrics)
         r("GET", "/col/list", self._handle_col_list)
         r("POST", "/cluster/register", self._handle_cluster_register)
+        r("POST", "/dir/leave", self._handle_dir_leave)
         r("GET", "/cluster/nodes", self._handle_cluster_nodes)
         r("POST", "/col/delete", self._handle_col_delete)
         r("GET", "/ui", self._handle_ui)
@@ -260,6 +261,18 @@ class MasterServer:
     def _handle_metrics(self, req: Request) -> Response:
         return Response(self.metrics.expose_text(),
                         content_type="text/plain; version=0.0.4")
+
+    def _handle_dir_leave(self, req: Request) -> Response:
+        """A volume server announcing a graceful exit: drop its volumes
+        from the topology immediately instead of waiting out the
+        liveness window (reference master_grpc_server.go UnRegister)."""
+        url = req.json().get("url", "")
+        for node in self.topo.all_nodes():
+            if node.url == url or node.id == url:
+                self.topo.unregister_data_node(node)
+                return Response({"unregistered": url})
+        return Response({"error": f"unknown volume server {url}"},
+                        status=404)
 
     def _handle_cluster_register(self, req: Request) -> Response:
         """Filer/broker membership announcements (reference

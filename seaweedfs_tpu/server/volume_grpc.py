@@ -846,6 +846,14 @@ class GrpcVolumeClient:
                 size=b["size"], needle_blob=bytes.fromhex(b["blob"])),
                 pb.WriteNeedleBlobResponse)
             return {}
+        if path == "/admin/batch_delete":
+            r = un("BatchDelete", pb.BatchDeleteRequest(
+                file_ids=b.get("file_ids", []),
+                skip_cookie_check=b.get("skip_cookie_check", False)),
+                pb.BatchDeleteResponse)
+            return {"results": [
+                {"file_id": x.file_id, "status": x.status,
+                 "error": x.error, "size": x.size} for x in r.results]}
         if path == "/admin/ec/generate":
             r = un("VolumeEcShardsGenerate",
                             pb.VolumeEcShardsGenerateRequest(

@@ -146,6 +146,8 @@ class VolumeServer:
     # ---- heartbeat (reference volume_grpc_client_to_master.go) ----
     def heartbeat_once(self) -> None:
         hb = self.store.collect_heartbeat()
+        if self.grpc_port:
+            hb["grpc_port"] = self.grpc_port
         for _attempt in range(2):  # second try after a leader redirect
             try:
                 reply = http_json(
@@ -253,6 +255,14 @@ class VolumeServer:
         r("POST", "/admin/allocate_volume", self._admin_allocate_volume)
         r("POST", "/admin/delete_volume", self._admin_delete_volume)
         r("POST", "/admin/mark_readonly", self._admin_mark_readonly)
+        r("POST", "/admin/mount_volume", self._admin_mount_volume)
+        r("POST", "/admin/unmount_volume", self._admin_unmount_volume)
+        r("POST", "/admin/configure_replication",
+          self._admin_configure_replication)
+        r("POST", "/admin/leave", self._admin_leave)
+        r("POST", "/admin/batch_delete", self._admin_batch_delete)
+        r("GET", "/admin/volume_file_status",
+          self._admin_volume_file_status)
         r("POST", "/admin/vacuum", self._admin_vacuum)
         r("POST", "/admin/sync", self._admin_sync)
         r("POST", "/admin/copy_volume", self._admin_copy_volume)
@@ -574,6 +584,93 @@ class VolumeServer:
         ok = self.store.mark_volume_readonly(b["volume_id"],
                                              b.get("read_only", True))
         return Response({"ok": ok})
+
+    def _admin_mount_volume(self, req: Request) -> Response:
+        """Attach a volume whose files are already on disk (reference
+        volume_grpc_admin.go VolumeMount)."""
+        ok = self.store.mount_volume(req.json()["volume_id"])
+        self._push_deltas()
+        return Response({"mounted": ok} if ok else
+                        {"error": "volume files not found"},
+                        status=200 if ok else 404)
+
+    def _admin_unmount_volume(self, req: Request) -> Response:
+        """Detach without deleting files (reference VolumeUnmount)."""
+        ok = self.store.unmount_volume(req.json()["volume_id"])
+        self._push_deltas()
+        return Response({"unmounted": ok} if ok else
+                        {"error": "volume not found"},
+                        status=200 if ok else 404)
+
+    def _admin_configure_replication(self, req: Request) -> Response:
+        """Rewrite a volume's replica placement in its superblock
+        (reference command_volume_configure_replication.go)."""
+        b = req.json()
+        v = self.store.find_volume(b["volume_id"])
+        if v is None:
+            return Response({"error": "volume not found"}, status=404)
+        v.configure_replication(b["replication"])
+        self.heartbeat_once()  # re-announce with the new placement
+        return Response({"replication": b["replication"]})
+
+    def _admin_volume_file_status(self, req: Request) -> Response:
+        """HTTP twin of the ReadVolumeFileStatus gRPC: file sizes,
+        mtimes, counts — what shell planners gate destructive ops on."""
+        vid = int(req.query["volumeId"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return Response({"error": "volume not found"}, status=404)
+        v.sync()
+        base = v.file_name()
+        out = {"volume_id": vid, "collection": v.collection,
+               "file_count": v.file_count(),
+               "last_append_at_ns": v.last_append_at_ns}
+        for ext, ts_key, size_key in (
+                (".idx", "idx_file_timestamp_seconds", "idx_file_size"),
+                (".dat", "dat_file_timestamp_seconds", "dat_file_size")):
+            try:
+                st = os.stat(base + ext)
+                out[ts_key] = int(st.st_mtime)
+                out[size_key] = st.st_size
+            except OSError:
+                pass
+        return Response(out)
+
+    def _admin_batch_delete(self, req: Request) -> Response:
+        """HTTP twin of the BatchDelete gRPC (local deletes only; the
+        caller addresses each replica — reference
+        volume_grpc_batch_delete.go)."""
+        from seaweedfs_tpu.storage.file_id import FileId
+        b = req.json()
+        skip = b.get("skip_cookie_check", False)
+        results = []
+        for fid in b.get("file_ids", []):
+            r = {"file_id": fid, "status": 202, "error": "", "size": 0}
+            try:
+                f = FileId.parse(fid)
+                r["size"] = self.store.delete_volume_needle(
+                    f.volume_id, f.key, None if skip else f.cookie)
+            except (ValueError, KeyError):
+                r["status"], r["error"] = 400, "malformed file id"
+            except (NotFoundError, DeletedError) as e:
+                r["status"], r["error"] = 404, str(e) or "not found"
+            except PermissionError as e:
+                r["status"], r["error"] = 403, str(e)
+            except Exception as e:
+                r["status"], r["error"] = 500, f"{type(e).__name__}: {e}"
+            results.append(r)
+        return Response({"results": results})
+
+    def _admin_leave(self, req: Request) -> Response:
+        """Stop heartbeating and unregister from the master — graceful
+        drain (reference shell command_volume_server_leave.go)."""
+        self._stop.set()
+        try:
+            http_json("POST", f"http://{self.master_url}/dir/leave",
+                      {"url": self.url})
+        except (ConnectionError, HttpError) as e:
+            return Response({"left": True, "master": str(e)})
+        return Response({"left": True})
 
     def _admin_vacuum(self, req: Request) -> Response:
         b = req.json()

@@ -42,7 +42,15 @@ class ShellContext:
             from seaweedfs_tpu.server.volume_grpc import GrpcVolumeClient
             from seaweedfs_tpu.utils.tls import make_channel
             ip, port = node.rsplit(":", 1)
-            addr = f"{ip}:{int(port) + 10000}"
+            # the node advertises its gRPC port in heartbeats; fall
+            # back to the reference's port+10000 convention
+            gport = 0
+            for dc in self.topology().get("data_centers", []):
+                for rack in dc.get("racks", []):
+                    for n in rack.get("nodes", []):
+                        if n["id"] == node:
+                            gport = n.get("grpc_port", 0)
+            addr = f"{ip}:{gport or int(port) + 10000}"
             ch = make_channel(addr)  # honors security.toml mTLS
             _grpc.channel_ready_future(ch).result(timeout=0.5)
             ch.close()
@@ -235,6 +243,192 @@ class ShellContext:
                  {"volume_id": vid, "collection": collection,
                   "source_data_node": source})
         self._vs(source, "/admin/delete_volume", {"volume_id": vid})
+
+    def volume_copy(self, vid: int, source: str, target: str,
+                    collection: str = "") -> None:
+        """Add a replica: copy WITHOUT deleting the source (reference
+        shell `volume.copy`)."""
+        self._vs(target, "/admin/copy_volume",
+                 {"volume_id": vid, "collection": collection,
+                  "source_data_node": source})
+
+    def volume_mount(self, vid: int, node: str) -> dict:
+        return self._vs(node, "/admin/mount_volume", {"volume_id": vid})
+
+    def volume_unmount(self, vid: int, node: str) -> dict:
+        return self._vs(node, "/admin/unmount_volume", {"volume_id": vid})
+
+    def volume_delete(self, vid: int, node: str) -> dict:
+        return self._vs(node, "/admin/delete_volume", {"volume_id": vid})
+
+    def volume_mark(self, vid: int, node: str,
+                    readonly: bool = True) -> dict:
+        """volume.mark -readonly / -writable (reference
+        command_volume_mark.go)."""
+        return self._vs(node, "/admin/mark_readonly",
+                        {"volume_id": vid, "read_only": readonly})
+
+    def volume_configure_replication(self, vid: int,
+                                     replication: str) -> list[dict]:
+        """Rewrite replica placement on every copy of the volume
+        (reference command_volume_configure_replication.go)."""
+        homes, _ = self._volume_locations()
+        out = []
+        for node in homes.get(vid, []):
+            out.append(self._vs(node, "/admin/configure_replication",
+                                {"volume_id": vid,
+                                 "replication": replication}))
+        if not out:
+            raise ValueError(f"volume {vid} not found on any server")
+        return out
+
+    def volume_delete_empty(self, apply: bool = True,
+                            quiet_for: float = 3600.0) -> list[dict]:
+        """Delete volumes holding zero live files AND untouched for
+        quiet_for seconds (reference command_volume_delete_empty.go
+        -quietFor: without the age gate, freshly grown writable volumes
+        the master is still assigning into would be destroyed)."""
+        import time as _time
+
+        from seaweedfs_tpu.utils.httpd import http_json
+        topo = self.topology()
+        now = _time.time()
+        doomed = []
+        for dc in topo.get("data_centers", []):
+            for rack in dc.get("racks", []):
+                for node in rack.get("nodes", []):
+                    for v in node.get("volumes", []):
+                        # file_count counts LIVE needles (the map drops
+                        # deleted ones), so 0 == nothing readable
+                        if v.get("file_count", 0) != 0:
+                            continue
+                        try:
+                            st = http_json(
+                                "GET", f"http://{node['id']}"
+                                       "/admin/volume_file_status"
+                                       f"?volumeId={v['id']}")
+                        except (ConnectionError, HttpError):
+                            continue
+                        age = now - st.get(
+                            "dat_file_timestamp_seconds", now)
+                        if age < quiet_for:
+                            continue
+                        doomed.append({"vid": v["id"],
+                                       "node": node["id"],
+                                       "quiet_seconds": int(age)})
+        if apply:
+            for d in doomed:
+                self._vs(d["node"], "/admin/delete_volume",
+                         {"volume_id": d["vid"]})
+        return doomed
+
+    def volume_server_evacuate(self, node: str,
+                               apply: bool = True) -> list[dict]:
+        """Move every volume off a node before decommissioning it
+        (reference command_volume_server_evacuate.go). EC shards are
+        re-balanced separately by ec.balance."""
+        topo = self.topology()
+        all_nodes = []
+        source = None
+        for dc in topo.get("data_centers", []):
+            for rack in dc.get("racks", []):
+                for n in rack.get("nodes", []):
+                    if n["id"] == node:
+                        source = n
+                    else:
+                        all_nodes.append(n)
+        if source is None:
+            raise ValueError(f"unknown volume server {node!r}")
+        if not all_nodes:
+            raise ValueError("no other volume servers to evacuate to")
+        moves = []
+        targets = sorted(all_nodes,
+                         key=lambda n: len(n.get("volumes", [])))
+        for v in source.get("volumes", []):
+            # skip targets that already hold a replica of this volume
+            ok = [t for t in targets
+                  if all(x["id"] != v["id"]
+                         for x in t.get("volumes", []))]
+            if not ok:
+                moves.append({"vid": v["id"], "source": node,
+                              "target": None, "blocked": True})
+                continue
+            tgt = ok[0]
+            moves.append({"vid": v["id"], "source": node,
+                          "target": tgt["id"],
+                          "collection": v.get("collection", "")})
+            tgt.setdefault("volumes", []).append(v)
+            targets.sort(key=lambda n: len(n.get("volumes", [])))
+        if apply:
+            for mv in moves:
+                if mv.get("target"):
+                    self.volume_move(mv["vid"], mv["source"],
+                                     mv["target"],
+                                     mv.get("collection", ""))
+        return moves
+
+    def volume_tail(self, vid: int, since_ns: int = 0,
+                    limit: int = 256) -> list[dict]:
+        """Stream needles appended after since_ns (reference
+        command_volume_tail.go) — rides the VolumeTailSender gRPC."""
+        replicas, _ = self._volume_locations()
+        nodes = replicas.get(vid)
+        if not nodes:
+            raise ValueError(f"volume {vid} not found")
+        client = self._grpc_client(nodes[0])
+        if client is None:
+            raise RuntimeError(f"{nodes[0]} has no gRPC plane "
+                               "(start volume with -grpc)")
+        out = []
+        for n in client.volume_tail_needles(vid, since_ns):
+            out.append({"needle_id": f"{n.id:x}",
+                        "size": len(n.data),
+                        "append_at_ns": n.append_at_ns,
+                        "deleted": n.size == 0 and not n.data})
+            if len(out) >= limit:
+                break
+        return out
+
+    def volume_server_leave(self, node: str) -> dict:
+        """Graceful drain: the server stops heartbeating and the master
+        drops it (reference command_volume_server_leave.go)."""
+        return self._vs(node, "/admin/leave", {})
+
+    def volume_fsck(self, filer_url: str, fix: bool = False,
+                    collection: str = "") -> dict:
+        from seaweedfs_tpu.shell.fsck import volume_fsck
+        return volume_fsck(self, filer_url, fix=fix,
+                           collection=collection or None)
+
+    def cluster_ps(self) -> dict:
+        """Every known cluster process (reference command_cluster_ps.go):
+        masters from raft status, volume servers from the topology,
+        filers/brokers from the registry."""
+        from seaweedfs_tpu.utils.httpd import http_json
+        status = http_json("GET",
+                           f"http://{self.master_url}/cluster/status")
+        topo = self.topology()
+        volume_servers = []
+        for dc in topo.get("data_centers", []):
+            for rack in dc.get("racks", []):
+                for n in rack.get("nodes", []):
+                    volume_servers.append({
+                        "url": n["id"], "data_center": dc["id"],
+                        "rack": rack["id"],
+                        "volumes": len(n.get("volumes", [])),
+                        "ec_shards": sum(
+                            bin(s.get("ec_index_bits", 0)).count("1")
+                            for s in n.get("ec_shards", []))})
+        others = {}
+        for ntype in ("filer", "broker"):
+            out = http_json(
+                "GET",
+                f"http://{self.master_url}/cluster/nodes?type={ntype}")
+            others[ntype + "s"] = out.get("cluster_nodes", [])
+        return {"masters": [status.get("Leader", "")]
+                + list(status.get("Peers", [])),
+                "leader": status.get("Leader", ""),
+                "volume_servers": volume_servers, **others}
 
     def volume_balance(self, apply: bool = True) -> list[dict]:
         """Even volume counts across nodes (reference

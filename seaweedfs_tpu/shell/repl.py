@@ -18,12 +18,28 @@ HELP = """commands:
   remote.status
   fs.meta.save [-root /p] [-o file] / fs.meta.load -i file / fs.meta.tail
   s3.bucket.list / s3.bucket.create -name B / s3.bucket.delete -name B
+  s3.bucket.quota -name B -sizeMB N | -name B -disable
   volume.list                       show topology
   volume.fix.replication [-n]      re-replicate under-replicated volumes
   volume.check.disk [-volumeId N] [-fix]   cross-check replica contents
+  volume.fsck [-fix] [-collection C]   cross filer<->volume orphan check
+  volume.move -volumeId N -source HOST -target HOST
+  volume.copy -volumeId N -source HOST -target HOST
+  volume.mount/unmount/delete -volumeId N -node HOST
+  volume.mark -volumeId N -node HOST [-readonly|-writable]
+  volume.configure.replication -volumeId N -replication XYZ
+  volume.delete_empty [-n]          drop volumes with zero live files
+  volume.balance [-n]               even volume counts across nodes
+  volume.server.evacuate -node HOST [-n]
+  volume.server.leave -node HOST
+  volume.tail -volumeId N [-since NS]   stream appended needles
   volume.tier.upload -volumeId N -endpoint URL -bucket B [-keepLocal]
   volume.tier.download -volumeId N
   volume.vacuum [threshold]         compact garbage-heavy volumes
+  cluster.ps                        list every cluster process
+  s3.configure -user U -access K -secret S [-actions a,b] | -delete U
+  s3.clean.uploads [-timeAgo SECONDS]   purge stale multipart uploads
+  fs.meta.cat <path>                one entry's raw metadata
   ec.encode [-volumeId N] [-collection C]
   ec.rebuild [-n]
   ec.balance [-n]
@@ -119,6 +135,14 @@ def run_command(sh: ShellContext, line: str):
             if not src:
                 raise ValueError("usage: fs.meta.load -i <dump.jsonl>")
             return {"loaded": fs_meta_load(fsc.filer_url, src)}
+        if op == "meta.cat":
+            # raw metadata of one entry (reference command_fs_meta_cat.go)
+            import urllib.parse
+
+            from seaweedfs_tpu.utils.httpd import http_json
+            return http_json(
+                "GET", f"http://{fsc.filer_url}/__api/entry?path="
+                       f"{urllib.parse.quote(args[-1], safe='')}")
         if op == "meta.tail":
             from seaweedfs_tpu.replication.sync import meta_tail
             n = meta_tail(fsc.filer_url,
@@ -180,12 +204,103 @@ def run_command(sh: ShellContext, line: str):
     if cmd == "volume.check.disk":
         vid = int(flags["volumeId"]) if "volumeId" in flags else None
         return sh.volume_check_disk(vid=vid, fix="-fix" in args)
+    if cmd == "volume.fsck":
+        return sh.volume_fsck(_find_filer(sh), fix="-fix" in args,
+                              collection=flags.get("collection", ""))
+    if cmd == "volume.move":
+        sh.volume_move(int(flags["volumeId"]), flags["source"],
+                       flags["target"], flags.get("collection", ""))
+        return {"moved": int(flags["volumeId"])}
+    if cmd == "volume.copy":
+        sh.volume_copy(int(flags["volumeId"]), flags["source"],
+                       flags["target"], flags.get("collection", ""))
+        return {"copied": int(flags["volumeId"])}
+    if cmd == "volume.mount":
+        return sh.volume_mount(int(flags["volumeId"]), flags["node"])
+    if cmd == "volume.unmount":
+        return sh.volume_unmount(int(flags["volumeId"]), flags["node"])
+    if cmd == "volume.delete":
+        return sh.volume_delete(int(flags["volumeId"]), flags["node"])
+    if cmd == "volume.mark":
+        return sh.volume_mark(int(flags["volumeId"]), flags["node"],
+                              readonly="-writable" not in args)
+    if cmd == "volume.configure.replication":
+        return sh.volume_configure_replication(int(flags["volumeId"]),
+                                               flags["replication"])
+    if cmd == "volume.delete_empty":
+        return sh.volume_delete_empty(
+            apply=apply, quiet_for=float(flags.get("quietFor", 3600)))
+    if cmd == "volume.server.evacuate":
+        return sh.volume_server_evacuate(flags["node"], apply=apply)
+    if cmd == "volume.server.leave":
+        return sh.volume_server_leave(flags["node"])
+    if cmd == "volume.tail":
+        return sh.volume_tail(int(flags["volumeId"]),
+                              since_ns=int(flags.get("since", 0)))
+    if cmd == "cluster.ps":
+        return sh.cluster_ps()
     if cmd == "volume.tier.upload":
         return sh.volume_tier_upload(
             int(flags["volumeId"]), flags["endpoint"], flags["bucket"],
             keep_local="-keepLocal" in args)
     if cmd == "volume.tier.download":
         return sh.volume_tier_download(int(flags["volumeId"]))
+    if cmd == "s3.configure":
+        # manage S3 identities in /etc/iam/identity.json (reference
+        # command_s3_configure.go; the gateway reads the same file)
+        import json as _json
+
+        from seaweedfs_tpu.utils.httpd import http_call, http_json
+        filer = _find_filer(sh)
+        ident_url = f"http://{filer}/etc/iam/identity.json"
+        status, body, _ = http_call("GET", ident_url)
+        if status == 200 and body:
+            conf = _json.loads(body)
+        elif status == 404:
+            conf = {"identities": []}
+        else:
+            # NEVER treat a transient error as "no identities" — the
+            # save below would wipe every existing access key
+            raise RuntimeError(f"cannot load identities: HTTP {status}")
+        idents = conf["identities"]
+        if "delete" in flags:
+            idents[:] = [x for x in idents if x["name"] != flags["delete"]]
+        elif "user" in flags:
+            ident = next((x for x in idents
+                          if x["name"] == flags["user"]), None)
+            if ident is None:
+                ident = {"name": flags["user"], "credentials": [],
+                         "actions": []}
+                idents.append(ident)
+            if "access" in flags:
+                ident["credentials"] = [{"accessKey": flags["access"],
+                                         "secretKey":
+                                         flags.get("secret", "")}]
+            if "actions" in flags:
+                ident["actions"] = flags["actions"].split(",")
+        status, body, _ = http_call(
+            "POST", ident_url, body=_json.dumps(conf, indent=2).encode())
+        if status >= 300:
+            raise RuntimeError(f"save failed: HTTP {status}")
+        return {"identities": [x["name"] for x in idents]}
+    if cmd == "s3.clean.uploads":
+        # purge stale multipart uploads (reference
+        # command_s3_clean_uploads.go); default cutoff 24h
+        import time as _time
+
+        from seaweedfs_tpu.shell.fs_commands import FsContext
+        fsc = FsContext(_find_filer(sh))
+        cutoff = _time.time() - float(flags.get("timeAgo", 86400))
+        removed = []
+        try:
+            uploads = fsc.ls("/buckets/.uploads", limit=100000)
+        except NotADirectoryError:
+            uploads = []
+        for e in uploads:
+            if e.get("Mtime", 0) < cutoff:
+                fsc.rm(e["FullPath"], recursive=True)
+                removed.append(e["FullPath"])
+        return {"removed": removed}
     if cmd.startswith("s3.bucket."):
         # reference shell command_s3_bucket_*.go: buckets are dirs under
         # /buckets with collection=<bucket>
@@ -193,6 +308,23 @@ def run_command(sh: ShellContext, line: str):
         from seaweedfs_tpu.utils.httpd import http_json
         fsc = FsContext(_find_filer(sh))
         op = cmd[len("s3.bucket."):]
+        if op == "quota":
+            # size quota on the bucket entry (reference
+            # command_s3_bucket_quota.go; the gateway enforces it)
+            path = f"/buckets/{flags['name']}"
+            out = http_json("GET", f"http://{fsc.filer_url}/__api/entry"
+                                   f"?path={path}")
+            entry = out["entry"]
+            if "-disable" in args:
+                entry.setdefault("extended", {}).pop("quota_bytes", None)
+                quota = 0
+            else:
+                quota = int(float(flags["sizeMB"]) * 1024 * 1024)
+                entry.setdefault("extended", {})["quota_bytes"] = \
+                    str(quota)
+            http_json("POST", f"http://{fsc.filer_url}/__api/entry",
+                      {"entry": entry, "meta_only": True})
+            return {"bucket": flags["name"], "quota_bytes": quota}
         if op == "list":
             try:
                 return [e["FullPath"].rsplit("/", 1)[-1]

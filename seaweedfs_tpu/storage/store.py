@@ -9,6 +9,7 @@ TPU-backed coder slots in here (reference store_ec.go:125-163,328-382).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, Optional
 
@@ -85,6 +86,47 @@ class Store:
                     info = self.volume_info(v)
                     loc.delete_volume(vid)
                     self.deleted_volumes.append(info)
+                    return True
+            return False
+
+    def unmount_volume(self, vid: int) -> bool:
+        """Detach a volume WITHOUT deleting its files (reference
+        volume_grpc_admin.go VolumeUnmount) — the .dat/.idx stay on disk
+        for a later mount or an off-node move."""
+        with self._lock:
+            for loc in self.locations:
+                v = loc.find_volume(vid)
+                if v is not None:
+                    info = self.volume_info(v)
+                    v.close()
+                    with loc._lock:
+                        loc.volumes.pop(vid, None)
+                    self.deleted_volumes.append(info)  # delta: gone here
+                    return True
+            return False
+
+    def mount_volume(self, vid: int) -> bool:
+        """(Re)attach a volume whose files already sit in a location's
+        directory (reference VolumeMount). Uses the same filename
+        grammar and .idx requirement as the startup scan."""
+        from seaweedfs_tpu.storage.disk_location import _DAT_RE
+        with self._lock:
+            if self.find_volume(vid) is not None:
+                return True
+            for loc in self.locations:
+                for name in os.listdir(loc.directory):
+                    m = _DAT_RE.match(name)
+                    if not m or int(m.group("vid")) != vid:
+                        continue
+                    col = m.group("col") or ""
+                    base = os.path.join(loc.directory,
+                                        f"{col}_{vid}" if col else str(vid))
+                    if not os.path.exists(base + ".idx"):
+                        continue
+                    vol = Volume(loc.directory, col, vid,
+                                 needle_map_kind=self.needle_map_kind)
+                    loc.add_volume(vol)
+                    self.new_volumes.append(self.volume_info(vol))
                     return True
             return False
 
