@@ -236,4 +236,7 @@ def make_store(name: str, **kwargs) -> FilerStore:
     if name == "remote":
         from seaweedfs_tpu.filer.remote_store import RemoteFilerStore
         return RemoteFilerStore(**kwargs)
+    if name == "redis":
+        from seaweedfs_tpu.filer.redis_store import RedisFilerStore
+        return RedisFilerStore(**kwargs)
     return STORES[name](**kwargs)
