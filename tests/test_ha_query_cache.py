@@ -59,8 +59,10 @@ def test_multi_master_failover(tmp_path):
         # replicated MaxVolumeId survived the failover: no vid reuse
         assert new_leader.topo.max_volume_id >= max_vid_before
 
-        # volume server re-registers with the new leader; uploads work again
-        deadline = time.time() + 30
+        # volume server re-registers with the new leader; uploads work
+        # again (generous deadline: on a loaded single-core CI box the
+        # election + re-registration can take a while)
+        deadline = time.time() + 60
         ok = False
         while time.time() < deadline:
             try:

