@@ -222,17 +222,41 @@ def cmd_ec(args):
 
 
 def cmd_mount(args):
-    """FUSE-mount a filer path (reference `weed mount`). Runs an embedded
-    filer client against the given master; the kernel protocol is served
-    in-process (seaweedfs_tpu/mount)."""
+    """FUSE-mount a filer path (reference `weed mount -filer=...`). The
+    kernel protocol is served in-process (seaweedfs_tpu/mount); metadata
+    lives on the CLUSTER's filer (remote store adapter) so the mount
+    sees — and is seen by — every other client. Without a reachable
+    filer, -store selects a private local store (metadata siloed to
+    this mount; useful for scratch mounts)."""
     from seaweedfs_tpu.mount.fuse_kernel import FuseConnection
     from seaweedfs_tpu.mount.weedfs import WeedFS
     from seaweedfs_tpu.server.filer_server import FilerServer
 
-    # an embedded (HTTP-less) filer client: reuse FilerServer's chunk
-    # plumbing against the cluster, but without serving HTTP
-    fs = FilerServer(args.master, store=args.store)
+    filer_addr = args.filer
+    if not filer_addr and args.store == "remote":
+        # discover a filer from the master's cluster registry
+        from seaweedfs_tpu.utils.httpd import http_json
+        try:
+            out = http_json(
+                "GET", f"http://{args.master}/cluster/nodes?type=filer")
+            nodes = out.get("cluster_nodes", [])
+            filer_addr = nodes[0]["url"] if nodes else ""
+        except ConnectionError:
+            filer_addr = ""
+    if filer_addr:
+        fs = FilerServer(args.master, store="remote",
+                         store_dir=filer_addr, announce=False)
+    else:
+        if args.store == "remote":
+            raise SystemExit("no filer found via the master; pass "
+                             "-filer host:port or -store memory/sqlite")
+        # an embedded (HTTP-less) filer: private metadata
+        fs = FilerServer(args.master, store=args.store)
     w = WeedFS(fs)
+    if filer_addr:
+        # other writers' changes reach the mount's meta cache through
+        # the filer's change-log subscription
+        w.meta_cache.attach_http(filer_addr)
     conn = FuseConnection(w, args.mountpoint)
     print(f"mounted seaweedfs-tpu at {args.mountpoint}")
     try:
@@ -501,7 +525,12 @@ def main(argv=None):
 
     mt = sub.add_parser("mount")
     mt.add_argument("-master", default="127.0.0.1:9333")
-    mt.add_argument("-store", default="memory")
+    mt.add_argument("-filer", default="",
+                    help="filer host:port holding the namespace "
+                         "(default: discovered from the master)")
+    mt.add_argument("-store", default="remote",
+                    help="remote (cluster filer, default) or a private "
+                         "memory/sqlite/lsm store")
     mt.add_argument("mountpoint")
     mt.set_defaults(fn=cmd_mount)
 

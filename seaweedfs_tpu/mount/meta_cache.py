@@ -48,6 +48,47 @@ class MetaCache:
             self._detach()
             self._detach = None
 
+    def attach_http(self, filer_addr: str) -> None:
+        """Subscribe to a REMOTE filer's metadata change log by
+        long-polling its /__api/meta_events endpoint — the HTTP twin of
+        the gRPC SubscribeMetadata stream the reference mount uses.
+        Events from other writers (HTTP clients, S3 gateway, other
+        mounts) reach this cache with at most one poll of latency."""
+        import threading as _th
+
+        from seaweedfs_tpu.utils.httpd import HttpError, http_json
+        stop = _th.Event()
+
+        class _Ev:
+            __slots__ = ("tsns", "directory", "old_entry", "new_entry")
+
+        def loop():
+            cursor = 0
+            while not stop.is_set():
+                try:
+                    out = http_json(
+                        "GET", f"http://{filer_addr}/__api/meta_events"
+                               f"?since_ns={cursor}&wait=25",
+                        timeout=40)
+                except (ConnectionError, HttpError):
+                    if stop.wait(1.0):
+                        return
+                    continue
+                for d in out.get("events", []):
+                    ev = _Ev()
+                    ev.tsns = d.get("tsns", 0)
+                    ev.directory = d.get("directory", "/")
+                    ev.old_entry = d.get("old_entry")
+                    ev.new_entry = d.get("new_entry")
+                    self._apply_event(ev)
+                    cursor = max(cursor, ev.tsns)
+
+        t = _th.Thread(target=loop, daemon=True)
+        t.start()
+        prev = self._detach
+        self._detach = lambda: (stop.set(),
+                                prev() if prev else None) and None
+
     def _apply_event(self, ev) -> None:
         """MetaLogEvent -> cache mutation. old+new = update/rename,
         old only = delete, new only = create."""

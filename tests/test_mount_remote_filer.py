@@ -1,0 +1,90 @@
+"""Mount attached to the CLUSTER's filer (reference `weed mount
+-filer=...`): the mount's metadata lives on the real filer via the
+remote store adapter, other writers' changes reach the mount through
+the HTTP meta-event subscription, and mount writes are visible to
+HTTP clients immediately."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.mount.fuse_kernel import ROOT_ID
+from seaweedfs_tpu.mount.weedfs import WeedFS
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils.httpd import http_call
+
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    real_filer = FilerServer(master.url)
+    real_filer.start()
+    time.sleep(0.1)
+    # the mount's embedded filer: metadata rows live on real_filer
+    mount_fs = FilerServer(master.url, store="remote",
+                           store_dir=real_filer.url, announce=False)
+    w = WeedFS(mount_fs, swap_dir=str(tmp_path))
+    w.meta_cache.attach_http(real_filer.url)
+    yield master, vs, real_filer, w
+    w.meta_cache.detach()
+    real_filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_mount_writes_visible_to_http_clients(stack):
+    master, vs, real_filer, w = stack
+    attr, fh = w.create(ROOT_ID, "shared.txt", 0o644)
+    w.write(attr.ino, fh, 0, b"written through the mount" * 300)
+    w.release(attr.ino, fh)
+    status, body, _ = http_call(
+        "GET", f"http://{real_filer.url}/shared.txt")
+    assert status == 200
+    assert body == b"written through the mount" * 300
+
+
+def test_http_writes_visible_to_mount_via_subscription(stack):
+    master, vs, real_filer, w = stack
+    # prime the mount's listing cache so only an event can update it
+    w.readdir(ROOT_ID)
+    assert w.lookup(ROOT_ID, "pushed.txt") is None
+
+    status, _, _ = http_call("POST",
+                             f"http://{real_filer.url}/pushed.txt",
+                             body=b"from an http client")
+    assert status < 300
+    # the subscription applies the event within a poll cycle
+    deadline = time.time() + 10
+    got = None
+    while time.time() < deadline:
+        got = w.lookup(ROOT_ID, "pushed.txt")
+        if got is not None:
+            break
+        time.sleep(0.2)
+    assert got is not None and got.size == len(b"from an http client")
+    fh = w.open(got.ino)
+    assert w.read(got.ino, fh, 0, 100) == b"from an http client"
+    w.release(got.ino, fh)
+
+
+def test_mount_namespace_survives_mount_restart(stack, tmp_path):
+    """Unlike a private-store mount, the namespace belongs to the
+    cluster: a new mount instance sees everything."""
+    master, vs, real_filer, w = stack
+    attr, fh = w.create(ROOT_ID, "durable.txt", 0o644)
+    w.write(attr.ino, fh, 0, b"outlives the mount")
+    w.release(attr.ino, fh)
+
+    mount2_fs = FilerServer(master.url, store="remote",
+                            store_dir=real_filer.url, announce=False)
+    w2 = WeedFS(mount2_fs, swap_dir=str(tmp_path))
+    got = w2.lookup(ROOT_ID, "durable.txt")
+    assert got is not None
+    fh2 = w2.open(got.ino)
+    assert w2.read(got.ino, fh2, 0, 100) == b"outlives the mount"
+    w2.release(got.ino, fh2)
