@@ -67,15 +67,49 @@ class FileQueue(MessageQueue):
                 f.write(json.dumps({"key": key, "message": message}) + "\n")
 
 
+def make_queue_from_config() -> Optional[MessageQueue]:
+    """Build the enabled backend from notification.toml (reference
+    weed/notification/configuration.go LoadConfiguration): the first
+    section with enabled=true wins."""
+    from seaweedfs_tpu.utils import config as _cfg
+    conf = _cfg.load_configuration("notification")
+    if not conf:
+        return None
+    root = conf.get("notification", conf)
+    if root.get("log", {}).get("enabled"):
+        return LogQueue()
+    if root.get("file", {}).get("enabled"):
+        return FileQueue(root["file"].get("path", "./notifications.jsonl"))
+    if root.get("kafka", {}).get("enabled"):
+        from seaweedfs_tpu.notification.kafka_queue import KafkaQueue
+        k = root["kafka"]
+        addr = k.get("address", "127.0.0.1:9092")
+        if ":" in addr:
+            host, _, port_s = addr.rpartition(":")
+            port = int(port_s)
+        else:
+            host, port = addr, 9092
+        return KafkaQueue(host or "127.0.0.1", port,
+                          topic=k.get("topic", "seaweedfs_meta"))
+    return None
+
+
 def attach_to_filer(filer, mq: MessageQueue) -> None:
     """Forward every filer meta event to the queue (the reference wires
-    this inside Filer.NotifyUpdateEvent)."""
+    this inside Filer.NotifyUpdateEvent). Queue errors are LOGGED, not
+    raised — the mutation already persisted, and a broker hiccup must
+    not fail filer writes (reference filer_notify.go does the same)."""
+    import logging
     original = filer._notify
+    log = logging.getLogger("seaweedfs_tpu.notify")
 
     def notify(directory, old_entry, new_entry):
         original(directory, old_entry, new_entry)
         path = (new_entry or old_entry or {}).get("full_path", directory)
-        mq.send_message(path, {"directory": directory,
-                               "old_entry": old_entry,
-                               "new_entry": new_entry})
+        try:
+            mq.send_message(path, {"directory": directory,
+                                   "old_entry": old_entry,
+                                   "new_entry": new_entry})
+        except Exception as e:
+            log.warning("notification for %s failed: %s", path, e)
     filer._notify = notify

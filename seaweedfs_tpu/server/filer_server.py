@@ -110,6 +110,13 @@ class FilerServer:
             from seaweedfs_tpu.server.filer_grpc import start_filer_grpc
             self._grpc_server, self.grpc_port = start_filer_grpc(
                 self, self.http.host, self._grpc_port_arg)
+        # external event publishing when notification.toml enables a
+        # backend (reference filer.go NotifyUpdateEvent)
+        from seaweedfs_tpu.notification.queue import (attach_to_filer,
+                                                      make_queue_from_config)
+        self._notify_queue = make_queue_from_config()
+        if self._notify_queue is not None:
+            attach_to_filer(self.filer, self._notify_queue)
         if not self.announce:
             return
         self._announce_stop = threading.Event()
@@ -151,6 +158,10 @@ class FilerServer:
         if self._grpc_server is not None:
             self._grpc_server.stop(0)
         self.http.stop()
+        # only after the HTTP plane is down: in-flight mutations must
+        # not hit a closed notification socket
+        if getattr(self, "_notify_queue", None) is not None:
+            self._notify_queue.close()
         self.filer.close()
 
     @property

@@ -77,6 +77,11 @@ enabled = false
 [notification.file]
 enabled = false
 path = "./notifications.jsonl"
+
+[notification.kafka]
+enabled = false
+address = "127.0.0.1:9092"   # any Kafka-wire broker
+topic = "seaweedfs_meta"
 """,
     "shell": """\
 # shell.toml
