@@ -88,6 +88,25 @@ class VolumeGrpc:
         return pb.VolumeMarkReadonlyResponse()
 
     @_guard
+    def volume_mount(self, request, context):
+        _check(self.vs._admin_mount_volume(
+            LocalRequest({"volume_id": request.volume_id})))
+        return pb.VolumeMountResponse()
+
+    @_guard
+    def volume_unmount(self, request, context):
+        _check(self.vs._admin_unmount_volume(
+            LocalRequest({"volume_id": request.volume_id})))
+        return pb.VolumeUnmountResponse()
+
+    @_guard
+    def volume_configure(self, request, context):
+        _check(self.vs._admin_configure_replication(
+            LocalRequest({"volume_id": request.volume_id,
+                          "replication": request.replication})))
+        return pb.VolumeConfigureResponse()
+
+    @_guard
     def vacuum_volume_check(self, request, context):
         body = _check(self.vs._admin_vacuum(LocalRequest(
             {"volume_id": request.volume_id, "check_only": True})))
@@ -542,6 +561,15 @@ class VolumeGrpc:
             "VolumeMarkReadonly": unary(self.volume_mark_readonly,
                                         pb.VolumeMarkReadonlyRequest,
                                         pb.VolumeMarkReadonlyResponse),
+            "VolumeMount": unary(self.volume_mount,
+                                 pb.VolumeMountRequest,
+                                 pb.VolumeMountResponse),
+            "VolumeUnmount": unary(self.volume_unmount,
+                                   pb.VolumeUnmountRequest,
+                                   pb.VolumeUnmountResponse),
+            "VolumeConfigure": unary(self.volume_configure,
+                                     pb.VolumeConfigureRequest,
+                                     pb.VolumeConfigureResponse),
             "VacuumVolumeCheck": unary(self.vacuum_volume_check,
                                        pb.VacuumVolumeCheckRequest,
                                        pb.VacuumVolumeCheckResponse),
@@ -846,6 +874,22 @@ class GrpcVolumeClient:
                 size=b["size"], needle_blob=bytes.fromhex(b["blob"])),
                 pb.WriteNeedleBlobResponse)
             return {}
+        if path == "/admin/mount_volume":
+            un("VolumeMount",
+               pb.VolumeMountRequest(volume_id=b["volume_id"]),
+               pb.VolumeMountResponse)
+            return {"mounted": True}
+        if path == "/admin/unmount_volume":
+            un("VolumeUnmount",
+               pb.VolumeUnmountRequest(volume_id=b["volume_id"]),
+               pb.VolumeUnmountResponse)
+            return {"unmounted": True}
+        if path == "/admin/configure_replication":
+            un("VolumeConfigure",
+               pb.VolumeConfigureRequest(volume_id=b["volume_id"],
+                                         replication=b["replication"]),
+               pb.VolumeConfigureResponse)
+            return {"replication": b["replication"]}
         if path == "/admin/batch_delete":
             r = un("BatchDelete", pb.BatchDeleteRequest(
                 file_ids=b.get("file_ids", []),
