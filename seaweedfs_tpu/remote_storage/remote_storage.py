@@ -161,14 +161,24 @@ def make_remote_client(conf: RemoteConf) -> RemoteStorageClient:
         if not conf.root:
             raise ValueError("local remote needs a root directory")
         return LocalDirRemote(conf.root)
-    if conf.type == "s3":
+    if conf.type in ("s3", "gcs", "b2", "wasabi"):
+        # gcs (XML interop mode with HMAC keys), backblaze b2, and
+        # wasabi all serve the S3 dialect — one client covers them
+        # (reference ships separate SDK wrappers per provider; the
+        # wire protocol is the same)
         from seaweedfs_tpu.remote_storage.s3_client import S3Remote
-        if not conf.endpoint or not conf.bucket:
-            raise ValueError("s3 remote needs endpoint and bucket")
-        return S3Remote(conf.endpoint, conf.bucket,
+        endpoint = conf.endpoint or {
+            "gcs": "https://storage.googleapis.com",
+            "b2": "https://s3.us-west-004.backblazeb2.com",
+            "wasabi": "https://s3.wasabisys.com",
+        }.get(conf.type, "")
+        if not endpoint or not conf.bucket:
+            raise ValueError(f"{conf.type} remote needs endpoint and "
+                             "bucket")
+        return S3Remote(endpoint, conf.bucket,
                         access_key=conf.access_key,
                         secret_key=conf.secret_key, region=conf.region)
     raise NotImplementedError(
-        f"remote type {conf.type!r}: cloud SDKs are not available in this "
-        "environment (gcs/azure/b2 would each need their own dialect); "
+        f"remote type {conf.type!r}: no S3-compatible dialect and no "
+        "cloud SDK in this environment (azure's protocol differs); "
         "implement a RemoteStorageClient and register it here")

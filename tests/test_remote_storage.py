@@ -37,11 +37,14 @@ def test_local_remote_client(tmp_path):
 
 
 def test_unknown_remote_type_is_plug_point():
+    # azure's wire protocol isn't S3-compatible: explicit plug point
     with pytest.raises(NotImplementedError):
-        make_remote_client(RemoteConf(name="x", type="gcs"))
-    # s3 is a real client now; misconfiguration is a ValueError
+        make_remote_client(RemoteConf(name="x", type="azure"))
+    # s3-dialect types are real clients now; misconfig is a ValueError
     with pytest.raises(ValueError):
         make_remote_client(RemoteConf(name="x", type="s3"))
+    with pytest.raises(ValueError):
+        make_remote_client(RemoteConf(name="x", type="gcs"))  # no bucket
 
 
 @pytest.fixture
