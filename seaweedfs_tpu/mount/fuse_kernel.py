@@ -27,8 +27,11 @@ FUSE_LOOKUP = 1
 FUSE_FORGET = 2
 FUSE_GETATTR = 3
 FUSE_SETATTR = 4
+FUSE_READLINK = 5
+FUSE_SYMLINK = 6
 FUSE_MKNOD = 8
 FUSE_MKDIR = 9
+FUSE_LINK = 13
 FUSE_UNLINK = 10
 FUSE_RMDIR = 11
 FUSE_RENAME = 12
@@ -241,6 +244,9 @@ class FuseConnection:
             FUSE_RELEASEDIR: lambda u, n, b: self._reply(u),
             FUSE_ACCESS: lambda u, n, b: self._reply(u),
             FUSE_CREATE: self._op_create,
+            FUSE_SYMLINK: self._op_symlink,
+            FUSE_READLINK: self._op_readlink,
+            FUSE_LINK: self._op_link,
             FUSE_GETXATTR: lambda u, n, b: self._reply_err(u, errno.ENODATA),
             FUSE_LISTXATTR: lambda u, n, b: self._reply_err(u, errno.ENODATA),
             FUSE_SETXATTR: lambda u, n, b: self._reply_err(u, errno.ENOTSUP),
@@ -333,9 +339,46 @@ class FuseConnection:
         else:
             self._reply(unique, WRITE_OUT.pack(written, 0))
 
+    def _op_symlink(self, unique, nodeid, body):
+        # body: linkname\0 target\0 (fuse SYMLINK sends name first)
+        name, _, rest = body.partition(b"\x00")
+        target = rest.split(b"\x00", 1)[0]
+        attr = self.ops.symlink(nodeid, name.decode(), target.decode())
+        if attr is None:
+            self._reply_err(unique, errno.EEXIST)
+        else:
+            self._reply_entry(unique, attr)
+
+    def _op_readlink(self, unique, nodeid, body):
+        target = self.ops.readlink(nodeid)
+        if target is None:
+            self._reply_err(unique, errno.EINVAL)
+        else:
+            self._reply(unique, target.encode())
+
+    def _op_link(self, unique, nodeid, body):
+        old_nodeid, = struct.unpack_from("<Q", body)
+        name = body[8:].rstrip(b"\x00").decode()
+        try:
+            attr = self.ops.link(old_nodeid, nodeid, name)
+        except FileExistsError:
+            self._reply_err(unique, errno.EEXIST)
+            return
+        if attr is None:
+            self._reply_err(unique, errno.ENOENT)
+        else:
+            self._reply_entry(unique, attr)
+
     def _op_statfs(self, unique, nodeid, body):
+        stats = None
+        statfs = getattr(self.ops, "statfs", None)
+        if statfs is not None:
+            stats = statfs()
+        if stats is None:  # static fallback
+            stats = (1 << 30, 1 << 29, 1 << 29, 1 << 20, 1 << 19)
+        blocks, bfree, bavail, files, ffree = stats
         self._reply(unique, KSTATFS.pack(
-            1 << 30, 1 << 29, 1 << 29, 1 << 20, 1 << 19, 4096, 255, 4096, 0))
+            blocks, bfree, bavail, files, ffree, 4096, 255, 4096, 0))
 
     def _op_release(self, unique, nodeid, body):
         fh, _fl, _rf, _lo = RELEASE_IN.unpack_from(body)

@@ -287,12 +287,16 @@ class WeedFS:
                        if h.dirty]
         if dirty_sizes:
             size = max(dirty_sizes)
+        if entry.is_directory:
+            mode = statmod.S_IFDIR | 0o755
+        elif entry.attr.symlink_target:
+            mode = statmod.S_IFLNK | 0o777
+            size = len(entry.attr.symlink_target)
+        else:
+            mode = statmod.S_IFREG | (entry.attr.mode & 0o777 or 0o644)
         return FileAttr(ino=ino, size=size,
                         mtime=entry.attr.mtime or time.time(),
-                        mode=(statmod.S_IFDIR | 0o755) if entry.is_directory
-                        else (statmod.S_IFREG | (entry.attr.mode & 0o777
-                                                 or 0o644)),
-                        is_dir=entry.is_directory,
+                        mode=mode, is_dir=entry.is_directory,
                         uid=entry.attr.uid, gid=entry.attr.gid)
 
     def _handles_for(self, path: str) -> list[FileHandle]:
@@ -464,6 +468,86 @@ class WeedFS:
             # dead dirty handle pinning stale sizes in _entry_attr
             with self._lock:
                 self._handles.pop(fh, None)
+
+    def symlink(self, parent_ino: int, name: str,
+                target: str) -> Optional[FileAttr]:
+        """reference weedfs_symlink.go: the target rides the entry's
+        attributes, no data chunks."""
+        path = self._child_path(parent_ino, name)
+        if path is None or self._find_entry(path) is not None:
+            return None
+        now = time.time()
+        entry = Entry(full_path=path,
+                      attr=Attr(mtime=now, crtime=now, mode=0o777,
+                                symlink_target=target))
+        self.filer.create_entry(entry)
+        return self._entry_attr(entry)
+
+    def readlink(self, ino: int) -> Optional[str]:
+        path = self.inodes.path(ino)
+        entry = self._find_entry(path) if path else None
+        if entry is None or not entry.attr.symlink_target:
+            return None
+        return entry.attr.symlink_target
+
+    def link(self, old_ino: int, newparent_ino: int,
+             newname: str) -> Optional[FileAttr]:
+        """Hard link (reference weedfs_link.go): both names share the
+        data through the filer's hard-link id. POSIX link(2): an
+        existing destination is EEXIST, never a silent replace."""
+        src = self.inodes.path(old_ino)
+        dst = self._child_path(newparent_ino, newname)
+        if src is None or dst is None:
+            return None
+        if self._find_entry(dst) is not None:
+            raise FileExistsError(dst)  # fuse maps to EEXIST
+        try:
+            entry = self.filer.add_hard_link(src, dst)
+        except (FileNotFoundError, IsADirectoryError):
+            return None
+        return self._entry_attr(entry)
+
+    STATFS_TTL = 10.0
+
+    def statfs(self):
+        """(blocks, bfree, bavail, files, ffree) in 4096-byte units from
+        the master topology (reference weedfs_statfs.go -> filer
+        Statistics). Cached on a TTL with a SHORT timeout: this runs in
+        the single-threaded FUSE loop, so a slow master must degrade to
+        stale/static numbers, never stall the whole mount."""
+        now = time.time()
+        cached = getattr(self, "_statfs_cache", None)
+        if cached is not None and cached[0] > now:
+            return cached[1]
+        from seaweedfs_tpu.cluster.topology import aggregate_topology_info
+        from seaweedfs_tpu.utils.httpd import http_json
+        master = self.fs.mc.leader or self.fs.mc.master_urls[0]
+        try:
+            topo = http_json("GET", f"http://{master}/dir/status",
+                             timeout=2.0)
+        except Exception:
+            # re-arm the TTL with the stale value: a down master must
+            # not cost 2s PER statfs once the cache expires
+            stale = cached[1] if cached else None
+            self._statfs_cache = (now + self.STATFS_TTL, stale)
+            return stale
+        agg = aggregate_topology_info(topo.get("Topology", topo))
+        if agg["slots"] == 0:
+            # no volume servers registered (yet): report the static
+            # defaults rather than a 0-bytes-free filesystem
+            result = None
+        else:
+            limit_mb = topo.get("VolumeSizeLimitMB", 1024)
+            total = agg["slots"] * limit_mb * 1024 * 1024
+            bsize = 4096
+            blocks = max(total // bsize, 1)
+            bfree = max((total - agg["used_bytes"]) // bsize, 0)
+            files = agg["file_count"]
+            f_files = max(files * 2, 1 << 20)
+            result = (blocks, bfree, bfree, f_files,
+                      max(f_files - files, 1 << 19))
+        self._statfs_cache = (now + self.STATFS_TTL, result)
+        return result
 
     def readdir(self, ino: int) -> list[tuple[str, FileAttr]]:
         path = self.inodes.path(ino)

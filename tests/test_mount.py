@@ -66,6 +66,27 @@ def test_weedfs_operations(stack):
     w.release(m.ino, fh3)
     assert w.lookup(dattr.ino, "moved.txt").size == 5
 
+    # symlink + readlink + hard link (direct ops)
+    s = w.symlink(ROOT_ID, "lnk", "sub/moved.txt")
+    assert s is not None and stat.S_ISLNK(s.mode)
+    assert w.readlink(s.ino) == "sub/moved.txt"
+    assert w.symlink(ROOT_ID, "lnk", "x") is None  # EEXIST
+    m2 = w.lookup(dattr.ino, "moved.txt")
+    h = w.link(m2.ino, ROOT_ID, "hard.txt")
+    assert h is not None
+    # POSIX: linking onto an existing name is EEXIST, not a replace
+    import pytest as _pytest
+    with _pytest.raises(FileExistsError):
+        w.link(m2.ino, ROOT_ID, "hard.txt")
+    fh4 = w.open(h.ino)
+    assert w.read(h.ino, fh4, 0, 100) == b"hello"
+    w.release(h.ino, fh4)
+    assert w.unlink(ROOT_ID, "hard.txt") == 0
+    assert w.unlink(ROOT_ID, "lnk") == 0
+    # statfs returns cluster-shaped numbers
+    st = w.statfs()
+    assert st is not None and st[0] > 0
+
     # unlink + rmdir
     assert w.unlink(dattr.ino, "moved.txt") == 0
     assert w.rmdir(ROOT_ID, "sub") == 0
@@ -105,8 +126,27 @@ def test_real_kernel_mount(stack, tmp_path):
         status, body, _ = http_call("GET", f"http://{fs.url}/d/renamed.txt")
         assert status == 200 and body == b"written through the kernel"
 
-        os.remove(mnt / "d" / "renamed.txt")
+        # symlinks through the kernel (reference weedfs_symlink.go)
+        os.symlink("d/renamed.txt", mnt / "alias")
+        assert os.readlink(mnt / "alias") == "d/renamed.txt"
+        assert (mnt / "alias").read_bytes() == \
+            b"written through the kernel"
+        assert os.lstat(mnt / "alias").st_mode & 0o170000 == stat.S_IFLNK
+
+        # hard links share data (reference weedfs_link.go)
+        os.link(mnt / "d" / "nested.bin", mnt / "hard.bin")
+        assert (mnt / "hard.bin").read_bytes() == b"x" * 5000
         os.remove(mnt / "d" / "nested.bin")
+        # data survives while the second name exists
+        assert (mnt / "hard.bin").read_bytes() == b"x" * 5000
+
+        # statfs reflects cluster capacity
+        sv = os.statvfs(mnt)
+        assert sv.f_blocks > 0 and sv.f_bfree > 0
+
+        os.remove(mnt / "alias")
+        os.remove(mnt / "hard.bin")
+        os.remove(mnt / "d" / "renamed.txt")
         os.rmdir(mnt / "d")
         assert os.listdir(mnt) == []
     finally:
