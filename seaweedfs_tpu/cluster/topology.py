@@ -386,3 +386,18 @@ class Topology:
                     } for r in dc.racks.values()],
                 } for dc in self.data_centers.values()],
             }
+
+
+def aggregate_topology_info(topo: dict) -> dict:
+    """Sum capacity/usage over a serialized topology dump (the
+    /dir/status shape): {'slots', 'used_bytes', 'file_count'}. Shared
+    by filer Statistics and mount statfs so the walk can't drift."""
+    used = files = slots = 0
+    for dc in topo.get("data_centers", []):
+        for rack in dc.get("racks", []):
+            for dn in rack.get("nodes", []):
+                for v in dn.get("volumes", []):
+                    used += v.get("size", 0)
+                    files += v.get("file_count", 0)
+                slots += dn.get("max_volume_count", 0)
+    return {"slots": slots, "used_bytes": used, "file_count": files}

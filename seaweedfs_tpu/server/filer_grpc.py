@@ -50,6 +50,13 @@ def _entry_to_pb(e: Entry) -> pb.Entry:
     return out
 
 
+def _chunk_from_pb(c: "pb.FileChunk") -> FileChunk:
+    return FileChunk(
+        fid=c.file_id, offset=c.offset, size=c.size, mtime_ns=c.mtime,
+        etag=c.e_tag, is_chunk_manifest=c.is_chunk_manifest,
+        cipher_key=bytes.fromhex(c.cipher_key) if c.cipher_key else b"")
+
+
 def _entry_from_pb(directory: str, p: pb.Entry) -> Entry:
     full = directory.rstrip("/") + "/" + p.name if p.name else directory
     a = p.attributes
@@ -64,10 +71,7 @@ def _entry_from_pb(directory: str, p: pb.Entry) -> Entry:
         content=bytes(p.content),
         hard_link_id=p.hard_link_id.decode() if p.hard_link_id else "")
     for c in p.chunks:
-        entry.chunks.append(FileChunk(
-            fid=c.file_id, offset=c.offset, size=c.size, mtime_ns=c.mtime,
-            etag=c.e_tag, is_chunk_manifest=c.is_chunk_manifest,
-            cipher_key=bytes.fromhex(c.cipher_key) if c.cipher_key else b""))
+        entry.chunks.append(_chunk_from_pb(c))
     entry.extended = {k: bytes(v) for k, v in p.extended.items()}
     return entry
 
@@ -236,6 +240,101 @@ class FilerGrpc:
             resp.locations_map[vid_str].CopyFrom(locs)
         return resp
 
+    def append_to_entry(self, request, context):
+        """reference filer_grpc_server.go AppendToEntry: extend an
+        entry's chunk list at its current tail (log-style appends; the
+        mq broker writes segments this way). The read-modify-write runs
+        under the filer lock so concurrent appenders can't compute the
+        same tail offset."""
+        import time as _time
+
+        from seaweedfs_tpu.filer.entry import Attr
+        path = request.directory.rstrip("/") + "/" + request.entry_name
+        with self.fs.filer._lock:
+            entry = self.fs.filer.find_entry(path)
+            if entry is None:
+                entry = Entry(full_path=path,
+                              attr=Attr(mtime=_time.time(),
+                                        crtime=_time.time(), mode=0o644))
+            elif entry.content:
+                # inline content can't coexist with chunks (the read
+                # path prefers content): spill it to a chunk first
+                fc = self.fs._save_chunk(entry.content, 0, "", "")
+                entry.chunks = [fc]
+                entry.content = b""
+            offset = entry.file_size()
+            for c in request.chunks:
+                fc = _chunk_from_pb(c)
+                fc.offset = offset
+                if not fc.mtime_ns:
+                    fc.mtime_ns = _time.time_ns()
+                offset += fc.size
+                entry.chunks.append(fc)
+            entry.attr.file_size = offset
+            try:
+                self.fs.filer.create_entry(entry)
+            except Exception as e:
+                return pb.AppendToEntryResponse(error=str(e))
+        return pb.AppendToEntryResponse()
+
+    def collection_list(self, request, context):
+        from seaweedfs_tpu.utils.httpd import http_json
+        try:
+            out = http_json("GET",
+                            f"http://{self.fs.master_url}/col/list")
+        except ConnectionError as e:
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        return pb.CollectionListResponse(
+            collections=[c["name"] if isinstance(c, dict) else c
+                         for c in out.get("collections", [])])
+
+    def delete_collection(self, request, context):
+        from seaweedfs_tpu.utils.httpd import http_json
+        try:
+            http_json("POST", f"http://{self.fs.master_url}/col/delete"
+                              f"?collection={request.collection}")
+        except ConnectionError as e:
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        return pb.DeleteCollectionResponse()
+
+    def ping(self, request, context):
+        import time as _time
+        start = _time.time_ns()
+        remote = start
+        if request.target:
+            from seaweedfs_tpu.utils.httpd import http_call
+            try:
+                http_call("GET", f"http://{request.target}/status",
+                          timeout=5)
+                remote = _time.time_ns()
+            except Exception as e:
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        return pb.PingResponse(start_time_ns=start,
+                               remote_time_ns=remote,
+                               stop_time_ns=_time.time_ns())
+
+    def cache_remote_object(self, request, context):
+        """reference filer_grpc_server_remote.go: materialize a
+        remote-mounted entry's bytes as local chunks."""
+        path = request.directory.rstrip("/") + "/" + request.name
+        entry = self.fs.filer.find_entry(path)
+        if entry is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, path)
+        if entry.remote is not None and not entry.chunks \
+                and not entry.content:
+            try:
+                rule = self.fs._current_filer_conf().match_storage_rule(
+                    path)
+                self.fs.remote_mounts.cache_entry(
+                    entry, lambda data: self.fs._upload_chunks(
+                        data, rule.collection, rule.replication,
+                        rule.ttl))
+                entry = self.fs.filer.find_entry(path)
+            except Exception as e:
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        return pb.CacheRemoteObjectToLocalClusterResponse(
+            entry=_entry_to_pb(entry))
+
     # ---- misc ----
     def statistics(self, request, context):
         """Aggregate capacity from the master topology (reference
@@ -244,18 +343,12 @@ class FilerGrpc:
             topo = self.fs.mc.topology()
         except Exception:
             return pb.StatisticsResponse()
-        total_slots = used = files = 0
-        topology = topo.get("Topology", topo)
+        from seaweedfs_tpu.cluster.topology import aggregate_topology_info
+        agg = aggregate_topology_info(topo.get("Topology", topo))
         limit = topo.get("VolumeSizeLimitMB", 0) * 1024 * 1024
-        for dc in topology.get("data_centers", []):
-            for rack in dc.get("racks", []):
-                for dn in rack.get("nodes", []):
-                    for v in dn.get("volumes", []):
-                        used += v.get("size", 0)
-                        files += v.get("file_count", 0)
-                    total_slots += dn.get("max_volume_count", 0)
-        return pb.StatisticsResponse(total_size=total_slots * limit,
-                                     used_size=used, file_count=files)
+        return pb.StatisticsResponse(total_size=agg["slots"] * limit,
+                                     used_size=agg["used_bytes"],
+                                     file_count=agg["file_count"])
 
     def get_configuration(self, request, context):
         return pb.GetFilerConfigurationResponse(
@@ -292,6 +385,23 @@ class FilerGrpc:
             "SubscribeMetadata": ustream(self.subscribe_metadata,
                                          pb.SubscribeMetadataRequest,
                                          pb.SubscribeMetadataResponse),
+            "SubscribeLocalMetadata": ustream(
+                self.subscribe_metadata, pb.SubscribeMetadataRequest,
+                pb.SubscribeMetadataResponse),
+            "AppendToEntry": unary(self.append_to_entry,
+                                   pb.AppendToEntryRequest,
+                                   pb.AppendToEntryResponse),
+            "CollectionList": unary(self.collection_list,
+                                    pb.CollectionListRequest,
+                                    pb.CollectionListResponse),
+            "DeleteCollection": unary(self.delete_collection,
+                                      pb.DeleteCollectionRequest,
+                                      pb.DeleteCollectionResponse),
+            "Ping": unary(self.ping, pb.PingRequest, pb.PingResponse),
+            "CacheRemoteObjectToLocalCluster": unary(
+                self.cache_remote_object,
+                pb.CacheRemoteObjectToLocalClusterRequest,
+                pb.CacheRemoteObjectToLocalClusterResponse),
             "AssignVolume": unary(self.assign_volume,
                                   pb.AssignVolumeRequest,
                                   pb.AssignVolumeResponse),

@@ -293,32 +293,43 @@ class MasterServer:
         return Response({"cluster_nodes": nodes})
 
     def _handle_col_list(self, req: Request) -> Response:
-        cols = sorted({c for (c, _, _) in self.topo.layouts if c})
+        # only collections that still HOLD volumes: stale delta
+        # processing can re-create an empty layout key after a
+        # collection delete (get_layout is get-or-create)
+        cols = sorted({c for (c, _, _), lo in self.topo.layouts.items()
+                       if c and lo.locations})
         return Response({"collections": [{"name": c} for c in cols]})
 
     def _handle_col_delete(self, req: Request) -> Response:
         collection = req.query.get("collection", "")
         if not collection:
             return Response({"error": "collection required"}, status=400)
-        deleted = []
         with self.topo.lock:
             doomed = []
             for node in self.topo.all_nodes():
                 for vid, v in list(node.volumes.items()):
                     if v.get("collection", "") == collection:
                         doomed.append((node, vid, v))
+        # the HTTP deletes run OUTSIDE the topology lock: the volume
+        # server's delete handler pushes a delta heartbeat back at this
+        # master, which needs the same lock (holding it here deadlocks
+        # until the pusher's timeout)
+        deleted = []
+        for node, vid, v in doomed:
+            try:
+                http_json("POST",
+                          f"http://{node.url}/admin/delete_volume",
+                          {"volume_id": vid}, timeout=30)
+            except Exception:
+                pass
+            deleted.append(vid)
+        with self.topo.lock:
             for node, vid, v in doomed:
-                try:
-                    http_json("POST",
-                              f"http://{node.url}/admin/delete_volume",
-                              {"volume_id": vid}, timeout=30)
-                except Exception:
-                    pass
-                node.volumes.pop(vid, None)
-                self.topo._unregister_volume(v, node)
-                deleted.append(vid)
-        for key in [k for k in self.topo.layouts if k[0] == collection]:
-            del self.topo.layouts[key]
+                if node.volumes.pop(vid, None) is not None:
+                    self.topo._unregister_volume(v, node)
+            for key in [k for k in self.topo.layouts
+                        if k[0] == collection]:
+                del self.topo.layouts[key]
         return Response({"deleted_volume_ids": sorted(set(deleted))})
 
     def _handle_ui(self, req: Request) -> Response:

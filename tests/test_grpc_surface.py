@@ -198,6 +198,120 @@ def test_replica_catch_up_via_tail_receiver(stack, tmp_path):
         vs2.stop()
 
 
+def test_filer_misc_rpcs(stack):
+    """AppendToEntry / CollectionList / DeleteCollection / Ping /
+    SubscribeLocalMetadata (reference filer.proto parity)."""
+    master, vs, fs, fc, vc = stack
+
+    # AppendToEntry builds a log-style file chunk by chunk
+    pieces = []
+    for i in range(3):
+        a = fc.assign_volume()
+        blob = f"segment-{i}|".encode()
+        http_call("POST", f"http://{a.url}/{a.file_id}", body=blob)
+        pieces.append((a.file_id, blob))
+    for fid, blob in pieces:
+        r = fc._unary("AppendToEntry", fpb.AppendToEntryRequest(
+            directory="/logs", entry_name="app.log",
+            chunks=[fpb.FileChunk(file_id=fid, size=len(blob),
+                                  mtime=time.time_ns())]),
+            fpb.AppendToEntryResponse)
+        assert not r.error
+    status, body, _ = http_call("GET", f"http://{fs.url}/logs/app.log")
+    assert status == 200
+    assert body == b"segment-0|segment-1|segment-2|"
+
+    # appending to an INLINE-content entry spills the content to a
+    # chunk first (round-4 review: content+chunks coexisting makes the
+    # appended bytes unreadable)
+    http_call("POST", f"http://{fs.url}/logs/tiny.log", body=b"head|")
+    a = fc.assign_volume()
+    http_call("POST", f"http://{a.url}/{a.file_id}", body=b"tail")
+    r = fc._unary("AppendToEntry", fpb.AppendToEntryRequest(
+        directory="/logs", entry_name="tiny.log",
+        chunks=[fpb.FileChunk(file_id=a.file_id, size=4,
+                              mtime=time.time_ns())]),
+        fpb.AppendToEntryResponse)
+    assert not r.error
+    status, body, _ = http_call("GET", f"http://{fs.url}/logs/tiny.log")
+    assert status == 200 and body == b"head|tail"
+
+    # collections appear/disappear via gRPC
+    a = fc.assign_volume(collection="grpccol")
+    http_call("POST", f"http://{a.url}/{a.file_id}", body=b"c")
+    vs.heartbeat_once()
+    r = fc._unary("CollectionList", fpb.CollectionListRequest(),
+                  fpb.CollectionListResponse)
+    assert "grpccol" in list(r.collections)
+    fc._unary("DeleteCollection",
+              fpb.DeleteCollectionRequest(collection="grpccol"),
+              fpb.DeleteCollectionResponse)
+    vs.heartbeat_once()
+    r = fc._unary("CollectionList", fpb.CollectionListRequest(),
+                  fpb.CollectionListResponse)
+    assert "grpccol" not in list(r.collections)
+
+    # ping self and via target
+    p = fc._unary("Ping", fpb.PingRequest(), fpb.PingResponse)
+    assert p.stop_time_ns >= p.start_time_ns
+
+    # SubscribeLocalMetadata streams the same log
+    ch = fc.channel.unary_stream(
+        "/weedtpu_filer_pb.SeaweedFiler/SubscribeLocalMetadata",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=fpb.SubscribeMetadataResponse.FromString)
+    call = ch(fpb.SubscribeMetadataRequest(client_name="t",
+                                           path_prefix="/logs",
+                                           since_ns=0))
+    first = next(iter(call))
+    assert first.directory.startswith("/logs")
+    call.cancel()
+
+
+def test_master_admin_rpcs(tmp_path):
+    """Statistics / CollectionList / CollectionDelete /
+    GetMasterConfiguration on the master gRPC plane (reference
+    master.proto parity)."""
+    from seaweedfs_tpu.server.master_grpc import GrpcMasterClient
+    master = MasterServer(volume_size_limit_mb=64, grpc_port=0)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    time.sleep(0.1)
+    client = GrpcMasterClient(f"127.0.0.1:{master.grpc_port}")
+    try:
+        a = http_json("GET", f"http://{master.url}/dir/assign"
+                             "?collection=mcol")
+        http_call("POST", f"http://{a['url']}/{a['fid']}", body=b"zz")
+        vs.heartbeat_once()
+
+        st = client._call("Statistics", mpb.StatisticsRequest(),
+                          mpb.StatisticsResponse)
+        assert st.total_size > 0 and st.used_size > 0
+
+        cl = client._call("CollectionList", mpb.CollectionListRequest(),
+                          mpb.CollectionListResponse)
+        assert any(c.name == "mcol" for c in cl.collections)
+
+        client._call("CollectionDelete",
+                     mpb.CollectionDeleteRequest(name="mcol"),
+                     mpb.CollectionDeleteResponse)
+        vs.heartbeat_once()
+        cl = client._call("CollectionList", mpb.CollectionListRequest(),
+                          mpb.CollectionListResponse)
+        assert not any(c.name == "mcol" for c in cl.collections)
+
+        conf = client._call("GetMasterConfiguration",
+                            mpb.GetMasterConfigurationRequest(),
+                            mpb.GetMasterConfigurationResponse)
+        assert conf.volume_size_limit_m_b == 64
+        assert conf.leader
+    finally:
+        client.close()
+        vs.stop()
+        master.stop()
+
+
 def test_query_rpc(stack):
     master, vs, fs, fc, vc = stack
     rows = [{"name": "ada", "age": 36}, {"name": "grace", "age": 45},
