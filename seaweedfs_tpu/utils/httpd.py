@@ -288,7 +288,14 @@ def _pooled_conn(netloc: str, timeout: float):
     already open from a previous request — the only case where an
     automatic retry is safe (a stale kept-alive socket fails before the
     server sees anything; a fresh connection that dies mid-response may
-    have EXECUTED the request, so replaying it is the caller's call)."""
+    have EXECUTED the request, so replaying it is the caller's call).
+
+    A pooled socket is liveness-checked before reuse (urllib3's
+    is_connection_dropped): a peer that closed shows readable-EOF, and
+    sending into it would "succeed" into the kernel buffer and only
+    fail at response time — un-retryable for non-idempotent methods.
+    This matters when a server restarts on a reused port."""
+    import select
     pool = getattr(_conn_local, "conns", None)
     if pool is None:
         pool = _conn_local.conns = {}
@@ -298,6 +305,17 @@ def _pooled_conn(netloc: str, timeout: float):
         pool[netloc] = conn
         return conn, False
     if conn.sock is None:
+        return conn, False
+    try:
+        readable, _, _ = select.select([conn.sock], [], [], 0)
+    except (OSError, ValueError):
+        readable = [conn.sock]
+    if readable:
+        # EOF or unsolicited bytes: the peer is gone (or the stream is
+        # desynced) — replace with a fresh connection
+        conn.close()
+        conn = _make_conn(netloc, timeout)
+        pool[netloc] = conn
         return conn, False
     conn.sock.settimeout(timeout)
     return conn, True
