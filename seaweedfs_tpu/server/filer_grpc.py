@@ -504,6 +504,30 @@ class GrpcFilerClient:
         return self._unary("Statistics", pb.StatisticsRequest(),
                            pb.StatisticsResponse)
 
+    def append_to_entry(self, directory: str, name: str,
+                        chunks: list) -> None:
+        r = self._unary("AppendToEntry", pb.AppendToEntryRequest(
+            directory=directory, entry_name=name, chunks=chunks),
+            pb.AppendToEntryResponse)
+        if r.error:
+            raise RuntimeError(r.error)
+
+    def collection_list(self) -> list[str]:
+        r = self._unary("CollectionList", pb.CollectionListRequest(),
+                        pb.CollectionListResponse)
+        return list(r.collections)
+
+    def delete_collection(self, name: str) -> None:
+        self._unary("DeleteCollection",
+                    pb.DeleteCollectionRequest(collection=name),
+                    pb.DeleteCollectionResponse)
+
+    def ping(self, target: str = "", target_type: str = ""
+             ) -> pb.PingResponse:
+        return self._unary("Ping", pb.PingRequest(
+            target=target, target_type=target_type), pb.PingResponse,
+            timeout=10)
+
     def get_configuration(self) -> pb.GetFilerConfigurationResponse:
         return self._unary("GetFilerConfiguration",
                            pb.GetFilerConfigurationRequest(),

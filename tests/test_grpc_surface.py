@@ -211,12 +211,9 @@ def test_filer_misc_rpcs(stack):
         http_call("POST", f"http://{a.url}/{a.file_id}", body=blob)
         pieces.append((a.file_id, blob))
     for fid, blob in pieces:
-        r = fc._unary("AppendToEntry", fpb.AppendToEntryRequest(
-            directory="/logs", entry_name="app.log",
-            chunks=[fpb.FileChunk(file_id=fid, size=len(blob),
-                                  mtime=time.time_ns())]),
-            fpb.AppendToEntryResponse)
-        assert not r.error
+        fc.append_to_entry("/logs", "app.log",
+                           [fpb.FileChunk(file_id=fid, size=len(blob),
+                                          mtime=time.time_ns())])
     status, body, _ = http_call("GET", f"http://{fs.url}/logs/app.log")
     assert status == 200
     assert body == b"segment-0|segment-1|segment-2|"
@@ -240,19 +237,13 @@ def test_filer_misc_rpcs(stack):
     a = fc.assign_volume(collection="grpccol")
     http_call("POST", f"http://{a.url}/{a.file_id}", body=b"c")
     vs.heartbeat_once()
-    r = fc._unary("CollectionList", fpb.CollectionListRequest(),
-                  fpb.CollectionListResponse)
-    assert "grpccol" in list(r.collections)
-    fc._unary("DeleteCollection",
-              fpb.DeleteCollectionRequest(collection="grpccol"),
-              fpb.DeleteCollectionResponse)
+    assert "grpccol" in fc.collection_list()
+    fc.delete_collection("grpccol")
     vs.heartbeat_once()
-    r = fc._unary("CollectionList", fpb.CollectionListRequest(),
-                  fpb.CollectionListResponse)
-    assert "grpccol" not in list(r.collections)
+    assert "grpccol" not in fc.collection_list()
 
     # ping self and via target
-    p = fc._unary("Ping", fpb.PingRequest(), fpb.PingResponse)
+    p = fc.ping()
     assert p.stop_time_ns >= p.start_time_ns
 
     # SubscribeLocalMetadata streams the same log
