@@ -96,31 +96,35 @@ class LocalSink(ReplicationSink):
 
 
 class S3Sink(ReplicationSink):
-    """Replicate objects into an S3-compatible bucket."""
+    """Replicate objects into an S3-compatible bucket (reference
+    replication/sink/s3sink — and, via the shared SigV4 client, the
+    gcs-interop/b2/wasabi endpoints the reference covers with separate
+    SDK sinks). Anonymous when no access key is given."""
 
     name = "s3"
 
-    def __init__(self, endpoint: str, bucket: str, prefix: str = ""):
-        self.endpoint = endpoint.rstrip("/")
-        self.bucket = bucket
+    def __init__(self, endpoint: str, bucket: str, prefix: str = "",
+                 access_key: str = "", secret_key: str = "",
+                 region: str = "us-east-1"):
+        from seaweedfs_tpu.remote_storage.s3_client import S3Remote
+        self.client = S3Remote(endpoint, bucket, access_key=access_key,
+                               secret_key=secret_key, region=region)
         self.prefix = prefix.strip("/")
 
-    def _url(self, path: str) -> str:
-        key = (self.prefix + "/" if self.prefix else "") + path.lstrip("/")
-        return f"{self.endpoint}/{self.bucket}/{urllib.parse.quote(key)}"
+    def _key(self, path: str) -> str:
+        return (self.prefix + "/" if self.prefix else "") \
+            + path.lstrip("/")
 
     def create_entry(self, path: str, entry: dict,
                      data: Optional[bytes]) -> None:
         if entry.get("attr", {}).get("is_directory"):
             return
-        from seaweedfs_tpu.utils.httpd import http_call
-        http_call("PUT", self._url(path), body=data or b"")
+        self.client.write_file(self._key(path), data or b"")
 
     def delete_entry(self, path: str, is_directory: bool) -> None:
         if is_directory:
             return
-        from seaweedfs_tpu.utils.httpd import http_call
-        http_call("DELETE", self._url(path))
+        self.client.remove_file(self._key(path))
 
 
 class Replicator:

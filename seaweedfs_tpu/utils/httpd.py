@@ -232,6 +232,16 @@ class HttpServer:
                     self.live_conns.discard(request)
                 super().shutdown_request(request)
 
+            def handle_error(self, request, client_address):
+                # severed-at-stop connections die with broken pipes in
+                # their handler threads; that's expected, not a crash
+                import sys
+                exc = sys.exception()
+                if isinstance(exc, (BrokenPipeError,
+                                    ConnectionResetError, OSError)):
+                    return
+                super().handle_error(request, client_address)
+
             def close_all_connections(self):
                 with self._conn_lock:
                     conns = list(self.live_conns)
