@@ -163,12 +163,17 @@ class FilerSync:
 
     def start(self, since_ns: int = 0) -> None:
         def loop():
+            import logging
+            log = logging.getLogger("seaweedfs_tpu.sync")
             cursor = since_ns
             while not self._stop.is_set():
                 try:
                     cursor = self.run_once(cursor)
-                except (ConnectionError, HttpError):
-                    pass
+                except (ConnectionError, HttpError, OSError) as e:
+                    # transient sink/source failures (incl. the S3
+                    # sink's IOError on non-2xx) must not kill the
+                    # daemon — log and retry from the same cursor
+                    log.warning("sync pass failed, retrying: %s", e)
                 self._stop.wait(0.2)
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

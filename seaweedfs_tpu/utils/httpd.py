@@ -234,11 +234,12 @@ class HttpServer:
 
             def handle_error(self, request, client_address):
                 # severed-at-stop connections die with broken pipes in
-                # their handler threads; that's expected, not a crash
+                # their handler threads; that's expected, not a crash.
+                # ONLY connection-class errors are quieted — other
+                # OSErrors (fd exhaustion etc.) must stay visible.
                 import sys
-                exc = sys.exception()
-                if isinstance(exc, (BrokenPipeError,
-                                    ConnectionResetError, OSError)):
+                exc = sys.exc_info()[1]
+                if isinstance(exc, ConnectionError):
                     return
                 super().handle_error(request, client_address)
 
