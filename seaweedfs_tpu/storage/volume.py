@@ -297,6 +297,11 @@ class Volume:
         with self._lock:
             if self._backend is not None:
                 raise ValueError(f"volume {self.id} is already tiered")
+            if self.is_compacting:
+                # tiering closes/replaces the .dat the copy phase is
+                # reading from
+                raise RuntimeError(
+                    f"volume {self.id} is compacting; retry later")
             self.read_only = True
             self.sync()
             self._dat.close()
@@ -352,11 +357,22 @@ class Volume:
 
     def compact(self) -> None:
         """Rewrite live needles to .cpd/.cpx then atomically commit
-        (reference volume_vacuum.go Compact2/CommitCompact)."""
+        (reference volume_vacuum.go Compact2/CommitCompact).
+
+        The bulk copy runs WITHOUT the volume lock — reads and writes
+        keep serving while gigabytes stream to the compact files (the
+        lock is taken per-snapshot and per-record-read only). Changes
+        that land during the copy are replayed as a tail delta inside
+        the brief commit lock (the reference's makeupDiff)."""
         with self._lock:
             if self._backend is not None:
                 raise ValueError(
                     f"volume {self.id} is cloud-tiered; download it first")
+            if self.is_compacting:
+                # two interleaved compactions would truncate each
+                # other's .cpd mid-copy and commit a corrupt volume
+                raise RuntimeError(
+                    f"volume {self.id} is already compacting")
             self.is_compacting = True
         try:
             base = self.file_name()
@@ -369,27 +385,28 @@ class Volume:
             with open(base + ".cpd", "wb") as dat, \
                     open(base + ".cpx", "wb") as idxf:
                 dat.write(new_sb.to_bytes())
-                entries = []
+                # snapshot the live map, then copy WITHOUT the lock
+                # held across the loop: each record read re-takes it
+                # briefly (concurrent writers/readers interleave)
+                snapshot: dict[int, tuple[int, int]] = {}
                 with self._lock:
                     self.nm.ascending_visit(
-                        lambda k, o, s: entries.append((k, o, s)))
-                    for key, off_units, size in entries:
-                        if not t.size_is_valid(size):
-                            continue
+                        lambda k, o, s: snapshot.__setitem__(k, (o, s))
+                        if t.size_is_valid(s) else None)
+                for key, (off_units, size) in snapshot.items():
+                    with self._lock:
                         blob = self._read_at(
                             t.offset_to_actual(off_units),
                             t.get_actual_size(size, self.version))
-                        # records are 8-byte aligned; the superblock may
-                        # end unaligned (wide-offset marker extra bytes)
-                        pad = (-dat.tell()) % t.NEEDLE_PADDING_SIZE
-                        if pad:
-                            dat.write(b"\0" * pad)
-                        new_off = dat.tell()
-                        dat.write(blob)
-                        idxf.write(t.pack_entry(
-                            key, t.actual_to_offset(new_off), size,
-                            self.offset_bytes))
+                    self._append_compact_record(dat, idxf, key, size,
+                                                blob)
             with self._lock:
+                if self._dat is None:
+                    raise RuntimeError(
+                        f"volume {self.id} was closed during compact")
+                # tail delta: anything created/changed/deleted since
+                # the snapshot gets replayed onto the compact files
+                self._replay_compact_delta(base, snapshot)
                 self._dat.close()
                 self._idx.close()
                 self._close_nm()
@@ -402,8 +419,59 @@ class Volume:
                 os.replace(base + ".cpd", base + ".dat")
                 os.replace(base + ".cpx", base + ".idx")
                 self._load()
+                # the delta may have replayed duplicate keys /
+                # tombstones into the new .idx; the map resolved them,
+                # so re-derive the stats from the resolved state
+                self.nm.file_count = len(self.nm)
+        except BaseException:
+            for ext in (".cpd", ".cpx"):
+                try:
+                    os.remove(base + ext)
+                except OSError:
+                    pass
+            raise
         finally:
             self.is_compacting = False
+
+    def _append_compact_record(self, dat, idxf, key: int, size: int,
+                               blob: bytes) -> None:
+        # records are 8-byte aligned; the superblock may end unaligned
+        # (wide-offset marker extra bytes)
+        pad = (-dat.tell()) % t.NEEDLE_PADDING_SIZE
+        if pad:
+            dat.write(b"\0" * pad)
+        new_off = dat.tell()
+        dat.write(blob)
+        idxf.write(t.pack_entry(key, t.actual_to_offset(new_off), size,
+                                self.offset_bytes))
+
+    def _replay_compact_delta(self, base: str,
+                              snapshot: dict[int, tuple[int, int]]
+                              ) -> None:
+        """Called under the lock at commit time: diff the LIVE needle
+        map against the copy-phase snapshot and append the difference
+        to .cpd/.cpx — new/overwritten needles copied, deletions
+        tombstoned (reference volume_vacuum.go makeupDiff)."""
+        live: dict[int, tuple[int, int]] = {}
+        self.nm.ascending_visit(
+            lambda k, o, s: live.__setitem__(k, (o, s)))
+        changed = [(k, os_) for k, os_ in live.items()
+                   if t.size_is_valid(os_[1]) and snapshot.get(k) != os_]
+        deleted = [k for k in snapshot if k not in live
+                   or not t.size_is_valid(live[k][1])]
+        if not changed and not deleted:
+            return
+        with open(base + ".cpd", "ab") as dat, \
+                open(base + ".cpx", "ab") as idxf:
+            for key, (off_units, size) in sorted(changed):
+                blob = self._read_at(t.offset_to_actual(off_units),
+                                     t.get_actual_size(size,
+                                                       self.version))
+                self._append_compact_record(dat, idxf, key, size, blob)
+            for key in sorted(deleted):
+                # idx replay treats a tombstone entry as a delete
+                idxf.write(t.pack_entry(key, 0, t.TOMBSTONE_FILE_SIZE,
+                                        self.offset_bytes))
 
     # ---- integrity ----
     def check_integrity(self) -> bool:

@@ -119,3 +119,82 @@ def test_tier_volume_to_s3_and_read_back(tmp_path):
         fs.stop()
         vs.stop()
         master.stop()
+
+
+def test_compact_serves_concurrent_writes(tmp_path):
+    """Round-4: vacuum must not block serving (reference Compact2 +
+    makeupDiff). Writers and readers run THROUGHOUT the compact; the
+    tail delta — creates, overwrites, deletes landing mid-copy — is
+    replayed at commit."""
+    import threading
+
+    v = Volume(str(tmp_path), "", 9)
+    payloads = {}
+    for i in range(1, 200):
+        data = bytes([i % 256]) * 512
+        payloads[i] = data
+        v.write_needle(Needle(id=i, cookie=1, data=data))
+    for i in range(1, 100, 2):  # garbage to reclaim
+        v.delete_needle(i)
+        payloads.pop(i)
+
+    stop = threading.Event()
+    written_during = {}
+    lock = threading.Lock()
+    errors = []
+
+    def churn():
+        k = 10_000
+        while not stop.is_set():
+            try:
+                data = bytes([k % 256]) * 256
+                v.write_needle(Needle(id=k, cookie=1, data=data))
+                with lock:
+                    written_during[k] = data
+                if k % 5 == 0:  # overwrite an old live needle too
+                    tgt = 100 + (k % 50)
+                    nd = bytes([7]) * 64
+                    v.write_needle(Needle(id=tgt, cookie=1, data=nd))
+                    with lock:
+                        if tgt in payloads:
+                            payloads[tgt] = nd
+                if k % 7 == 0:  # and delete one
+                    tgt = 150 + (k % 40)
+                    v.delete_needle(tgt)
+                    with lock:
+                        payloads.pop(tgt, None)
+                        written_during.pop(tgt, None)
+                # reads keep working mid-compact
+                v.read_needle(2, 1)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+            k += 1
+
+    th = threading.Thread(target=churn)
+    th.start()
+    time.sleep(0.05)
+    v.compact()
+    stop.set()
+    th.join(timeout=10)
+    assert not th.is_alive(), "churn thread deadlocked against compact"
+    assert not errors, errors
+    # stats re-derived from the resolved map, not the raw idx replay
+    assert v.nm.file_count == len(v.nm)
+
+    # every live needle — pre-existing, overwritten, or written during
+    # the compact — reads back; deleted ones are gone
+    with lock:
+        expected = {**payloads, **written_during}
+    for key, data in expected.items():
+        assert v.read_needle(key, 1).data == data, f"needle {key}"
+    for i in range(1, 100, 2):
+        with pytest.raises(Exception):
+            v.read_needle(i, 1)
+
+    # and the state survives a reopen from the compacted files
+    v.close()
+    v2 = Volume(str(tmp_path), "", 9)
+    for key, data in expected.items():
+        assert v2.read_needle(key, 1).data == data, f"reopen {key}"
+    v2.close()
