@@ -401,3 +401,14 @@ def aggregate_topology_info(topo: dict) -> dict:
                     files += v.get("file_count", 0)
                 slots += dn.get("max_volume_count", 0)
     return {"slots": slots, "used_bytes": used, "file_count": files}
+
+
+def find_node_info(topo: dict, node_url: str) -> Optional[dict]:
+    """Locate one node's info dict in a serialized topology dump by its
+    'ip:port' id (shared by shell gRPC-client resolution and backup)."""
+    for dc in topo.get("data_centers", []):
+        for rack in dc.get("racks", []):
+            for n in rack.get("nodes", []):
+                if n["id"] == node_url:
+                    return n
+    return None

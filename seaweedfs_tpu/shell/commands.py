@@ -41,15 +41,12 @@ class ShellContext:
 
             from seaweedfs_tpu.server.volume_grpc import GrpcVolumeClient
             from seaweedfs_tpu.utils.tls import make_channel
+            from seaweedfs_tpu.cluster.topology import find_node_info
             ip, port = node.rsplit(":", 1)
             # the node advertises its gRPC port in heartbeats; fall
             # back to the reference's port+10000 convention
-            gport = 0
-            for dc in self.topology().get("data_centers", []):
-                for rack in dc.get("racks", []):
-                    for n in rack.get("nodes", []):
-                        if n["id"] == node:
-                            gport = n.get("grpc_port", 0)
+            info = find_node_info(self.topology(), node)
+            gport = info.get("grpc_port", 0) if info else 0
             addr = f"{ip}:{gport or int(port) + 10000}"
             ch = make_channel(addr)  # honors security.toml mTLS
             _grpc.channel_ready_future(ch).result(timeout=0.5)

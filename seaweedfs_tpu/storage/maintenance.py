@@ -113,8 +113,12 @@ def export_volume(base_path: str, out_dir: str,
 
 def backup_volume(master_url: str, vid: int, out_dir: str,
                   collection: str = "") -> str:
-    """Pull a volume's .dat/.idx from whichever server has it
-    (reference command/backup.go). Returns the local base path."""
+    """Pull a volume to a local directory (reference command/backup.go).
+    First run copies .dat/.idx whole; later runs against the same
+    out_dir catch up INCREMENTALLY via the gRPC tail plane when the
+    source serves it — only records appended since the local tail cross
+    the wire (the reference's backup does the same via appendAtNs).
+    Returns the local base path."""
     from seaweedfs_tpu.utils.httpd import http_call, http_json
     os.makedirs(out_dir, exist_ok=True)
     locs = http_json(
@@ -124,6 +128,16 @@ def backup_volume(master_url: str, vid: int, out_dir: str,
     url = locs["locations"][0]["url"]
     name = f"{collection}_{vid}" if collection else str(vid)
     base = os.path.join(out_dir, name)
+
+    if os.path.exists(base + ".dat") and os.path.exists(base + ".idx"):
+        gport = _grpc_port_for(master_url, url)
+        if gport:
+            try:
+                return _backup_incremental(out_dir, collection, vid,
+                                           base, url, gport)
+            except Exception:
+                pass  # fall through to a full copy
+
     for ext in (".dat", ".idx"):
         status, body, _ = http_call(
             "GET", f"http://{url}/admin/volume_file?volumeId={vid}"
@@ -133,6 +147,79 @@ def backup_volume(master_url: str, vid: int, out_dir: str,
         with open(base + ext, "wb") as f:
             f.write(body)
     return base
+
+
+def _grpc_port_for(master_url: str, node_url: str) -> int:
+    """The node's advertised gRPC port, from the master topology.
+    Best-effort: ANY failure means 'no gRPC plane' and the caller does
+    a full copy."""
+    from seaweedfs_tpu.cluster.topology import find_node_info
+    from seaweedfs_tpu.utils.httpd import http_json
+    try:
+        topo = http_json("GET", f"http://{master_url}/dir/status")
+        node = find_node_info(topo.get("Topology", topo), node_url)
+    except Exception:
+        return 0
+    return node.get("grpc_port", 0) if node else 0
+
+
+def _backup_incremental(out_dir: str, collection: str, vid: int,
+                        base: str, node_url: str, gport: int) -> str:
+    """Open the local copy as a volume and replay the source's tail
+    (appends + deletes) since the local last-append timestamp."""
+    from seaweedfs_tpu.server.volume_grpc import GrpcVolumeClient
+    from seaweedfs_tpu.storage.volume import Volume
+    host = node_url.rsplit(":", 1)[0]
+    v = Volume(out_dir, collection, vid)
+    try:
+        client = GrpcVolumeClient(f"{host}:{gport}")
+        try:
+            # a source-side vacuum rewrote history (deletes absorbed
+            # into the compacted file would never reach the tail) —
+            # revision mismatch forces a full re-copy, like the
+            # reference's CompactRevision check (command/backup.go)
+            st = client.read_volume_file_status(vid)
+            if st.compaction_revision != \
+                    v.super_block.compaction_revision:
+                raise RuntimeError("compaction revision changed")
+            since = _last_local_append_ns(v, base)
+            for n in client.volume_tail_needles(vid, since_ns=since):
+                if n.size == 0 and not n.data:
+                    v.delete_needle(n.id)
+                else:
+                    v.write_needle(n)
+        finally:
+            client.close()
+    finally:
+        v.close()
+    return base
+
+
+def _last_local_append_ns(v, base: str) -> int:
+    """append_at_ns of the newest LIVE record in the local copy: walk
+    the .idx backwards past tombstones to the last addressable needle
+    (replaying a hair too much is harmless — the records are
+    idempotent)."""
+    esize = t.entry_size(v.offset_bytes)
+    try:
+        size = os.path.getsize(base + ".idx")
+    except OSError:
+        return 0
+    with open(base + ".idx", "rb") as f:
+        pos = size - esize
+        while pos >= 0:
+            f.seek(pos)
+            key, off, sz = t.unpack_entry(f.read(esize), 0,
+                                          v.offset_bytes)
+            if off != 0 and t.size_is_valid(sz):
+                try:
+                    return v.read_needle(key).append_at_ns
+                except Exception:
+                    # the needle behind this stale idx entry was later
+                    # deleted (or is unreadable) — keep walking back
+                    pass
+            pos -= esize
+    return 0
 
 
 def compact_volume(base_path: str) -> tuple[int, int]:

@@ -200,3 +200,67 @@ def test_compact_serves_concurrent_writes(tmp_path):
     for key, data in expected.items():
         assert v2.read_needle(key, 1).data == data, f"reopen {key}"
     v2.close()
+
+
+def test_backup_volume_incremental(tmp_path):
+    """Second backup run catches up via the gRPC tail instead of
+    re-copying the whole volume (reference command/backup.go)."""
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url, grpc_port=0)
+    vs.start()
+    time.sleep(0.1)
+    out = str(tmp_path / "bak")
+    try:
+        mc = MasterClient(master.url)
+        fid1 = operation.upload_data(mc, b"first wave " * 50).fid
+        vid = int(fid1.split(",")[0])
+        vs.heartbeat_once()
+
+        base = maintenance.backup_volume(master.url, vid, out)
+        size_after_full = os.path.getsize(base + ".dat")
+
+        # more writes + a delete land on the source
+        fid2 = operation.upload_data(mc, b"second wave " * 80).fid
+        for url in mc.lookup_file_id(fid1):
+            from seaweedfs_tpu.utils.httpd import http_call
+            http_call("DELETE", url)
+
+        base2 = maintenance.backup_volume(master.url, vid, out)
+        assert base2 == base
+        # incremental: local file GREW (appended), not rewritten smaller
+        assert os.path.getsize(base + ".dat") > size_after_full
+
+        # the local copy serves the new needle and not the deleted one
+        from seaweedfs_tpu.storage.volume import Volume
+        v = Volume(out, "", vid)
+        key2 = int(fid2.split(",")[1][:-8], 16)
+        assert v.read_needle(key2).data == b"second wave " * 80
+        key1 = int(fid1.split(",")[1][:-8], 16)
+        with pytest.raises(Exception):
+            v.read_needle(key1)
+        v.close()
+
+        # a source-side vacuum rewrites history: the next backup must
+        # detect the compaction-revision change and full-copy instead
+        # of tailing (deletes absorbed by the vacuum would otherwise
+        # never propagate)
+        src_v = vs.store.find_volume(vid)
+        src_v.compact()
+        base3 = maintenance.backup_volume(master.url, vid, out)
+        assert base3 == base
+        v = Volume(out, "", vid)
+        assert v.super_block.compaction_revision == \
+            src_v.super_block.compaction_revision
+        assert v.read_needle(key2).data == b"second wave " * 80
+        with pytest.raises(Exception):
+            v.read_needle(key1)
+        v.close()
+    finally:
+        vs.stop()
+        master.stop()
