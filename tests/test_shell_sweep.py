@@ -262,3 +262,32 @@ def test_fs_meta_cat(cluster):
     out = run_command(sh, "fs.meta.cat /meta/x.bin")
     assert out["entry"]["full_path"] == "/meta/x.bin"
     assert out["entry"]["chunks"]
+
+
+def test_volume_fsck_refuses_purge_on_incomplete_walk(cluster,
+                                                      monkeypatch):
+    """The purge guard: if any directory listing failed, -fix must NOT
+    delete anything (an incomplete walk hides live references)."""
+    from seaweedfs_tpu.shell import fsck as fsck_mod
+    master, vs1, vs2, fs, sh = cluster
+    _upload_file(fs, "/safe/a.bin", b"A" * 5000)
+    _hb(vs1, vs2)
+    mc = MasterClient(master.url)
+    operation.upload_data(mc, b"orphan bytes")  # a genuine orphan
+    _hb(vs1, vs2)
+
+    def failing_walk(filer_url, path, referenced, broken, errors,
+                     page=10000):
+        errors.append(f"{path}: simulated listing failure")
+
+    monkeypatch.setattr(fsck_mod, "_walk_filer", failing_walk)
+    out = sh.volume_fsck(fs.url, fix=True)
+    monkeypatch.undo()
+    assert out["purge_refused"] is True
+    assert out["purged"] == 0
+    # nothing was deleted: the referenced file still reads
+    status, body, _ = http_call("GET", f"http://{fs.url}/safe/a.bin")
+    assert status == 200 and body == b"A" * 5000
+    # a clean run afterwards still sees both the file and the orphan
+    out = sh.volume_fsck(fs.url)
+    assert out["orphan_count"] == 1 and out["missing_count"] == 0
