@@ -133,6 +133,15 @@ def cmd_filer(args):
         extra += f", ftp {ftp.url}"
     if fs.grpc_port:
         extra += f", grpc {fs.grpc_port}"
+    if args.mq:
+        # mq broker rides the filer process (reference runs a separate
+        # `weed mq.broker` that dials the filer; this broker embeds it)
+        from seaweedfs_tpu.mq.broker import Broker
+        from seaweedfs_tpu.mq.broker_grpc import start_broker_grpc
+        broker = Broker(fs)
+        _, mq_port = start_broker_grpc(broker, host=args.ip,
+                                       port=args.mqPort)
+        extra += f", mq grpc {args.ip}:{mq_port}"
     print(f"filer {fs.url} (store={args.store}){extra}")
     _wait_forever()
 
@@ -480,6 +489,9 @@ def main(argv=None):
     fl.add_argument("-ftpPort", type=int, default=0)
     fl.add_argument("-grpc", action="store_true",
                     help="serve the filer_pb gRPC plane on port+10000")
+    fl.add_argument("-mq", action="store_true",
+                    help="serve the mq broker gRPC plane (weed mq.broker)")
+    fl.add_argument("-mqPort", type=int, default=0)
     fl.set_defaults(fn=cmd_filer)
 
     for gw_name, default_port in (("s3", 8333), ("webdav", 7333),

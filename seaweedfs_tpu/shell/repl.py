@@ -37,6 +37,7 @@ HELP = """commands:
   volume.tier.download -volumeId N
   volume.vacuum [threshold]         compact garbage-heavy volumes
   cluster.ps                        list every cluster process
+  mq.topic.list                     list broker topics (filer /topics tree)
   s3.configure -user U -access K -secret S [-actions a,b] | -delete U
   s3.clean.uploads [-timeAgo SECONDS]   purge stale multipart uploads
   fs.meta.cat <path>                one entry's raw metadata
@@ -237,6 +238,31 @@ def run_command(sh: ShellContext, line: str):
     if cmd == "volume.tail":
         return sh.volume_tail(int(flags["volumeId"]),
                               since_ns=int(flags.get("since", 0)))
+    if cmd == "mq.topic.list":
+        # topics live under /topics/<ns>/<topic>/.conf in the filer
+        # (reference command_mq_topic_list.go asks the broker; the broker
+        # state IS the filer tree, so the shell reads it directly)
+        from seaweedfs_tpu.shell.fs_commands import FsContext
+        fsc = FsContext(_find_filer(sh))
+        topics = []
+        try:
+            namespaces = fsc.ls("/topics")
+        except Exception:
+            namespaces = []
+        for nse in namespaces:
+            ns = nse["FullPath"].rsplit("/", 1)[-1]
+            for te in fsc.ls(nse["FullPath"]):
+                if not te.get("IsDirectory"):
+                    continue
+                try:
+                    conf = json.loads(fsc.cat(te["FullPath"] + "/.conf"))
+                except FileNotFoundError:
+                    continue
+                topics.append({
+                    "namespace": ns,
+                    "topic": te["FullPath"].rsplit("/", 1)[-1],
+                    "partition_count": conf.get("partition_count", 0)})
+        return {"topics": topics}
     if cmd == "cluster.ps":
         return sh.cluster_ps()
     if cmd == "volume.tier.upload":

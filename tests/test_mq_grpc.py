@@ -1,0 +1,191 @@
+"""mq broker gRPC plane (reference weed/pb/mq.proto: control plane +
+streaming Publish; our Subscribe stream replaces the reference's
+separate subscriber client): topic configure/list, streamed publish
+acks, replay + live tail, binary values, broker load, shell
+mq.topic.list."""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.mq.broker import Broker
+from seaweedfs_tpu.mq.broker_grpc import MqClient, start_broker_grpc
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+@pytest.fixture
+def mq(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    time.sleep(0.1)
+    broker = Broker(fs)
+    server, port = start_broker_grpc(broker, port=0)
+    client = MqClient(f"127.0.0.1:{port}")
+    yield broker, client
+    client.close()
+    server.stop(grace=None)
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_configure_publish_subscribe(mq):
+    broker, client = mq
+    assert client.configure_topic("chat", "events", 2) == 2
+    # configure is idempotent and keeps the original partition count
+    assert client.configure_topic("chat", "events", 8) == 2
+
+    acks = client.publish("chat", "events",
+                          [(f"k{i}", f"v{i}".encode()) for i in range(20)])
+    assert len(acks) == 20
+    assert acks == sorted(acks) and len(set(acks)) == 20  # monotonic
+
+    records = list(client.subscribe("chat", "events"))
+    assert len(records) == 20
+    assert sorted(r["value"] for r in records) == sorted(
+        f"v{i}".encode() for i in range(20))
+    # same key lands on the same partition
+    parts = {r["key"]: r["partition"] for r in records}
+    acks2 = client.publish("chat", "events", [("k3", b"again")])
+    assert len(acks2) == 1
+    again = [r for r in client.subscribe("chat", "events")
+             if r["value"] == b"again"]
+    assert again[0]["partition"] == parts["k3"]
+
+    load = client.broker_load()
+    assert load["message_count"] == 21
+    assert load["bytes_count"] > 21 * 30
+
+    topics = client.list_topics()
+    assert topics == [
+        {"namespace": "chat", "topic": "events", "partition_count": 2}]
+
+
+def test_empty_record_first_in_stream_is_published(mq):
+    # regression: the init frame carries no record, so an empty-key/
+    # empty-value record as the FIRST item must not be swallowed
+    broker, client = mq
+    client.configure_topic("e", "t", 1)
+    acks = client.publish("e", "t", [("", b"")])
+    assert len(acks) == 1
+    [rec] = list(client.subscribe("e", "t"))
+    assert rec["key"] == "" and rec["value"] == b""
+
+
+def test_publish_unknown_topic_errors(mq):
+    broker, client = mq
+    with pytest.raises(RuntimeError, match="not found"):
+        client.publish("nope", "missing", [("k", b"v")])
+
+
+def test_binary_values_roundtrip(mq):
+    broker, client = mq
+    client.configure_topic("bin", "blobs", 1)
+    payload = bytes(range(256))
+    client.publish("bin", "blobs", [("k", payload)])
+    broker.flush()  # force the JSONL segment path, not just the live ring
+    [rec] = list(client.subscribe("bin", "blobs"))
+    assert rec["value"] == payload
+
+
+def test_segment_overflow_autoflush(mq, monkeypatch):
+    # crossing SEGMENT_MAX_BYTES pops the segment and uploads it
+    # outside the broker lock (two-phase flush); a subscriber attaching
+    # mid-stream still sees every record exactly once, and the >2KB
+    # segment takes the chunked-upload branch
+    import seaweedfs_tpu.mq.broker as broker_mod
+    broker, client = mq
+    monkeypatch.setattr(broker_mod, "SEGMENT_MAX_BYTES", 8 * 1024)
+    client.configure_topic("big", "stream", 1)
+    payload = b"x" * 1024
+    acks = client.publish("big", "stream",
+                          [(f"k{i}", payload) for i in range(40)])
+    assert len(acks) == 40
+    # at least one segment was flushed to the filer
+    segs = broker.filer.list_entries("/topics/big/stream/p00", limit=100)
+    assert len(segs) >= 2
+    recs = list(client.subscribe("big", "stream"))
+    assert len(recs) == 40
+    assert sorted(r["key"] for r in recs) == sorted(
+        f"k{i}" for i in range(40))
+    assert all(r["value"] == payload for r in recs)
+
+
+def test_live_tail_sees_replay_then_new_records(mq):
+    broker, client = mq
+    client.configure_topic("t", "tail", 1)
+    client.publish("t", "tail", [("a", b"old1"), ("a", b"old2")])
+    broker.flush()
+    client.publish("t", "tail", [("a", b"old3")])  # unflushed in-memory
+
+    got, done = [], threading.Event()
+
+    def consume():
+        for rec in client.subscribe("t", "tail", tail=True, timeout=30):
+            got.append(rec)
+            if len(got) == 5:
+                break
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while len(got) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    assert [r["value"] for r in got] == [b"old1", b"old2", b"old3"]
+    assert all(r["seq"] == 0 for r in got)  # replayed
+    client.publish("t", "tail", [("a", b"new1"), ("a", b"new2")])
+    assert done.wait(10), f"tail delivered only {len(got)} records"
+    assert [r["value"] for r in got[3:]] == [b"new1", b"new2"]
+    assert all(r["seq"] > 0 for r in got[3:])  # live
+
+
+def test_flush_names_assigned_at_pop_order(mq):
+    # segment filenames are assigned under the lock at pop time, so
+    # replay order (filename sort) matches record order even if the
+    # slower upload completes last
+    broker, client = mq
+    client.configure_topic("o", "t", 1)
+    broker.publish("o", "t", "k", "first")
+    a = broker._begin_flush("o/t", 0)
+    broker.publish("o", "t", "k", "second")
+    b = broker._begin_flush("o/t", 0)
+    assert a[0] < b[0]
+    # complete them OUT of order; replay must still be first, second
+    broker._complete_flush("o", "t", 0, *b)
+    broker._complete_flush("o", "t", 0, *a)
+    vals = [r["value"] for r in broker.subscribe("o", "t")]
+    assert vals == ["first", "second"]
+
+
+def test_tail_overflow_raises_not_skips(mq):
+    import collections
+    from seaweedfs_tpu.mq.broker import MqTailOverflow
+    broker, client = mq
+    client.configure_topic("lag", "t", 1)
+    broker._recent = collections.deque(broker._recent, maxlen=8)
+    gen = broker.subscribe("lag", "t", tail=True)
+    broker.publish("lag", "t", "k", "v0")
+    assert next(gen)["value"] == "v0"  # attach: replay, last=1
+    for _ in range(12):  # seqs 2..13; maxlen-8 ring evicts 2..5 unseen
+        broker.publish("lag", "t", "k", "v")
+    with pytest.raises(MqTailOverflow):
+        next(gen)
+
+
+def test_shell_mq_topic_list(mq, tmp_path):
+    broker, client = mq
+    client.configure_topic("ns1", "orders", 4)
+    from seaweedfs_tpu.shell.commands import ShellContext
+    from seaweedfs_tpu.shell.repl import run_command
+    sh = ShellContext(broker.fs.master_url)
+    out = run_command(sh, "mq.topic.list")
+    assert {"namespace": "ns1", "topic": "orders",
+            "partition_count": 4} in out["topics"]
