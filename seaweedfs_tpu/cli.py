@@ -103,9 +103,12 @@ def cmd_server(args):
     if args.filer:
         from seaweedfs_tpu.server.filer_server import FilerServer
         fs = FilerServer(ms.url, host=args.ip, port=args.filerPort,
-                         store_dir=dirs[0])
+                         store_dir=dirs[0],
+                         grpc_port=(args.filerPort + 10000
+                                    if args.grpc else None))
         fs.start()
-        print(f"filer {fs.url}")
+        print(f"filer {fs.url}"
+              + (f" (grpc {fs.grpc_port})" if args.grpc else ""))
         extra.append(fs)
         if args.s3:
             from seaweedfs_tpu.gateway.s3_server import S3Server
@@ -266,8 +269,15 @@ def cmd_mount(args):
         # other writers' changes reach the mount's meta cache through
         # the filer's change-log subscription
         w.meta_cache.attach_http(filer_addr)
+    # admin plane (mount.proto Configure), announced to the master so
+    # shell mount.configure can find this mount
+    from seaweedfs_tpu.mount.mount_grpc import start_mount_grpc
+    # keep the server object referenced for the life of the mount — a
+    # dropped grpc.Server is garbage-collected and stops listening
+    admin_server, admin_port, _ = start_mount_grpc(w, master_url=args.master)
     conn = FuseConnection(w, args.mountpoint)
-    print(f"mounted seaweedfs-tpu at {args.mountpoint}")
+    print(f"mounted seaweedfs-tpu at {args.mountpoint} "
+          f"(admin grpc 127.0.0.1:{admin_port})")
     try:
         conn.serve_forever(background=False)
     except KeyboardInterrupt:

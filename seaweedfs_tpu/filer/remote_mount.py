@@ -34,12 +34,26 @@ class RemoteMounts:
         self.filer = filer
 
     # ---- configuration (reference shell command_remote_configure.go) ----
+    # Persisted as weedtpu_remote_pb proto bytes (the reference keeps
+    # proto-marshalled RemoteConf/RemoteStorageMapping in the same KV
+    # spots); pre-round-4 JSON blobs still parse via fallback.
     def list_confs(self) -> dict[str, RemoteConf]:
         blob = self.filer.store.kv_get(REMOTE_CONF_KV_KEY)
         if not blob:
             return {}
+        try:
+            data = json.loads(blob)
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            from seaweedfs_tpu.pb import remote_pb2
+            lst = remote_pb2.RemoteConfList.FromString(blob)
+            return {c.name: RemoteConf(
+                name=c.name, type=c.type, root=c.root,
+                endpoint=c.endpoint, access_key=c.access_key,
+                secret_key=c.secret_key, bucket=c.bucket,
+                region=c.region or "us-east-1")
+                for c in lst.remotes}
         return {d["name"]: RemoteConf.from_dict(d)
-                for d in json.loads(blob)["remotes"]}
+                for d in data["remotes"]}
 
     def configure(self, conf: RemoteConf) -> None:
         confs = self.list_confs()
@@ -52,13 +66,28 @@ class RemoteMounts:
         self._save_confs(confs)
 
     def _save_confs(self, confs: dict[str, RemoteConf]) -> None:
-        self.filer.store.kv_put(REMOTE_CONF_KV_KEY, json.dumps(
-            {"remotes": [c.to_dict() for c in confs.values()]}).encode())
+        from seaweedfs_tpu.pb import remote_pb2
+        lst = remote_pb2.RemoteConfList(remotes=[
+            remote_pb2.RemoteConf(
+                name=c.name, type=c.type, root=c.root,
+                endpoint=c.endpoint, access_key=c.access_key,
+                secret_key=c.secret_key, bucket=c.bucket, region=c.region)
+            for c in confs.values()])
+        self.filer.store.kv_put(REMOTE_CONF_KV_KEY, lst.SerializeToString())
 
     # ---- mappings (reference remote_mapping.go) ----
     def list_mappings(self) -> dict[str, dict]:
         blob = self.filer.store.kv_get(REMOTE_MAPPING_KV_KEY)
-        return json.loads(blob)["mappings"] if blob else {}
+        if not blob:
+            return {}
+        try:
+            return json.loads(blob)["mappings"]
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            from seaweedfs_tpu.pb import remote_pb2
+            m = remote_pb2.RemoteStorageMapping.FromString(blob)
+            return {d: {"remote_name": loc.name,
+                        "remote_path": loc.remote_path}
+                    for d, loc in m.mappings.items()}
 
     def mount(self, dir_path: str, remote_name: str,
               remote_path: str = "") -> None:
@@ -76,8 +105,13 @@ class RemoteMounts:
         self._save_mappings(mappings)
 
     def _save_mappings(self, mappings: dict) -> None:
+        from seaweedfs_tpu.pb import remote_pb2
+        m = remote_pb2.RemoteStorageMapping()
+        for d, loc in mappings.items():
+            m.mappings[d].name = loc["remote_name"]
+            m.mappings[d].remote_path = loc["remote_path"]
         self.filer.store.kv_put(REMOTE_MAPPING_KV_KEY,
-                                json.dumps({"mappings": mappings}).encode())
+                                m.SerializeToString())
 
     def mapping_for(self, path: str) -> Optional[tuple[str, dict]]:
         """Longest mount-dir prefix covering `path`."""

@@ -457,6 +457,45 @@ class S3Server:
                 ET.SubElement(d, "Key").text = key
         return Response(_xml(root), content_type="application/xml")
 
+    # ---- circuit-breaker hot-reload ----
+    CB_PATH = "/etc/s3/circuit_breaker"
+    CB_TTL = 2.0
+
+    def _refresh_breaker(self) -> None:
+        """Hot-reload /etc/s3/circuit_breaker (proto bytes,
+        weedtpu_s3_pb.S3CircuitBreakerConfig — reference
+        s3api_circuit_breaker.go loads the same message from the
+        filer) at most every CB_TTL seconds, mtime-gated."""
+        now = time.time()
+        next_at, seen_mtime = getattr(self, "_cb_state", (0.0, -1.0))
+        if now < next_at:
+            return
+        self._cb_state = (now + self.CB_TTL, seen_mtime)
+        entry = self.filer.find_entry(self.CB_PATH)
+        mtime = entry.attr.mtime if entry is not None else 0.0
+        if mtime == seen_mtime:
+            return
+        self._cb_state = (now + self.CB_TTL, mtime)
+        # full entry read, not entry.content — a config big enough to
+        # chunk (or on a cipher-enabled filer) has empty inline content
+        data = self.fs._read_entry_bytes(entry) if entry is not None else b""
+        if not data:
+            self.breaker.global_limits = {"Read": 0, "Write": 0}
+            self.breaker.bucket_limits = {}
+            return
+        from seaweedfs_tpu.pb import s3_pb2
+        try:
+            conf = s3_pb2.S3CircuitBreakerConfig.FromString(data)
+        except Exception:
+            return  # malformed config must not take the gateway down
+        def limits(opts):
+            if not opts.enabled:
+                return {}
+            return {a: int(n) for a, n in opts.actions.items()}
+        self.breaker.global_limits = limits(conf.global_options)
+        self.breaker.bucket_limits = {
+            b: limits(o) for b, o in conf.buckets.items()}
+
     # ---- objects ----
     def _object_dispatch(self, req: Request) -> Response:
         denied = self._check_auth(req)
@@ -464,6 +503,7 @@ class S3Server:
             return denied
         bucket, key = req.match.group(1), req.match.group(2)
         action = "Read" if req.method in ("GET", "HEAD") else "Write"
+        self._refresh_breaker()
         if not self.breaker.acquire(bucket, action):
             return _err("TooManyRequests", "circuit breaker open", 503)
         try:

@@ -242,6 +242,10 @@ class WeedFS:
         self.mem_chunks = mem_chunks or _pw.DEFAULT_MEM_CHUNKS
         self.upload_concurrency = (upload_concurrency
                                    or _pw.DEFAULT_CONCURRENCY)
+        # statfs quota override, set live via the mount admin plane
+        # (mount_grpc Configure / shell mount.configure); 0 = report
+        # the cluster's aggregate capacity
+        self.collection_capacity = 0
         self.meta_cache = MetaCache()
         self.meta_cache.attach(self.filer.meta_log)
 
@@ -532,13 +536,18 @@ class WeedFS:
             self._statfs_cache = (now + self.STATFS_TTL, stale)
             return stale
         agg = aggregate_topology_info(topo.get("Topology", topo))
-        if agg["slots"] == 0:
+        if agg["slots"] == 0 and not self.collection_capacity:
             # no volume servers registered (yet): report the static
             # defaults rather than a 0-bytes-free filesystem
             result = None
         else:
             limit_mb = topo.get("VolumeSizeLimitMB", 1024)
             total = agg["slots"] * limit_mb * 1024 * 1024
+            if self.collection_capacity:
+                # admin-set quota wins over cluster capacity (used
+                # bytes remain the cluster aggregate — a per-mount
+                # byte meter would need per-collection accounting)
+                total = self.collection_capacity
             bsize = 4096
             blocks = max(total // bsize, 1)
             bfree = max((total - agg["used_bytes"]) // bsize, 0)

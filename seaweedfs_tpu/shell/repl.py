@@ -40,6 +40,8 @@ HELP = """commands:
   mq.topic.list                     list broker topics (filer /topics tree)
   s3.configure -user U -access K -secret S [-actions a,b] | -delete U
   s3.clean.uploads [-timeAgo SECONDS]   purge stale multipart uploads
+  s3.circuitbreaker [-bucket B] [-read N] [-write N] [-disable]
+  mount.configure -collectionCapacity BYTES   statfs quota on live mounts
   fs.meta.cat <path>                one entry's raw metadata
   ec.encode [-volumeId N] [-collection C]
   ec.rebuild [-n]
@@ -238,6 +240,27 @@ def run_command(sh: ShellContext, line: str):
     if cmd == "volume.tail":
         return sh.volume_tail(int(flags["volumeId"]),
                               since_ns=int(flags.get("since", 0)))
+    if cmd == "mount.configure":
+        # push a statfs quota to every live mount via its admin plane
+        # (reference command_mount_configure.go -> mount_pb.Configure)
+        from seaweedfs_tpu.mount.mount_grpc import MountAdminClient
+        from seaweedfs_tpu.utils.httpd import http_json
+        out = http_json(
+            "GET", f"http://{sh.master_url}/cluster/nodes?type=mount")
+        mounts = out.get("cluster_nodes", [])
+        capacity = int(flags.get("collectionCapacity", -1))
+        results = {}
+        for node in mounts:
+            # a mount that died within the registry's 60s TTL must not
+            # abort configuring the live ones
+            client = MountAdminClient(node["url"])
+            try:
+                results[node["url"]] = client.configure(capacity)
+            except Exception as e:
+                results[node["url"]] = f"unreachable: {e.__class__.__name__}"
+            finally:
+                client.close()
+        return {"mounts": results}
     if cmd == "mq.topic.list":
         # topics live under /topics/<ns>/<topic>/.conf in the filer
         # (reference command_mq_topic_list.go asks the broker; the broker
@@ -309,6 +332,50 @@ def run_command(sh: ShellContext, line: str):
         if status >= 300:
             raise RuntimeError(f"save failed: HTTP {status}")
         return {"identities": [x["name"] for x in idents]}
+    if cmd == "s3.circuitbreaker":
+        # concurrent-request limits, hot-reloaded by the gateway from
+        # /etc/s3/circuit_breaker proto bytes (reference
+        # command_s3_circuitbreaker.go edits the same config)
+        from seaweedfs_tpu.pb import s3_pb2
+        from seaweedfs_tpu.utils.httpd import http_call
+        filer = _find_filer(sh)
+        cb_url = f"http://{filer}/etc/s3/circuit_breaker"
+        status, body, _ = http_call("GET", cb_url)
+        if status == 200 and body:
+            conf = s3_pb2.S3CircuitBreakerConfig.FromString(body)
+        elif status == 404:
+            conf = s3_pb2.S3CircuitBreakerConfig()
+        else:
+            raise RuntimeError(f"cannot load config: HTTP {status}")
+        mutating = ("-disable" in args or "read" in flags
+                    or "write" in flags)
+        if "bucket" in flags and not mutating \
+                and flags["bucket"] not in conf.buckets:
+            # query-only: indexing the proto map would auto-vivify a
+            # phantom "configured" bucket in the display
+            opts = None
+        else:
+            opts = (conf.buckets[flags["bucket"]] if "bucket" in flags
+                    else conf.global_options)
+        changed = False
+        if opts is not None:
+            if "-disable" in args:
+                opts.enabled = False
+                changed = True
+            for action in ("read", "write"):
+                if action in flags:
+                    opts.enabled = True
+                    opts.actions[action.capitalize()] = int(flags[action])
+                    changed = True
+        if changed:
+            status, body, _ = http_call(
+                "POST", cb_url, body=conf.SerializeToString())
+            if status >= 300:
+                raise RuntimeError(f"save failed: HTTP {status}")
+        def show(o):
+            return {"enabled": o.enabled, "actions": dict(o.actions)}
+        return {"global": show(conf.global_options),
+                "buckets": {b: show(o) for b, o in conf.buckets.items()}}
     if cmd == "s3.clean.uploads":
         # purge stale multipart uploads (reference
         # command_s3_clean_uploads.go); default cutoff 24h
