@@ -118,8 +118,13 @@ class RaftNode:
         self.snap_index = st.get("snap_index", 0)
         self.snap_term = st.get("snap_term", 0)
         self.snap_state = st.get("snap_state", {})
+        if "peers" in st:
+            # committed membership changes override the boot -peers
+            # list (the operator's flag predates them)
+            self.peers = [p for p in st["peers"] if p != self.id]
         if self.snap_state:
             self.restore_fn(self.snap_state)
+            self._apply_snapshot_membership(self.snap_state)
         self.commit_index = self.last_applied = self.snap_index
         # re-apply entries that were committed before shutdown is not
         # possible to know — raft re-commits them once a leader emerges
@@ -133,6 +138,7 @@ class RaftNode:
                 json.dump({"term": self.current_term,
                            "voted_for": self.voted_for,
                            "log": self.log,
+                           "peers": self.peers,
                            "snap_index": self.snap_index,
                            "snap_term": self.snap_term,
                            "snap_state": self.snap_state}, f)
@@ -404,13 +410,48 @@ class RaftNode:
         applied_in_log = self.last_applied - self.snap_index
         if applied_in_log < self.compact_threshold:
             return
-        self.snap_state = self.snapshot_fn()
+        # carry the member set inside the snapshot: compaction may drop
+        # raft_config entries from the log, and a follower caught up
+        # via snapshot must still learn the committed membership
+        self.snap_state = {**self.snapshot_fn(),
+                           "_raft_members": sorted(self.peers + [self.id])}
         self.snap_term = self._term_at(self.last_applied)
         self.log = self.log[applied_in_log:]
         self.snap_index = self.last_applied
         self._persist()
 
     # ---- client API ----
+    # ---- membership (reference raft AddServer/RemoveServer, shell
+    # cluster.raft.add/remove). Single-step changes: safe when applied
+    # one at a time through the log, which is how the shell drives it.
+    def add_peer(self, peer: str) -> None:
+        with self.lock:
+            if peer == self.id or peer in self.peers:
+                return
+            self.peers.append(peer)
+            if self.state == LEADER:
+                self.next_index[peer] = self._last_index() + 1
+                self.match_index[peer] = 0
+                self._peer_acked[peer] = time.monotonic()
+            self._persist()
+
+    def remove_peer(self, peer: str) -> None:
+        with self.lock:
+            if peer not in self.peers:
+                return
+            self.peers.remove(peer)
+            self.next_index.pop(peer, None)
+            self.match_index.pop(peer, None)
+            self._peer_acked.pop(peer, None)
+            self._persist()
+
+    def membership(self) -> dict:
+        with self.lock:
+            return {"id": self.id, "peers": list(self.peers),
+                    "leader": self.leader_id, "term": self.current_term,
+                    "state": self.state,
+                    "commit_index": self.commit_index}
+
     def propose(self, command: dict, timeout: float = 5.0) -> bool:
         """Leader-only: append, replicate, wait for commit."""
         with self._commit_cond:
@@ -432,9 +473,20 @@ class RaftNode:
         return True
 
     # ---- RPC handlers (wired to HTTP routes by the master) ----
+    def _apply_snapshot_membership(self, state: dict) -> None:
+        members = state.get("_raft_members")
+        if members:
+            self.peers = [p for p in members if p != self.id]
+
     def on_request_vote(self, body: dict) -> dict:
         with self.lock:
             term = body["term"]
+            candidate = body["candidate_id"]
+            if candidate not in self.peers and candidate != self.id:
+                # a removed (or not-yet-added) node must not depose the
+                # leader or win votes — reject WITHOUT adopting its
+                # term, or its election loop walks our term forever
+                return {"term": self.current_term, "vote_granted": False}
             if term > self.current_term:
                 self._step_down(term)
             granted = False
@@ -521,6 +573,7 @@ class RaftNode:
             self.snap_term = body["last_included_term"]
             self.snap_state = body["state"]
             self.restore_fn(self.snap_state)
+            self._apply_snapshot_membership(self.snap_state)
             self.commit_index = max(self.commit_index, idx)
             self.last_applied = max(self.last_applied, idx)
             self._persist()

@@ -99,6 +99,37 @@ class RemoteMounts:
         self._save_mappings(mappings)
         self.filer.mkdirs(dir_path)
 
+    def mount_buckets(self, remote_name: str,
+                      bucket_pattern: str = "") -> list[str]:
+        """Mount every bucket of an S3-dialect remote under
+        /buckets/<name> (reference command_remote_mount_buckets.go).
+        Each bucket gets a derived conf `<remote>.<bucket>` so the
+        existing conf->client machinery addresses it directly."""
+        import dataclasses
+        import fnmatch
+        confs = self.list_confs()
+        if remote_name not in confs:
+            raise KeyError(f"remote {remote_name!r} not configured")
+        conf = confs[remote_name]
+        if conf.type not in ("s3", "gcs", "b2", "wasabi"):
+            raise ValueError("remote.mount.buckets needs an S3-dialect "
+                             f"remote, not {conf.type!r}")
+        if not conf.endpoint:
+            raise ValueError("remote conf has no endpoint")
+        from seaweedfs_tpu.remote_storage.s3_client import S3Remote
+        lister = S3Remote(conf.endpoint, "", access_key=conf.access_key,
+                          secret_key=conf.secret_key, region=conf.region)
+        mounted = []
+        for b in lister.list_buckets():
+            if bucket_pattern and not fnmatch.fnmatch(b, bucket_pattern):
+                continue
+            sub = dataclasses.replace(conf, name=f"{remote_name}.{b}",
+                                      bucket=b)
+            self.configure(sub)
+            self.mount(f"/buckets/{b}", sub.name)
+            mounted.append(b)
+        return mounted
+
     def unmount(self, dir_path: str) -> None:
         mappings = self.list_mappings()
         mappings.pop(dir_path, None)

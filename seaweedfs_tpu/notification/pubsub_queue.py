@@ -1,0 +1,90 @@
+"""Google-Pub/Sub-wire notification queue (reference weed/notification/
+google_pub_sub/google_pub_sub.go, which uses the GCP SDK; here the
+Pub/Sub REST publish API is spoken directly — JSON POST with a Bearer
+token, no SDK).
+
+Auth is a static bearer token from configuration (a service-account
+OAuth flow needs egress this environment doesn't have; any
+Pub/Sub-compatible emulator accepts tokenless/static-token calls).
+Tests run against MiniPubSubServer below.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.request
+
+from seaweedfs_tpu.notification.queue import MessageQueue
+
+
+class PubSubQueue(MessageQueue):
+    name = "google_pub_sub"
+
+    def __init__(self, endpoint: str, project: str, topic: str,
+                 token: str = "", timeout: float = 10.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.project = project
+        self.topic = topic
+        self.token = token
+        self.timeout = timeout
+
+    def send_message(self, key: str, message: dict) -> None:
+        url = (f"{self.endpoint}/v1/projects/{self.project}"
+               f"/topics/{self.topic}:publish")
+        payload = json.dumps({"messages": [{
+            "data": base64.b64encode(
+                json.dumps(message).encode()).decode(),
+            "attributes": {"key": key},
+        }]}).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        req = urllib.request.Request(url, data=payload, method="POST",
+                                     headers=headers)
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            if resp.status >= 300:
+                raise ConnectionError(f"Pub/Sub publish: {resp.status}")
+
+
+class MiniPubSubServer:
+    """In-process Pub/Sub publish endpoint for tests: checks the Bearer
+    token and records decoded messages per topic."""
+
+    def __init__(self, token: str = ""):
+        from seaweedfs_tpu.utils.httpd import HttpServer, Response
+        self.token = token
+        self.messages: list[dict] = []
+        self._response_cls = Response
+        self.http = HttpServer("127.0.0.1", 0)
+        self.http.add("POST",
+                      r"/v1/projects/([^/]+)/topics/([^:]+):publish$",
+                      self._publish)
+
+    def start(self):
+        self.http.start()
+        return self
+
+    def stop(self):
+        self.http.stop()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.http.host}:{self.http.port}"
+
+    def _publish(self, req) -> "Response":
+        Response = self._response_cls
+        if self.token:
+            if req.headers.get("Authorization") != f"Bearer {self.token}":
+                return Response({"error": {"code": 401}}, status=401)
+        body = req.json()
+        ids = []
+        for m in body.get("messages", []):
+            self.messages.append({
+                "project": req.match.group(1),
+                "topic": req.match.group(2),
+                "key": m.get("attributes", {}).get("key", ""),
+                "message": json.loads(base64.b64decode(m["data"])),
+            })
+            ids.append(str(len(self.messages)))
+        return Response({"messageIds": ids})

@@ -91,6 +91,19 @@ def make_queue_from_config() -> Optional[MessageQueue]:
             host, port = addr, 9092
         return KafkaQueue(host or "127.0.0.1", port,
                           topic=k.get("topic", "seaweedfs_meta"))
+    if root.get("aws_sqs", {}).get("enabled"):
+        from seaweedfs_tpu.notification.sqs_queue import SqsQueue
+        s = root["aws_sqs"]
+        return SqsQueue(s["sqs_queue_url"],
+                        access_key=s.get("access_key", ""),
+                        secret_key=s.get("secret_key", ""),
+                        region=s.get("region", "us-east-1"))
+    if root.get("google_pub_sub", {}).get("enabled"):
+        from seaweedfs_tpu.notification.pubsub_queue import PubSubQueue
+        g = root["google_pub_sub"]
+        return PubSubQueue(
+            g.get("endpoint", "https://pubsub.googleapis.com"),
+            g["project_id"], g["topic"], token=g.get("token", ""))
     return None
 
 

@@ -178,7 +178,18 @@ def make_remote_client(conf: RemoteConf) -> RemoteStorageClient:
         return S3Remote(endpoint, conf.bucket,
                         access_key=conf.access_key,
                         secret_key=conf.secret_key, region=conf.region)
+    if conf.type == "azure":
+        # Blob REST protocol with SharedKey signing, spoken directly
+        # (reference wraps the Azure SDK): access_key = account name,
+        # secret_key = base64 account key, bucket = container
+        from seaweedfs_tpu.remote_storage.azure_client import AzureRemote
+        endpoint = conf.endpoint or \
+            f"https://{conf.access_key}.blob.core.windows.net"
+        if not conf.bucket:
+            raise ValueError("azure remote needs a container (bucket)")
+        return AzureRemote(endpoint, conf.bucket, conf.access_key,
+                           conf.secret_key)
     raise NotImplementedError(
         f"remote type {conf.type!r}: no S3-compatible dialect and no "
-        "cloud SDK in this environment (azure's protocol differs); "
+        "cloud SDK in this environment; "
         "implement a RemoteStorageClient and register it here")

@@ -95,6 +95,26 @@ class S3Remote(RemoteStorageClient):
     def _clean_etag(etag: str) -> str:
         return etag.strip().strip('"')
 
+    def list_buckets(self) -> list[str]:
+        """GET / — ListAllMyBucketsResult (shell remote.mount.buckets
+        enumerates the remote's buckets with this)."""
+        headers = {}
+        if self.signer is not None:
+            headers = self.signer.signed_headers("GET", self.host, "/",
+                                                 {}, b"")
+        status, resp, _ = http_call("GET", f"{self.endpoint}/",
+                                    headers=headers, timeout=30)
+        if status >= 300:
+            raise ConnectionError(f"ListBuckets: HTTP {status}")
+        root = ET.fromstring(resp)
+        names = []
+        for b in root.iter():
+            if b.tag.rsplit("}", 1)[-1] == "Bucket":
+                for child in b:
+                    if child.tag.rsplit("}", 1)[-1] == "Name":
+                        names.append(child.text or "")
+        return names
+
     # ---- SPI ----
     def traverse(self, prefix: str = "") -> Iterator[RemoteFile]:
         token = ""

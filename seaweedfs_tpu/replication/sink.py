@@ -4,9 +4,9 @@ Functional equivalent of reference weed/replication: a ReplicationSink
 receives filer meta events (create/update/delete) and applies them to a
 destination — another filer, a local directory, or a cloud bucket. The
 reference ships filer/s3/gcs/azure/b2/local sinks (sink SPI at
-replication/sink/replication_sink.go); we ship the SPI plus filer, local,
-and s3 sinks (the s3 sink points at any S3 endpoint, including our own
-gateway).
+replication/sink/replication_sink.go); we ship the SPI plus filer,
+local, s3 (which also covers the gcs-interop/b2/wasabi S3-dialect
+endpoints), and azure (SharedKey Blob REST) sinks.
 """
 
 from __future__ import annotations
@@ -109,6 +109,35 @@ class S3Sink(ReplicationSink):
         from seaweedfs_tpu.remote_storage.s3_client import S3Remote
         self.client = S3Remote(endpoint, bucket, access_key=access_key,
                                secret_key=secret_key, region=region)
+        self.prefix = prefix.strip("/")
+
+    def _key(self, path: str) -> str:
+        return (self.prefix + "/" if self.prefix else "") \
+            + path.lstrip("/")
+
+    def create_entry(self, path: str, entry: dict,
+                     data: Optional[bytes]) -> None:
+        if entry.get("attr", {}).get("is_directory"):
+            return
+        self.client.write_file(self._key(path), data or b"")
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        if is_directory:
+            return
+        self.client.remove_file(self._key(path))
+
+
+class AzureSink(ReplicationSink):
+    """Replicate objects into an Azure Blob container (reference
+    replication/sink/azuresink/azure_sink.go) over the SharedKey REST
+    client — no SDK."""
+
+    name = "azure"
+
+    def __init__(self, endpoint: str, container: str, account: str,
+                 key_b64: str, prefix: str = ""):
+        from seaweedfs_tpu.remote_storage.azure_client import AzureRemote
+        self.client = AzureRemote(endpoint, container, account, key_b64)
         self.prefix = prefix.strip("/")
 
     def _key(self, path: str) -> str:

@@ -196,6 +196,8 @@ class FilerServer:
         r("GET", "/__api/remote/status", self._api_remote_status)
         r("POST", "/__api/remote/configure", self._api_remote_configure)
         r("POST", "/__api/remote/mount", self._api_remote_mount)
+        r("POST", "/__api/remote/mount_buckets",
+          self._api_remote_mount_buckets)
         r("POST", "/__api/remote/unmount", self._api_remote_unmount)
         r("POST", "/__api/remote/pull", self._api_remote_pull)
         r("POST", "/__api/remote/cache", self._api_remote_cache)
@@ -569,6 +571,17 @@ class FilerServer:
         except KeyError as e:
             return Response({"error": str(e)}, status=404)
         return self._api_remote_status(req)
+
+    def _api_remote_mount_buckets(self, req: Request) -> Response:
+        b = req.json()
+        try:
+            mounted = self.remote_mounts.mount_buckets(
+                b["remote_name"], b.get("bucket_pattern", ""))
+        except KeyError as e:
+            return Response({"error": str(e)}, status=404)
+        except (ValueError, ConnectionError) as e:
+            return Response({"error": str(e)}, status=400)
+        return Response({"mounted": mounted})
 
     def _api_remote_unmount(self, req: Request) -> Response:
         self.remote_mounts.unmount(req.json()["dir"])

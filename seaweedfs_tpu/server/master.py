@@ -189,6 +189,15 @@ class MasterServer:
             # checkpoint once per leadership change (assign_fid) so a
             # continuing leader doesn't burn a batch per checkpoint
             self._seq_ckpt = max(self._seq_ckpt, cmd["value"])
+        elif cmd.get("type") == "raft_config":
+            # membership change committed through the log, so every
+            # master (and a restarted one replaying it) converges on
+            # the same peer set (reference cluster.raft.add/remove)
+            if self.raft is not None:
+                if cmd["op"] == "add":
+                    self.raft.add_peer(cmd["peer"])
+                elif cmd["op"] == "remove":
+                    self.raft.remove_peer(cmd["peer"])
 
     def _restore_raft_snapshot(self, state: dict) -> None:
         with self.topo.lock:
@@ -231,6 +240,38 @@ class MasterServer:
         return Response({"error": "not leader", "leader": self.leader},
                         status=409)
 
+    def _handle_raft_ps(self, req: Request) -> Response:
+        """Raft membership view (reference shell cluster.raft.ps)."""
+        if self.raft is None:
+            return Response({"id": self.url, "peers": [],
+                             "leader": self.url, "term": 0,
+                             "state": "single"})
+        return Response(self.raft.membership())
+
+    def _handle_raft_change(self, op: str):
+        """cluster.raft.add/remove: commit a membership change through
+        the log (leader-only; followers 409 to the leader)."""
+        def handler(req: Request) -> Response:
+            if self.raft is None:
+                return Response({"error": "raft not configured"},
+                                status=503)
+            if not self.is_leader():
+                return self._not_leader()
+            peer = (req.json() or {}).get("peer", "")
+            if not peer:
+                return Response({"error": "missing peer"}, status=400)
+            if op == "remove" and peer == self.raft.id:
+                return Response(
+                    {"error": "cannot remove the leader; transfer "
+                     "leadership first (stop this master)"}, status=400)
+            ok = self._raft_propose(
+                {"type": "raft_config", "op": op, "peer": peer})
+            if not ok:
+                return Response({"error": "config change not committed"},
+                                status=503)
+            return Response(self.raft.membership())
+        return handler
+
     # ---- routes ----
     def _register_routes(self) -> None:
         r = self.http.add
@@ -242,6 +283,10 @@ class MasterServer:
         r("GET", "/dir/status", self._handle_dir_status)
         r("POST", "/vol/grow", self._handle_grow)
         r("GET", "/cluster/status", self._handle_cluster_status)
+        r("GET", "/cluster/raft/ps", self._handle_raft_ps)
+        r("POST", "/cluster/raft/add", self._handle_raft_change("add"))
+        r("POST", "/cluster/raft/remove",
+          self._handle_raft_change("remove"))
         r("POST", "/admin/lock", self._handle_lock)
         r("POST", "/admin/unlock", self._handle_unlock)
         r("GET", "/metrics", self._handle_metrics)

@@ -20,6 +20,7 @@ from seaweedfs_tpu.utils.httpd import HttpError, http_json
 class ShellContext:
     def __init__(self, master_url: str, use_grpc: bool = True):
         self.master_url = master_url
+        self.cwd = "/"  # fs.cd state; relative fs.* paths resolve here
         # volume-server gRPC admin plane: probed per node (port+10000
         # convention, like the master), HTTP fallback kept — the
         # reference's shell is gRPC-first the same way
@@ -318,6 +319,80 @@ class ShellContext:
                 self._vs(d["node"], "/admin/delete_volume",
                          {"volume_id": d["vid"]})
         return doomed
+
+    def volume_tier_move(self, to_node: str, full_percent: float = 95.0,
+                         quiet_for: float = 0.0, collection: str = "",
+                         apply: bool = True) -> list[dict]:
+        """Move full + quiet volumes to a cold-tier node (reference
+        command_volume_tier_move.go migrates across disk TYPES; this
+        topology has no per-disk typing, so the destination tier is
+        addressed as a node). A volume qualifies when its content is
+        >= full_percent of the volume size limit and its .dat has been
+        untouched for quiet_for seconds."""
+        import time as _time
+
+        from seaweedfs_tpu.utils.httpd import http_json
+        status = http_json("GET",
+                           f"http://{self.master_url}/dir/status")
+        topo = status["Topology"]
+        limit = status.get("VolumeSizeLimitMB", 1024) * 1024 * 1024
+        threshold = limit * full_percent / 100.0
+        now = _time.time()
+        moved = []
+        all_nodes = []
+        vids_on_target: set = set()
+        for dc in topo.get("data_centers", []):
+            for rack in dc.get("racks", []):
+                for node in rack.get("nodes", []):
+                    all_nodes.append(node["id"])
+                    if node["id"] == to_node:
+                        vids_on_target = {v["id"] for v in
+                                          node.get("volumes", [])}
+        if to_node not in all_nodes:
+            raise ValueError(f"unknown volume server {to_node!r} "
+                             f"(known: {all_nodes})")
+        planned_vids: set = set()
+        for dc in topo.get("data_centers", []):
+            for rack in dc.get("racks", []):
+                for node in rack.get("nodes", []):
+                    if node["id"] == to_node:
+                        continue
+                    for v in node.get("volumes", []):
+                        if collection and \
+                                v.get("collection", "") != collection:
+                            continue
+                        if v.get("size", 0) < threshold:
+                            continue
+                        # one replica per volume moves; a second move
+                        # would collapse the replica set onto to_node,
+                        # and a vid already on to_node can't land again
+                        if v["id"] in planned_vids or \
+                                v["id"] in vids_on_target:
+                            continue
+                        if quiet_for:
+                            try:
+                                st = http_json(
+                                    "GET", f"http://{node['id']}"
+                                           "/admin/volume_file_status"
+                                           f"?volumeId={v['id']}")
+                            except (ConnectionError, HttpError):
+                                continue
+                            age = now - st.get(
+                                "dat_file_timestamp_seconds", now)
+                            if age < quiet_for:
+                                continue
+                        planned_vids.add(v["id"])
+                        moved.append({"vid": v["id"],
+                                      "from": node["id"],
+                                      "to": to_node,
+                                      "collection": v.get(
+                                          "collection", ""),
+                                      "size": v.get("size", 0)})
+        if apply:
+            for m in moved:
+                self.volume_move(m["vid"], m["from"], to_node,
+                                 m["collection"])
+        return moved
 
     def volume_server_evacuate(self, node: str,
                                apply: bool = True) -> list[dict]:

@@ -9,9 +9,12 @@ from seaweedfs_tpu.shell.commands import ShellContext
 
 HELP = """commands:
   fs.ls/cat/rm/mkdir/mv/du/tree <path> [..]   filer namespace ops
+  fs.cd <dir> / fs.pwd              relative paths resolve against cwd
+  fs.meta.notify [-root /p]         resend subtree to notification queue
   fs.configure -locationPrefix /p [-collection C] [-ttl T] [-readOnly] [-delete]
   remote.configure -name N [-type local] [-root DIR] | -delete N
   remote.mount -dir /m -remote N [-path prefix]
+  remote.mount.buckets -remote N [-bucketPattern G]
   remote.unmount -dir /m
   remote.meta.sync -dir /m          pull remote listing into the filer
   remote.cache/uncache -path /m/f   materialize / drop local chunk copy
@@ -35,8 +38,10 @@ HELP = """commands:
   volume.tail -volumeId N [-since NS]   stream appended needles
   volume.tier.upload -volumeId N -endpoint URL -bucket B [-keepLocal]
   volume.tier.download -volumeId N
+  volume.tier.move -toNode HOST [-fullPercent P] [-quietFor S] [-n]
   volume.vacuum [threshold]         compact garbage-heavy volumes
   cluster.ps                        list every cluster process
+  cluster.raft.ps / cluster.raft.add -peer URL / cluster.raft.remove -peer URL
   mq.topic.list                     list broker topics (filer /topics tree)
   s3.configure -user U -access K -secret S [-actions a,b] | -delete U
   s3.clean.uploads [-timeAgo SECONDS]   purge stale multipart uploads
@@ -101,32 +106,73 @@ def run_command(sh: ShellContext, line: str):
         sh.unlock()
         return {"locked": False}
     if cmd.startswith("fs."):
+        import posixpath
+
         from seaweedfs_tpu.shell.fs_commands import FsContext
         fsc = FsContext(_find_filer(sh))
         op = cmd[3:]
+        cwd = getattr(sh, "cwd", "/")
+
+        def rp(p: str) -> str:
+            # relative paths resolve against the REPL's fs.cd state
+            # (reference command_fs_cd.go / fs_pwd.go)
+            return p if p.startswith("/") \
+                else posixpath.normpath(posixpath.join(cwd, p))
+        if op == "cd":
+            target = rp(args[0]) if args else "/"
+            fsc.ls(target)  # raises if not a directory
+            sh.cwd = target
+            return {"cwd": target}
+        if op == "pwd":
+            return {"cwd": cwd}
         if op == "ls":
-            return fsc.ls(args[0] if args else "/")
+            return fsc.ls(rp(args[0]) if args else cwd)
         if op == "cat":
-            data = fsc.cat(args[0])
+            data = fsc.cat(rp(args[0]))
             print(data.decode(errors="replace"))
             return None
         if op == "rm":
             paths = [a for a in args if not a.startswith("-")]
-            fsc.rm(paths[0], recursive="-r" in args)
-            return {"removed": paths[0]}
+            fsc.rm(rp(paths[0]), recursive="-r" in args)
+            return {"removed": rp(paths[0])}
         if op == "mkdir":
-            fsc.mkdir(args[0])
-            return {"created": args[0]}
+            fsc.mkdir(rp(args[0]))
+            return {"created": rp(args[0])}
         if op == "mv":
-            fsc.mv(args[0], args[1])
-            return {"moved": [args[0], args[1]]}
+            fsc.mv(rp(args[0]), rp(args[1]))
+            return {"moved": [rp(args[0]), rp(args[1])]}
         if op == "du":
-            files, size = fsc.du(args[0] if args else "/")
+            files, size = fsc.du(rp(args[0]) if args else cwd)
             return {"files": files, "bytes": size}
         if op == "tree":
-            for line_ in fsc.tree(args[0] if args else "/"):
+            for line_ in fsc.tree(rp(args[0]) if args else cwd):
                 print(line_)
             return None
+        if op == "meta.notify":
+            # resend a subtree's entries to the configured notification
+            # queue (reference command_fs_meta_notify.go loads
+            # notification.toml in the shell process the same way)
+            from seaweedfs_tpu.notification.queue import \
+                make_queue_from_config
+            mq = make_queue_from_config()
+            if mq is None:
+                raise RuntimeError(
+                    "no notification backend enabled in notification.toml")
+            root = rp(flags.get("root", cwd))
+            sent = 0
+
+            def walk(d: str):
+                nonlocal sent
+                for e in fsc.ls(d, limit=1 << 20):
+                    if e.get("IsDirectory"):
+                        walk(e["FullPath"])
+                    else:
+                        mq.send_message(e["FullPath"], {
+                            "event": "create", "new_entry": e})
+                        sent += 1
+            walk(root)
+            mq.close()
+            return {"notified": sent, "root": root}
         if op == "meta.save":
             from seaweedfs_tpu.shell.fs_commands import fs_meta_save
             n = fs_meta_save(fsc.filer_url, flags.get("root", "/"),
@@ -183,7 +229,15 @@ def run_command(sh: ShellContext, line: str):
                 "name": flags["name"],
                 "type": flags.get("type", "local"),
                 "root": flags.get("root", ""),
-                "endpoint": flags.get("endpoint", "")})
+                "endpoint": flags.get("endpoint", ""),
+                "bucket": flags.get("bucket", ""),
+                "access_key": flags.get("accessKey", ""),
+                "secret_key": flags.get("secretKey", ""),
+                "region": flags.get("region", "us-east-1")})
+        if op == "mount.buckets":
+            return http_json("POST", f"{base}/mount_buckets", {
+                "remote_name": flags["remote"],
+                "bucket_pattern": flags.get("bucketPattern", "")})
         if op == "mount":
             return http_json("POST", f"{base}/mount", {
                 "dir": flags["dir"], "remote_name": flags["remote"],
@@ -286,6 +340,37 @@ def run_command(sh: ShellContext, line: str):
                     "topic": te["FullPath"].rsplit("/", 1)[-1],
                     "partition_count": conf.get("partition_count", 0)})
         return {"topics": topics}
+    if cmd == "cluster.raft.ps":
+        from seaweedfs_tpu.utils.httpd import http_json
+        return http_json("GET",
+                         f"http://{sh.master_url}/cluster/raft/ps")
+    if cmd in ("cluster.raft.add", "cluster.raft.remove"):
+        from seaweedfs_tpu.utils.httpd import http_call
+        op = cmd.rsplit(".", 1)[1]
+        # follow one not-leader hop (the 409 body carries the leader)
+        url = sh.master_url
+        for _ in range(3):
+            status, body, _ = http_call(
+                "POST", f"http://{url}/cluster/raft/{op}",
+                json_body={"peer": flags["peer"]})
+            out = json.loads(body) if body else {}
+            if status < 300:
+                return out
+            if status == 409 and out.get("leader"):
+                url = out["leader"]
+                continue
+            raise RuntimeError(f"raft {op} failed: HTTP {status} {out}")
+        raise RuntimeError("leader kept moving; retry")
+    if cmd == "volume.tier.move":
+        # move full+quiet volumes to a destination ("cold tier") node
+        # (reference command_volume_tier_move.go moves across disk
+        # types; this topology addresses tiers by node instead)
+        return sh.volume_tier_move(
+            flags["toNode"],
+            full_percent=float(flags.get("fullPercent", 95)),
+            quiet_for=float(flags.get("quietFor", 0)),
+            collection=flags.get("collection", ""),
+            apply=apply)
     if cmd == "cluster.ps":
         return sh.cluster_ps()
     if cmd == "volume.tier.upload":

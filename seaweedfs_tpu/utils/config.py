@@ -82,6 +82,20 @@ path = "./notifications.jsonl"
 enabled = false
 address = "127.0.0.1:9092"   # any Kafka-wire broker
 topic = "seaweedfs_meta"
+
+[notification.aws_sqs]
+enabled = false
+sqs_queue_url = ""           # any SQS-wire endpoint (AWS/localstack/elasticmq)
+access_key = ""
+secret_key = ""
+region = "us-east-1"
+
+[notification.google_pub_sub]
+enabled = false
+endpoint = "https://pubsub.googleapis.com"   # or an emulator
+project_id = ""
+topic = "seaweedfs_meta"
+token = ""                   # static bearer token (emulators accept any)
 """,
     "shell": """\
 # shell.toml

@@ -1,0 +1,100 @@
+"""Azure-Blob-wire remote client + replication sink (reference
+weed/remote_storage/azure/azure_storage_client.go +
+replication/sink/azuresink/azure_sink.go — SDK-based there; here the
+Blob REST protocol with SharedKey signing is spoken directly, verified
+against an in-process endpoint that checks every signature)."""
+
+import base64
+import time
+
+import pytest
+
+from seaweedfs_tpu.remote_storage.azure_client import (AzureRemote,
+                                                       MiniAzureServer)
+
+KEY = base64.b64encode(b"super-secret-account-key").decode()
+
+
+@pytest.fixture
+def azure():
+    srv = MiniAzureServer(account="acct", key_b64=KEY).start()
+    yield srv, AzureRemote(srv.url, "box", "acct", KEY)
+    srv.stop()
+
+
+def test_blob_crud_and_list(azure):
+    srv, c = azure
+    c.write_file("docs/a.txt", b"alpha")
+    c.write_file("docs/b.txt", b"bravo-bravo")
+    c.write_file("other/c.txt", b"charlie")
+
+    assert c.read_file("docs/a.txt") == b"alpha"
+    assert c.read_file("docs/b.txt", offset=6, size=5) == b"bravo"
+
+    st = c.stat("docs/b.txt")
+    assert st is not None and st.size == 11
+    assert c.stat("missing.txt") is None
+
+    names = sorted(f.path for f in c.traverse())
+    assert names == ["docs/a.txt", "docs/b.txt", "other/c.txt"]
+    docs = [f.path for f in c.traverse(prefix="docs/")]
+    assert docs == ["docs/a.txt", "docs/b.txt"]
+
+    c.remove_file("docs/a.txt")
+    assert c.stat("docs/a.txt") is None
+    c.remove_file("docs/a.txt")  # idempotent
+
+
+def test_bad_key_rejected(azure):
+    import urllib.error
+    srv, _ = azure
+    bad = AzureRemote(srv.url, "box", "acct",
+                      base64.b64encode(b"wrong").decode())
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        bad.write_file("x", b"data")
+    assert exc.value.code == 403
+    assert not srv.blobs
+
+
+def test_registry_builds_azure_client(azure):
+    from seaweedfs_tpu.remote_storage.remote_storage import (
+        RemoteConf, make_remote_client)
+    srv, _ = azure
+    client = make_remote_client(RemoteConf(
+        name="az", type="azure", endpoint=srv.url, bucket="box",
+        access_key="acct", secret_key=KEY))
+    client.write_file("via-registry.txt", b"hello")
+    assert srv.blobs["box"]["via-registry.txt"] == b"hello"
+
+
+def test_azure_sink_replication(azure, tmp_path):
+    """Filer events land in the blob container through AzureSink."""
+    from seaweedfs_tpu.replication.sink import AzureSink, Replicator
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.replication.sync import FilerSync
+    from seaweedfs_tpu.utils.httpd import http_call
+
+    srv, _ = azure
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    src = FilerServer(master.url)
+    src.start()
+    time.sleep(0.1)
+    try:
+        sink = AzureSink(srv.url, "box", "acct", KEY, prefix="backup")
+        sync = FilerSync(src.url, sink)
+        http_call("POST", f"http://{src.url}/m/doc.txt", body=b"payload")
+        sync.run_once(0)
+        assert srv.blobs["box"]["backup/m/doc.txt"] == b"payload"
+
+        http_call("DELETE", f"http://{src.url}/m/doc.txt")
+        sync.run_once(0)
+        assert "backup/m/doc.txt" not in srv.blobs["box"]
+    finally:
+        src.stop()
+        vs.stop()
+        master.stop()

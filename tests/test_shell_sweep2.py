@@ -1,0 +1,287 @@
+"""Second shell parity sweep (round-4): cluster.raft.ps/add/remove,
+fs.cd/fs.pwd relative paths, fs.meta.notify, remote.mount.buckets,
+volume.tier.move. Reference: weed/shell/command_cluster_raft_*.go,
+command_fs_cd.go, command_fs_meta_notify.go,
+command_remote_mount_buckets.go, command_volume_tier_move.go."""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.commands import ShellContext
+from seaweedfs_tpu.shell.repl import run_command
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    time.sleep(0.2)
+    sh = ShellContext(master.url)
+    yield master, vs, fs, sh
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_fs_cd_pwd_relative_paths(cluster):
+    master, vs, fs, sh = cluster
+    http_call("POST", f"http://{fs.url}/deep/nest/file.txt", body=b"x")
+    assert run_command(sh, "fs.pwd") == {"cwd": "/"}
+    assert run_command(sh, "fs.cd /deep") == {"cwd": "/deep"}
+    assert run_command(sh, "fs.pwd") == {"cwd": "/deep"}
+    # relative ls resolves under /deep
+    names = [e["FullPath"] for e in run_command(sh, "fs.ls nest")]
+    assert names == ["/deep/nest/file.txt"]
+    assert run_command(sh, "fs.cd nest") == {"cwd": "/deep/nest"}
+    assert run_command(sh, "fs.cd ..") == {"cwd": "/deep"}
+    with pytest.raises(Exception):
+        run_command(sh, "fs.cd /deep/nest/file.txt")  # not a directory
+
+
+def test_fs_meta_notify(cluster, tmp_path, monkeypatch):
+    from seaweedfs_tpu.utils import config as _cfg
+    master, vs, fs, sh = cluster
+    http_call("POST", f"http://{fs.url}/n/a.txt", body=b"1")
+    http_call("POST", f"http://{fs.url}/n/sub/b.txt", body=b"2")
+    out_file = tmp_path / "events.jsonl"
+    (tmp_path / "notification.toml").write_text(
+        f'[notification.file]\nenabled = true\npath = "{out_file}"\n')
+    monkeypatch.setattr(_cfg, "SEARCH_PATHS", [str(tmp_path)])
+    out = run_command(sh, "fs.meta.notify -root /n")
+    assert out["notified"] == 2
+    lines = [json.loads(l) for l in out_file.read_text().splitlines()]
+    keys = sorted(l["key"] for l in lines)
+    assert keys == ["/n/a.txt", "/n/sub/b.txt"]
+
+
+def test_remote_mount_buckets(cluster):
+    from seaweedfs_tpu.gateway.s3_server import S3Server
+    master, vs, fs, sh = cluster
+    s3 = S3Server(fs)  # anonymous gateway as the "cloud"
+    s3.start()
+    try:
+        for b in ("alpha", "beta", "gamma"):
+            status, _, _ = http_call("PUT", f"http://{s3.url}/{b}")
+            assert status < 300
+        http_call("PUT", f"http://{s3.url}/alpha/hello.txt", body=b"hi")
+        run_command(sh, f"remote.configure -name cloud -type s3 "
+                        f"-endpoint http://{s3.url}")
+        out = run_command(sh,
+                          "remote.mount.buckets -remote cloud "
+                          "-bucketPattern 'a*'")
+        assert out == {"mounted": ["alpha"]}
+        out = run_command(sh, "remote.mount.buckets -remote cloud")
+        assert set(out["mounted"]) == {"alpha", "beta", "gamma"}
+        # the mounted bucket lists through the filer after a meta pull
+        http_json("POST", f"http://{fs.url}/__api/remote/pull",
+                  {"dir": "/buckets/alpha"})
+        names = [e["FullPath"]
+                 for e in run_command(sh, "fs.ls /buckets/alpha")]
+        assert "/buckets/alpha/hello.txt" in names
+    finally:
+        s3.stop()
+
+
+def test_volume_tier_move(cluster, tmp_path):
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    master, vs, fs, sh = cluster
+    mc = MasterClient(master.url)
+    # upload BEFORE the cold node exists so the volume grows on vs
+    fid = operation.upload_data(mc, b"c" * 4096, name="c.bin").fid
+    vid = int(fid.split(",")[0])
+    cold = VolumeServer([str(tmp_path / "cold")], master.url)
+    cold.start()
+    time.sleep(0.3)
+    try:
+        # plan with a 0% threshold: everything qualifies
+        planned = run_command(
+            sh, f"volume.tier.move -toNode {cold.url} -fullPercent 0 -n")
+        assert any(p["vid"] == vid and p["to"] == cold.url
+                   for p in planned)
+        moved = run_command(
+            sh, f"volume.tier.move -toNode {cold.url} -fullPercent 0")
+        assert any(m["vid"] == vid for m in moved)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            locs = http_json(
+                "GET",
+                f"http://{master.url}/dir/lookup?volumeId={vid}")
+            urls = [l["url"] for l in locs.get("locations", [])]
+            if urls == [cold.url]:
+                break
+            time.sleep(0.2)
+        assert urls == [cold.url]
+        assert operation.read_data(mc, fid) == b"c" * 4096
+    finally:
+        mc.stop()
+        cold.stop()
+
+
+def _wait_unique_leader(masters, timeout=15):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [m for m in masters if m.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError("no unique leader")
+
+
+def test_volume_tier_move_guards(cluster, tmp_path):
+    """Unknown target raises; a replicated volume moves only ONE
+    replica (review: two moves would collapse the replica set)."""
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    master, vs, fs, sh = cluster
+    with pytest.raises(ValueError, match="unknown volume server"):
+        run_command(sh, "volume.tier.move -toNode 127.0.0.1:1 -n")
+
+    mc = MasterClient(master.url)
+    # upload BEFORE the extra nodes exist so the volume grows on vs
+    fid = operation.upload_data(mc, b"r" * 1024, name="r.bin").fid
+    vid = int(fid.split(",")[0])
+    vs2 = VolumeServer([str(tmp_path / "v2")], master.url)
+    cold = VolumeServer([str(tmp_path / "cold2")], master.url)
+    vs2.start()
+    cold.start()
+    time.sleep(0.3)
+    try:
+        # make a second replica on the other warm node
+        sh.volume_copy(vid, vs.url, vs2.url)
+        time.sleep(0.5)  # both replicas heartbeat in
+        planned = run_command(
+            sh, f"volume.tier.move -toNode {cold.url} -fullPercent 0 -n")
+        assert len([p for p in planned if p["vid"] == vid]) == 1
+    finally:
+        mc.stop()
+        cold.stop()
+        vs2.stop()
+
+
+def test_removed_peer_cannot_depose_leader(tmp_path):
+    """Review finding: a removed node's election loop must not walk the
+    cluster's term up or win votes (membership-guarded RequestVote)."""
+    masters = [MasterServer() for _ in range(3)]
+    for m in masters:
+        m.start()
+    urls = [m.url for m in masters]
+    try:
+        for m in masters:
+            m.set_peers(urls)
+        leader = _wait_unique_leader(masters)
+        follower = next(m for m in masters if m is not leader)
+        sh = ShellContext(leader.url)
+        run_command(sh, f"cluster.raft.remove -peer {follower.url}")
+        others = [m for m in masters if m is not follower]
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(follower.url not in m.raft.peers for m in others):
+                break
+            time.sleep(0.1)
+        term_before = leader.raft.current_term
+        # several election timeouts for the removed node to try a coup
+        time.sleep(3.5)
+        assert leader.is_leader()
+        assert leader.raft.current_term == term_before
+        # the orphan kept electioneering but was never granted a vote
+        assert not follower.is_leader() or follower.raft.peers == []
+    finally:
+        for m in masters:
+            m.stop()
+
+
+def test_membership_survives_restart(tmp_path):
+    """Review finding: committed membership outlives a restart even
+    when the boot -peers list is stale (persisted peers win)."""
+    dirs = [tmp_path / f"m{i}" for i in range(3)]
+    for d in dirs:
+        d.mkdir()
+    masters = [MasterServer(meta_dir=str(d)) for d in dirs]
+    for m in masters:
+        m.start()
+    urls = [m.url for m in masters]
+    try:
+        for m in masters:
+            m.set_peers(urls)
+        leader = _wait_unique_leader(masters)
+        victim = next(m for m in masters if m is not leader)
+        sh = ShellContext(leader.url)
+        run_command(sh, f"cluster.raft.remove -peer {victim.url}")
+        survivor = next(m for m in masters
+                        if m is not leader and m is not victim)
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                victim.url in survivor.raft.peers:
+            time.sleep(0.1)
+        assert victim.url not in survivor.raft.peers
+        # restart the surviving follower with the STALE 3-node list
+        si = masters.index(survivor)
+        survivor.stop()
+        restarted = MasterServer(meta_dir=str(dirs[si]))
+        restarted.start()
+        restarted.set_peers(urls)  # stale boot list includes victim
+        try:
+            assert victim.url not in restarted.raft.peers  # persisted won
+        finally:
+            restarted.stop()
+    finally:
+        for m in masters:
+            m.stop()
+
+
+def test_cluster_raft_ps_add_remove(tmp_path):
+    masters = [MasterServer() for _ in range(3)]
+    for m in masters:
+        m.start()
+    urls = [m.url for m in masters]
+    try:
+        for m in masters:
+            m.set_peers(urls)
+        leader = _wait_unique_leader(masters)
+        sh = ShellContext(masters[0].url)  # may or may not be the leader
+        ps = run_command(sh, "cluster.raft.ps")
+        assert set(ps["peers"]) | {ps["id"]} == set(urls)
+
+        # remove a follower through the log; every master converges
+        follower = next(m for m in masters if m is not leader)
+        out = run_command(sh,
+                          f"cluster.raft.remove -peer {follower.url}")
+        assert follower.url not in out["peers"]
+        deadline = time.time() + 5
+        others = [m for m in masters if m is not follower]
+        while time.time() < deadline:
+            if all(follower.url not in m.raft.peers for m in others):
+                break
+            time.sleep(0.1)
+        assert all(follower.url not in m.raft.peers for m in others)
+
+        # the 2-node cluster still commits (assign works on the leader)
+        leader2 = _wait_unique_leader(others)
+        # add the follower back; peers converge again
+        run_command(sh, f"cluster.raft.add -peer {follower.url}")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(follower.url in m.raft.peers for m in others):
+                break
+            time.sleep(0.1)
+        assert all(follower.url in m.raft.peers for m in others)
+
+        # removing the leader itself is refused
+        with pytest.raises(RuntimeError, match="cannot remove"):
+            sh2 = ShellContext(leader2.url)
+            run_command(sh2,
+                        f"cluster.raft.remove -peer {leader2.url}")
+    finally:
+        for m in masters:
+            m.stop()
