@@ -239,4 +239,7 @@ def make_store(name: str, **kwargs) -> FilerStore:
     if name == "redis":
         from seaweedfs_tpu.filer.redis_store import RedisFilerStore
         return RedisFilerStore(**kwargs)
+    if name == "etcd":
+        from seaweedfs_tpu.filer.etcd_store import EtcdFilerStore
+        return EtcdFilerStore(**kwargs)
     return STORES[name](**kwargs)

@@ -81,15 +81,17 @@ class FilerServer:
             # gateway mode: metadata lives on another filer
             # (filer/remote_store.py); store_dir carries its address
             kwargs["filer_addr"] = store_dir
-        elif store == "redis":
-            # store_dir carries the redis address "host:port"
-            # (reference filer.toml [redis2] address); a non-address
-            # value (e.g. the CLI's default -dir ".") means localhost
+        elif store in ("redis", "etcd"):
+            # store_dir carries the database address "host:port"
+            # (reference filer.toml [redis2] address / [etcd] servers);
+            # a non-address value (e.g. the CLI's default -dir ".")
+            # means localhost on the protocol's standard port
+            default_port = 6379 if store == "redis" else 2379
             addr = store_dir if store_dir and ":" in store_dir \
-                else "127.0.0.1:6379"
-            r_host, _, r_port = addr.rpartition(":")
-            kwargs["host"] = r_host or "127.0.0.1"
-            kwargs["port"] = int(r_port)
+                else f"127.0.0.1:{default_port}"
+            db_host, _, db_port = addr.rpartition(":")
+            kwargs["host"] = db_host or "127.0.0.1"
+            kwargs["port"] = int(db_port)
         self.filer = Filer(make_store(store, **kwargs),
                            delete_chunks_fn=self._delete_chunks,
                            read_chunk_fn=self._read_chunk)
