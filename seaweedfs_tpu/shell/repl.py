@@ -345,22 +345,37 @@ def run_command(sh: ShellContext, line: str):
         return http_json("GET",
                          f"http://{sh.master_url}/cluster/raft/ps")
     if cmd in ("cluster.raft.add", "cluster.raft.remove"):
+        import time as _time
+
         from seaweedfs_tpu.utils.httpd import http_call
         op = cmd.rsplit(".", 1)[1]
-        # follow one not-leader hop (the 409 body carries the leader)
+        # follow not-leader hops (the 409 body carries the leader) and
+        # ride out an election in progress — membership commands often
+        # run exactly when leadership is churning
         url = sh.master_url
-        for _ in range(3):
-            status, body, _ = http_call(
-                "POST", f"http://{url}/cluster/raft/{op}",
-                json_body={"peer": flags["peer"]})
+        deadline = _time.time() + 10
+        while True:
+            try:
+                status, body, _ = http_call(
+                    "POST", f"http://{url}/cluster/raft/{op}",
+                    json_body={"peer": flags["peer"]}, timeout=5)
+            except ConnectionError:
+                status, body = 0, b""
             out = json.loads(body) if body else {}
-            if status < 300:
+            if status and status < 300:
                 return out
+            if status not in (0, 409, 503):
+                # permanent (e.g. 400 cannot-remove-leader): no retry
+                raise RuntimeError(
+                    f"raft {op} failed: HTTP {status} {out}")
+            if _time.time() > deadline:
+                raise RuntimeError(
+                    f"raft {op} failed: HTTP {status} {out}")
             if status == 409 and out.get("leader"):
                 url = out["leader"]
-                continue
-            raise RuntimeError(f"raft {op} failed: HTTP {status} {out}")
-        raise RuntimeError("leader kept moving; retry")
+            else:
+                url = sh.master_url  # re-resolve from scratch
+                _time.sleep(0.3)
     if cmd == "volume.tier.move":
         # move full+quiet volumes to a destination ("cold tier") node
         # (reference command_volume_tier_move.go moves across disk

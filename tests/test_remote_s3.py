@@ -103,9 +103,11 @@ def test_gcs_b2_types_ride_the_s3_dialect(cloud):
         assert isinstance(c, S3Remote)
         c.write_file(f"{t}.txt", t.encode())
         assert c.read_file(f"{t}.txt") == t.encode()
-    # azure has its own wire protocol: still an explicit plug point
+    # azure speaks its own wire protocol via the SharedKey REST client
+    # (tests/test_azure_remote.py); a truly unknown type stays a plug
+    # point
     with pytest.raises(NotImplementedError):
-        make_remote_client(RemoteConf(name="az", type="azure"))
+        make_remote_client(RemoteConf(name="x", type="hdfs"))
 
 
 def test_s3_remote_bad_credentials_rejected(cloud):

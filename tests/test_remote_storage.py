@@ -37,9 +37,12 @@ def test_local_remote_client(tmp_path):
 
 
 def test_unknown_remote_type_is_plug_point():
-    # azure's wire protocol isn't S3-compatible: explicit plug point
-    with pytest.raises(NotImplementedError):
+    # azure is a real client now (SharedKey REST); misconfig errors
+    with pytest.raises(ValueError):
         make_remote_client(RemoteConf(name="x", type="azure"))
+    # a truly unknown type stays an explicit plug point
+    with pytest.raises(NotImplementedError):
+        make_remote_client(RemoteConf(name="x", type="hdfs"))
     # s3-dialect types are real clients now; misconfig is a ValueError
     with pytest.raises(ValueError):
         make_remote_client(RemoteConf(name="x", type="s3"))
