@@ -174,6 +174,128 @@ def cmd_gateway(args):
     _wait_forever()
 
 
+def cmd_filer_sync(args):
+    """Active-active sync between two filers (reference
+    command/filer_sync.go), or one-way with -oneWay."""
+    from seaweedfs_tpu.replication.sync import BidirectionalSync, FilerSync
+    if args.oneWay:
+        from seaweedfs_tpu.replication.sink import FilerSink
+        # one-way: -bPrefix is the DESTINATION prefix on B (in
+        # bidirectional mode it is B's source-path filter)
+        sync = FilerSync(args.a,
+                         FilerSink(args.b,
+                                   path_prefix=args.bPrefix.rstrip("/")),
+                         path_prefix=args.aPrefix)
+        print(f"filer.sync {args.a} -> {args.b} (one-way)")
+    else:
+        sync = BidirectionalSync(args.a, args.b,
+                                 a_prefix=args.aPrefix,
+                                 b_prefix=args.bPrefix)
+        print(f"filer.sync {args.a} <-> {args.b}")
+    sync.start(args.since)
+    _wait_forever()
+
+
+def cmd_filer_backup(args):
+    """Continuously back a filer subtree up to a sink (reference
+    command/filer_backup.go): -dir for a local mirror, or -endpoint +
+    -bucket for an S3-dialect target."""
+    from seaweedfs_tpu.replication.sync import FilerSync
+    if args.endpoint:
+        from seaweedfs_tpu.replication.sink import S3Sink
+        sink = S3Sink(args.endpoint, args.bucket, prefix=args.keyPrefix,
+                      access_key=args.accessKey, secret_key=args.secretKey)
+        target = f"s3 {args.endpoint}/{args.bucket}"
+    else:
+        from seaweedfs_tpu.replication.sink import LocalSink
+        sink = LocalSink(args.dir)
+        target = args.dir
+    sync = FilerSync(args.filer, sink, path_prefix=args.filerPath)
+    print(f"filer.backup {args.filer}{args.filerPath} -> {target}")
+    sync.start(args.since)
+    _wait_forever()
+
+
+def cmd_filer_cat(args):
+    """Print a filer file to stdout (reference command/filer_cat.go)."""
+    import sys
+
+    from seaweedfs_tpu.utils.httpd import http_call
+    status, body, _ = http_call(
+        "GET", f"http://{args.filer}{args.path}")
+    if status >= 400:
+        raise SystemExit(f"HTTP {status}")
+    sys.stdout.buffer.write(body)
+
+
+def cmd_filer_copy(args):
+    """Copy local files/dirs into the filer (reference
+    command/filer_copy.go; `weed filer.copy file1 ... /dest/`)."""
+    from seaweedfs_tpu.shell.fs_commands import filer_copy
+    n = filer_copy(args.filer, args.paths, args.dest)
+    print(json.dumps({"copied": n, "dest": args.dest}))
+
+
+def cmd_filer_meta_backup(args):
+    from seaweedfs_tpu.replication.sync import meta_backup
+    # one-shot dump by default; -follow keeps tailing like the
+    # reference's continuous backup daemon
+    n = meta_backup(args.filer, args.output,
+                    path_prefix=args.filerPath,
+                    stop_on_idle=not args.follow)
+    print(json.dumps({"events": n, "file": args.output}))
+
+
+def cmd_filer_meta_tail(args):
+    from seaweedfs_tpu.replication.sync import meta_tail
+    n = meta_tail(args.filer, path_prefix=args.pathPrefix,
+                  max_events=args.n or None)
+    print(json.dumps({"events": n}))
+
+
+def cmd_filer_remote_sync(args):
+    """Write-back daemon for a remote mount (reference
+    command/filer_remote_sync.go)."""
+    from seaweedfs_tpu.replication.remote_sync import FilerRemoteSync
+    sync = FilerRemoteSync(args.filer, args.dir)
+    print(f"filer.remote.sync {args.filer}{args.dir}")
+    sync.start()
+    _wait_forever()
+
+
+def cmd_iam(args):
+    """Standalone IAM API server over a remote filer (reference
+    command/iam.go)."""
+    from seaweedfs_tpu.gateway.iam_server import IamServer
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    fs = FilerServer(args.master, store="remote", store_dir=args.filer,
+                     announce=False)
+    fs.start()
+    iam = IamServer(fs, host=args.ip, port=args.port)
+    iam.start()
+    print(f"iam {iam.url} (filer {args.filer})")
+    _wait_forever()
+
+
+def cmd_version(args):
+    import platform
+    print(json.dumps({
+        "version": "0.1.0",
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+    }))
+
+
+def cmd_fuse(args):
+    """fstab-style mount (reference command/fuse.go): options ride -o."""
+    opts = dict(kv.split("=", 1) for kv in args.o.split(",")
+                if "=" in kv)
+    args.filer = opts.get("filer", "")
+    args.master = opts.get("master", "127.0.0.1:9333")
+    args.store = opts.get("store", "remote")
+    cmd_mount(args)
+
+
 def cmd_upload(args):
     from seaweedfs_tpu.client import operation
     from seaweedfs_tpu.client.wdclient import MasterClient
@@ -515,6 +637,88 @@ def main(argv=None):
                        help="filer address holding the metadata")
         g.add_argument("-master", default="127.0.0.1:9333")
         g.set_defaults(fn=cmd_gateway)
+
+    fsy = sub.add_parser("filer.sync",
+                         help="active-active sync between two filers")
+    fsy.add_argument("-a", required=True, help="filer A host:port")
+    fsy.add_argument("-b", required=True, help="filer B host:port")
+    fsy.add_argument("-aPrefix", default="/",
+                     help="A-side source path filter")
+    fsy.add_argument("-bPrefix", default="/",
+                     help="B-side source path filter (bidirectional) "
+                          "or destination prefix on B (-oneWay)")
+    fsy.add_argument("-oneWay", action="store_true",
+                     help="only replicate A -> B")
+    fsy.add_argument("-since", type=int, default=0,
+                     help="start cursor (ns); 0 = replay everything")
+    fsy.set_defaults(fn=cmd_filer_sync)
+
+    fbk = sub.add_parser("filer.backup",
+                         help="continuous filer backup to a sink")
+    fbk.add_argument("-filer", default="127.0.0.1:8888")
+    fbk.add_argument("-filerPath", default="/")
+    fbk.add_argument("-dir", default="./filer_backup",
+                     help="local mirror directory sink")
+    fbk.add_argument("-endpoint", default="",
+                     help="S3-dialect endpoint sink (overrides -dir)")
+    fbk.add_argument("-bucket", default="")
+    fbk.add_argument("-keyPrefix", default="")
+    fbk.add_argument("-accessKey", default="")
+    fbk.add_argument("-secretKey", default="")
+    fbk.add_argument("-since", type=int, default=0)
+    fbk.set_defaults(fn=cmd_filer_backup)
+
+    fct = sub.add_parser("filer.cat", help="print a filer file")
+    fct.add_argument("-filer", default="127.0.0.1:8888")
+    fct.add_argument("path")
+    fct.set_defaults(fn=cmd_filer_cat)
+
+    fcp = sub.add_parser("filer.copy",
+                         help="copy local files into the filer")
+    fcp.add_argument("-filer", default="127.0.0.1:8888")
+    fcp.add_argument("paths", nargs="+")
+    fcp.add_argument("dest")
+    fcp.set_defaults(fn=cmd_filer_copy)
+
+    fmb = sub.add_parser("filer.meta.backup",
+                         help="dump the filer meta log to JSONL")
+    fmb.add_argument("-filer", default="127.0.0.1:8888")
+    fmb.add_argument("-filerPath", default="/")
+    fmb.add_argument("-o", dest="output", default="filer_meta.jsonl")
+    fmb.add_argument("-follow", action="store_true",
+                     help="keep tailing instead of a one-shot dump")
+    fmb.set_defaults(fn=cmd_filer_meta_backup)
+
+    fmt_ = sub.add_parser("filer.meta.tail",
+                          help="print filer meta events")
+    fmt_.add_argument("-filer", default="127.0.0.1:8888")
+    fmt_.add_argument("-pathPrefix", default="/")
+    fmt_.add_argument("-n", type=int, default=16)
+    fmt_.set_defaults(fn=cmd_filer_meta_tail)
+
+    frs = sub.add_parser("filer.remote.sync",
+                         help="write-back daemon for a remote mount")
+    frs.add_argument("-filer", default="127.0.0.1:8888")
+    frs.add_argument("-dir", required=True, help="mounted directory")
+    frs.set_defaults(fn=cmd_filer_remote_sync)
+
+    im = sub.add_parser("iam", help="standalone IAM API server")
+    im.add_argument("-ip", default="127.0.0.1")
+    im.add_argument("-port", type=int, default=8111)
+    im.add_argument("-filer", default="127.0.0.1:8888")
+    im.add_argument("-master", default="127.0.0.1:9333")
+    im.set_defaults(fn=cmd_iam)
+
+    ver = sub.add_parser("version", help="print version info")
+    ver.set_defaults(fn=cmd_version)
+
+    fu = sub.add_parser(
+        "fuse", help="mount via fstab conventions (reference weed fuse: "
+                     "`weed-tpu fuse /mnt -o filer=host:port`)")
+    fu.add_argument("mountpoint")
+    fu.add_argument("-o", default="", help="comma-separated options: "
+                    "filer=,master=,store=")
+    fu.set_defaults(fn=cmd_fuse)
 
     u = sub.add_parser("upload")
     u.add_argument("-master", default="127.0.0.1:9333")

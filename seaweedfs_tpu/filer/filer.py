@@ -21,18 +21,25 @@ from seaweedfs_tpu.filer.filerstore_hardlink import (HardLinkStore,
 
 
 class MetaLogEvent:
-    __slots__ = ("tsns", "directory", "old_entry", "new_entry")
+    __slots__ = ("tsns", "directory", "old_entry", "new_entry",
+                 "signature")
 
     def __init__(self, directory: str, old_entry: Optional[dict],
-                 new_entry: Optional[dict], tsns: Optional[int] = None):
+                 new_entry: Optional[dict], tsns: Optional[int] = None,
+                 signature: int = 0):
         self.tsns = tsns or time.time_ns()
         self.directory = directory
         self.old_entry = old_entry
         self.new_entry = new_entry
+        # originator tag (reference filer.sync signatures): writes
+        # applied by a replicator carry its signature so the reverse
+        # direction can exclude them instead of echoing forever
+        self.signature = signature
 
     def to_dict(self) -> dict:
         return {"tsns": self.tsns, "directory": self.directory,
-                "old_entry": self.old_entry, "new_entry": self.new_entry}
+                "old_entry": self.old_entry, "new_entry": self.new_entry,
+                "signature": self.signature}
 
 
 class MetaLog:
@@ -90,24 +97,33 @@ class MetaLog:
                 self._flush_segment_locked()
 
     def read_since(self, tsns: int, path_prefix: str = "/",
-                   limit: int = 1024) -> list[MetaLogEvent]:
+                   limit: int = 1024,
+                   exclude_signature: int = 0) -> list[MetaLogEvent]:
+        # signature exclusion happens BEFORE the limit (like the prefix
+        # filter): >= limit consecutive replicated events must not
+        # starve a reverse-sync reader of the native events after them
         prefix = path_prefix.rstrip("/") or "/"
         with self._lock:
             ring_start = self.events[0].tsns if self.events else None
         out: list[MetaLogEvent] = []
         # cursor predates the ring: replay persisted segments first
         if self.persist_dir and (ring_start is None or tsns < ring_start - 1):
-            out.extend(self._read_persisted(tsns, prefix, limit, ring_start))
+            out.extend(self._read_persisted(tsns, prefix, limit, ring_start,
+                                            exclude_signature))
         with self._lock:
             for e in self.events:
                 if len(out) >= limit:
                     break
-                if e.tsns > tsns and e.directory.startswith(prefix):
-                    out.append(e)
+                if e.tsns <= tsns or not e.directory.startswith(prefix):
+                    continue
+                if exclude_signature and e.signature == exclude_signature:
+                    continue
+                out.append(e)
         return out[:limit]
 
     def _read_persisted(self, tsns: int, prefix: str, limit: int,
-                        ring_start) -> list[MetaLogEvent]:
+                        ring_start,
+                        exclude_signature: int = 0) -> list[MetaLogEvent]:
         import json
         import os
         out: list[MetaLogEvent] = []
@@ -128,10 +144,14 @@ class MetaLog:
                             continue
                         if ring_start is not None and d["tsns"] >= ring_start:
                             return out
-                        if d["directory"].startswith(prefix):
+                        if d["directory"].startswith(prefix) and not (
+                                exclude_signature and
+                                d.get("signature", 0)
+                                == exclude_signature):
                             out.append(MetaLogEvent(
                                 d["directory"], d.get("old_entry"),
-                                d.get("new_entry"), d["tsns"]))
+                                d.get("new_entry"), d["tsns"],
+                                signature=d.get("signature", 0)))
                         if len(out) >= limit:
                             return out
             except (OSError, ValueError):
@@ -167,9 +187,15 @@ class Filer:
         self.delete_chunks_fn = delete_chunks_fn
         self.read_chunk_fn = read_chunk_fn  # to expand manifest chunks on GC
         self._lock = threading.RLock()
+        self._sig = threading.local()  # per-request originator tag
         root = self.store.find_entry("/")
         if root is None:
             self.store.insert_entry(new_directory_entry("/"))
+
+    def set_signature(self, signature: int) -> None:
+        """Tag this thread's subsequent mutations with a replicator
+        signature (reference filer.sync signatures); 0 clears it."""
+        self._sig.value = signature
 
     # ---- entry ops ----
     def create_entry(self, entry: Entry, o_excl: bool = False) -> Entry:
@@ -386,7 +412,9 @@ class Filer:
 
     def _notify(self, directory: str, old_entry: Optional[dict],
                 new_entry: Optional[dict]) -> None:
-        self.meta_log.append(MetaLogEvent(directory, old_entry, new_entry))
+        self.meta_log.append(MetaLogEvent(
+            directory, old_entry, new_entry,
+            signature=getattr(self._sig, "value", 0)))
 
     def close(self) -> None:
         self.meta_log.flush()

@@ -39,6 +39,10 @@ class AggregatedLog:
             "directory": ev.get("directory", "/"),
             "old_entry": ev.get("old_entry"),
             "new_entry": ev.get("new_entry"),
+            # replicator tag must survive the merge or the aggregated
+            # stream's exclude_signature filter silently no-ops and
+            # bidirectional sync over it echoes forever
+            "signature": ev.get("signature", 0),
         }
         with self._cond:
             # the local clock can tie under coarse timers; keep strictly
@@ -51,12 +55,22 @@ class AggregatedLog:
             self._cond.notify_all()
 
     def read_since(self, tsns: int, path_prefix: str = "/",
-                   limit: int = 1024) -> list[dict]:
+                   limit: int = 1024,
+                   exclude_signature: int = 0) -> list[dict]:
+        # exclusion BEFORE the limit: a run of >= limit replicated
+        # events must not starve the reader of what follows them
         prefix = path_prefix.rstrip("/") or "/"
         with self._lock:
             return [e for e in self.events
                     if e["tsns"] > tsns
-                    and e["directory"].startswith(prefix)][:limit]
+                    and e["directory"].startswith(prefix)
+                    and not (exclude_signature and
+                             e.get("signature", 0) == exclude_signature)
+                    ][:limit]
+
+    def latest_tsns(self) -> int:
+        with self._lock:
+            return self.events[-1]["tsns"] if self.events else 0
 
     def wait_for_events(self, tsns: int, timeout: float = 10.0) -> bool:
         with self._cond:

@@ -32,33 +32,45 @@ class ReplicationSink(abc.ABC):
 
 
 class FilerSink(ReplicationSink):
-    """Replicate into another filer over HTTP."""
+    """Replicate into another filer over HTTP. When `signature` is set,
+    every write carries X-Weed-Sync-Signature so the destination tags
+    the resulting events — the reverse sync direction excludes them
+    (reference filer.sync signatures)."""
 
     name = "filer"
 
-    def __init__(self, filer_url: str, path_prefix: str = "/"):
+    def __init__(self, filer_url: str, path_prefix: str = "/",
+                 signature: int = 0):
         self.filer_url = filer_url
         self.path_prefix = path_prefix.rstrip("/")
+        self.signature = signature
 
     def _url(self, path: str) -> str:
         return (f"http://{self.filer_url}{self.path_prefix}"
                 f"{urllib.parse.quote(path)}")
+
+    def _headers(self) -> Optional[dict]:
+        if not self.signature:
+            return None
+        return {"X-Weed-Sync-Signature": str(self.signature)}
 
     def create_entry(self, path: str, entry: dict,
                      data: Optional[bytes]) -> None:
         from seaweedfs_tpu.utils.httpd import http_call
         attr = entry.get("attr", {})
         if attr.get("is_directory"):
-            http_call("POST", self._url(path) + "?mkdir=true", body=b"")
+            http_call("POST", self._url(path) + "?mkdir=true", body=b"",
+                      headers=self._headers())
             return
-        http_call("POST", self._url(path), body=data or b"")
+        http_call("POST", self._url(path), body=data or b"",
+                  headers=self._headers())
 
     def delete_entry(self, path: str, is_directory: bool) -> None:
         from seaweedfs_tpu.utils.httpd import http_call
         url = self._url(path)
         if is_directory:
             url += "?recursive=true"
-        http_call("DELETE", url)
+        http_call("DELETE", url, headers=self._headers())
 
 
 class LocalSink(ReplicationSink):

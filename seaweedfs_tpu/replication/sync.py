@@ -136,30 +136,35 @@ def subscribe_meta_events(filer_url: str, since_ns: int = 0,
 
 
 class FilerSync:
-    """Continuous one-way sync source-filer -> sink
-    (half of the reference's bidirectional filer.sync)."""
+    """Continuous one-way sync source-filer -> sink (half of the
+    reference's bidirectional filer.sync; BidirectionalSync pairs two
+    of these with signature exclusion so they never echo)."""
 
     def __init__(self, source_filer_url: str, sink: ReplicationSink,
-                 path_prefix: str = "/"):
+                 path_prefix: str = "/", exclude_signature: int = 0):
         self.source = source_filer_url
         self.replicator = Replicator(sink, source_filer_url, path_prefix)
         self.path_prefix = path_prefix
+        self.exclude_signature = exclude_signature
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.applied = 0
 
     def run_once(self, since_ns: int = 0) -> int:
         """Apply all currently-available events; returns last tsns."""
-        out = http_json(
-            "GET",
-            f"http://{self.source}/__api/meta_events?since_ns={since_ns}"
-            f"&prefix={self.path_prefix}")
+        url = (f"http://{self.source}/__api/meta_events"
+               f"?since_ns={since_ns}&prefix={self.path_prefix}")
+        if self.exclude_signature:
+            url += f"&exclude_signature={self.exclude_signature}"
+        out = http_json("GET", url)
         last = since_ns
         for ev in out.get("events", []):
             self.replicator.apply_event(ev)
             self.applied += 1
             last = max(last, ev["tsns"])
-        return last
+        # the server's cursor also advances past trailing excluded /
+        # non-matching events so they aren't re-scanned every poll
+        return max(last, out.get("cursor", last))
 
     def start(self, since_ns: int = 0) -> None:
         def loop():
@@ -184,18 +189,53 @@ class FilerSync:
             self._thread.join(timeout=5)
 
 
+class BidirectionalSync:
+    """Active-active filer.sync (reference command/filer_sync.go): two
+    one-way FilerSync daemons whose sinks tag writes with per-direction
+    signatures, each excluding the other's signature from its event
+    stream so replicated writes are never echoed back."""
+
+    def __init__(self, filer_a: str, filer_b: str,
+                 a_prefix: str = "/", b_prefix: str = "/"):
+        import zlib
+        from seaweedfs_tpu.replication.sink import FilerSink
+        sig_ab = zlib.crc32(f"{filer_a}=>{filer_b}".encode()) or 1
+        sig_ba = zlib.crc32(f"{filer_b}=>{filer_a}".encode()) or 1
+        self.a_to_b = FilerSync(
+            filer_a, FilerSink(filer_b, signature=sig_ab),
+            path_prefix=a_prefix, exclude_signature=sig_ba)
+        self.b_to_a = FilerSync(
+            filer_b, FilerSink(filer_a, signature=sig_ba),
+            path_prefix=b_prefix, exclude_signature=sig_ab)
+
+    def start(self, since_ns: int = 0) -> None:
+        self.a_to_b.start(since_ns)
+        self.b_to_a.start(since_ns)
+
+    def stop(self) -> None:
+        self.a_to_b.stop()
+        self.b_to_a.stop()
+
+
 def meta_tail(filer_url: str, path_prefix: str = "/", since_ns: int = 0,
               emit: Callable[[dict], None] = None,
               max_events: Optional[int] = None,
-              aggregated: bool = False) -> int:
+              aggregated: bool = False,
+              stop_on_idle: bool = False) -> int:
     """Print (or hand to `emit`) meta events as they happen
-    (reference filer_meta_tail.go). Returns events seen."""
+    (reference filer_meta_tail.go). Returns events seen.
+    stop_on_idle: return at the first idle tick — "drain what exists
+    now" semantics for one-shot dumps instead of tailing forever."""
     emit = emit or (lambda ev: print(json.dumps(ev)))
     seen = 0
+    # one-shot drains skip the gRPC stream and use a sub-second poll so
+    # the trailing idle tick costs ~0.2s, not the 5s long-poll timeout
+    kwargs = ({"poll_wait": 0.2, "use_grpc": False}
+              if stop_on_idle else {})
     for ev in subscribe_meta_events(filer_url, since_ns, path_prefix,
-                                    aggregated=aggregated):
+                                    aggregated=aggregated, **kwargs):
         if ev is None:
-            if max_events is not None:
+            if stop_on_idle or max_events is not None:
                 break
             continue
         emit(ev)
@@ -206,12 +246,14 @@ def meta_tail(filer_url: str, path_prefix: str = "/", since_ns: int = 0,
 
 
 def meta_backup(filer_url: str, backup_path: str, path_prefix: str = "/",
-                since_ns: int = 0, max_events: Optional[int] = None) -> int:
+                since_ns: int = 0, max_events: Optional[int] = None,
+                stop_on_idle: bool = False) -> int:
     """Append meta events to a JSONL file (reference filer_meta_backup.go
     with the file 'store')."""
     count = 0
     with open(backup_path, "a") as f:
         def emit(ev):
             f.write(json.dumps(ev) + "\n")
-        count = meta_tail(filer_url, path_prefix, since_ns, emit, max_events)
+        count = meta_tail(filer_url, path_prefix, since_ns, emit,
+                          max_events, stop_on_idle=stop_on_idle)
     return count

@@ -207,10 +207,28 @@ class FilerServer:
         r("POST", "/__api/remote/writeback", self._api_remote_writeback)
         r("POST", "/__api/remote/rm", self._api_remote_rm)
         for method in ("POST", "PUT"):
-            r(method, "/.*", self._handle_write)
+            r(method, "/.*", self._signed(self._handle_write))
         r("GET", "/.*", self._handle_read)
         r("HEAD", "/.*", self._handle_read)
-        r("DELETE", "/.*", self._handle_delete)
+        r("DELETE", "/.*", self._signed(self._handle_delete))
+
+    def _signed(self, handler):
+        """A replicator identifies its writes with
+        X-Weed-Sync-Signature so the reverse sync direction can exclude
+        them from the event stream (reference filer.sync signatures)."""
+        def wrapped(req: Request) -> Response:
+            sig = req.headers.get("X-Weed-Sync-Signature")
+            if not sig:
+                return handler(req)
+            try:
+                self.filer.set_signature(int(sig))
+            except ValueError:
+                return handler(req)
+            try:
+                return handler(req)
+            finally:
+                self.filer.set_signature(0)
+        return wrapped
 
     # ---- write ----
     def _handle_write(self, req: Request) -> Response:
@@ -653,6 +671,10 @@ class FilerServer:
         since = int(req.query.get("since_ns", 0))
         prefix = req.query.get("prefix", "/")
         wait = float(req.query.get("wait", 0))
+        # a sync direction excludes events its PEER direction wrote
+        # (reference filer.sync signature exclusion — without it, a
+        # bidirectional pair echoes every write forever)
+        exclude = int(req.query.get("exclude_signature", 0))
         if req.query.get("aggregated") == "true":
             # reference SubscribeMetadata (cluster-wide) vs
             # SubscribeLocalMetadata (this filer only)
@@ -662,9 +684,20 @@ class FilerServer:
                                 status=503)
             if wait > 0:
                 log.log.wait_for_events(since, timeout=min(wait, 30))
-            return Response(
-                {"events": log.log.read_since(since, prefix)})
+            events = log.log.read_since(since, prefix,
+                                        exclude_signature=exclude)
+            cursor = (events[-1]["tsns"] if events
+                      else max(since, log.log.latest_tsns()))
+            return Response({"events": events, "cursor": cursor})
         if wait > 0:
             self.filer.meta_log.wait_for_events(since, timeout=min(wait, 30))
-        events = self.filer.meta_log.read_since(since, prefix)
-        return Response({"events": [e.to_dict() for e in events]})
+        events = self.filer.meta_log.read_since(
+            since, prefix, exclude_signature=exclude)
+        # cursor: where the NEXT poll should resume. With results, the
+        # last returned event (more may wait beyond the limit); with
+        # none, the whole scanned range was excluded/non-matching, so
+        # skip past it instead of re-scanning it every poll
+        cursor = (events[-1].tsns if events
+                  else max(since, self.filer.meta_log.latest_tsns()))
+        return Response({"events": [e.to_dict() for e in events],
+                         "cursor": cursor})

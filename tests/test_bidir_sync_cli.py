@@ -1,0 +1,177 @@
+"""Bidirectional filer.sync with signature-based echo suppression
+(reference command/filer_sync.go signatures) and the round-4 CLI
+subcommands (filer.cat/copy/meta.backup, version)."""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.cli import main as cli_main
+from seaweedfs_tpu.replication.sync import BidirectionalSync
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+@pytest.fixture
+def two_filers(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    a = FilerServer(master.url)
+    b = FilerServer(master.url)
+    a.start()
+    b.start()
+    time.sleep(0.1)
+    yield master, a, b
+    b.stop()
+    a.stop()
+    vs.stop()
+    master.stop()
+
+
+def _wait_for(fn, timeout=10):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_bidirectional_sync_no_echo(two_filers):
+    master, a, b = two_filers
+    sync = BidirectionalSync(a.url, b.url)
+    sync.start()
+    try:
+        # A-side write replicates to B
+        http_call("POST", f"http://{a.url}/docs/from_a.txt", body=b"AAA")
+        assert _wait_for(lambda: http_call(
+            "GET", f"http://{b.url}/docs/from_a.txt")[0] == 200)
+        # B-side write replicates to A — active-active
+        http_call("POST", f"http://{b.url}/docs/from_b.txt", body=b"BBB")
+        assert _wait_for(lambda: http_call(
+            "GET", f"http://{a.url}/docs/from_b.txt")[0] == 200)
+
+        # no echo: the event logs stop growing once both sides settle
+        time.sleep(1.0)
+        counts = (len(a.filer.meta_log.events),
+                  len(b.filer.meta_log.events))
+        time.sleep(1.5)
+        assert (len(a.filer.meta_log.events),
+                len(b.filer.meta_log.events)) == counts, \
+            "event logs still growing: replication is echoing"
+
+        # updates propagate too (and still don't echo)
+        http_call("POST", f"http://{a.url}/docs/from_a.txt", body=b"A2")
+        assert _wait_for(lambda: http_call(
+            "GET", f"http://{b.url}/docs/from_a.txt")[1] == b"A2")
+
+        # deletes propagate
+        http_call("DELETE", f"http://{b.url}/docs/from_a.txt")
+        assert _wait_for(lambda: http_call(
+            "GET", f"http://{a.url}/docs/from_a.txt")[0] == 404)
+    finally:
+        sync.stop()
+
+
+def test_sync_signature_tagging(two_filers):
+    """Writes carrying X-Weed-Sync-Signature surface the tag in the
+    event stream, and exclude_signature filters exactly those."""
+    master, a, b = two_filers
+    http_call("POST", f"http://{a.url}/p/mine.txt", body=b"x")
+    http_call("POST", f"http://{a.url}/p/theirs.txt", body=b"y",
+              headers={"X-Weed-Sync-Signature": "777"})
+    out = http_json("GET",
+                    f"http://{a.url}/__api/meta_events?since_ns=0")
+    sigs = {e["new_entry"]["full_path"]: e.get("signature", 0)
+            for e in out["events"] if e.get("new_entry")}
+    assert sigs["/p/mine.txt"] == 0
+    assert sigs["/p/theirs.txt"] == 777
+    out = http_json(
+        "GET", f"http://{a.url}/__api/meta_events?since_ns=0"
+               f"&exclude_signature=777")
+    paths = [e["new_entry"]["full_path"] for e in out["events"]
+             if e.get("new_entry")]
+    assert "/p/mine.txt" in paths and "/p/theirs.txt" not in paths
+
+
+def test_excluded_burst_does_not_starve_reader(two_filers):
+    """Review finding: >= 1024 consecutive replicated (excluded) events
+    must not hide the native events behind them, and the poll cursor
+    must advance past an all-excluded scan."""
+    master, a, b2 = two_filers
+    for i in range(1100):
+        http_call("POST", f"http://{b2.url}/bulk/g{i:04d}", body=b"y",
+                  headers={"X-Weed-Sync-Signature": "555"})
+    http_call("POST", f"http://{b2.url}/bulk/native.txt", body=b"mine")
+    out = http_json("GET", f"http://{b2.url}/__api/meta_events"
+                           f"?since_ns=0&exclude_signature=555")
+    paths = [(e.get("new_entry") or {}).get("full_path")
+             for e in out["events"]]
+    assert "/bulk/native.txt" in paths, \
+        "native event starved behind the excluded burst"
+    assert not any(p and p.startswith("/bulk/g") for p in paths)
+    # an all-excluded window advances the cursor instead of stalling
+    native_ts = next(e["tsns"] for e in out["events"]
+                     if (e.get("new_entry") or {}).get("full_path")
+                     == "/bulk/native.txt")
+    out2 = http_json("GET", f"http://{b2.url}/__api/meta_events"
+                            f"?since_ns={native_ts}"
+                            f"&exclude_signature=555")
+    assert out2["events"] == []
+    assert out2["cursor"] >= native_ts
+
+
+def test_aggregated_stream_keeps_signature(two_filers):
+    """Review finding: the aggregator must carry the signature through
+    the merge or aggregated-stream exclusion silently no-ops."""
+    master, a, b = two_filers
+    if getattr(a, "meta_aggregator", None) is None:
+        pytest.skip("aggregator not running on this fixture")
+    http_call("POST", f"http://{a.url}/agg/tagged.txt", body=b"t",
+              headers={"X-Weed-Sync-Signature": "909"})
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        evs = a.meta_aggregator.log.read_since(0, "/agg")
+        if evs:
+            break
+        time.sleep(0.05)
+    assert evs and evs[-1].get("signature") == 909
+    assert a.meta_aggregator.log.read_since(
+        0, "/agg", exclude_signature=909) == []
+
+
+def test_cli_version(capsys):
+    cli_main(["version"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["version"] and out["python"]
+
+
+def test_cli_filer_cat_copy_meta_backup(two_filers, tmp_path, capsys):
+    master, a, b = two_filers
+    # filer.copy: local tree -> filer
+    src = tmp_path / "local"
+    (src / "sub").mkdir(parents=True)
+    (src / "one.txt").write_bytes(b"first")
+    (src / "sub" / "two.txt").write_bytes(b"second")
+    cli_main(["filer.copy", "-filer", a.url, str(src / "one.txt"),
+              str(src / "sub"), "/in/"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["copied"] == 2
+
+    # filer.cat prints the copied bytes
+    cli_main(["filer.cat", "-filer", a.url, "/in/one.txt"])
+    assert capsys.readouterr().out.encode().strip() == b"first"
+
+    # filer.meta.backup dumps the event log
+    dump = tmp_path / "meta.jsonl"
+    cli_main(["filer.meta.backup", "-filer", a.url, "-o", str(dump)])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["events"] >= 2
+    lines = [json.loads(l) for l in dump.read_text().splitlines()]
+    assert any((l.get("new_entry") or {}).get("full_path")
+               == "/in/one.txt" for l in lines)
