@@ -219,10 +219,11 @@ def cmd_filer_backup(args):
 def cmd_filer_cat(args):
     """Print a filer file to stdout (reference command/filer_cat.go)."""
     import sys
+    import urllib.parse
 
     from seaweedfs_tpu.utils.httpd import http_call
     status, body, _ = http_call(
-        "GET", f"http://{args.filer}{args.path}")
+        "GET", f"http://{args.filer}{urllib.parse.quote(args.path)}")
     if status >= 400:
         raise SystemExit(f"HTTP {status}")
     sys.stdout.buffer.write(body)

@@ -44,6 +44,7 @@ FUSE_FSYNC = 20
 FUSE_SETXATTR = 21
 FUSE_GETXATTR = 22
 FUSE_LISTXATTR = 23
+FUSE_REMOVEXATTR = 24
 FUSE_FLUSH = 25
 FUSE_INIT = 26
 FUSE_OPENDIR = 27
@@ -247,14 +248,62 @@ class FuseConnection:
             FUSE_SYMLINK: self._op_symlink,
             FUSE_READLINK: self._op_readlink,
             FUSE_LINK: self._op_link,
-            FUSE_GETXATTR: lambda u, n, b: self._reply_err(u, errno.ENODATA),
-            FUSE_LISTXATTR: lambda u, n, b: self._reply_err(u, errno.ENODATA),
-            FUSE_SETXATTR: lambda u, n, b: self._reply_err(u, errno.ENOTSUP),
+            FUSE_GETXATTR: self._op_getxattr,
+            FUSE_LISTXATTR: self._op_listxattr,
+            FUSE_SETXATTR: self._op_setxattr,
+            FUSE_REMOVEXATTR: self._op_removexattr,
         }.get(opcode)
         if handler is None:
             self._reply_err(unique, errno.ENOSYS)
             return
         handler(unique, nodeid, body)
+
+    # ---- xattr ops (reference weedfs_xattr.go: attributes live in
+    # Entry.Extended; get/list answer the size-probe convention) ----
+    def _op_setxattr(self, unique, nodeid, body):
+        # fuse_setxattr_in: size u32, flags u32; then name\0value
+        size, flags = struct.unpack_from("<II", body)
+        rest = body[8:]
+        name, _, tail = rest.partition(b"\x00")
+        value = tail[:size]
+        err = self.ops.setxattr(nodeid, name.decode(), value, flags)
+        if err:
+            self._reply_err(unique, err)
+        else:
+            self._reply(unique)
+
+    def _op_getxattr(self, unique, nodeid, body):
+        out_size, _pad = struct.unpack_from("<II", body)
+        name = body[8:].rstrip(b"\x00").decode()
+        value = self.ops.getxattr(nodeid, name)
+        if value is None:
+            self._reply_err(unique, errno.ENODATA)
+            return
+        if out_size == 0:  # size probe: fuse_getxattr_out
+            self._reply(unique, struct.pack("<II", len(value), 0))
+        elif len(value) > out_size:
+            self._reply_err(unique, errno.ERANGE)
+        else:
+            self._reply(unique, value)
+
+    def _op_listxattr(self, unique, nodeid, body):
+        out_size, _pad = struct.unpack_from("<II", body)
+        names = self.ops.listxattr(nodeid)
+        payload = b"".join(n.encode() + b"\x00" for n in names)
+        if out_size == 0:
+            self._reply(unique, struct.pack("<II", len(payload), 0))
+        elif len(payload) > out_size:
+            self._reply_err(unique, errno.ERANGE)
+        else:
+            self._reply(unique, payload)
+
+    def _op_removexattr(self, unique, nodeid, body):
+        name = body.rstrip(b"\x00").decode()
+        err = self.ops.removexattr(nodeid, name)
+        if err:
+            self._reply_err(unique, err)
+        else:
+            self._reply(unique)
 
     # ---- ops ----
     def _op_lookup(self, unique, nodeid, body):

@@ -358,6 +358,46 @@ class WeedFS:
                 entry = self.filer.find_entry(path)
         return self._entry_attr(entry)
 
+    # ---- xattrs (reference weedfs_xattr.go: Entry.Extended map) ----
+    XATTR_CREATE, XATTR_REPLACE = 1, 2
+
+    def _xattr_entry(self, ino: int) -> Optional[Entry]:
+        path = self.inodes.path(ino)
+        return None if path is None else self._find_entry(path)
+
+    def setxattr(self, ino: int, name: str, value: bytes,
+                 flags: int) -> int:
+        entry = self._xattr_entry(ino)
+        if entry is None:
+            return errno.ENOENT
+        if flags & self.XATTR_CREATE and name in entry.extended:
+            return errno.EEXIST
+        if flags & self.XATTR_REPLACE and name not in entry.extended:
+            return errno.ENODATA
+        entry.extended[name] = value
+        self.filer.update_entry(entry)
+        return 0
+
+    def getxattr(self, ino: int, name: str) -> Optional[bytes]:
+        entry = self._xattr_entry(ino)
+        if entry is None:
+            return None
+        return entry.extended.get(name)
+
+    def listxattr(self, ino: int) -> list[str]:
+        entry = self._xattr_entry(ino)
+        return sorted(entry.extended) if entry is not None else []
+
+    def removexattr(self, ino: int, name: str) -> int:
+        entry = self._xattr_entry(ino)
+        if entry is None:
+            return errno.ENOENT
+        if name not in entry.extended:
+            return errno.ENODATA
+        del entry.extended[name]
+        self.filer.update_entry(entry)
+        return 0
+
     def mkdir(self, parent_ino: int, name: str, mode: int) -> FileAttr:
         path = self._child_path(parent_ino, name)
         self.filer.mkdirs(path)

@@ -684,20 +684,26 @@ class FilerServer:
                                 status=503)
             if wait > 0:
                 log.log.wait_for_events(since, timeout=min(wait, 30))
+            # snapshot BEFORE reading (same ordering as the gRPC
+            # subscribe path): an event appended between read and
+            # snapshot would be jumped by the cursor and lost
+            latest = log.log.latest_tsns()
             events = log.log.read_since(since, prefix,
                                         exclude_signature=exclude)
             cursor = (events[-1]["tsns"] if events
-                      else max(since, log.log.latest_tsns()))
+                      else max(since, latest))
             return Response({"events": events, "cursor": cursor})
         if wait > 0:
             self.filer.meta_log.wait_for_events(since, timeout=min(wait, 30))
-        events = self.filer.meta_log.read_since(
-            since, prefix, exclude_signature=exclude)
         # cursor: where the NEXT poll should resume. With results, the
         # last returned event (more may wait beyond the limit); with
         # none, the whole scanned range was excluded/non-matching, so
-        # skip past it instead of re-scanning it every poll
-        cursor = (events[-1].tsns if events
-                  else max(since, self.filer.meta_log.latest_tsns()))
+        # skip past it instead of re-scanning it every poll. The
+        # latest-snapshot happens BEFORE the read so a concurrent
+        # append can never land inside the skipped range.
+        latest = self.filer.meta_log.latest_tsns()
+        events = self.filer.meta_log.read_since(
+            since, prefix, exclude_signature=exclude)
+        cursor = (events[-1].tsns if events else max(since, latest))
         return Response({"events": [e.to_dict() for e in events],
                          "cursor": cursor})

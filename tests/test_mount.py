@@ -144,6 +144,27 @@ def test_real_kernel_mount(stack, tmp_path):
         sv = os.statvfs(mnt)
         assert sv.f_blocks > 0 and sv.f_bfree > 0
 
+        # extended attributes through the kernel (weedfs_xattr.go)
+        target = str(mnt / "d" / "renamed.txt")
+        os.setxattr(target, "user.color", b"blue")
+        os.setxattr(target, "user.shape", b"\x00binary\xff")
+        assert os.getxattr(target, "user.color") == b"blue"
+        assert os.getxattr(target, "user.shape") == b"\x00binary\xff"
+        assert sorted(os.listxattr(target)) == ["user.color",
+                                                "user.shape"]
+        with pytest.raises(OSError):  # XATTR_CREATE on existing
+            os.setxattr(target, "user.color", b"red",
+                        os.XATTR_CREATE)
+        os.setxattr(target, "user.color", b"red", os.XATTR_REPLACE)
+        assert os.getxattr(target, "user.color") == b"red"
+        os.removexattr(target, "user.shape")
+        assert os.listxattr(target) == ["user.color"]
+        with pytest.raises(OSError):
+            os.getxattr(target, "user.shape")
+        # xattrs persist in the filer entry itself
+        e = fs.filer.find_entry("/d/renamed.txt")
+        assert e.extended == {"user.color": b"red"}
+
         os.remove(mnt / "alias")
         os.remove(mnt / "hard.bin")
         os.remove(mnt / "d" / "renamed.txt")
