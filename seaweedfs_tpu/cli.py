@@ -16,6 +16,9 @@ import time
 def _add_common_volume_args(p):
     p.add_argument("-dir", default="./data", help="data directory (comma-separated)")
     p.add_argument("-max", type=int, default=8, help="max volumes per dir")
+    p.add_argument("-disk", default="",
+                   help="disk type per -dir entry, comma-separated "
+                        "(hdd/ssd; short lists pad with the last value)")
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-port", type=int, default=8080)
     p.add_argument("-mserver", default="127.0.0.1:9333")
@@ -65,6 +68,9 @@ def cmd_volume(args):
                       rack=args.rack, data_center=args.dataCenter,
                       coder=make_coder(args.coder),
                       max_volume_counts=[args.max] * len(dirs),
+                      disk_types=[t.strip() for t in args.disk.split(",")
+                                  if t.strip()] if args.disk.strip()
+                      else None,
                       needle_map_kind=args.index,
                       tcp_port=0 if args.tcp else -1,
                       grpc_port=args.port + 10000 if args.grpc else None,
@@ -91,6 +97,9 @@ def cmd_server(args):
     vs = VolumeServer(dirs, ms.url, host=args.ip, port=args.port,
                       coder=make_coder(args.coder),
                       max_volume_counts=[args.max] * len(dirs),
+                      disk_types=[t.strip() for t in args.disk.split(",")
+                                  if t.strip()] if args.disk.strip()
+                      else None,
                       needle_map_kind=args.index,
                       tcp_port=0 if args.tcp else -1,
                       grpc_port=args.port + 10000 if args.grpc else None,

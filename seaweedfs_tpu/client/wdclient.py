@@ -201,9 +201,10 @@ class MasterClient:
 
     def assign(self, count: int = 1, collection: str = "",
                replication: str = "", ttl: str = "",
-               data_center: str = "") -> dict:
+               data_center: str = "", disk: str = "") -> dict:
         qs = (f"count={count}&collection={collection}"
-              f"&replication={replication}&ttl={ttl}&dataCenter={data_center}")
+              f"&replication={replication}&ttl={ttl}&dataCenter={data_center}"
+              f"&disk={disk}")
         return self._call("POST", f"/dir/assign?{qs}")
 
     def cluster_status(self) -> dict:

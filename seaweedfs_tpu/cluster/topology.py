@@ -18,6 +18,12 @@ from seaweedfs_tpu.storage.erasure_coding import layout as ec_layout
 from seaweedfs_tpu.storage.super_block import ReplicaPlacement, TTL
 
 
+def norm_disk(disk: str) -> str:
+    """'' and 'hdd' are the same default tier (reference types.DiskType:
+    the empty disk type IS hdd)."""
+    return "" if disk in ("", "hdd") else disk
+
+
 class DataNode:
     def __init__(self, ip: str, port: int, public_url: str = "",
                  max_volume_count: int = 8):
@@ -25,6 +31,9 @@ class DataNode:
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
         self.max_volume_count = max_volume_count
+        # slots per disk type (reference DiskInfo map); default: all
+        # slots on the hdd tier
+        self.disk_slots: dict[str, int] = {"": max_volume_count}
         self.volumes: dict[int, dict] = {}
         self.ec_shards: dict[int, int] = {}  # vid -> shard bits
         self.rack: Optional["Rack"] = None
@@ -41,12 +50,21 @@ class DataNode:
     def ec_shard_count(self) -> int:
         return sum(bin(bits).count("1") for bits in self.ec_shards.values())
 
-    def free_space(self) -> float:
+    def free_space(self, disk: Optional[str] = None) -> float:
         """Free volume slots; EC shards consume fractional slots
-        (reference counts 1 slot per TotalShardsCount shards)."""
-        used = len(self.volumes) + \
-            self.ec_shard_count() / ec_layout.TOTAL_SHARDS_COUNT
-        return self.max_volume_count - used
+        (reference counts 1 slot per TotalShardsCount shards).
+        disk=None: all tiers; otherwise that tier only (EC shards
+        count against the default tier)."""
+        if disk is None:
+            used = len(self.volumes) + \
+                self.ec_shard_count() / ec_layout.TOTAL_SHARDS_COUNT
+            return self.max_volume_count - used
+        d = norm_disk(disk)
+        used = sum(1 for v in self.volumes.values()
+                   if norm_disk(v.get("disk_type", "")) == d)
+        if d == "":
+            used += self.ec_shard_count() / ec_layout.TOTAL_SHARDS_COUNT
+        return self.disk_slots.get(d, 0) - used
 
     def to_info(self) -> dict:
         return {
@@ -54,6 +72,7 @@ class DataNode:
             "public_url": self.public_url,
             "grpc_port": getattr(self, "grpc_port", 0),
             "max_volume_count": self.max_volume_count,
+            "disk_slots": dict(self.disk_slots),
             "volumes": list(self.volumes.values()),
             "ec_shards": [
                 {"id": vid, "ec_index_bits": bits}
@@ -80,8 +99,8 @@ class Rack:
             self.nodes[key] = n
         return n
 
-    def free_space(self) -> float:
-        return sum(n.free_space() for n in self.nodes.values())
+    def free_space(self, disk: Optional[str] = None) -> float:
+        return sum(n.free_space(disk) for n in self.nodes.values())
 
 
 class DataCenter:
@@ -97,8 +116,8 @@ class DataCenter:
             self.racks[rack_id] = r
         return r
 
-    def free_space(self) -> float:
-        return sum(r.free_space() for r in self.racks.values())
+    def free_space(self, disk: Optional[str] = None) -> float:
+        return sum(r.free_space(disk) for r in self.racks.values())
 
 
 class VolumeLayout:
@@ -204,8 +223,9 @@ class Topology:
         return None
 
     # ---- layouts ----
-    def get_layout(self, collection: str, rp: str, ttl: str) -> VolumeLayout:
-        key = (collection, rp, ttl)
+    def get_layout(self, collection: str, rp: str, ttl: str,
+                   disk: str = "") -> VolumeLayout:
+        key = (collection, rp, ttl, norm_disk(disk))
         lo = self.layouts.get(key)
         if lo is None:
             lo = VolumeLayout(ReplicaPlacement.parse(rp), TTL.parse(ttl),
@@ -228,6 +248,12 @@ class Topology:
                 hb.get("max_volume_count", 8))
             node.last_seen = time.time()
             node.grpc_port = hb.get("grpc_port", 0)
+            node.max_volume_count = hb.get("max_volume_count",
+                                           node.max_volume_count)
+            node.disk_slots = {
+                norm_disk(d): c
+                for d, c in (hb.get("disk_slots")
+                             or {"": node.max_volume_count}).items()}
             prev_vids = set(node.volumes)
             prev_ec_vids = set(node.ec_shards)
 
@@ -267,15 +293,19 @@ class Topology:
             node.last_seen = time.time()
             new_vids, deleted_vids = set(), set()
             new_ec_vids, deleted_ec_vids = set(), set()
+            # deletes BEFORE adds: a disk-tier move reports the same
+            # vid in both lists (old tier deleted, new tier added) and
+            # must net out to "present on the new tier", not "gone"
+            for v in deltas.get("deleted_volumes", []):
+                node.volumes.pop(v["id"], None)
+                self._unregister_volume(v, node)
+                deleted_vids.add(v["id"])
             for v in deltas.get("new_volumes", []):
                 node.volumes[v["id"]] = v
                 self._register_volume(v, node)
                 self.max_volume_id = max(self.max_volume_id, v["id"])
                 new_vids.add(v["id"])
-            for v in deltas.get("deleted_volumes", []):
-                node.volumes.pop(v["id"], None)
-                self._unregister_volume(v, node)
-                deleted_vids.add(v["id"])
+                deleted_vids.discard(v["id"])
             for e in deltas.get("new_ec_shards", []):
                 vid, bits = e["id"], e["ec_index_bits"]
                 old = node.ec_shards.get(vid, 0)
@@ -316,14 +346,16 @@ class Topology:
         rp = ReplicaPlacement.from_byte(v.get("replica_placement", 0))
         ttl = TTL.from_bytes(
             v.get("ttl", 0).to_bytes(2, "big")) if v.get("ttl") else TTL()
-        lo = self.get_layout(v.get("collection", ""), str(rp), str(ttl))
+        lo = self.get_layout(v.get("collection", ""), str(rp), str(ttl),
+                             v.get("disk_type", ""))
         lo.register_volume(v, node)
 
     def _unregister_volume(self, v: dict, node: DataNode) -> None:
         rp = ReplicaPlacement.from_byte(v.get("replica_placement", 0))
         ttl = TTL.from_bytes(
             v.get("ttl", 0).to_bytes(2, "big")) if v.get("ttl") else TTL()
-        lo = self.get_layout(v.get("collection", ""), str(rp), str(ttl))
+        lo = self.get_layout(v.get("collection", ""), str(rp), str(ttl),
+                             v.get("disk_type", ""))
         lo.unregister_volume(v["id"], node)
 
     # ---- EC registry ----
@@ -348,7 +380,7 @@ class Topology:
 
     # ---- lookup ----
     def lookup(self, collection: str, vid: int) -> list[DataNode]:
-        for (col, _, _), lo in self.layouts.items():
+        for (col, _, _, _), lo in self.layouts.items():
             if collection and col != collection:
                 continue
             locs = lo.locations.get(vid)

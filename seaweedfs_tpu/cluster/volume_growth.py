@@ -35,38 +35,46 @@ def _weighted_pick(candidates, weight_fn):
 
 
 def find_empty_slots(topo: Topology, rp: ReplicaPlacement,
-                     preferred_dc: str = "") -> list[DataNode]:
-    """Choose rp.copy_count nodes for one volume's replicas."""
-    dcs = [dc for dc in topo.data_centers.values() if dc.free_space() >= 1]
+                     preferred_dc: str = "",
+                     disk: str = "") -> list[DataNode]:
+    """Choose rp.copy_count nodes for one volume's replicas. Every
+    free-space check is tier-scoped: the empty disk type IS the hdd
+    tier (reference types.DiskType), so untyped growth never lands on
+    a node that only has ssd slots."""
+    def fs(obj) -> float:
+        return obj.free_space(disk or "")
+
+    dcs = [dc for dc in topo.data_centers.values() if fs(dc) >= 1]
     if preferred_dc:
         dcs = [dc for dc in dcs if dc.id == preferred_dc]
     # main DC must fit 1 + same_rack + diff_rack copies; need diff_dc_count
     # other DCs with >= 1 slot
     main_needed = 1 + rp.same_rack_count + rp.diff_rack_count
-    viable = [dc for dc in dcs if dc.free_space() >= main_needed]
+    viable = [dc for dc in dcs if fs(dc) >= main_needed]
     if not viable or len(topo.data_centers) < rp.diff_dc_count + 1:
         raise NoFreeSpaceError(
-            f"not enough data centers for placement {rp}")
-    main_dc = _weighted_pick(viable, lambda dc: dc.free_space())
+            f"not enough data centers for placement {rp}"
+            + (f" on disk type {disk!r}" if disk else ""))
+    main_dc = _weighted_pick(viable, fs)
 
     # main rack must fit 1 + same_rack copies; need diff_rack_count other
     # racks in main DC
     racks = [r for r in main_dc.racks.values()
-             if r.free_space() >= 1 + rp.same_rack_count
-             and len([n for n in r.nodes.values() if n.free_space() >= 1])
+             if fs(r) >= 1 + rp.same_rack_count
+             and len([n for n in r.nodes.values() if fs(n) >= 1])
              >= 1 + rp.same_rack_count]
     racks = [r for r in racks
              if len([x for x in main_dc.racks.values()
-                     if x is not r and x.free_space() >= 1])
+                     if x is not r and fs(x) >= 1])
              >= rp.diff_rack_count]
     if not racks:
         raise NoFreeSpaceError(f"not enough racks in {main_dc.id} for {rp}")
-    main_rack = _weighted_pick(racks, lambda r: r.free_space())
+    main_rack = _weighted_pick(racks, fs)
 
-    nodes = [n for n in main_rack.nodes.values() if n.free_space() >= 1]
+    nodes = [n for n in main_rack.nodes.values() if fs(n) >= 1]
     if len(nodes) < 1 + rp.same_rack_count:
         raise NoFreeSpaceError(f"not enough servers in rack {main_rack.id}")
-    main_node = _weighted_pick(nodes, lambda n: n.free_space())
+    main_node = _weighted_pick(nodes, fs)
 
     chosen = [main_node]
     # z: other servers in the same rack
@@ -77,42 +85,44 @@ def find_empty_slots(topo: Topology, rp: ReplicaPlacement,
         raise NoFreeSpaceError("not enough same-rack servers")
     # y: other racks in main DC
     other_racks = [r for r in main_dc.racks.values()
-                   if r is not main_rack and r.free_space() >= 1]
+                   if r is not main_rack and fs(r) >= 1]
     random.shuffle(other_racks)
     for r in other_racks[:rp.diff_rack_count]:
-        rnodes = [n for n in r.nodes.values() if n.free_space() >= 1]
-        chosen.append(_weighted_pick(rnodes, lambda n: n.free_space()))
+        rnodes = [n for n in r.nodes.values() if fs(n) >= 1]
+        chosen.append(_weighted_pick(rnodes, fs))
     if len(chosen) < 1 + rp.same_rack_count + rp.diff_rack_count:
         raise NoFreeSpaceError("not enough diff-rack servers")
     # x: other data centers
     other_dcs = [dc for dc in topo.data_centers.values()
-                 if dc is not main_dc and dc.free_space() >= 1]
+                 if dc is not main_dc and fs(dc) >= 1]
     random.shuffle(other_dcs)
     for dc in other_dcs[:rp.diff_dc_count]:
         all_nodes = [n for r in dc.racks.values()
-                     for n in r.nodes.values() if n.free_space() >= 1]
-        chosen.append(_weighted_pick(all_nodes, lambda n: n.free_space()))
+                     for n in r.nodes.values() if fs(n) >= 1]
+        chosen.append(_weighted_pick(all_nodes, fs))
     if len(chosen) != rp.copy_count:
         raise NoFreeSpaceError(
             f"found {len(chosen)} slots, need {rp.copy_count}")
     return chosen
 
 
-AllocateFn = Callable[[DataNode, int, str, str, str], bool]
+# (node, vid, collection, rp, ttl, disk) -> success
+AllocateFn = Callable[[DataNode, int, str, str, str, str], bool]
 
 
 def grow_by_type(topo: Topology, collection: str, rp_str: str, ttl: str,
                  allocate: AllocateFn, count: int = 1,
-                 preferred_dc: str = "") -> list[int]:
-    """Grow `count` volumes; `allocate(node, vid, collection, rp, ttl)` is
-    the AllocateVolume RPC (reference volume_growth.go AutomaticGrowByType).
-    Returns the new volume ids."""
+                 preferred_dc: str = "", disk: str = "") -> list[int]:
+    """Grow `count` volumes; `allocate(node, vid, collection, rp, ttl,
+    disk)` is the AllocateVolume RPC (reference volume_growth.go
+    AutomaticGrowByType). Returns the new volume ids."""
     rp = ReplicaPlacement.parse(rp_str)
     grown = []
     for _ in range(count):
-        nodes = find_empty_slots(topo, rp, preferred_dc)
+        nodes = find_empty_slots(topo, rp, preferred_dc, disk)
         vid = topo.next_volume_id()
-        ok = all(allocate(n, vid, collection, rp_str, ttl) for n in nodes)
+        ok = all(allocate(n, vid, collection, rp_str, ttl, disk)
+                 for n in nodes)
         if ok:
             grown.append(vid)
     return grown

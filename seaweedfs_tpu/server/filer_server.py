@@ -259,7 +259,8 @@ class FilerServer:
             entry.content = data
         else:
             entry.chunks = self._upload_chunks(data, collection, replication,
-                                               ttl)
+                                               ttl,
+                                               disk_type=rule.disk_type)
         try:
             self.filer.create_entry(entry)
         except IsADirectoryError:
@@ -267,24 +268,28 @@ class FilerServer:
         return Response({"name": entry.name, "size": len(data)}, status=201)
 
     def _upload_chunks(self, data: bytes, collection: str,
-                       replication: str, ttl: str = "") -> list[FileChunk]:
+                       replication: str, ttl: str = "",
+                       disk_type: str = "") -> list[FileChunk]:
         """Split into CHUNK_SIZE pieces, assign + upload each
         (reference filer_server_handlers_write_upload.go:32-140). Wide
-        chunk lists collapse into manifest chunks (filechunk_manifest.go)."""
+        chunk lists collapse into manifest chunks (filechunk_manifest.go).
+        disk_type routes the assigns to that storage tier (per-path
+        filer.conf rule, reference -disk)."""
         chunks = []
         for off in range(0, len(data), CHUNK_SIZE):
             piece = data[off:off + CHUNK_SIZE]
             chunks.append(self._save_chunk(piece, off, collection,
-                                           replication, ttl))
+                                           replication, ttl, disk_type))
         return maybe_manifestize(
             lambda blob: self._save_chunk(blob, 0, collection,
-                                          replication, ttl),
+                                          replication, ttl, disk_type),
             chunks)
 
     def _save_chunk(self, piece: bytes, offset: int, collection: str,
-                    replication: str, ttl: str = "") -> FileChunk:
+                    replication: str, ttl: str = "",
+                    disk_type: str = "") -> FileChunk:
         a = self.mc.assign(collection=collection, replication=replication,
-                           ttl=ttl)
+                           ttl=ttl, disk=disk_type)
         if a.get("error"):
             raise HttpError(500, a["error"].encode())
         key = b""

@@ -341,7 +341,7 @@ class MasterServer:
         # only collections that still HOLD volumes: stale delta
         # processing can re-create an empty layout key after a
         # collection delete (get_layout is get-or-create)
-        cols = sorted({c for (c, _, _), lo in self.topo.layouts.items()
+        cols = sorted({c for (c, _, _, _), lo in self.topo.layouts.items()
                        if c and lo.locations})
         return Response({"collections": [{"name": c} for c in cols]})
 
@@ -417,7 +417,7 @@ class MasterServer:
 
     def assign_fid(self, count: int = 1, collection: str = "",
                    replication: str = "", ttl: str = "",
-                   data_center: str = "") -> dict:
+                   data_center: str = "", disk_type: str = "") -> dict:
         """Core assignment: pick/grow a writable volume, mint a fid.
         Returns the reply dict or {"error": ...} (used by both the HTTP
         and gRPC planes)."""
@@ -435,13 +435,14 @@ class MasterServer:
                 self.sequencer.set_max(self._seq_ckpt)
                 self._seq_synced_term = term
         replication = replication or self.default_replication
-        layout = self.topo.get_layout(collection, replication, ttl)
+        layout = self.topo.get_layout(collection, replication, ttl,
+                                      disk_type)
         with self._grow_lock:
             if layout.active_volume_count() == 0:
                 try:
                     grow_by_type(self.topo, collection, replication, ttl,
                                  self._allocate_rpc, count=1,
-                                 preferred_dc=data_center)
+                                 preferred_dc=data_center, disk=disk_type)
                 except NoFreeSpaceError as e:
                     return {"error": str(e)}
                 # replicate the new MaxVolumeId so a failed-over leader
@@ -492,19 +493,22 @@ class MasterServer:
             collection=req.query.get("collection", ""),
             replication=req.query.get("replication", ""),
             ttl=req.query.get("ttl", ""),
-            data_center=req.query.get("dataCenter", ""))
+            data_center=req.query.get("dataCenter", ""),
+            disk_type=req.query.get("disk", ""))
         if "error" in reply:
             return Response(reply, status=500)
         return Response(reply)
 
-    def _allocate_rpc(self, node, vid, collection, rp, ttl) -> bool:
+    def _allocate_rpc(self, node, vid, collection, rp, ttl,
+                      disk: str = "") -> bool:
         from seaweedfs_tpu.storage.super_block import (ReplicaPlacement,
                                                        TTL)
         try:
             http_json("POST",
                       f"http://{node.url}/admin/allocate_volume",
                       {"volume_id": vid, "collection": collection,
-                       "replication": rp, "ttl": ttl})
+                       "replication": rp, "ttl": ttl,
+                       "disk_type": disk})
         except Exception:
             return False
         # register immediately (like the reference's RegisterVolumeLayout
@@ -512,7 +516,7 @@ class MasterServer:
         vinfo = {"id": vid, "size": 0, "collection": collection,
                  "replica_placement": ReplicaPlacement.parse(rp).to_byte(),
                  "read_only": False, "file_count": 0, "delete_count": 0,
-                 "deleted_byte_count": 0,
+                 "deleted_byte_count": 0, "disk_type": disk or "hdd",
                  "ttl": TTL.parse(ttl).to_uint32(), "version": 3}
         with self.topo.lock:
             node.volumes[vid] = vinfo
@@ -571,7 +575,8 @@ class MasterServer:
         ttl = req.query.get("ttl", "")
         try:
             vids = grow_by_type(self.topo, collection, replication, ttl,
-                                self._allocate_rpc, count=count)
+                                self._allocate_rpc, count=count,
+                                disk=req.query.get("disk", ""))
         except NoFreeSpaceError as e:
             return Response({"error": str(e)}, status=500)
         if not self._raft_propose({"type": "max_volume_id",

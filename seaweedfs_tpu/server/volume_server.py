@@ -48,7 +48,8 @@ class VolumeServer:
                  concurrent_upload_limit_mb: int = 256,
                  concurrent_download_limit_mb: int = 256,
                  file_size_limit_mb: int = 256,
-                 inflight_timeout: float = 30.0):
+                 inflight_timeout: float = 30.0,
+                 disk_types: Optional[list[str]] = None):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
         the volume_server_pb gRPC admin plane (0 = ephemeral).
@@ -66,6 +67,7 @@ class VolumeServer:
         self.http = HttpServer(host, port)
         self._store_dirs = directories
         self._max_volume_counts = max_volume_counts
+        self._disk_types = disk_types
         self._rack = rack
         self._dc = data_center
         self._coder = coder
@@ -111,7 +113,8 @@ class VolumeServer:
             ip=self.http.host, port=self.http.port,
             public_url=self._public_url or f"{self.http.host}:{self.http.port}",
             rack=self._rack, data_center=self._dc, coder=self._coder,
-            needle_map_kind=self._needle_map_kind)
+            needle_map_kind=self._needle_map_kind,
+            disk_types=self._disk_types)
         self.store.load_existing_volumes()
         self.store.remote_shard_reader = self._remote_shard_reader
         if self._tcp_port >= 0:
@@ -266,6 +269,8 @@ class VolumeServer:
         r("POST", "/admin/vacuum", self._admin_vacuum)
         r("POST", "/admin/sync", self._admin_sync)
         r("POST", "/admin/copy_volume", self._admin_copy_volume)
+        r("POST", "/admin/move_volume_disk",
+          self._admin_move_volume_disk)
         r("GET", "/admin/volume_file", self._admin_volume_file)
         r("POST", "/admin/tier_upload", self._admin_tier_upload)
         r("POST", "/admin/tier_download", self._admin_tier_download)
@@ -569,8 +574,13 @@ class VolumeServer:
     # ---- admin ----
     def _admin_allocate_volume(self, req: Request) -> Response:
         b = req.json()
-        self.store.add_volume(b["volume_id"], b.get("collection", ""),
-                              b.get("replication", "000"), b.get("ttl", ""))
+        try:
+            self.store.add_volume(b["volume_id"], b.get("collection", ""),
+                                  b.get("replication", "000"),
+                                  b.get("ttl", ""),
+                                  disk_type=b.get("disk_type", ""))
+        except ValueError as e:
+            return Response({"error": str(e)}, status=400)
         return Response({})
 
     def _admin_delete_volume(self, req: Request) -> Response:
@@ -690,6 +700,21 @@ class VolumeServer:
             v.sync()
         return Response({})
 
+    def _admin_move_volume_disk(self, req: Request) -> Response:
+        """Intra-node tier move: relocate a volume's files to a
+        location of another disk type (volume.tier.move on one
+        server)."""
+        b = req.json()
+        try:
+            ok = self.store.move_volume_disk(b["volume_id"],
+                                             b.get("disk_type", ""))
+        except ValueError as e:
+            return Response({"error": str(e)}, status=400)
+        if not ok:
+            return Response({"error": "volume not found"}, status=404)
+        self._push_deltas()
+        return Response({"moved": b["volume_id"]})
+
     def _admin_copy_volume(self, req: Request) -> Response:
         """Pull a volume's .dat/.idx from a peer and load it
         (reference volume_grpc_copy.go VolumeCopy)."""
@@ -700,7 +725,16 @@ class VolumeServer:
         if self.store.find_volume(vid) is not None:
             return Response({"error": f"volume {vid} already exists"},
                             status=409)
-        loc = min(self.store.locations, key=lambda l: l.volumes_len())
+        # "" IS the hdd tier, same strictness as add_volume: an
+        # untyped copy (balance/evacuate/fix.replication) must not
+        # silently flip an hdd volume onto an ssd dir
+        want = b.get("disk_type", "") or "hdd"
+        candidates = [l for l in self.store.locations
+                      if l.disk_type == want]
+        if not candidates:
+            return Response(
+                {"error": f"no {want!r} disk on this server"}, status=400)
+        loc = min(candidates, key=lambda l: l.volumes_len())
         name = f"{collection}_{vid}" if collection else str(vid)
         base = os.path.join(loc.directory, name)
         for ext in (".dat", ".idx"):

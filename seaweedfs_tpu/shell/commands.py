@@ -121,13 +121,16 @@ class ShellContext:
             for target in candidates[:need]:
                 fixes.append({"vid": vid, "source": owners[0],
                               "target": target["id"],
-                              "collection": vinfos[vid].get("collection", "")})
+                              "collection": vinfos[vid].get("collection", ""),
+                              "disk_type": vinfos[vid].get("disk_type",
+                                                           "")})
         if apply:
             for fix in fixes:
                 self._vs(fix["target"], "/admin/copy_volume",
                          {"volume_id": fix["vid"],
                           "collection": fix["collection"],
-                          "source_data_node": fix["source"]})
+                          "source_data_node": fix["source"],
+                          "disk_type": fix["disk_type"]})
         return fixes
 
     def volume_vacuum(self, garbage_threshold: float = 0.3) -> list[int]:
@@ -234,12 +237,13 @@ class ShellContext:
                 for node in replicas[vid]}
 
     def volume_move(self, vid: int, source: str, target: str,
-                    collection: str = "") -> None:
+                    collection: str = "", disk_type: str = "") -> None:
         """Move a volume: copy to target then delete on source
-        (reference shell `volume.move`)."""
+        (reference shell `volume.move`); disk_type lands the copy on
+        that tier of the target."""
         self._vs(target, "/admin/copy_volume",
                  {"volume_id": vid, "collection": collection,
-                  "source_data_node": source})
+                  "source_data_node": source, "disk_type": disk_type})
         self._vs(source, "/admin/delete_volume", {"volume_id": vid})
 
     def volume_copy(self, vid: int, source: str, target: str,
@@ -320,18 +324,23 @@ class ShellContext:
                          {"volume_id": d["vid"]})
         return doomed
 
-    def volume_tier_move(self, to_node: str, full_percent: float = 95.0,
+    def volume_tier_move(self, to_node: str = "", to_disk: str = "",
+                         full_percent: float = 95.0,
                          quiet_for: float = 0.0, collection: str = "",
                          apply: bool = True) -> list[dict]:
-        """Move full + quiet volumes to a cold-tier node (reference
-        command_volume_tier_move.go migrates across disk TYPES; this
-        topology has no per-disk typing, so the destination tier is
-        addressed as a node). A volume qualifies when its content is
-        >= full_percent of the volume size limit and its .dat has been
-        untouched for quiet_for seconds."""
+        """Move full + quiet volumes to a cold tier (reference
+        command_volume_tier_move.go): the destination is a disk TYPE
+        (-toDiskType ssd/hdd — any node with free slots of that type
+        qualifies), a node (-toNode), or both. A volume qualifies when
+        its content is >= full_percent of the volume size limit, its
+        .dat has been untouched for quiet_for seconds, and (for a disk
+        destination) it is not already on that tier."""
         import time as _time
 
+        from seaweedfs_tpu.cluster.topology import norm_disk
         from seaweedfs_tpu.utils.httpd import http_json
+        if not to_node and not to_disk:
+            raise ValueError("need -toNode and/or -toDiskType")
         status = http_json("GET",
                            f"http://{self.master_url}/dir/status")
         topo = status["Topology"]
@@ -339,18 +348,53 @@ class ShellContext:
         threshold = limit * full_percent / 100.0
         now = _time.time()
         moved = []
-        all_nodes = []
-        vids_on_target: set = set()
+        all_nodes = {}
         for dc in topo.get("data_centers", []):
             for rack in dc.get("racks", []):
                 for node in rack.get("nodes", []):
-                    all_nodes.append(node["id"])
-                    if node["id"] == to_node:
-                        vids_on_target = {v["id"] for v in
-                                          node.get("volumes", [])}
-        if to_node not in all_nodes:
+                    all_nodes[node["id"]] = node
+        if to_node and to_node not in all_nodes:
             raise ValueError(f"unknown volume server {to_node!r} "
-                             f"(known: {all_nodes})")
+                             f"(known: {sorted(all_nodes)})")
+
+        holders: dict[int, set] = {}
+        for node in all_nodes.values():
+            for v in node.get("volumes", []):
+                holders.setdefault(v["id"], set()).add(node["id"])
+        planned_onto: dict[str, int] = {}
+
+        def free_of(node: dict, disk: str) -> float:
+            # topology serializes tiers NORMALIZED ('' is the hdd tier)
+            slots = node.get("disk_slots") or {
+                "": node.get("max_volume_count", 0)}
+            d = norm_disk(disk)
+            used = sum(1 for v in node.get("volumes", [])
+                       if norm_disk(v.get("disk_type", "")) == d)
+            return (slots.get(d, 0) - used
+                    - planned_onto.get((node["id"], d), 0))
+
+        def pick_target(source: str, vid: int) -> str:
+            if to_node:
+                return to_node if (not to_disk or
+                                   free_of(all_nodes[to_node],
+                                           to_disk) >= 1) else ""
+            # disk-type mode: the SOURCE node's own tier counts too —
+            # an hdd->ssd move on one server is an intra-node relocate.
+            # Nodes already holding a replica of this vid (other than
+            # the source itself) can't receive a copy.
+            best, best_free = "", 0.0
+            for nid, node in all_nodes.items():
+                if nid != source and nid in holders.get(vid, ()):
+                    continue
+                f = free_of(node, to_disk)
+                if f > best_free:
+                    best, best_free = nid, f
+            return best
+
+        vids_on_target: set = set()
+        if to_node:
+            vids_on_target = {v["id"] for v in
+                              all_nodes[to_node].get("volumes", [])}
         planned_vids: set = set()
         for dc in topo.get("data_centers", []):
             for rack in dc.get("racks", []):
@@ -361,6 +405,10 @@ class ShellContext:
                         if collection and \
                                 v.get("collection", "") != collection:
                             continue
+                        if to_disk and norm_disk(
+                                v.get("disk_type", "")) \
+                                == norm_disk(to_disk):
+                            continue  # already on the target tier
                         if v.get("size", 0) < threshold:
                             continue
                         # one replica per volume moves; a second move
@@ -381,17 +429,34 @@ class ShellContext:
                                 "dat_file_timestamp_seconds", now)
                             if age < quiet_for:
                                 continue
+                        target = pick_target(node["id"], v["id"])
+                        if not target:
+                            continue  # no tier capacity anywhere
                         planned_vids.add(v["id"])
+                        key = (target, norm_disk(to_disk))
+                        planned_onto[key] = planned_onto.get(key, 0) + 1
                         moved.append({"vid": v["id"],
                                       "from": node["id"],
-                                      "to": to_node,
+                                      "to": target,
+                                      "to_disk": to_disk,
                                       "collection": v.get(
                                           "collection", ""),
                                       "size": v.get("size", 0)})
         if apply:
             for m in moved:
-                self.volume_move(m["vid"], m["from"], to_node,
-                                 m["collection"])
+                try:
+                    if m["to"] == m["from"]:
+                        # same server, different tier: relocate in place
+                        self._vs(m["from"], "/admin/move_volume_disk",
+                                 {"volume_id": m["vid"],
+                                  "disk_type": to_disk})
+                    else:
+                        self.volume_move(m["vid"], m["from"], m["to"],
+                                         m["collection"],
+                                         disk_type=to_disk)
+                except (ConnectionError, HttpError) as e:
+                    # one failed move must not abandon the rest
+                    m["error"] = str(e)
         return moved
 
     def volume_server_evacuate(self, node: str,
@@ -428,7 +493,8 @@ class ShellContext:
             tgt = ok[0]
             moves.append({"vid": v["id"], "source": node,
                           "target": tgt["id"],
-                          "collection": v.get("collection", "")})
+                          "collection": v.get("collection", ""),
+                          "disk_type": v.get("disk_type", "")})
             tgt.setdefault("volumes", []).append(v)
             targets.sort(key=lambda n: len(n.get("volumes", [])))
         if apply:
@@ -436,7 +502,8 @@ class ShellContext:
                 if mv.get("target"):
                     self.volume_move(mv["vid"], mv["source"],
                                      mv["target"],
-                                     mv.get("collection", ""))
+                                     mv.get("collection", ""),
+                                     disk_type=mv.get("disk_type", ""))
         return moves
 
     def volume_tail(self, vid: int, since_ns: int = 0,
@@ -527,13 +594,15 @@ class ShellContext:
                 v = vols.pop()
                 moves.append({"vid": v["id"], "source": donor["id"],
                               "target": target["id"],
-                              "collection": v.get("collection", "")})
+                              "collection": v.get("collection", ""),
+                              "disk_type": v.get("disk_type", "")})
                 target.setdefault("volumes", []).append(v)
                 receivers.sort(key=lambda n: len(n.get("volumes", [])))
         if apply:
             for mv in moves:
                 self.volume_move(mv["vid"], mv["source"], mv["target"],
-                                 mv["collection"])
+                                 mv["collection"],
+                                 disk_type=mv.get("disk_type", ""))
         return moves
 
     # ---- ec.encode (reference command_ec_encode.go doEcEncode) ----

@@ -38,7 +38,7 @@ HELP = """commands:
   volume.tail -volumeId N [-since NS]   stream appended needles
   volume.tier.upload -volumeId N -endpoint URL -bucket B [-keepLocal]
   volume.tier.download -volumeId N
-  volume.tier.move -toNode HOST [-fullPercent P] [-quietFor S] [-n]
+  volume.tier.move [-toDiskType ssd] [-toNode HOST] [-fullPercent P] [-quietFor S] [-n]
   volume.vacuum [threshold]         compact garbage-heavy volumes
   cluster.ps                        list every cluster process
   cluster.raft.ps / cluster.raft.add -peer URL / cluster.raft.remove -peer URL
@@ -377,11 +377,12 @@ def run_command(sh: ShellContext, line: str):
                 url = sh.master_url  # re-resolve from scratch
                 _time.sleep(0.3)
     if cmd == "volume.tier.move":
-        # move full+quiet volumes to a destination ("cold tier") node
-        # (reference command_volume_tier_move.go moves across disk
-        # types; this topology addresses tiers by node instead)
+        # move full+quiet volumes to a cold tier: a disk type
+        # (-toDiskType ssd), a node (-toNode), or both (reference
+        # command_volume_tier_move.go)
         return sh.volume_tier_move(
-            flags["toNode"],
+            to_node=flags.get("toNode", ""),
+            to_disk=flags.get("toDiskType", ""),
             full_percent=float(flags.get("fullPercent", 95)),
             quiet_for=float(flags.get("quietFor", 0)),
             collection=flags.get("collection", ""),

@@ -32,15 +32,21 @@ class Store:
                  ip: str = "localhost", port: int = 8080,
                  public_url: str = "", rack: str = "", data_center: str = "",
                  coder: Optional[ErasureCoder] = None,
-                 needle_map_kind: str = "memory"):
+                 needle_map_kind: str = "memory",
+                 disk_types: Optional[list[str]] = None):
         self.ip = ip
         self.needle_map_kind = needle_map_kind
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
         self.rack = rack
         self.data_center = data_center
+        # per-dir disk type (reference -disk flag, one entry per -dir;
+        # short lists pad with the last value, default hdd)
+        types = list(disk_types or ["hdd"])
+        types += [types[-1]] * (len(directories) - len(types))
         self.locations = [
             DiskLocation(d, (max_volume_counts or [8] * len(directories))[i],
+                         disk_type=types[i] or "hdd",
                          needle_map_kind=needle_map_kind)
             for i, d in enumerate(directories)]
         self.coder = coder or make_coder("cpu")
@@ -58,11 +64,21 @@ class Store:
 
     # ---- normal volumes ----
     def add_volume(self, vid: int, collection: str = "",
-                   replica_placement: str = "000", ttl: str = "") -> Volume:
+                   replica_placement: str = "000", ttl: str = "",
+                   disk_type: str = "") -> Volume:
         with self._lock:
             if self.find_volume(vid) is not None:
                 raise ValueError(f"volume {vid} already exists")
-            loc = min(self.locations, key=lambda l: l.volumes_len())
+            # "" IS the hdd tier (reference types.DiskType): an untyped
+            # allocation must not consume an ssd slot
+            want = disk_type or "hdd"
+            candidates = [l for l in self.locations
+                          if l.disk_type == want]
+            if not candidates:
+                raise ValueError(
+                    f"no {want!r} disk on this server (have "
+                    f"{sorted({l.disk_type for l in self.locations})})")
+            loc = min(candidates, key=lambda l: l.volumes_len())
             vol = Volume(loc.directory, collection, vid,
                          ReplicaPlacement.parse(replica_placement),
                          TTL.parse(ttl),
@@ -129,6 +145,46 @@ class Store:
                     self.new_volumes.append(self.volume_info(vol))
                     return True
             return False
+
+    def move_volume_disk(self, vid: int, disk_type: str) -> bool:
+        """Move a volume's files to a location of another disk type on
+        THIS server (intra-node half of volume.tier.move; the
+        cross-node half is copy+delete). No-op when already there."""
+        want = disk_type or "hdd"
+        with self._lock:
+            src_loc = None
+            for loc in self.locations:
+                if vid in loc.volumes:
+                    src_loc = loc
+                    break
+            if src_loc is None:
+                return False
+            if src_loc.disk_type == want:
+                return True
+            candidates = [l for l in self.locations
+                          if l.disk_type == want]
+            if not candidates:
+                raise ValueError(f"no {want!r} disk on this server")
+            dst_loc = min(candidates, key=lambda l: l.volumes_len())
+            v = src_loc.volumes[vid]
+            old_info = self.volume_info(v)
+            collection = v.collection
+            v.close()
+            with src_loc._lock:
+                src_loc.volumes.pop(vid, None)
+            name = (f"{collection}_{vid}" if collection else str(vid))
+            for fname in sorted(os.listdir(src_loc.directory)):
+                base, dot, _ext = fname.partition(".")
+                if dot and base == name:
+                    os.rename(os.path.join(src_loc.directory, fname),
+                              os.path.join(dst_loc.directory, fname))
+            vol = Volume(dst_loc.directory, collection, vid,
+                         needle_map_kind=self.needle_map_kind)
+            dst_loc.add_volume(vol)
+            # delta: the volume's disk_type changed
+            self.deleted_volumes.append(old_info)
+            self.new_volumes.append(self.volume_info(vol))
+            return True
 
     def write_volume_needle(self, vid: int, n: Needle) -> int:
         v = self.find_volume(vid)
@@ -279,6 +335,12 @@ class Store:
         return len(n.data)
 
     # ---- heartbeat ----
+    def _disk_type_of(self, v: Volume) -> str:
+        for loc in self.locations:
+            if v.id in loc.volumes:
+                return loc.disk_type
+        return "hdd"
+
     def volume_info(self, v: Volume) -> dict:
         return {
             "id": v.id,
@@ -291,6 +353,7 @@ class Store:
             "replica_placement": v.super_block.replica_placement.to_byte(),
             "ttl": v.super_block.ttl.to_uint32(),
             "version": v.version,
+            "disk_type": self._disk_type_of(v),
         }
 
     def collect_heartbeat(self) -> dict:
@@ -307,10 +370,15 @@ class Store:
                     "collection": ev.collection,
                     "ec_index_bits": ev.shard_bits().bits,
                 })
+        disk_slots: dict[str, int] = {}
+        for loc in self.locations:
+            disk_slots[loc.disk_type] = (disk_slots.get(loc.disk_type, 0)
+                                         + loc.max_volume_count)
         return {
             "ip": self.ip, "port": self.port, "public_url": self.public_url,
             "rack": self.rack, "data_center": self.data_center,
             "max_volume_count": max_volume_count,
+            "disk_slots": disk_slots,
             "volumes": volumes,
             "ec_shards": ec_shards,
             "has_no_volumes": not volumes and not ec_shards,

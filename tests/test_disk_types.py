@@ -1,0 +1,154 @@
+"""Disk-type (hdd/ssd) tiering (reference types.DiskType threaded
+through volume_growth/topology/assign and the -disk flag): typed
+dirs, tier-scoped placement, per-path filer rules, and
+volume.tier.move across tiers."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.client import operation
+from seaweedfs_tpu.client.wdclient import MasterClient
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.commands import ShellContext
+from seaweedfs_tpu.shell.repl import run_command
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+@pytest.fixture
+def tiered(tmp_path):
+    """One volume server with an hdd dir and an ssd dir."""
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "hdd"), str(tmp_path / "ssd")],
+                      master.url, disk_types=["hdd", "ssd"],
+                      max_volume_counts=[4, 4])
+    vs.start()
+    time.sleep(0.2)
+    yield master, vs, tmp_path
+    vs.stop()
+    master.stop()
+
+
+def _disk_of(master, vid: int) -> str:
+    topo = http_json("GET", f"http://{master.url}/dir/status")["Topology"]
+    for dc in topo["data_centers"]:
+        for rack in dc["racks"]:
+            for node in rack["nodes"]:
+                for v in node["volumes"]:
+                    if v["id"] == vid:
+                        return v["disk_type"]
+    raise AssertionError(f"vid {vid} not in topology")
+
+
+def test_assign_routes_to_requested_tier(tiered, tmp_path):
+    master, vs, _ = tiered
+    mc = MasterClient(master.url)
+    try:
+        a_ssd = mc.assign(disk="ssd")
+        assert "error" not in a_ssd or not a_ssd.get("error")
+        vid_ssd = int(a_ssd["fid"].split(",")[0])
+        a_hdd = mc.assign()  # untyped = hdd tier
+        vid_hdd = int(a_hdd["fid"].split(",")[0])
+        assert vid_ssd != vid_hdd
+        # volumes physically live in the right dirs
+        import os
+        assert os.path.exists(tmp_path / "ssd" / f"{vid_ssd}.dat")
+        assert os.path.exists(tmp_path / "hdd" / f"{vid_hdd}.dat")
+        # heartbeat topology reports the tier
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                if _disk_of(master, vid_ssd) == "ssd":
+                    break
+            except AssertionError:
+                pass
+            time.sleep(0.2)
+        assert _disk_of(master, vid_ssd) == "ssd"
+        assert _disk_of(master, vid_hdd) == "hdd"
+        # data written to the ssd fid reads back
+        status, _, _ = http_call(
+            "POST", f"http://{a_ssd['url']}/{a_ssd['fid']}",
+            body=b"fast bytes")
+        assert status < 300
+        status, body, _ = http_call(
+            "GET", f"http://{a_ssd['url']}/{a_ssd['fid']}")
+        assert body == b"fast bytes"
+    finally:
+        mc.stop()
+
+
+def test_ssd_only_server_rejects_untyped_growth(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "s")], master.url,
+                      disk_types=["ssd"])
+    vs.start()
+    time.sleep(0.2)
+    from seaweedfs_tpu.utils.httpd import HttpError
+    mc = MasterClient(master.url)
+    try:
+        with pytest.raises(HttpError) as exc:
+            mc.assign()  # hdd tier: no capacity anywhere
+        assert b"not enough" in exc.value.body
+        out = mc.assign(disk="ssd")
+        assert out.get("fid")
+    finally:
+        mc.stop()
+        vs.stop()
+        master.stop()
+
+
+def test_filer_rule_routes_path_to_ssd(tiered):
+    master, vs, tmp_path = tiered
+    fs = FilerServer(master.url)
+    fs.start()
+    time.sleep(0.1)
+    try:
+        http_json("POST", f"http://{fs.url}/__api/filer_conf",
+                  {"location_prefix": "/fast/", "disk_type": "ssd"})
+        # big enough to chunk (past the inline limit)
+        payload = b"s" * 4096
+        status, _, _ = http_call("POST", f"http://{fs.url}/fast/f.bin",
+                                 body=payload)
+        assert status < 300
+        status, _, _ = http_call("POST", f"http://{fs.url}/slow/f.bin",
+                                 body=payload)
+        assert status < 300
+        out = http_json("GET",
+                        f"http://{fs.url}/__api/entry?path=/fast/f.bin")
+        fast_vid = int(out["entry"]["chunks"][0]["fid"].split(",")[0])
+        out = http_json("GET",
+                        f"http://{fs.url}/__api/entry?path=/slow/f.bin")
+        slow_vid = int(out["entry"]["chunks"][0]["fid"].split(",")[0])
+        assert _disk_of(master, fast_vid) == "ssd"
+        assert _disk_of(master, slow_vid) == "hdd"
+    finally:
+        fs.stop()
+
+
+def test_tier_move_to_disk_type(tiered):
+    master, vs, tmp_path = tiered
+    mc = MasterClient(master.url)
+    sh = ShellContext(master.url)
+    try:
+        fid = operation.upload_data(mc, b"h" * 2048, name="h.bin").fid
+        vid = int(fid.split(",")[0])
+        assert _disk_of(master, vid) == "hdd"
+        moved = run_command(
+            sh, "volume.tier.move -toDiskType ssd -fullPercent 0")
+        assert any(m["vid"] == vid for m in moved)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if _disk_of(master, vid) == "ssd":
+                break
+            time.sleep(0.2)
+        assert _disk_of(master, vid) == "ssd"
+        import os
+        assert os.path.exists(tmp_path / "ssd" / f"{vid}.dat")
+        assert not os.path.exists(tmp_path / "hdd" / f"{vid}.dat")
+        assert operation.read_data(mc, fid) == b"h" * 2048
+    finally:
+        mc.stop()

@@ -114,7 +114,7 @@ def test_grow_by_type_allocates_and_assigns_ids():
         topo.sync_data_node_registration(_hb(f"n{i}", 80))
     allocated = []
 
-    def alloc(node, vid, collection, rp, ttl):
+    def alloc(node, vid, collection, rp, ttl, disk=""):
         allocated.append((node.id, vid))
         node.volumes[vid] = _vol(vid)
         topo._register_volume(_vol(vid), node)
