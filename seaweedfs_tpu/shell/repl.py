@@ -22,6 +22,7 @@ HELP = """commands:
   fs.meta.save [-root /p] [-o file] / fs.meta.load -i file / fs.meta.tail
   s3.bucket.list / s3.bucket.create -name B / s3.bucket.delete -name B
   s3.bucket.quota -name B -sizeMB N | -name B -disable
+  s3.bucket.quota.check             usage vs quota per bucket
   volume.list                       show topology
   volume.fix.replication [-n]      re-replicate under-replicated volumes
   volume.check.disk [-volumeId N] [-fix]   cross-check replica contents
@@ -519,6 +520,33 @@ def run_command(sh: ShellContext, line: str):
             http_json("POST", f"http://{fsc.filer_url}/__api/entry",
                       {"entry": entry, "meta_only": True})
             return {"bucket": flags["name"], "quota_bytes": quota}
+        if op == "quota.check":
+            # usage vs quota per bucket (reference
+            # command_s3_bucket_quota_check.go; enforcement itself is
+            # live in the gateway's write path, so this reports)
+            from seaweedfs_tpu.utils.httpd import HttpError as _HErr
+            report = []
+            try:
+                buckets = fsc.ls("/buckets")
+            except (NotADirectoryError, _HErr):
+                buckets = []  # no bucket ever created: /buckets absent
+            for be in buckets:
+                name = be["FullPath"].rsplit("/", 1)[-1]
+                if name.startswith(".") or not be.get("IsDirectory"):
+                    continue
+                out = http_json(
+                    "GET", f"http://{fsc.filer_url}/__api/entry"
+                           f"?path=/buckets/{name}")
+                ext = out["entry"].get("extended") or {}
+                q = ext.get("quota_bytes")
+                if isinstance(q, dict):  # bytes-valued xattr encoding
+                    q = bytes.fromhex(q["__bytes__"]).decode()
+                quota = int(q) if q else 0
+                _files, used = fsc.du(f"/buckets/{name}")
+                report.append({"bucket": name, "quota_bytes": quota,
+                               "used_bytes": used,
+                               "over": bool(quota) and used > quota})
+            return {"buckets": report}
         if op == "list":
             try:
                 return [e["FullPath"].rsplit("/", 1)[-1]

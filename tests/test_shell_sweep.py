@@ -246,6 +246,17 @@ def test_s3_bucket_quota(cluster, tmp_path):
         status, body, _ = http_call("PUT", f"{base}/big.bin",
                                     body=b"y" * 8000)
         assert status == 403 and b"QuotaExceeded" in body
+        # quota.check reports usage vs quota; stray files under
+        # /buckets are skipped, not fatal
+        http_call("POST", f"http://{fs.url}/buckets/stray.txt",
+                  body=b"not a bucket")
+        out = run_command(sh, "s3.bucket.quota.check")
+        row = next(b for b in out["buckets"] if b["bucket"] == "quoted")
+        assert row["quota_bytes"] == 10485
+        assert row["used_bytes"] >= 4000
+        assert row["over"] is False
+        assert not any(b["bucket"] == "stray.txt"
+                       for b in out["buckets"])
         # lifting the quota unblocks writes
         run_command(sh, "s3.bucket.quota -name quoted -disable")
         s3._usage_cache.clear()
