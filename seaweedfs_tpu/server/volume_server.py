@@ -218,6 +218,16 @@ class VolumeServer:
         ticks = 0
         while not self._stop.wait(PULSE_SECONDS):
             ticks += 1
+            if ticks % 12 == 0:
+                # TTL volume reaping (reference master vacuum loop
+                # cadence); deletions ride the next delta heartbeat
+                try:
+                    self.store.delete_expired_ttl_volumes()
+                except Exception as e:
+                    import logging
+                    logging.getLogger("seaweedfs_tpu.volume").warning(
+                        "TTL reap failed (will retry): %s", e,
+                        exc_info=True)
             deltas = self.store.drain_deltas()
             has_delta = any(deltas.values())
             try:
@@ -740,12 +750,18 @@ class VolumeServer:
         for ext in (".dat", ".idx"):
             url = (f"http://{src}/admin/volume_file?volumeId={vid}"
                    f"&ext={ext}&collection={collection}")
-            status, body, _ = http_call("GET", url, timeout=300)
+            status, body, hdrs = http_call("GET", url, timeout=300)
             if status >= 400:
                 return Response({"error": f"copy {ext}: HTTP {status}"},
                                 status=500)
             with open(base + ext, "wb") as f:
                 f.write(body)
+            # preserve the source's mtime: a replica copy must NOT
+            # restart a TTL volume's expiry clock
+            src_mtime = hdrs.get("X-Weed-File-Mtime")
+            if src_mtime:
+                os.utime(base + ext, (float(src_mtime),
+                                      float(src_mtime)))
         from seaweedfs_tpu.storage.volume import Volume
         vol = Volume(loc.directory, collection, vid)
         loc.add_volume(vol)
@@ -847,8 +863,12 @@ class VolumeServer:
         if ext not in (".dat", ".idx"):
             return Response({"error": "bad ext"}, status=400)
         v.sync()
-        with open(v.file_name() + ext, "rb") as f:
-            return Response(f.read(), content_type="application/octet-stream")
+        path = v.file_name() + ext
+        with open(path, "rb") as f:
+            return Response(
+                f.read(), content_type="application/octet-stream",
+                headers={"X-Weed-File-Mtime":
+                         str(os.stat(path).st_mtime)})
 
     # ---- EC rpcs (reference volume_grpc_erasure_coding.go) ----
     def _ec_generate(self, req: Request) -> Response:

@@ -146,6 +146,29 @@ class Store:
                     return True
             return False
 
+    def delete_expired_ttl_volumes(self) -> list[int]:
+        """Drop TTL volumes whose newest write is older than ttl+grace
+        (reference topology_event_handling / volume_checking: TTL
+        volumes are removed whole, not needle-by-needle)."""
+        with self._lock:
+            doomed = [v.id for loc in self.locations
+                      for v in list(loc.volumes.values())
+                      if v.is_expired_long_enough()
+                      and not v.is_compacting]
+        reaped = []
+        for vid in doomed:
+            with self._lock:
+                v = self.find_volume(vid)
+                # re-check at the moment of deletion: a write acked
+                # between the scan and here resets the clock, and a
+                # vacuum may have started — never destroy either
+                if v is None or v.is_compacting \
+                        or not v.is_expired_long_enough():
+                    continue
+            if self.delete_volume(vid):
+                reaped.append(vid)
+        return reaped
+
     def move_volume_disk(self, vid: int, disk_type: str) -> bool:
         """Move a volume's files to a location of another disk type on
         THIS server (intra-node half of volume.tier.move; the
@@ -197,6 +220,10 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
+        if v.is_expired():
+            # past-TTL data is gone to readers even before the removal
+            # grace deletes the files (reference store read path)
+            raise NotFoundError(f"volume {vid} expired")
         return v.read_needle(needle_id, cookie)
 
     def delete_volume_needle(self, vid: int, needle_id: int,

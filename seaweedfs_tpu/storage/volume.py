@@ -474,6 +474,40 @@ class Volume:
                                         self.offset_bytes))
 
     # ---- integrity ----
+    # reference volume_checking.go expired()/expiredLongEnough(): a TTL
+    # volume dies WHOLE once its newest write is older than the TTL
+    MAX_TTL_REMOVAL_DELAY_SEC = 10 * 60
+
+    def _last_activity_sec(self) -> float:
+        if self.last_append_at_ns:
+            return self.last_append_at_ns / 1e9
+        # no in-process write yet: the .dat mtime (replica copies
+        # preserve the source's, see _admin_copy_volume), else the
+        # .vif for cloud-tiered volumes (tiering was the last
+        # activity), else now (brand-new empty volume)
+        for ext in (".dat", ".vif"):
+            try:
+                return os.stat(self.file_name() + ext).st_mtime
+            except OSError:
+                continue
+        return time.time()
+
+    def is_expired(self) -> bool:
+        ttl_sec = self.super_block.ttl.minutes * 60
+        if ttl_sec == 0:
+            return False
+        return time.time() > self._last_activity_sec() + ttl_sec
+
+    def is_expired_long_enough(self) -> bool:
+        """Expired plus a removal grace (min(ttl, 10min), the
+        reference's MAX_TTL_VOLUME_REMOVAL_DELAY) so replicas converge
+        before any copy disappears."""
+        ttl_sec = self.super_block.ttl.minutes * 60
+        if ttl_sec == 0:
+            return False
+        grace = min(ttl_sec, self.MAX_TTL_REMOVAL_DELAY_SEC)
+        return time.time() > self._last_activity_sec() + ttl_sec + grace
+
     def check_integrity(self) -> bool:
         """Verify the last index entry points at a well-formed needle
         (reference volume_checking.go CheckAndFixVolumeDataIntegrity)."""

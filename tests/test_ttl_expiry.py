@@ -1,0 +1,126 @@
+"""TTL volume expiry (reference volume_checking.go expired/
+expiredLongEnough + topology_event_handling: TTL volumes die whole
+once their newest write ages past the TTL; reads 404 immediately at
+expiry, files are reaped after a removal grace)."""
+
+import os
+import time
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.store import NotFoundError, Store
+
+
+def _hours_ago(h: float) -> int:
+    return int((time.time() - h * 3600) * 1e9)
+
+
+def test_ttl_volume_expires_whole(tmp_path):
+    store = Store([str(tmp_path)], ip="127.0.0.1", port=0)
+    v = store.add_volume(1, ttl="1m")
+    store.write_volume_needle(1, Needle(id=7, cookie=0xAB, data=b"brief"))
+    assert not v.is_expired()
+    assert store.read_volume_needle(1, 7, 0xAB).data == b"brief"
+
+    # age the newest write 2 hours past a 1-minute TTL
+    v.last_append_at_ns = _hours_ago(2)
+    assert v.is_expired() and v.is_expired_long_enough()
+    # reads 404 even before the files are reaped
+    try:
+        store.read_volume_needle(1, 7, 0xAB)
+        raise AssertionError("expired volume still served a read")
+    except NotFoundError:
+        pass
+
+    store.drain_deltas()  # clear the add delta
+    assert store.delete_expired_ttl_volumes() == [1]
+    assert store.find_volume(1) is None
+    assert not os.path.exists(tmp_path / "1.dat")
+    deltas = store.drain_deltas()
+    assert [d["id"] for d in deltas["deleted_volumes"]] == [1]
+
+
+def test_expiry_grace_and_activity_reset(tmp_path):
+    store = Store([str(tmp_path)], ip="127.0.0.1", port=0)
+    v = store.add_volume(2, ttl="1h")
+    store.write_volume_needle(2, Needle(id=1, cookie=1, data=b"x"))
+    # expired but within the removal grace: reads gone, files kept
+    v.last_append_at_ns = _hours_ago(1.1)
+    assert v.is_expired() and not v.is_expired_long_enough()
+    assert store.delete_expired_ttl_volumes() == []
+    assert store.find_volume(2) is not None
+    # a fresh write resets the clock (lastModified semantics)
+    store.write_volume_needle(2, Needle(id=2, cookie=1, data=b"y"))
+    assert not v.is_expired()
+    assert store.read_volume_needle(2, 2, 1).data == b"y"
+
+
+def test_reaper_skips_compacting_and_rechecks(tmp_path):
+    """A vacuum in progress or a write acked after the scan must stop
+    the reaper (review findings: destroy-mid-vacuum / acked-write
+    loss)."""
+    store = Store([str(tmp_path)], ip="127.0.0.1", port=0)
+    v = store.add_volume(4, ttl="1m")
+    store.write_volume_needle(4, Needle(id=1, cookie=1, data=b"a"))
+    v.last_append_at_ns = _hours_ago(2)
+    v.is_compacting = True
+    assert store.delete_expired_ttl_volumes() == []
+    assert store.find_volume(4) is not None
+    v.is_compacting = False
+    assert store.delete_expired_ttl_volumes() == [4]
+
+
+def test_replica_copy_preserves_ttl_clock(tmp_path):
+    """volume.copy carries the source .dat mtime so the new replica
+    expires on the ORIGINAL schedule, not a fresh one."""
+    import time as _time
+
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.shell.commands import ShellContext
+    from seaweedfs_tpu.utils.httpd import http_call
+
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs1 = VolumeServer([str(tmp_path / "a")], master.url)
+    vs2 = VolumeServer([str(tmp_path / "b")], master.url)
+    vs1.start()
+    vs2.start()
+    _time.sleep(0.3)
+    try:
+        import urllib.request
+        a = __import__("json").loads(urllib.request.urlopen(
+            f"http://{master.url}/dir/assign?ttl=1h").read())
+        fid = a["fid"]
+        vid = int(fid.split(",")[0])
+        status, _, _ = http_call("POST", f"http://{a['url']}/{fid}",
+                                 body=b"ttl payload")
+        assert status < 300
+        # age the source files two hours into the past
+        src_vs = vs1 if a["url"] == vs1.url else vs2
+        dst_vs = vs2 if src_vs is vs1 else vs1
+        v = src_vs.store.find_volume(vid)
+        v.sync()
+        old = _time.time() - 7200
+        os.utime(v.file_name() + ".dat", (old, old))
+        os.utime(v.file_name() + ".idx", (old, old))
+        ShellContext(master.url).volume_copy(vid, src_vs.url, dst_vs.url)
+        copied = dst_vs.store.find_volume(vid)
+        assert copied is not None
+        mtime = os.stat(copied.file_name() + ".dat").st_mtime
+        assert abs(mtime - old) < 5, "copy restarted the TTL clock"
+        # and the copy is therefore already expired, like the source
+        assert copied.is_expired()
+    finally:
+        vs2.stop()
+        vs1.stop()
+        master.stop()
+
+
+def test_non_ttl_volume_never_expires(tmp_path):
+    store = Store([str(tmp_path)], ip="127.0.0.1", port=0)
+    v = store.add_volume(3)
+    store.write_volume_needle(3, Needle(id=1, cookie=1, data=b"z"))
+    v.last_append_at_ns = _hours_ago(1000)
+    assert not v.is_expired()
+    assert store.delete_expired_ttl_volumes() == []
+    assert store.read_volume_needle(3, 1, 1).data == b"z"
