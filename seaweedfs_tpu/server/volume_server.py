@@ -310,6 +310,7 @@ class VolumeServer:
             f"<tr><td>{v['id']}</td><td>{v['collection']}</td>"
             f"<td>{v['size']}</td><td>{v['file_count']}</td>"
             f"<td>{v['delete_count']}</td>"
+            f"<td>{v.get('disk_type', 'hdd')}</td>"
             f"<td>{'RO' if v['read_only'] else 'RW'}</td></tr>"
             for v in hb["volumes"])
         ec_rows = "".join(
@@ -321,7 +322,7 @@ class VolumeServer:
             f"<p>master: {self.master_url} | rack: {self.store.rack}</p>"
             "<h2>Volumes</h2><table border=1><tr><th>id</th>"
             "<th>collection</th><th>size</th><th>files</th><th>deleted</th>"
-            f"<th>mode</th></tr>{rows}</table>"
+            f"<th>disk</th><th>mode</th></tr>{rows}</table>"
             "<h2>EC shards</h2><table border=1><tr><th>vid</th>"
             f"<th>shard bits</th></tr>{ec_rows}</table></body></html>")
         return Response(html, content_type="text/html")
