@@ -523,7 +523,40 @@ class MasterServer:
             self.topo._register_volume(vinfo, node)
         return True
 
+    def _proxy_to_leader(self, req: Request) -> Optional[Response]:
+        """Followers answer read endpoints by proxying to the leader
+        (reference master.follower / master proxy-to-leader): volume
+        servers heartbeat only to the leader, so a follower's topology
+        is empty — serving it locally would 404 every lookup. The
+        X-Weed-Proxied guard stops loops during elections."""
+        if self.is_leader():
+            return None
+        if req.headers.get("X-Weed-Proxied"):
+            return None  # second hop: answer locally rather than loop
+        leader = self.leader
+        if not leader or leader == self.url:
+            return None
+        import json
+        import urllib.parse
+
+        from seaweedfs_tpu.utils.httpd import http_call
+        qs = urllib.parse.urlencode(req.query)
+        try:
+            status, body, _ = http_call(
+                "GET", f"http://{leader}{req.path}?{qs}",
+                headers={"X-Weed-Proxied": "1"}, timeout=10)
+            parsed = json.loads(body) if body else {}
+        except (ConnectionError, ValueError):
+            # leader unreachable or spoke garbage (e.g. a stale
+            # leader_id now pointing at something else): best-effort
+            # local answer instead of a 500
+            return None
+        return Response(parsed, status=status)
+
     def _handle_lookup(self, req: Request) -> Response:
+        proxied = self._proxy_to_leader(req)
+        if proxied is not None:
+            return proxied
         vid_str = req.query.get("volumeId", "")
         vid = int(vid_str.split(",")[0]) if vid_str else 0
         collection = req.query.get("collection", "")
@@ -540,6 +573,9 @@ class MasterServer:
         })
 
     def _handle_lookup_ec(self, req: Request) -> Response:
+        proxied = self._proxy_to_leader(req)
+        if proxied is not None:
+            return proxied
         vid = int(req.query.get("volumeId", 0))
         shards = self.topo.lookup_ec_shards(vid)
         if shards is None:
@@ -554,6 +590,9 @@ class MasterServer:
         })
 
     def _handle_dir_status(self, req: Request) -> Response:
+        proxied = self._proxy_to_leader(req)
+        if proxied is not None:
+            return proxied
         return Response({"Topology": self.topo.to_info(),
                          "VolumeSizeLimitMB":
                          self.topo.volume_size_limit // (1024 * 1024),

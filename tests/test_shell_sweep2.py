@@ -128,6 +128,40 @@ def test_volume_tier_move(cluster, tmp_path):
         cold.stop()
 
 
+def test_follower_proxies_read_endpoints(tmp_path):
+    """Volume servers heartbeat only to the leader, so a follower's
+    own topology is empty — /dir/lookup and /dir/status on a follower
+    must proxy to the leader (reference master.follower semantics)."""
+    masters = [MasterServer() for _ in range(3)]
+    for m in masters:
+        m.start()
+    urls = [m.url for m in masters]
+    vs = None
+    try:
+        for m in masters:
+            m.set_peers(urls)
+        leader = _wait_unique_leader(masters)
+        vs = VolumeServer([str(tmp_path / "v")], urls)
+        vs.start()
+        deadline = time.time() + 15
+        while time.time() < deadline and not leader.topo.all_nodes():
+            time.sleep(0.1)
+        a = http_json("GET", f"http://{leader.url}/dir/assign",
+                      timeout=5)
+        vid = int(a["fid"].split(",")[0])
+        follower = next(m for m in masters if m is not leader)
+        out = http_json(
+            "GET", f"http://{follower.url}/dir/lookup?volumeId={vid}")
+        assert [l["url"] for l in out["locations"]] == [vs.url]
+        topo = http_json("GET", f"http://{follower.url}/dir/status")
+        assert topo["Topology"]["data_centers"]  # leader's view, not empty
+    finally:
+        if vs is not None:
+            vs.stop()
+        for m in masters:
+            m.stop()
+
+
 def _wait_unique_leader(masters, timeout=15):
     deadline = time.time() + timeout
     while time.time() < deadline:
