@@ -3,6 +3,17 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "MB/s", "vs_baseline": N}
 
+Resilience (round-4 postmortem: BENCH_r04 was lost to a single-shot TPU
+relay init failure that also threw away the already-measured CPU number):
+  * the CPU denominator is measured FIRST and is always reported;
+  * the TPU probe runs in a SUBPROCESS — a failed/cached-broken backend
+    init can never poison this process — and is retried with backoff
+    (>= 4 attempts spanning >= 60s) before giving up;
+  * on total TPU failure the output is still ONE valid JSON line, with
+    the CPU throughput as value, vs_baseline 1.0, "backend":
+    "cpu-fallback" and a diagnostic "error" field — never a bare
+    traceback / rc=1.
+
 value       = TPU (default JAX backend) GF(256) parity-kernel throughput in
               MB/s of input shard data, device-resident steady state with
               the parity MATERIALIZED to HBM every step (the parity rows
@@ -21,9 +32,18 @@ vs_baseline = value / CPU-coder throughput measured in the same process on
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+# Attempt schedule for the TPU probe subprocess: sleep-before-attempt
+# seconds. Cumulative pre-attempt delay 0+10+20+35 = 65s > the 60s floor
+# the round-4 verdict demands, on top of each attempt's own runtime.
+TPU_ATTEMPT_DELAYS = (0, 10, 20, 35)
+TPU_ATTEMPT_TIMEOUT = 600  # first compile through the relay can be slow
 
 
 def bench_cpu(batch_bytes: int = 256 * 1024, n_batches: int = 32,
@@ -99,16 +119,77 @@ def bench_tpu(n_bytes_per_shard: int = 32 * 1024 * 1024, outer: int = 5,
     return inner * 10 * n_bytes_per_shard / dt / 1e6
 
 
-def main():
-    cpu = bench_cpu()
-    tpu = bench_tpu()
-    print(json.dumps({
-        "metric": "rs_10_4_encode_throughput",
-        "value": round(tpu, 1),
-        "unit": "MB/s",
-        "vs_baseline": round(tpu / cpu, 2),
-    }))
+def tpu_probe_with_retries(delays=TPU_ATTEMPT_DELAYS,
+                           timeout=TPU_ATTEMPT_TIMEOUT,
+                           argv_prefix=None, sleep=time.sleep):
+    """Run the TPU probe in a fresh subprocess per attempt.
+
+    JAX caches a failed backend init for the life of the process, so
+    retrying in-process is useless — each attempt gets a new interpreter.
+    Returns (mbps or None, attempts_made, last_error or None).
+    `argv_prefix` overrides the child command for tests."""
+    cmd = list(argv_prefix) if argv_prefix is not None else [
+        sys.executable, os.path.abspath(__file__), "--tpu-probe"]
+    last_err = None
+    for i, delay in enumerate(delays):
+        if delay:
+            sleep(delay)
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout)
+        except subprocess.TimeoutExpired:
+            last_err = f"attempt {i + 1}: timeout after {timeout}s"
+            continue
+        if proc.returncode == 0:
+            for line in reversed(proc.stdout.strip().splitlines()):
+                try:
+                    out = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(out, dict) and "tpu_mbps" in out:
+                    try:
+                        return float(out["tpu_mbps"]), i + 1, None
+                    except (TypeError, ValueError):
+                        break
+            last_err = (f"attempt {i + 1}: rc=0 but no tpu_mbps JSON in "
+                        f"stdout: {proc.stdout[-300:]!r}")
+        else:
+            tail = (proc.stderr or proc.stdout or "").strip()[-500:]
+            last_err = f"attempt {i + 1}: rc={proc.returncode}: {tail}"
+    return None, len(delays), last_err
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if "--tpu-probe" in argv:
+        # Child mode: just the device measurement, one JSON line.
+        print(json.dumps({"tpu_mbps": bench_tpu()}))
+        return 0
+    cpu = bench_cpu()  # measured first; never discarded
+    tpu, attempts, err = tpu_probe_with_retries()
+    if tpu is not None:
+        print(json.dumps({
+            "metric": "rs_10_4_encode_throughput",
+            "value": round(tpu, 1),
+            "unit": "MB/s",
+            "vs_baseline": round(tpu / cpu, 2),
+            "backend": "tpu",
+            "cpu_mbps": round(cpu, 1),
+            "attempts": attempts,
+        }))
+    else:
+        print(json.dumps({
+            "metric": "rs_10_4_encode_throughput",
+            "value": round(cpu, 1),
+            "unit": "MB/s",
+            "vs_baseline": 1.0,
+            "backend": "cpu-fallback",
+            "cpu_mbps": round(cpu, 1),
+            "attempts": attempts,
+            "error": err or "tpu probe failed",
+        }))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -124,7 +124,13 @@ class RaftNode:
             self.peers = [p for p in st["peers"] if p != self.id]
         if self.snap_state:
             self.restore_fn(self.snap_state)
-            self._apply_snapshot_membership(self.snap_state)
+            if "peers" not in st:
+                # fallback for pre-membership state files only: the
+                # persisted peer list is written on every _persist and is
+                # therefore always >= the snapshot's age — letting the
+                # snapshot's member set win here would revert a
+                # membership change committed after the last compaction
+                self._apply_snapshot_membership(self.snap_state)
         self.commit_index = self.last_applied = self.snap_index
         # re-apply entries that were committed before shutdown is not
         # possible to know — raft re-commits them once a leader emerges

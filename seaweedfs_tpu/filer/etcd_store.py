@@ -109,8 +109,13 @@ class EtcdFilerStore(FilerStore):
 
     def delete_folder_children(self, full_path: str) -> None:
         base = full_path.rstrip("/")
-        if not base:  # root: every entry key
-            self.client.delete_range(b"e", b"f")
+        if not base:  # root: every entry key EXCEPT the root's own
+            # (b"e/\x00") — other stores keep the root entry when
+            # clearing its children, so find_entry('/') must survive
+            # every key is b"e/" + ... and the smallest is the root key
+            # itself, so one range starting just past it covers all
+            root_key = _entry_key("/")
+            self.client.delete_range(root_key + b"\x00", b"f")
             return
         enc = base.encode()
         # direct children, then deeper descendants — two exact ranges

@@ -480,8 +480,12 @@ class S3Server:
         # chunk (or on a cipher-enabled filer) has empty inline content
         data = self.fs._read_entry_bytes(entry) if entry is not None else b""
         if not data:
-            self.breaker.global_limits = {"Read": 0, "Write": 0}
-            self.breaker.bucket_limits = {}
+            if seen_mtime > 0:
+                # config entry deleted after having existed: drop limits.
+                # A missing entry on first look leaves constructor-
+                # provided limits (still a public parameter) untouched.
+                self.breaker.global_limits = {"Read": 0, "Write": 0}
+                self.breaker.bucket_limits = {}
             return
         from seaweedfs_tpu.pb import s3_pb2
         try:

@@ -50,6 +50,11 @@ class Broker:
         self._conf_cache: dict[tuple[str, str], dict] = {}
         self._seq = 0  # broker-global publish sequence (per process)
         self._recent: collections.deque = collections.deque(maxlen=RECENT_MAX)
+        # highest ring-evicted seq per (topic, partition): a slow tailer
+        # overflows only when an evicted record could actually have
+        # matched its subscription, not whenever busy foreign topics
+        # churn the shared ring
+        self._evict_high: dict[tuple[str, int], int] = {}
         self.message_count = 0
         self.bytes_count = 0
 
@@ -136,6 +141,9 @@ class Broker:
             seg += line
             self.message_count += 1
             self.bytes_count += len(line)
+            if len(self._recent) == self._recent.maxlen:
+                es, et, ep, _ = self._recent[0]  # about to fall off
+                self._evict_high[(et, ep)] = es
             self._recent.append((seq, nt, partition, record))
             if len(seg) >= SEGMENT_MAX_BYTES:
                 to_flush = self._begin_flush(nt, partition)
@@ -279,10 +287,14 @@ class Broker:
                     if t == nt and part in want:
                         batch.append((s, part, rec))
                 if (not hit_last and self._recent
-                        and self._recent[0][0] > last + 1):
+                        and self._recent[0][0] > last + 1
+                        and any(self._evict_high.get((nt, p), 0) > last
+                                for p in want)):
                     # entries in (last, oldest) were evicted before we
-                    # scanned them; they may have held our topic's
-                    # records — fail loudly, never skip silently
+                    # scanned them AND at least one evicted record
+                    # belonged to a subscribed (topic, partition) — fail
+                    # loudly, never skip silently. Foreign-topic churn
+                    # alone does not abort a quiet topic's tail.
                     raise MqTailOverflow(
                         f"tail lagged past the {RECENT_MAX}-record live "
                         f"ring (behind by {cur - last}); re-attach and "

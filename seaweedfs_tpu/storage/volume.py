@@ -499,13 +499,14 @@ class Volume:
         return time.time() > self._last_activity_sec() + ttl_sec
 
     def is_expired_long_enough(self) -> bool:
-        """Expired plus a removal grace (min(ttl, 10min), the
-        reference's MAX_TTL_VOLUME_REMOVAL_DELAY) so replicas converge
-        before any copy disappears."""
+        """Expired plus a removal grace of 10% of the TTL capped at
+        10min (reference volume.go expiredLongEnough: ttl/10, max
+        MAX_TTL_VOLUME_REMOVAL_DELAY) so replicas converge before any
+        copy disappears."""
         ttl_sec = self.super_block.ttl.minutes * 60
         if ttl_sec == 0:
             return False
-        grace = min(ttl_sec, self.MAX_TTL_REMOVAL_DELAY_SEC)
+        grace = min(ttl_sec // 10, self.MAX_TTL_REMOVAL_DELAY_SEC)
         return time.time() > self._last_activity_sec() + ttl_sec + grace
 
     def check_integrity(self) -> bool:

@@ -180,6 +180,24 @@ def test_tail_overflow_raises_not_skips(mq):
         next(gen)
 
 
+def test_tail_survives_foreign_topic_churn(mq):
+    # Ring eviction is tracked per (topic, partition): a busy foreign
+    # topic churning the shared ring must NOT abort a quiet topic's
+    # tail when none of the evicted records matched its subscription.
+    import collections
+    broker, client = mq
+    client.configure_topic("quiet", "t", 1)
+    client.configure_topic("busy", "t", 1)
+    broker._recent = collections.deque(broker._recent, maxlen=8)
+    gen = broker.subscribe("quiet", "t", tail=True)
+    broker.publish("quiet", "t", "k", "q0")
+    assert next(gen)["value"] == "q0"
+    for _ in range(20):  # evicts well past the quiet tailer's cursor
+        broker.publish("busy", "t", "k", "noise")
+    broker.publish("quiet", "t", "k", "q1")
+    assert next(gen)["value"] == "q1"  # no MqTailOverflow
+
+
 def test_shell_mq_topic_list(mq, tmp_path):
     broker, client = mq
     client.configure_topic("ns1", "orders", 4)

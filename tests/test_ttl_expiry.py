@@ -43,8 +43,11 @@ def test_expiry_grace_and_activity_reset(tmp_path):
     store = Store([str(tmp_path)], ip="127.0.0.1", port=0)
     v = store.add_volume(2, ttl="1h")
     store.write_volume_needle(2, Needle(id=1, cookie=1, data=b"x"))
-    # expired but within the removal grace: reads gone, files kept
-    v.last_append_at_ns = _hours_ago(1.1)
+    # expired but within the removal grace (ttl/10 = 6min for a 1h TTL,
+    # reference volume.go expiredLongEnough): reads gone, files kept
+    v.last_append_at_ns = _hours_ago(1.2)
+    assert v.is_expired_long_enough()  # past the 6min grace
+    v.last_append_at_ns = _hours_ago(1.05)
     assert v.is_expired() and not v.is_expired_long_enough()
     assert store.delete_expired_ttl_volumes() == []
     assert store.find_volume(2) is not None
