@@ -43,7 +43,10 @@ import numpy as np
 # seconds. Cumulative pre-attempt delay 0+10+20+35 = 65s > the 60s floor
 # the round-4 verdict demands, on top of each attempt's own runtime.
 TPU_ATTEMPT_DELAYS = (0, 10, 20, 35)
-TPU_ATTEMPT_TIMEOUT = 600  # first compile through the relay can be slow
+# Healthy runs finish in ~2min including the first compile; a hung
+# relay must not eat the whole round (4 attempts x 300s + 65s backoff
+# is the worst case, ~21min).
+TPU_ATTEMPT_TIMEOUT = 300
 
 
 def bench_cpu(batch_bytes: int = 256 * 1024, n_batches: int = 32,

@@ -1,21 +1,20 @@
-"""FilerStore SPI + embedded implementations.
+"""FilerStore SPI + the store registry + MemoryStore.
 
-Functional equivalent of reference weed/filer/filerstore.go:21-44. The
-reference ships 22 store plugins (leveldb/rocksdb/sql/redis/...); we ship
-the SPI plus two embedded stores covering the same contract:
-  - MemoryStore: sorted dict (tests, ephemeral filers)
-  - SqliteStore: stdlib sqlite3 (the abstract_sql analogue; durable)
-New stores implement the same five entry ops + kv + listing.
+Functional equivalent of reference weed/filer/filerstore.go:21-44 plus
+the plugin table weed/command/imports.go:17-36. Eight store families
+register in STORES below: embedded (memory here; sqlite and the shared
+SQL mapping in abstract_sql.py; lsm_store.py) and wire-protocol
+(redis_store.py RESP2, etcd_store.py gRPC, mysql_store.py,
+postgres_store.py, mongodb_store.py OP_MSG). New stores implement the
+same five entry ops + kv + listing.
 """
 
 from __future__ import annotations
 
 import abc
 import bisect
-import json
-import sqlite3
 import threading
-from typing import Iterator, Optional
+from typing import Optional
 
 from seaweedfs_tpu.filer.entry import Entry
 
@@ -94,7 +93,10 @@ class MemoryStore(FilerStore):
     def delete_folder_children(self, full_path: str) -> None:
         prefix = full_path.rstrip("/") + "/"
         with self._lock:
-            doomed = [p for p in self._sorted if p.startswith(prefix)]
+            # the folder's own entry survives (for root, "/" itself
+            # matches the "/" prefix and must be excluded)
+            doomed = [p for p in self._sorted
+                      if p.startswith(prefix) and p != full_path]
             for p in doomed:
                 self.delete_entry(p)
 
@@ -134,112 +136,49 @@ class MemoryStore(FilerStore):
         self._kv.pop(key, None)
 
 
-class SqliteStore(FilerStore):
-    name = "sqlite"
-
-    def __init__(self, path: str = ":memory:"):
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.RLock()
-        with self._lock:
-            self._conn.execute(
-                "CREATE TABLE IF NOT EXISTS entries ("
-                "dir TEXT NOT NULL, name TEXT NOT NULL, meta TEXT NOT NULL, "
-                "PRIMARY KEY (dir, name))")
-            self._conn.execute(
-                "CREATE TABLE IF NOT EXISTS kv ("
-                "k BLOB PRIMARY KEY, v BLOB)")
-            self._conn.commit()
-
-    @staticmethod
-    def _split(full_path: str) -> tuple[str, str]:
-        full_path = full_path.rstrip("/") or "/"
-        if full_path == "/":
-            return "", "/"
-        d, _, n = full_path.rpartition("/")
-        return d or "/", n
-
-    def insert_entry(self, entry: Entry) -> None:
-        d, n = self._split(entry.full_path)
-        with self._lock:
-            self._conn.execute(
-                "INSERT OR REPLACE INTO entries (dir, name, meta) "
-                "VALUES (?, ?, ?)", (d, n, json.dumps(entry.to_dict())))
-            self._conn.commit()
-
-    update_entry = insert_entry
-
-    def find_entry(self, full_path: str) -> Optional[Entry]:
-        d, n = self._split(full_path)
-        with self._lock:
-            row = self._conn.execute(
-                "SELECT meta FROM entries WHERE dir=? AND name=?",
-                (d, n)).fetchone()
-        return Entry.from_dict(json.loads(row[0])) if row else None
-
-    def delete_entry(self, full_path: str) -> None:
-        d, n = self._split(full_path)
-        with self._lock:
-            self._conn.execute(
-                "DELETE FROM entries WHERE dir=? AND name=?", (d, n))
-            self._conn.commit()
-
-    def delete_folder_children(self, full_path: str) -> None:
-        base = full_path.rstrip("/")
-        with self._lock:
-            self._conn.execute(
-                "DELETE FROM entries WHERE dir=? OR dir LIKE ?",
-                (base or "/", base + "/%"))
-            self._conn.commit()
-
-    def list_directory_entries(self, dir_path: str, start_name: str = "",
-                               include_start: bool = False,
-                               limit: int = 1024,
-                               prefix: str = "") -> list[Entry]:
-        d = dir_path.rstrip("/") or "/"
-        cmp = ">=" if include_start else ">"
-        q = (f"SELECT meta FROM entries WHERE dir=? AND name {cmp} ? "
-             "AND name LIKE ? ORDER BY name LIMIT ?")
-        with self._lock:
-            rows = self._conn.execute(
-                q, (d, start_name, (prefix or "") + "%", limit)).fetchall()
-        return [Entry.from_dict(json.loads(r[0])) for r in rows]
-
-    def kv_put(self, key: bytes, value: bytes) -> None:
-        with self._lock:
-            self._conn.execute(
-                "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)",
-                (key, value))
-            self._conn.commit()
-
-    def kv_get(self, key: bytes) -> Optional[bytes]:
-        with self._lock:
-            row = self._conn.execute(
-                "SELECT v FROM kv WHERE k=?", (key,)).fetchone()
-        return row[0] if row else None
-
-    def kv_delete(self, key: bytes) -> None:
-        with self._lock:
-            self._conn.execute("DELETE FROM kv WHERE k=?", (key,))
-            self._conn.commit()
-
-    def close(self) -> None:
-        self._conn.close()
+def _lazy(module: str, cls: str):
+    """Import-on-first-use factory so optional store backends (each a
+    wire-protocol client) don't load until requested."""
+    def factory(**kwargs):
+        import importlib
+        return getattr(importlib.import_module(module), cls)(**kwargs)
+    factory.__name__ = cls
+    return factory
 
 
-STORES = {"memory": MemoryStore, "sqlite": SqliteStore}
+# The store registry — the analogue of the reference's blank-import
+# plugin table (weed/command/imports.go:17-36). Eight families:
+# embedded (memory, sqlite, lsm) and wire-protocol (redis RESP2,
+# etcd gRPC, mysql, postgres, mongodb OP_MSG), plus the remote-filer
+# adapter used by gateway mode.
+STORES = {
+    "memory": MemoryStore,
+    "sqlite": _lazy("seaweedfs_tpu.filer.abstract_sql", "SqliteStore"),
+    "lsm": _lazy("seaweedfs_tpu.filer.lsm_store", "LsmStore"),
+    "redis": _lazy("seaweedfs_tpu.filer.redis_store", "RedisFilerStore"),
+    "etcd": _lazy("seaweedfs_tpu.filer.etcd_store", "EtcdFilerStore"),
+    "mysql": _lazy("seaweedfs_tpu.filer.mysql_store", "MysqlFilerStore"),
+    "postgres": _lazy("seaweedfs_tpu.filer.postgres_store",
+                      "PostgresFilerStore"),
+    "mongodb": _lazy("seaweedfs_tpu.filer.mongodb_store",
+                     "MongoFilerStore"),
+    "remote": _lazy("seaweedfs_tpu.filer.remote_store",
+                    "RemoteFilerStore"),
+}
+_ALIASES = {"mongo": "mongodb", "postgres2": "postgres",
+            "mysql2": "mysql", "redis2": "redis"}
+
+
+def __getattr__(name):
+    # SqliteStore lives in abstract_sql (it subclasses the shared SQL
+    # mapping, which itself imports FilerStore from this module); the
+    # lazy re-export keeps `from filerstore import SqliteStore` working
+    # without a circular module-level import.
+    if name == "SqliteStore":
+        from seaweedfs_tpu.filer.abstract_sql import SqliteStore
+        return SqliteStore
+    raise AttributeError(name)
 
 
 def make_store(name: str, **kwargs) -> FilerStore:
-    if name == "lsm":
-        from seaweedfs_tpu.filer.lsm_store import LsmStore
-        return LsmStore(**kwargs)
-    if name == "remote":
-        from seaweedfs_tpu.filer.remote_store import RemoteFilerStore
-        return RemoteFilerStore(**kwargs)
-    if name == "redis":
-        from seaweedfs_tpu.filer.redis_store import RedisFilerStore
-        return RedisFilerStore(**kwargs)
-    if name == "etcd":
-        from seaweedfs_tpu.filer.etcd_store import EtcdFilerStore
-        return EtcdFilerStore(**kwargs)
-    return STORES[name](**kwargs)
+    return STORES[_ALIASES.get(name, name)](**kwargs)
