@@ -43,6 +43,20 @@ def _add_common_volume_args(p):
                         "port+10000")
 
 
+def _start_push(args, *servers):
+    """Attach the push-gateway loop to each server's registry when
+    -metricsAddress is set (reference stats/metrics.go
+    LoopPushingMetric; job name matches the subsystem)."""
+    addr = getattr(args, "metricsAddress", "")
+    if not addr:
+        return
+    for job, srv in servers:
+        reg = getattr(srv, "metrics", None)
+        if reg is not None:
+            reg.start_push(addr, job, srv.url,
+                           getattr(args, "metricsIntervalSec", 15))
+
+
 def cmd_master(args):
     from seaweedfs_tpu.server.master import MasterServer
     ms = MasterServer(host=args.ip, port=args.port,
@@ -51,6 +65,7 @@ def cmd_master(args):
                       meta_dir=args.mdir,
                       grpc_port=args.port + 10000 if args.grpc else None)
     ms.start()
+    _start_push(args, ("master", ms))
     if args.peers:
         ms.set_peers(args.peers.split(","))
     extra = f", grpc {ms.grpc_port}" if ms.grpc_port else ""
@@ -78,6 +93,7 @@ def cmd_volume(args):
                       concurrent_download_limit_mb=args.concurrentDownloadLimitMB,
                       file_size_limit_mb=args.fileSizeLimitMB)
     vs.start()
+    _start_push(args, ("volumeServer", vs))
     tcp = f", tcp {vs.tcp_server.port}" if vs.tcp_server else ""
     g = f", grpc {vs.grpc_port}" if vs.grpc_port else ""
     print(f"volume server listening on {vs.url}{tcp}{g}, "
@@ -125,6 +141,9 @@ def cmd_server(args):
             s3.start()
             print(f"s3 {s3.url}")
             extra.append(s3)
+    _start_push(args, ("master", ms), ("volumeServer", vs),
+                *[("filer" if e.__class__.__name__ == "FilerServer"
+                   else "s3", e) for e in extra])
     _wait_forever()
 
 
@@ -137,6 +156,7 @@ def cmd_filer(args):
                      cipher=args.encryptVolumeData,
                      grpc_port=args.port + 10000 if args.grpc else None)
     fs.start()
+    _start_push(args, ("filer", fs))
     extra = " cipher" if args.encryptVolumeData else ""
     if args.ftp:
         from seaweedfs_tpu.gateway.ftp_server import FtpServer
@@ -589,6 +609,17 @@ def _wait_forever():
 
 def main(argv=None):
     p = argparse.ArgumentParser(prog="weed-tpu")
+    # global logging/metrics surface (reference glog -v/-vmodule flags,
+    # weed.go MaxSize; stats/metrics.go push gateway)
+    p.add_argument("-v", type=int, default=0, dest="verbosity",
+                   help="verbose log level (glog -v)")
+    p.add_argument("-vmodule", default="",
+                   help="per-module verbosity, e.g. volume_server=3")
+    p.add_argument("-logfile", default="",
+                   help="rotating log file (default: stderr only)")
+    p.add_argument("-metricsAddress", default="",
+                   help="Prometheus push gateway host:port")
+    p.add_argument("-metricsIntervalSec", type=int, default=15)
     sub = p.add_subparsers(dest="cmd", required=True)
 
     m = sub.add_parser("master")
@@ -810,6 +841,12 @@ def main(argv=None):
     b.set_defaults(fn=cmd_benchmark)
 
     args = p.parse_args(argv)
+    from seaweedfs_tpu.utils import glog
+    glog.set_verbosity(args.verbosity)
+    if args.vmodule:
+        glog.set_vmodule(args.vmodule)
+    if args.logfile:
+        glog.set_log_file(args.logfile)
     args.fn(args)
 
 

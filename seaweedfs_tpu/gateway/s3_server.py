@@ -34,6 +34,7 @@ from typing import Optional
 
 from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
 from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.utils.httpd import HttpServer, Request, Response
 
 BUCKETS_PATH = "/buckets"
@@ -107,11 +108,20 @@ class S3Server:
         self.breaker = circuit_breaker or CircuitBreaker()
         from seaweedfs_tpu.gateway.iam_server import IdentityStore
         self._identities = IdentityStore(self.filer)
+        # reference stats/metrics.go s3 subsystem: per-action request
+        # counter + latency histogram (action = the S3 operation class)
+        from seaweedfs_tpu.utils.metrics import Registry
+        self.metrics = Registry()
+        self._m_req = self.metrics.counter(
+            "s3", "request_total", "s3 requests", ("action", "bucket"))
+        self._m_lat = self.metrics.histogram(
+            "s3", "request_seconds", "s3 request latency", ("action",))
         self.http = HttpServer(host, port)
         self._register_routes()
 
     def start(self) -> None:
         self.http.start()
+        glog.info("s3 gateway up at %s", self.url)
 
     def stop(self) -> None:
         self.http.stop()
@@ -123,10 +133,18 @@ class S3Server:
     # ---- routing ----
     def _register_routes(self) -> None:
         r = self.http.add
+        # "/-/" is not a legal bucket name (S3 names start with a
+        # letter/digit), so the scrape endpoint can't shadow user data
+        # (the reference uses a separate -metricsPort instead)
+        r("GET", "/-/metrics", self._handle_metrics)
         r("GET", "/", self._list_buckets)
         for m in ("GET", "PUT", "DELETE", "HEAD", "POST"):
             r(m, r"/([^/]+)", self._bucket_dispatch)
             r(m, r"/([^/]+)/(.+)", self._object_dispatch)
+
+    def _handle_metrics(self, req: Request) -> Response:
+        return Response(self.metrics.expose_text(),
+                        content_type="text/plain; version=0.0.4")
 
     # ---- auth (SigV4 subset; static key or IAM identities) ----
     def _secret_for(self, access_key: str) -> Optional[str]:
@@ -241,6 +259,9 @@ class S3Server:
         denied = self._check_auth(req)
         if denied:
             return denied
+        # count only after auth: unauthenticated probes of random
+        # bucket names must not mint unbounded label cardinality
+        self._m_req.inc(f"Bucket{req.method.capitalize()}", bucket)
         if req.method == "PUT":
             self.filer.mkdirs(f"{BUCKETS_PATH}/{bucket}")
             return Response(b"", content_type="application/xml")
@@ -507,11 +528,13 @@ class S3Server:
             return denied
         bucket, key = req.match.group(1), req.match.group(2)
         action = "Read" if req.method in ("GET", "HEAD") else "Write"
+        self._m_req.inc(action, bucket)
         self._refresh_breaker()
         if not self.breaker.acquire(bucket, action):
             return _err("TooManyRequests", "circuit breaker open", 503)
         try:
-            return self._object_dispatch_inner(req, bucket, key)
+            with self._m_lat.time(action):
+                return self._object_dispatch_inner(req, bucket, key)
         finally:
             self.breaker.release(bucket, action)
 

@@ -31,6 +31,7 @@ from seaweedfs_tpu.filer.filechunks import (non_overlapping_visible_intervals,
 from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.filer.filer_conf import FilerConf, PathConf
 from seaweedfs_tpu.filer.filerstore import make_store
+from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call)
 
@@ -105,11 +106,27 @@ class FilerServer:
         self.default_replication = default_replication
         from seaweedfs_tpu.utils.chunk_cache import TieredChunkCache
         self.chunk_cache = TieredChunkCache()
+        # reference stats/metrics.go filer subsystem: request counter +
+        # latency histogram per handler type
+        from seaweedfs_tpu.utils.metrics import Registry
+        self.metrics = Registry()
+        self._m_req = self.metrics.counter(
+            "filer", "request_total", "filer requests", ("type",))
+        self._m_lat = self.metrics.histogram(
+            "filer", "request_seconds", "filer request latency", ("type",))
         self.http = HttpServer(host, port)
+        # metrics ride their own listener (reference filer -metricsPort):
+        # every path on the main port is user namespace, so a /metrics
+        # route there would shadow a stored file of that name
+        self.metrics_http = HttpServer(host, 0)
+        self.metrics_http.add("GET", "/metrics", self._handle_metrics)
         self._register_routes()
 
     def start(self) -> None:
         self.http.start()
+        self.metrics_http.start()
+        glog.info("filer server up at %s (store=%s, metrics=%s)",
+                  self.url, self.filer.store.name, self.metrics_url)
         if self._grpc_port_arg is not None:
             from seaweedfs_tpu.server.filer_grpc import start_filer_grpc
             self._grpc_server, self.grpc_port = start_filer_grpc(
@@ -147,8 +164,9 @@ class FilerServer:
                 http_json("POST",
                           f"http://{self.master_url}/cluster/register",
                           {"type": "filer", "url": self.url}, timeout=5)
-            except Exception:
-                pass
+            except Exception as e:
+                glog.vlog(1, "filer announce to master %s failed: %s",
+                          self.master_url, e)
 
         announce()
         while not self._announce_stop.wait(15.0):
@@ -162,6 +180,7 @@ class FilerServer:
         if self._grpc_server is not None:
             self._grpc_server.stop(0)
         self.http.stop()
+        self.metrics_http.stop()
         # only after the HTTP plane is down: in-flight mutations must
         # not hit a closed notification socket
         if getattr(self, "_notify_queue", None) is not None:
@@ -172,14 +191,18 @@ class FilerServer:
     def url(self) -> str:
         return f"{self.http.host}:{self.http.port}"
 
+    @property
+    def metrics_url(self) -> str:
+        return f"{self.metrics_http.host}:{self.metrics_http.port}"
+
     # ---- chunk GC ----
     def _delete_chunks(self, fids: list[str]) -> None:
         def work():
             for fid in fids:
                 try:
                     operation.delete_file(self.mc, fid)
-                except Exception:
-                    pass
+                except Exception as e:
+                    glog.warning("chunk gc: delete %s failed: %s", fid, e)
         threading.Thread(target=work, daemon=True).start()
 
     # ---- routes ----
@@ -209,10 +232,23 @@ class FilerServer:
         r("POST", "/__api/remote/writeback", self._api_remote_writeback)
         r("POST", "/__api/remote/rm", self._api_remote_rm)
         for method in ("POST", "PUT"):
-            r(method, "/.*", self._signed(self._handle_write))
-        r("GET", "/.*", self._handle_read)
-        r("HEAD", "/.*", self._handle_read)
-        r("DELETE", "/.*", self._signed(self._handle_delete))
+            r(method, "/.*", self._timed(
+                "write", self._signed(self._handle_write)))
+        r("GET", "/.*", self._timed("read", self._handle_read))
+        r("HEAD", "/.*", self._timed("head", self._handle_read))
+        r("DELETE", "/.*", self._timed(
+            "delete", self._signed(self._handle_delete)))
+
+    def _handle_metrics(self, req: Request) -> Response:
+        return Response(self.metrics.expose_text(),
+                        content_type="text/plain; version=0.0.4")
+
+    def _timed(self, kind: str, handler):
+        def wrapped(req: Request) -> Response:
+            self._m_req.inc(kind)
+            with self._m_lat.time(kind):
+                return handler(req)
+        return wrapped
 
     def _signed(self, handler):
         """A replicator identifies its writes with

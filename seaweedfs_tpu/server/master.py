@@ -25,6 +25,7 @@ from seaweedfs_tpu.cluster.topology import Topology
 from seaweedfs_tpu.cluster.volume_growth import (NoFreeSpaceError,
                                                  grow_by_type)
 from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.utils.httpd import (HttpServer, Request, Response,
                                        http_json)
 import random
@@ -50,6 +51,17 @@ class MasterServer:
             "master", "lookup_total", "lookup requests")
         self._m_heartbeat = self.metrics.counter(
             "master", "received_heartbeats", "heartbeats received")
+        # topology gauges refreshed at scrape time (reference
+        # stats/metrics.go MasterVolumeLayout / data-node gauges)
+        self._m_nodes = self.metrics.gauge(
+            "master", "data_nodes", "registered volume servers")
+        self._m_volumes = self.metrics.gauge(
+            "master", "volumes", "volumes known to the topology")
+        self._m_ec_shards = self.metrics.gauge(
+            "master", "ec_shards", "ec shards known to the topology")
+        self._m_is_leader = self.metrics.gauge(
+            "master", "is_leader", "1 when this master leads")
+        self.metrics.on_expose(self._refresh_gauges)
         self.sequencer = MemorySequencer()
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
@@ -87,6 +99,8 @@ class MasterServer:
                 self, self.http.host, self._grpc_port)
         self._pruner = threading.Thread(target=self._prune_loop, daemon=True)
         self._pruner.start()
+        glog.info("master server up at %s (peers=%s)", self.url,
+                  ",".join(self.peers) if self.peers else "-")
 
     def stop(self) -> None:
         self._stop.set()
@@ -122,7 +136,9 @@ class MasterServer:
                     if check.get("garbage_ratio", 0) > self.garbage_threshold:
                         http_json("POST", f"http://{node.url}/admin/vacuum",
                                   {"volume_id": vid}, timeout=600)
-                except Exception:
+                except Exception as e:
+                    glog.warning("auto-vacuum of %d on %s failed: %s",
+                                 vid, node.url, e)
                     continue
 
     def _state_path(self) -> str:
@@ -303,6 +319,17 @@ class MasterServer:
         from seaweedfs_tpu.utils.debug import install_debug_routes
         install_debug_routes(self.http)
 
+    def _refresh_gauges(self) -> None:
+        # runs before every exposition (scrape AND push-gateway loop)
+        with self.topo.lock:
+            nodes = self.topo.all_nodes()
+            self._m_nodes.set(value=len(nodes))
+            self._m_volumes.set(
+                value=sum(len(n.volumes) for n in nodes))
+            self._m_ec_shards.set(
+                value=sum(n.ec_shard_count() for n in nodes))
+        self._m_is_leader.set(value=1.0 if self.is_leader() else 0.0)
+
     def _handle_metrics(self, req: Request) -> Response:
         return Response(self.metrics.expose_text(),
                         content_type="text/plain; version=0.0.4")
@@ -365,8 +392,9 @@ class MasterServer:
                 http_json("POST",
                           f"http://{node.url}/admin/delete_volume",
                           {"volume_id": vid}, timeout=30)
-            except Exception:
-                pass
+            except Exception as e:
+                glog.warning("collection delete: volume %d on %s: %s",
+                             vid, node.url, e)
             deleted.append(vid)
         with self.topo.lock:
             for node, vid, v in doomed:
@@ -509,7 +537,9 @@ class MasterServer:
                       {"volume_id": vid, "collection": collection,
                        "replication": rp, "ttl": ttl,
                        "disk_type": disk})
-        except Exception:
+        except Exception as e:
+            glog.error("volume growth: allocate %d on %s failed: %s",
+                       vid, node.url, e)
             return False
         # register immediately (like the reference's RegisterVolumeLayout
         # after AllocateVolume) instead of waiting for the next heartbeat

@@ -30,6 +30,7 @@ from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.volume import (CookieMismatchError, DeletedError,
                                           NotFoundError)
+from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call, http_json)
 
@@ -104,6 +105,18 @@ class VolumeServer:
             "volumeServer", "request_total", "requests", ("type",))
         self._m_lat = self.metrics.histogram(
             "volumeServer", "request_seconds", "request latency", ("type",))
+        # gauges refreshed at scrape (reference stats/metrics.go
+        # VolumeServerVolumeCounter / disk gauges + disk_supported.go)
+        self._m_volumes = self.metrics.gauge(
+            "volumeServer", "volumes", "mounted volumes")
+        self._m_ec_shards = self.metrics.gauge(
+            "volumeServer", "ec_shards", "mounted ec shards")
+        self._m_bytes = self.metrics.gauge(
+            "volumeServer", "total_disk_size", "bytes across volumes")
+        self._m_disk_free = self.metrics.gauge(
+            "volumeServer", "disk_free_bytes", "statvfs free bytes",
+            ("dir",))
+        self.metrics.on_expose(self._refresh_gauges)
 
     # ---- lifecycle ----
     def start(self) -> None:
@@ -131,6 +144,8 @@ class VolumeServer:
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True)
         self._hb_thread.start()
+        glog.info("volume server up at %s (dirs=%s, master=%s)",
+                  self.url, ",".join(self._store_dirs), self.master_url)
 
     def stop(self) -> None:
         self._stop.set()
@@ -299,6 +314,26 @@ class VolumeServer:
         r("POST", "/admin/ec/blob_delete", self._ec_blob_delete)
         r("GET", "/admin/ec/shard_read", self._ec_shard_read)
         r("GET", "/admin/ec/shard_file", self._ec_shard_file)
+
+    def _refresh_gauges(self) -> None:
+        # runs before every exposition (scrape AND push-gateway loop)
+        import os
+        store = getattr(self, "store", None)
+        if store is None:
+            return
+        hb = store.collect_heartbeat()
+        self._m_volumes.set(value=len(hb.get("volumes", [])))
+        self._m_ec_shards.set(value=sum(
+            bin(e.get("ec_index_bits", 0)).count("1")
+            for e in hb.get("ec_shards", [])))
+        self._m_bytes.set(value=sum(
+            v.get("size", 0) for v in hb.get("volumes", [])))
+        for d in self._store_dirs:
+            try:
+                st = os.statvfs(d)
+                self._m_disk_free.set(d, value=st.f_bavail * st.f_frsize)
+            except OSError:
+                pass
 
     def _handle_metrics(self, req: Request) -> Response:
         return Response(self.metrics.expose_text(),

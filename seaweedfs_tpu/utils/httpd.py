@@ -12,11 +12,14 @@ import json
 import re
 import socket
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
+
+from seaweedfs_tpu.utils import glog
 
 
 class Request:
@@ -130,7 +133,7 @@ class HttpServer:
             disable_nagle_algorithm = True
 
             def log_message(self, *args):
-                pass
+                pass  # request lines are emitted via glog at -v=2
 
             def _dispatch(self):
                 length = int(self.headers.get("Content-Length") or 0)
@@ -162,6 +165,7 @@ class HttpServer:
                         return
                     on_sent = verdict
                 resp = None
+                t0 = time.perf_counter()
                 try:
                     body = self.rfile.read(length) if length else b""
                     for method, pattern, fn in routes:
@@ -172,6 +176,10 @@ class HttpServer:
                             try:
                                 resp = fn(Request(self, m, body))
                             except Exception as e:  # surface as 500 JSON
+                                glog.exception(
+                                    "handler error: %s %s -> %s",
+                                    self.command, path,
+                                    type(e).__name__)
                                 resp = Response(
                                     {"error": f"{type(e).__name__}: {e}"},
                                     status=500)
@@ -179,6 +187,10 @@ class HttpServer:
                     else:
                         resp = Response({"error": "not found"}, status=404)
                     self._send(resp)
+                    glog.vlog(2, "%s %s %d %dB %.1fms",
+                              self.command, self.path, resp.status,
+                              len(resp.body),
+                              (time.perf_counter() - t0) * 1e3)
                 finally:
                     if on_sent is not None:
                         on_sent()

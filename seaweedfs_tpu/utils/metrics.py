@@ -10,6 +10,7 @@ from __future__ import annotations
 import bisect
 import threading
 import time
+import urllib.parse
 from typing import Optional
 
 
@@ -112,7 +113,14 @@ class Registry:
     def __init__(self, namespace: str = "SeaweedFS_TPU"):
         self.namespace = namespace
         self._metrics: list = []
+        self._refreshers: list = []
         self._lock = threading.Lock()
+
+    def on_expose(self, fn) -> None:
+        """Register a hook run before every exposition — servers
+        refresh scrape-time gauges here so the push-gateway loop and
+        /metrics handlers share identical, fresh samples."""
+        self._refreshers.append(fn)
 
     def counter(self, subsystem: str, name: str, help_: str,
                 labels: tuple = ()) -> Counter:
@@ -135,8 +143,45 @@ class Registry:
         return m
 
     def expose_text(self) -> str:
+        for fn in list(self._refreshers):
+            try:
+                fn()
+            except Exception:
+                pass  # a broken refresher must not kill the scrape
         lines = []
         with self._lock:
             for m in self._metrics:
                 lines.extend(m.expose())
         return "\n".join(lines) + "\n"
+
+    # ---- push gateway (reference stats/metrics.go:226-247 LoopPushingMetric:
+    # each process PUTs its whole registry to
+    # {addr}/metrics/job/{job}/instance/{instance} every interval) ----
+    def start_push(self, address: str, job: str, instance: str,
+                   interval_sec: float = 15.0) -> None:
+        if not address:
+            return
+        import urllib.request
+
+        from seaweedfs_tpu.utils import glog
+        self._push_stop = threading.Event()
+        url = (f"http://{address}/metrics/job/{job}"
+               f"/instance/{urllib.parse.quote(instance, safe='')}")
+
+        def loop():
+            while not self._push_stop.wait(interval_sec):
+                try:
+                    req = urllib.request.Request(
+                        url, data=self.expose_text().encode(),
+                        method="PUT",
+                        headers={"Content-Type": "text/plain"})
+                    urllib.request.urlopen(req, timeout=10).read()
+                except Exception as e:
+                    glog.vlog(1, "metrics push to %s failed: %s", url, e)
+
+        self._push_thread = threading.Thread(target=loop, daemon=True)
+        self._push_thread.start()
+
+    def stop_push(self) -> None:
+        if hasattr(self, "_push_stop"):
+            self._push_stop.set()
