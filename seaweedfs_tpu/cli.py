@@ -125,6 +125,7 @@ def cmd_server(args):
     vs.start()
     print(f"master {ms.url}; volume {vs.url}")
     extra = []
+    push_targets = [("master", ms), ("volumeServer", vs)]
     if args.filer:
         from seaweedfs_tpu.server.filer_server import FilerServer
         fs = FilerServer(ms.url, host=args.ip, port=args.filerPort,
@@ -135,15 +136,15 @@ def cmd_server(args):
         print(f"filer {fs.url}"
               + (f" (grpc {fs.grpc_port})" if args.grpc else ""))
         extra.append(fs)
+        push_targets.append(("filer", fs))
         if args.s3:
             from seaweedfs_tpu.gateway.s3_server import S3Server
             s3 = S3Server(fs, host=args.ip, port=args.s3Port)
             s3.start()
             print(f"s3 {s3.url}")
             extra.append(s3)
-    _start_push(args, ("master", ms), ("volumeServer", vs),
-                *[("filer" if e.__class__.__name__ == "FilerServer"
-                   else "s3", e) for e in extra])
+            push_targets.append(("s3", s3))
+    _start_push(args, *push_targets)
     _wait_forever()
 
 

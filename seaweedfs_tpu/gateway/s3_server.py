@@ -117,26 +117,37 @@ class S3Server:
         self._m_lat = self.metrics.histogram(
             "s3", "request_seconds", "s3 request latency", ("action",))
         self.http = HttpServer(host, port)
+        # metrics ride a dedicated listener (reference -metricsPort):
+        # the public port is all bucket namespace (this server does not
+        # validate bucket names, so no path is safely reservable) and
+        # the exposition would leak bucket names/traffic to
+        # unauthenticated clients
+        self.metrics_http = HttpServer(host, 0)
+        self.metrics_http.add("GET", "/metrics", self._handle_metrics)
         self._register_routes()
 
     def start(self) -> None:
         self.http.start()
-        glog.info("s3 gateway up at %s", self.url)
+        self.metrics_http.start()
+        glog.info("s3 gateway up at %s (metrics=%s)", self.url,
+                  self.metrics_url)
 
     def stop(self) -> None:
         self.http.stop()
+        self.metrics_http.stop()
+        self.metrics.stop_push()
 
     @property
     def url(self) -> str:
         return f"{self.http.host}:{self.http.port}"
 
+    @property
+    def metrics_url(self) -> str:
+        return f"{self.metrics_http.host}:{self.metrics_http.port}"
+
     # ---- routing ----
     def _register_routes(self) -> None:
         r = self.http.add
-        # "/-/" is not a legal bucket name (S3 names start with a
-        # letter/digit), so the scrape endpoint can't shadow user data
-        # (the reference uses a separate -metricsPort instead)
-        r("GET", "/-/metrics", self._handle_metrics)
         r("GET", "/", self._list_buckets)
         for m in ("GET", "PUT", "DELETE", "HEAD", "POST"):
             r(m, r"/([^/]+)", self._bucket_dispatch)

@@ -101,6 +101,7 @@ class FileHandle:
         self.attr = entry.attr if entry else Attr()
         self._vis_cache = None  # (key, visibles) for read-path reuse
         self._gen = 0  # bumped whenever chunk lists mutate
+        self._last_read_end = -1  # sequential-read detection (prefetch)
 
     # ---- write ----
     def _ensure_pipeline(self) -> UploadPipeline:
@@ -142,8 +143,15 @@ class FileHandle:
                 buf.extend(b"\x00" * (size - len(buf)))
             else:
                 chunks = self.base_chunks + self.flushed_chunks + uploaded
+                visibles = self._visibles(chunks)
                 buf = self.w._read_chunks_range(
-                    chunks, offset, size, visibles=self._visibles(chunks))
+                    chunks, offset, size, visibles=visibles)
+                if offset == self._last_read_end:
+                    # sequential stream: warm the chunks the next reads
+                    # will want (reference reader_cache.go MaybeCache
+                    # via reader_at.go on consecutive offsets)
+                    self.w._prefetch_ahead(chunks, visibles, offset + size)
+            self._last_read_end = offset + size
             if self.pipeline is not None:
                 self.pipeline.overlay(buf, offset)
             return bytes(buf)
@@ -255,6 +263,23 @@ class WeedFS:
         fc = self.fs._save_chunk(data, logical_offset, "", "")
         fc.mtime_ns = mtime_ns
         return fc
+
+    PREFETCH_BYTES = 2 * 4 * 1024 * 1024  # two default chunks ahead
+
+    def _prefetch_ahead(self, chunks: list[FileChunk], visibles,
+                        from_offset: int) -> None:
+        """Background-warm the chunks covering the next PREFETCH_BYTES
+        of a sequential stream (skips fids already cached/in flight)."""
+        rc = getattr(self.fs, "reader_cache", None)
+        if rc is None:
+            return
+        fids = []
+        for view in view_from_visibles(visibles, from_offset,
+                                       self.PREFETCH_BYTES):
+            if view.fid not in fids:
+                fids.append(view.fid)
+        if fids:
+            rc.maybe_prefetch(fids)
 
     def _read_chunks_range(self, chunks: list[FileChunk], offset: int,
                            size: int, visibles=None) -> bytearray:

@@ -104,8 +104,13 @@ class FilerServer:
         from seaweedfs_tpu.filer.remote_mount import RemoteMounts
         self.remote_mounts = RemoteMounts(self.filer)
         self.default_replication = default_replication
+        from seaweedfs_tpu.filer.reader_cache import ReaderCache
         from seaweedfs_tpu.utils.chunk_cache import TieredChunkCache
         self.chunk_cache = TieredChunkCache()
+        # single-flight + prefetch in front of volume fetches
+        # (reference filer/reader_cache.go backing reader_at.go)
+        self.reader_cache = ReaderCache(self._fetch_chunk_remote,
+                                        self.chunk_cache)
         # reference stats/metrics.go filer subsystem: request counter +
         # latency histogram per handler type
         from seaweedfs_tpu.utils.metrics import Registry
@@ -181,10 +186,12 @@ class FilerServer:
             self._grpc_server.stop(0)
         self.http.stop()
         self.metrics_http.stop()
+        self.metrics.stop_push()
         # only after the HTTP plane is down: in-flight mutations must
         # not hit a closed notification socket
         if getattr(self, "_notify_queue", None) is not None:
             self._notify_queue.close()
+        self.reader_cache.close()
         self.filer.close()
 
     @property
@@ -375,26 +382,25 @@ class FilerServer:
         from seaweedfs_tpu.utils.security import gen_jwt
         return gen_jwt(self._jwt_read_key, fid)
 
+    def _fetch_chunk_remote(self, fid: str) -> bytes:
+        """One real network fetch of a chunk's stored bytes (the
+        ReaderCache guarantees a single flight per fid)."""
+        jwt = self._read_jwt_for(fid)
+        for url in self.mc.lookup_file_id(fid):
+            try:
+                sep = "&" if "?" in url else "?"
+                status, body, _ = http_call(
+                    "GET", url + (f"{sep}jwt={jwt}" if jwt else ""))
+            except ConnectionError:
+                continue
+            if status == 200:
+                return body
+        raise HttpError(500, f"chunk {fid} unreachable".encode())
+
     def _read_chunk_blob(self, fid: str) -> bytes:
         """Raw stored bytes of a chunk (ciphertext when encrypted);
-        cached as stored."""
-        blob = self.chunk_cache.get(fid)
-        if blob is None:
-            jwt = self._read_jwt_for(fid)
-            for url in self.mc.lookup_file_id(fid):
-                try:
-                    sep = "&" if "?" in url else "?"
-                    status, body, _ = http_call(
-                        "GET", url + (f"{sep}jwt={jwt}" if jwt else ""))
-                except ConnectionError:
-                    continue
-                if status == 200:
-                    blob = body
-                    self.chunk_cache.put(fid, blob)
-                    break
-        if blob is None:
-            raise HttpError(500, f"chunk {fid} unreachable".encode())
-        return blob
+        cached as stored, fetched single-flight."""
+        return self.reader_cache.get(fid)
 
     def _read_chunk(self, chunk: FileChunk) -> bytes:
         """Plaintext bytes of a chunk (decrypts with the per-chunk key

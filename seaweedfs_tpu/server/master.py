@@ -104,6 +104,7 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self.metrics.stop_push()
         self._save_state()
         if self.raft is not None:
             self.raft.stop()

@@ -149,6 +149,7 @@ class VolumeServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self.metrics.stop_push()
         if self.tcp_server is not None:
             self.tcp_server.stop()
         if self._grpc_server is not None:

@@ -41,6 +41,12 @@ class MemChunkCache:
             self._data[key] = value
             self._used += len(value)
 
+    def contains(self, key: str) -> bool:
+        """Presence probe that does NOT touch LRU order or counters
+        (prefetch planning must not look like traffic)."""
+        with self._lock:
+            return key in self._data
+
 
 class DiskChunkCache:
     def __init__(self, directory: str,
@@ -119,3 +125,10 @@ class TieredChunkCache:
         self.mem.put(key, value)
         if self.disk is not None and len(value) >= 1024:
             self.disk.put(key, value)
+
+    def contains(self, key: str) -> bool:
+        if self.mem.contains(key):
+            return True
+        if self.disk is not None:
+            return os.path.exists(self.disk._path(key))
+        return False

@@ -29,7 +29,9 @@ class Counter:
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} counter"]
-        for labels, v in sorted(self._values.items()):
+        with self._lock:  # inc() can add a label key mid-scrape
+            items = sorted(self._values.items())
+        for labels, v in items:
             out.append(f"{self.name}{_fmt_labels(self.label_names, labels)} {v}")
         return out
 
@@ -42,7 +44,9 @@ class Gauge(Counter):
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} gauge"]
-        for labels, v in sorted(self._values.items()):
+        with self._lock:
+            items = sorted(self._values.items())
+        for labels, v in items:
             out.append(f"{self.name}{_fmt_labels(self.label_names, labels)} {v}")
         return out
 
@@ -73,7 +77,11 @@ class Histogram:
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
-        for labels, counts in sorted(self._counts.items()):
+        with self._lock:
+            snapshot = sorted(
+                (labels, list(counts), self._sums[labels])
+                for labels, counts in self._counts.items())
+        for labels, counts, total in snapshot:
             cum = 0
             for i, b in enumerate(self.buckets):
                 cum += counts[i]
@@ -84,7 +92,7 @@ class Histogram:
             lbl = _fmt_labels(self.label_names + ("le",), labels + ("+Inf",))
             out.append(f"{self.name}_bucket{lbl} {cum}")
             base = _fmt_labels(self.label_names, labels)
-            out.append(f"{self.name}_sum{base} {self._sums[labels]}")
+            out.append(f"{self.name}_sum{base} {total}")
             out.append(f"{self.name}_count{base} {cum}")
         return out
 

@@ -126,7 +126,10 @@ def test_metrics_on_all_four_servers(stack):
     assert 'SeaweedFS_TPU_filer_request_total{type="write"} 1' in filer_m
     assert 'SeaweedFS_TPU_filer_request_total{type="read"} 1' in filer_m
     assert "SeaweedFS_TPU_filer_request_seconds_bucket" in filer_m
-    s3_m = scrape(f"{s3.url}/-/metrics")
+    # s3 metrics also ride a dedicated listener: the public port is
+    # all unvalidated bucket namespace and the exposition would leak
+    # bucket names to unauthenticated clients
+    s3_m = scrape(f"{s3.metrics_url}/metrics")
     assert ('SeaweedFS_TPU_s3_request_total'
             '{action="Write",bucket="obsbkt"} 1') in s3_m
     assert "SeaweedFS_TPU_s3_request_seconds_count" in s3_m

@@ -1,0 +1,162 @@
+"""ReaderCache: single-flight chunk fetch, prefetch, and the mount/filer
+read paths hitting it (reference weed/filer/reader_cache.go,
+reader_at.go:107-170, util/chunk_cache/)."""
+
+import threading
+import time
+
+from seaweedfs_tpu.filer.reader_cache import ReaderCache
+from seaweedfs_tpu.utils.chunk_cache import MemChunkCache, TieredChunkCache
+
+
+def test_single_flight_coalesces_concurrent_fetches():
+    calls = []
+    gate = threading.Event()
+
+    def slow_fetch(fid):
+        calls.append(fid)
+        gate.wait(5)
+        return b"blob-" + fid.encode()
+
+    rc = ReaderCache(slow_fetch, MemChunkCache())
+    results = []
+    threads = [threading.Thread(
+        target=lambda: results.append(rc.get("3,abc")))
+        for _ in range(8)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)  # let every thread reach the flight table
+    gate.set()
+    for t in threads:
+        t.join(10)
+    assert results == [b"blob-3,abc"] * 8
+    assert calls == ["3,abc"], "network fetch must happen exactly once"
+    assert rc.misses == 1
+    assert rc.joins == 7
+
+
+def test_errors_propagate_to_all_waiters_and_dont_cache():
+    calls = []
+
+    def failing_fetch(fid):
+        calls.append(fid)
+        raise ConnectionError("volume down")
+
+    rc = ReaderCache(failing_fetch, MemChunkCache())
+    for _ in range(2):
+        try:
+            rc.get("1,dead")
+            raise AssertionError("expected ConnectionError")
+        except ConnectionError:
+            pass
+    # a failed fetch is not cached: the second get re-fetches
+    assert calls == ["1,dead", "1,dead"]
+
+
+def test_cache_hits_counted():
+    rc = ReaderCache(lambda fid: b"x" * 100, MemChunkCache())
+    rc.get("1,a")
+    rc.get("1,a")
+    rc.get("1,a")
+    assert rc.misses == 1 and rc.hits == 2
+
+
+def test_prefetch_warms_cache_and_dedupes():
+    fetched = []
+    rc = ReaderCache(lambda fid: fetched.append(fid) or b"d" + fid.encode(),
+                     MemChunkCache())
+    rc.get("1,a")  # already cached -> prefetch must skip it
+    rc.maybe_prefetch(["1,a", "2,b", "3,c"])
+    deadline = time.time() + 5
+    while len(fetched) < 3 and time.time() < deadline:
+        time.sleep(0.02)
+    assert sorted(fetched) == ["1,a", "2,b", "3,c"]
+    assert rc.prefetches == 2  # 1,a skipped (already cached)
+    # the foreground read of a prefetched chunk is a pure cache hit
+    before = rc.misses
+    assert rc.get("2,b") == b"d2,b"
+    assert rc.misses == before
+    rc.close()
+
+
+def test_tiered_contains_does_not_disturb_counters(tmp_path):
+    cache = TieredChunkCache(disk_dir=str(tmp_path / "d"))
+    cache.put("k", b"v" * 2048)
+    h, m = cache.mem.hits, cache.mem.misses
+    assert cache.contains("k")
+    assert not cache.contains("nope")
+    assert (cache.mem.hits, cache.mem.misses) == (h, m)
+
+
+def _stack(tmp_path):
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    ms = MasterServer(volume_size_limit_mb=64)
+    ms.start()
+    vs = VolumeServer([str(tmp_path / "v")], ms.url)
+    vs.start()
+    time.sleep(0.3)
+    fs = FilerServer(ms.url)
+    fs.start()
+    return ms, vs, fs
+
+
+def test_filer_repeated_reads_hit_reader_cache(tmp_path):
+    import urllib.request
+
+    from seaweedfs_tpu.utils.httpd import http_call
+    ms, vs, fs = _stack(tmp_path)
+    try:
+        body = bytes(range(256)) * 64  # 16KB, chunked (above inline)
+        status, _, _ = http_call("POST", f"http://{fs.url}/rc/f.bin",
+                                 body=body)
+        assert status < 300
+        for _ in range(3):
+            got = urllib.request.urlopen(
+                f"http://{fs.url}/rc/f.bin").read()
+            assert got == body
+        rc = fs.reader_cache
+        assert rc.misses >= 1
+        assert rc.hits >= 2 * rc.misses, \
+            f"repeated reads missed: hits={rc.hits} misses={rc.misses}"
+    finally:
+        fs.stop()
+        vs.stop()
+        ms.stop()
+
+
+def test_mount_sequential_read_prefetches_and_hits_cache(tmp_path):
+    from seaweedfs_tpu.mount.weedfs import ROOT_ID, WeedFS
+    ms, vs, fs = _stack(tmp_path)
+    try:
+        # small chunks so one file spans many
+        w = WeedFS(fs, swap_dir=str(tmp_path), chunk_size=8 * 1024)
+        payload = bytes([i % 251 for i in range(64 * 1024)])
+        attr, fh = w.create(ROOT_ID, "seq.bin", 0o644)
+        assert w.write(attr.ino, fh, 0, payload) == len(payload)
+        w.release(attr.ino, fh)
+
+        rc = fs.reader_cache
+        got = w.lookup(ROOT_ID, "seq.bin")
+        assert got.size == len(payload)
+        base_pref = rc.prefetches
+        fh = w.open(got.ino)
+        out = bytearray()
+        for off in range(0, len(payload), 16 * 1024):  # sequential
+            out += w.read(got.ino, fh, off, 16 * 1024)
+        w.release(got.ino, fh)
+        assert bytes(out) == payload
+        assert rc.prefetches > base_pref, "no prefetch was issued"
+        # re-stream through a fresh handle: chunks come from cache
+        time.sleep(0.3)  # let background prefetches settle
+        before_miss = rc.misses
+        fh = w.open(got.ino)
+        for off in range(0, len(payload), 16 * 1024):
+            w.read(got.ino, fh, off, 16 * 1024)
+        w.release(got.ino, fh)
+        assert rc.misses == before_miss, "second stream re-fetched"
+    finally:
+        fs.stop()
+        vs.stop()
+        ms.stop()
