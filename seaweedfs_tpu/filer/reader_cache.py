@@ -75,7 +75,11 @@ class ReaderCache:
             return fl.value
         # join the in-flight download instead of fetching again
         if not fl.event.wait(timeout=60.0):
-            return self.fetch(fid)  # leader wedged: fetch independently
+            # leader wedged: fetch independently, but park the result so
+            # simultaneous timed-out waiters don't keep re-fetching
+            value = self.fetch(fid)
+            self.cache.put(fid, value)
+            return value
         if fl.err is not None:
             raise fl.err
         return fl.value

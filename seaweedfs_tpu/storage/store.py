@@ -323,25 +323,53 @@ class Store:
         # degraded: fetch the same range of >= k other shards and reconstruct
         return self._recover_one_interval(ev, iv, shard_id)
 
+
     def _recover_one_interval(self, ev: EcVolume, iv: layout.Interval,
                               wanted_shard: int) -> bytes:
+        """Degraded read: collect >= k sibling-shard ranges and
+        reconstruct. Local shards read inline; remote peers are fetched
+        CONCURRENTLY with first-k-wins — one slow peer must not
+        serialize recovery (reference store_ec.go:328-382 fans out a
+        goroutine per source shard the same way)."""
         k = self.coder.scheme.data_shards
         total = self.coder.scheme.total_shards
         shard_off = iv.to_shard_id_and_offset()[1]
         bufs: dict[int, bytes] = {}
+        remote_sids: list[int] = []
         for sid in range(total):
             if sid == wanted_shard:
                 continue
             local = ev.shards.get(sid)
             if local is not None:
                 bufs[sid] = local.read_at(shard_off, iv.size)
+                if len(bufs) >= k:
+                    break
             elif self.remote_shard_reader is not None:
-                got = self.remote_shard_reader(ev.volume_id, sid, shard_off,
-                                               iv.size)
-                if got is not None and len(got) == iv.size:
-                    bufs[sid] = got
-            if len(bufs) >= k:
-                break
+                remote_sids.append(sid)
+        if len(bufs) < k and remote_sids:
+            from concurrent.futures import ThreadPoolExecutor, as_completed
+            # one worker per candidate (<= 13), like the reference's
+            # goroutine-per-source-shard: a smaller bound would let
+            # `bound` wedged peers re-serialize recovery
+            pool = ThreadPoolExecutor(
+                max_workers=len(remote_sids),
+                thread_name_prefix="ec-recover")
+            try:
+                futs = {pool.submit(self.remote_shard_reader,
+                                    ev.volume_id, sid, shard_off,
+                                    iv.size): sid
+                        for sid in remote_sids}
+                for fut in as_completed(futs):
+                    try:
+                        got = fut.result()
+                    except Exception:
+                        continue
+                    if got is not None and len(got) == iv.size:
+                        bufs[futs[fut]] = got
+                        if len(bufs) >= k:
+                            break  # stragglers are abandoned
+            finally:
+                pool.shutdown(wait=False, cancel_futures=True)
         if len(bufs) < k:
             raise NotFoundError(
                 f"ec volume {ev.volume_id}: only {len(bufs)} shards "

@@ -68,6 +68,9 @@ class DiskChunkCache:
         except FileNotFoundError:
             return None
 
+    def contains(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
     def put(self, key: str, value: bytes) -> None:
         p = self._path(key)
         os.makedirs(os.path.dirname(p), exist_ok=True)
@@ -129,6 +132,4 @@ class TieredChunkCache:
     def contains(self, key: str) -> bool:
         if self.mem.contains(key):
             return True
-        if self.disk is not None:
-            return os.path.exists(self.disk._path(key))
-        return False
+        return self.disk is not None and self.disk.contains(key)

@@ -122,3 +122,56 @@ def test_store_heartbeat(tmp_path):
     assert len(deltas["new_volumes"]) == 1
     assert store.drain_deltas()["new_volumes"] == []
     store.close()
+
+
+def test_degraded_recovery_parallel_survives_slow_peer(tmp_path):
+    """Recovery fans out peer-shard fetches concurrently with
+    first-k-wins (reference store_ec.go:328-382): one wedged peer must
+    not serialize — or block — the read when enough fast shards exist."""
+    import threading
+    import time
+
+    a = Store([str(tmp_path / "a")], coder=make_coder("cpu"))
+    b_dir = tmp_path / "b"
+    b_dir.mkdir()
+    payloads = _fill_volume(a, 3, n_files=4, seed=7)
+    base = a.generate_ec_shards(3)
+    a.delete_volume(3)
+
+    import shutil
+    # the volume is tiny, so every needle's data lives in shard 0:
+    # delete shard 0 outright (recovery is the only path), keep shard
+    # 13 local on A, spread 1..12 across the "network" on B
+    os.remove(base + layout.shard_ext(0))
+    for sid in range(1, 13):
+        shutil.move(base + layout.shard_ext(sid),
+                    str(b_dir / f"3{layout.shard_ext(sid)}"))
+    shutil.copy(base + ".ecx", str(b_dir / "3.ecx"))
+    b = Store([str(b_dir)], coder=make_coder("cpu"))
+    b.mount_ec_shards("", 3, list(range(1, 13)))
+    a.mount_ec_shards("", 3, [13])
+
+    SLOW = {1, 2}  # two wedged peers; local 13 + fast 3..12 >= k=10
+    in_flight = []
+
+    def remote_reader(vid, shard_id, offset, size):
+        in_flight.append(shard_id)
+        if shard_id in SLOW:
+            time.sleep(8.0)
+            return None
+        ev = b.find_ec_volume(vid)
+        if ev is None or shard_id not in ev.shards:
+            return None
+        return ev.shards[shard_id].read_at(offset, size)
+
+    a.remote_shard_reader = remote_reader
+    t0 = time.perf_counter()
+    for nid, data in payloads.items():
+        n = a.read_ec_shard_needle(3, nid)
+        assert n.data == data, f"needle {nid}"
+    elapsed = time.perf_counter() - t0
+    # sequential fetching would block 8s on the first slow peer before
+    # trying the rest; the parallel fan-out completes on the fast ones
+    assert elapsed < 6.0, f"slow peer serialized recovery: {elapsed:.1f}s"
+    a.close()
+    b.close()
