@@ -560,6 +560,8 @@ class S3Server:
                 return self._complete_multipart(req, bucket, key)
             if req.method == "DELETE":
                 return self._abort_multipart(req, bucket, key)
+            if req.method == "GET":
+                return self._list_parts(req, bucket, key)
         if "tagging" in req.query:
             return self._object_tagging(req, bucket, key)
         if "acl" in req.query and req.method == "GET":
@@ -580,11 +582,18 @@ class S3Server:
                     "Last-Modified": _http_date(entry.attr.mtime),
                 })
             data = self.fs._read_entry_bytes(entry)
-            rng = req.headers.get("Range")
-            if rng and rng.startswith("bytes="):
-                lo_s, _, hi_s = rng[6:].partition("-")
-                lo = int(lo_s or 0)
-                hi = int(hi_s) if hi_s else len(data) - 1
+            from seaweedfs_tpu.utils.httpd import (RangeNotSatisfiable,
+                                                   parse_byte_range)
+            try:
+                rng = parse_byte_range(req.headers.get("Range", ""),
+                                       len(data))
+            except RangeNotSatisfiable:
+                resp = _err("InvalidRange",
+                            "the requested range is not satisfiable", 416)
+                resp.headers["Content-Range"] = f"bytes */{len(data)}"
+                return resp
+            if rng is not None:
+                lo, hi = rng
                 piece = data[lo:hi + 1]
                 return Response(piece, status=206,
                                 content_type=entry.attr.mime
@@ -833,6 +842,55 @@ class S3Server:
         ET.SubElement(root, "Bucket").text = bucket
         ET.SubElement(root, "Key").text = key
         ET.SubElement(root, "ETag").text = f'"{etag}"'
+        return Response(_xml(root), content_type="application/xml")
+
+    def _list_parts(self, req: Request, bucket: str,
+                    key: str) -> Response:
+        """ListParts (reference s3api_object_multipart_handlers.go
+        ListObjectPartsHandler): the uploaded parts of one in-progress
+        multipart upload."""
+        upload_id = req.query["uploadId"]
+        dirp = f"{UPLOADS_PATH}/{upload_id}"
+        meta = self.filer.find_entry(f"{dirp}/.meta")
+        if meta is None or meta.extended.get("bucket") != bucket \
+                or meta.extended.get("key") != key:
+            # AWS answers NoSuchUpload when the id belongs to a
+            # different bucket/key — never another upload's part list
+            return _err("NoSuchUpload", upload_id, 404)
+        max_parts = int(req.query.get("max-parts", 1000))
+        marker = int(req.query.get("part-number-marker", 0))
+        parts = sorted(
+            (e for e in self.filer.list_entries(dirp, limit=100000)
+             if e.name.endswith(".part")), key=lambda e: e.name)
+        root = ET.Element("ListPartsResult")
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "UploadId").text = upload_id
+        ET.SubElement(root, "PartNumberMarker").text = str(marker)
+        ET.SubElement(root, "MaxParts").text = str(max_parts)
+        shown = 0
+        last_num = marker
+        truncated = False
+        for p in parts:
+            num = int(p.name.split(".")[0])
+            if num <= marker:
+                continue
+            if shown >= max_parts:
+                truncated = True
+                break
+            el = ET.SubElement(root, "Part")
+            ET.SubElement(el, "PartNumber").text = str(num)
+            ET.SubElement(el, "Size").text = str(p.file_size())
+            ET.SubElement(el, "ETag").text = f'"{p.attr.md5.hex()}"'
+            ET.SubElement(el, "LastModified").text = \
+                _http_date(p.attr.mtime)
+            shown += 1
+            last_num = num
+        ET.SubElement(root, "IsTruncated").text = \
+            "true" if truncated else "false"
+        if truncated:
+            ET.SubElement(root, "NextPartNumberMarker").text = \
+                str(last_num)
         return Response(_xml(root), content_type="application/xml")
 
     def _abort_multipart(self, req: Request, bucket: str,

@@ -545,11 +545,17 @@ class VolumeServer:
                 return Response(b"", status=404, content_type="text/plain")
         mime = (n.mime.decode(errors="replace")
                 if n.mime else "application/octet-stream")
-        rng_hdr = req.headers.get("Range", "")
-        if rng_hdr.startswith("bytes="):
-            lo_s, _, hi_s = rng_hdr[6:].partition("-")
-            lo = int(lo_s or 0)
-            hi = int(hi_s) if hi_s else len(n.data) - 1
+        from seaweedfs_tpu.utils.httpd import (RangeNotSatisfiable,
+                                               parse_byte_range)
+        try:
+            rng = parse_byte_range(req.headers.get("Range", ""),
+                                   len(n.data))
+        except RangeNotSatisfiable:
+            headers["Content-Range"] = f"bytes */{len(n.data)}"
+            return Response(b"", status=416, content_type=mime,
+                            headers=headers)
+        if rng is not None:
+            lo, hi = rng
             piece = n.data[lo:hi + 1]
             headers["Content-Range"] = f"bytes {lo}-{hi}/{len(n.data)}"
             return Response(piece, status=206, content_type=mime,

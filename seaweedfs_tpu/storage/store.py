@@ -324,6 +324,19 @@ class Store:
         return self._recover_one_interval(ev, iv, shard_id)
 
 
+    RECOVER_POOL_WORKERS = 32  # > 2x total shards: room for concurrent
+    #                            degraded reads even with wedged peers
+
+    def _recover_pool(self):
+        pool = getattr(self, "_recover_pool_obj", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            pool = ThreadPoolExecutor(
+                max_workers=self.RECOVER_POOL_WORKERS,
+                thread_name_prefix="ec-recover")
+            self._recover_pool_obj = pool
+        return pool
+
     def _recover_one_interval(self, ev: EcVolume, iv: layout.Interval,
                               wanted_shard: int) -> bytes:
         """Degraded read: collect >= k sibling-shard ranges and
@@ -347,29 +360,28 @@ class Store:
             elif self.remote_shard_reader is not None:
                 remote_sids.append(sid)
         if len(bufs) < k and remote_sids:
-            from concurrent.futures import ThreadPoolExecutor, as_completed
-            # one worker per candidate (<= 13), like the reference's
-            # goroutine-per-source-shard: a smaller bound would let
-            # `bound` wedged peers re-serialize recovery
-            pool = ThreadPoolExecutor(
-                max_workers=len(remote_sids),
-                thread_name_prefix="ec-recover")
-            try:
-                futs = {pool.submit(self.remote_shard_reader,
-                                    ev.volume_id, sid, shard_off,
-                                    iv.size): sid
-                        for sid in remote_sids}
-                for fut in as_completed(futs):
-                    try:
-                        got = fut.result()
-                    except Exception:
-                        continue
-                    if got is not None and len(got) == iv.size:
-                        bufs[futs[fut]] = got
-                        if len(bufs) >= k:
-                            break  # stragglers are abandoned
-            finally:
-                pool.shutdown(wait=False, cancel_futures=True)
+            from concurrent.futures import as_completed
+            # shared bounded pool: per-read fan-out (like the
+            # reference's goroutine-per-source-shard) without letting a
+            # wedged peer accumulate unbounded abandoned threads across
+            # many degraded reads — stragglers occupy pool slots until
+            # their own network timeout, which is the backpressure
+            pool = self._recover_pool()
+            futs = {pool.submit(self.remote_shard_reader,
+                                ev.volume_id, sid, shard_off,
+                                iv.size): sid
+                    for sid in remote_sids}
+            for fut in as_completed(futs):
+                try:
+                    got = fut.result()
+                except Exception:
+                    continue
+                if got is not None and len(got) == iv.size:
+                    bufs[futs[fut]] = got
+                    if len(bufs) >= k:
+                        break  # stragglers are abandoned
+            for fut in futs:
+                fut.cancel()  # drop the ones still queued
         if len(bufs) < k:
             raise NotFoundError(
                 f"ec volume {ev.volume_id}: only {len(bufs)} shards "
@@ -454,5 +466,9 @@ class Store:
             return out
 
     def close(self) -> None:
+        pool = getattr(self, "_recover_pool_obj", None)
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+            self._recover_pool_obj = None
         for loc in self.locations:
             loc.close()

@@ -278,6 +278,41 @@ class HttpServer:
             self._httpd = None
 
 
+class RangeNotSatisfiable(Exception):
+    """Raise-to-416: the range is well-formed but outside the entity
+    (RFC 7233 §4.4; S3 answers InvalidRange). Callers respond 416 with
+    'Content-Range: bytes */<total>' — serving a 200 full body instead
+    would corrupt resuming downloaders that append the response."""
+
+
+def parse_byte_range(spec: str, total: int) -> Optional[tuple[int, int]]:
+    """RFC 7233 single-range parse: 'bytes=a-b' / 'bytes=a-' /
+    'bytes=-n' (suffix: the LAST n bytes). Returns (lo, hi) inclusive;
+    None when no/malformed range (serve the full body, per RFC);
+    raises RangeNotSatisfiable when lo lies beyond the entity."""
+    if not spec or not spec.startswith("bytes="):
+        return None
+    lo_s, _, hi_s = spec[6:].partition("-")
+    try:
+        if not lo_s:  # suffix form
+            n = int(hi_s)
+            if n <= 0:
+                return None
+            return max(0, total - n), total - 1
+        lo = int(lo_s)
+        hi = int(hi_s) if hi_s else total - 1
+    except ValueError:
+        return None
+    if lo >= total:
+        # beyond EOF — includes the open-ended 'bytes=<past-end>-'
+        # form, whose default hi (total-1) is < lo and must not be
+        # mistaken for a malformed spec
+        raise RangeNotSatisfiable(spec)
+    if hi < lo:
+        return None
+    return lo, min(hi, total - 1)
+
+
 class HttpError(Exception):
     def __init__(self, status: int, body: bytes):
         self.status = status

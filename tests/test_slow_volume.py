@@ -46,14 +46,19 @@ def test_100mb_volume_ec_lifecycle(tmp_path):
 
     base = os.path.join(d, "9")
     dat_size = os.path.getsize(base + ".dat")
-    t0 = time.perf_counter()
-    encoder.write_ec_files(base)
-    dt = time.perf_counter() - t0
-    mb_s = dat_size / dt / 1e6
     # loose floor: the native CPU pipeline measures >1 GB/s on this
-    # class of hardware (PERF.md); 60 MB/s catches a broken fast path
-    # without flaking on loaded CI
-    assert mb_s > 60, f"e2e encode regressed to {mb_s:.0f} MB/s"
+    # class of hardware (PERF.md); 60 MB/s catches a broken fast path.
+    # Best-of-3: a single timing on the shared 1-vCPU CI box flakes
+    # when the rest of the suite's servers steal the core mid-encode.
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        encoder.write_ec_files(base)
+        dt = time.perf_counter() - t0
+        best = max(best, dat_size / dt / 1e6)
+        if best > 60:
+            break
+    assert best > 60, f"e2e encode regressed to {best:.0f} MB/s"
 
     encoder.write_sorted_ecx(base)
     shard_size = os.path.getsize(base + layout.shard_ext(0))
