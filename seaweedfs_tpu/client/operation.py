@@ -30,7 +30,11 @@ def upload_data(mc: MasterClient, data: bytes, name: str = "",
                 collection: str = "", replication: str = "",
                 ttl: str = "", mime: str = "",
                 compress: bool = False) -> UploadResult:
-    a = mc.assign(collection=collection, replication=replication, ttl=ttl)
+    # batched assigns: one master round trip mints a pool of keys, so
+    # the hot path is a single volume-server POST per file (reference
+    # clients amortize the assign plane the same way via gRPC)
+    a = mc.assign_batched(collection=collection, replication=replication,
+                          ttl=ttl)
     if "error" in a and a["error"]:
         raise RuntimeError(a["error"])
     fid, url = a["fid"], a["url"]

@@ -99,6 +99,8 @@ class VolumeServer:
         self.download_limiter = InFlightLimiter(
             concurrent_download_limit_mb * 1024 * 1024, inflight_timeout)
         self.http.body_gate = self._upload_gate
+        # vid -> (expires_monotonic, [peer urls]) for replica fan-out
+        self._replica_cache: dict[int, tuple[float, list]] = {}
         from seaweedfs_tpu.utils.metrics import Registry
         self.metrics = Registry()
         self._m_req = self.metrics.counter(
@@ -588,19 +590,40 @@ class VolumeServer:
                 return Response({"error": err}, status=500)
         return Response({"size": size}, status=202)
 
-    def _replicate(self, req: Request, op: str) -> Optional[str]:
-        """Synchronous fan-out to the other replicas
-        (reference topology/store_replicate.go:58-110)."""
-        vid = int(req.match.group(1))
+    REPLICA_CACHE_TTL = 5.0  # matches the freshest vidMap tier
+
+    def _replica_peers(self, vid: int) -> list[str]:
+        """Peer replica urls for a volume, with a short-TTL cache — a
+        master /dir/lookup per write would cost more than the write
+        itself (the reference's writers resolve replicas through the
+        wdclient vidMap cache the same way)."""
+        import time as _time
+        now = _time.monotonic()
+        cached = self._replica_cache.get(vid)
+        if cached is not None and cached[0] > now:
+            return cached[1]
         try:
             locs = http_json(
                 "GET",
                 f"http://{self.master_url}/dir/lookup?volumeId={vid}",
                 timeout=5)
         except (ConnectionError, HttpError):
-            return None  # nobody to replicate to (not registered yet)
+            return []  # nobody to replicate to (not registered yet)
         others = [l["url"] for l in locs.get("locations", [])
                   if l["url"] != self.url]
+        self._replica_cache[vid] = (now + self.REPLICA_CACHE_TTL, others)
+        return others
+
+    def _replicate(self, req: Request, op: str) -> Optional[str]:
+        """Synchronous fan-out to the other replicas
+        (reference topology/store_replicate.go:58-110)."""
+        vid = int(req.match.group(1))
+        vol = self.store.find_volume(vid)
+        if vol is not None and \
+                vol.super_block.replica_placement.to_byte() == 0:
+            # single-copy volume: no peers can exist, skip the lookup
+            return None
+        others = self._replica_peers(vid)
         qs = "&".join(f"{k}={v}" for k, v in req.query.items()
                       if k != "type")
         sep = "&" if qs else ""

@@ -327,14 +327,20 @@ class Store:
     RECOVER_POOL_WORKERS = 32  # > 2x total shards: room for concurrent
     #                            degraded reads even with wedged peers
 
+    _recover_pool_init_lock = threading.Lock()  # class-wide is fine:
+    #                                             held only at first use
+
     def _recover_pool(self):
         pool = getattr(self, "_recover_pool_obj", None)
         if pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-            pool = ThreadPoolExecutor(
-                max_workers=self.RECOVER_POOL_WORKERS,
-                thread_name_prefix="ec-recover")
-            self._recover_pool_obj = pool
+            with self._recover_pool_init_lock:
+                pool = getattr(self, "_recover_pool_obj", None)
+                if pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+                    pool = ThreadPoolExecutor(
+                        max_workers=self.RECOVER_POOL_WORKERS,
+                        thread_name_prefix="ec-recover")
+                    self._recover_pool_obj = pool
         return pool
 
     def _recover_one_interval(self, ev: EcVolume, iv: layout.Interval,

@@ -87,6 +87,42 @@ class Response:
             self.content_type = content_type
 
 
+class HeaderDict:
+    """Case-insensitive header mapping that preserves wire-case keys —
+    a lean stand-in for email.message.Message on the hot path (the
+    stdlib parse_headers routes every message through the full email
+    parser, which costs more than our entire dispatch)."""
+
+    __slots__ = ("_d",)
+
+    def __init__(self):
+        self._d: dict[str, tuple[str, str]] = {}
+
+    def add(self, key: str, value: str) -> None:
+        lk = key.lower()
+        old = self._d.get(lk)
+        if old is not None:  # duplicate header: RFC 7230 comma-join
+            self._d[lk] = (old[0], old[1] + ", " + value)
+        else:
+            self._d[lk] = (key, value)
+
+    def get(self, key: str, default=None):
+        hit = self._d.get(key.lower())
+        return hit[1] if hit is not None else default
+
+    def __getitem__(self, key: str) -> str:
+        return self._d[key.lower()][1]
+
+    def __contains__(self, key) -> bool:
+        return str(key).lower() in self._d
+
+    def items(self):
+        return list(self._d.values())
+
+    def __iter__(self):
+        return iter(k for k, _ in self._d.values())
+
+
 Route = tuple[str, re.Pattern, Callable[[Request], Response]]
 
 
@@ -134,6 +170,59 @@ class HttpServer:
 
             def log_message(self, *args):
                 pass  # request lines are emitted via glog at -v=2
+
+            def parse_request(self) -> bool:
+                """Minimal HTTP/1.1 request parse replacing the stdlib
+                email-parser path (which dominates per-request CPU on
+                the 1KB data path). Sets the same attributes the base
+                class would: command/path/request_version/headers/
+                close_connection, incl. Expect: 100-continue."""
+                self.command = None
+                self.request_version = version = "HTTP/0.9"
+                self.close_connection = True
+                raw = str(self.raw_requestline, "latin-1").rstrip("\r\n")
+                self.requestline = raw
+                parts = raw.split()
+                if len(parts) == 3:
+                    command, path, version = parts
+                    if not version.startswith("HTTP/"):
+                        self.send_error(400,
+                                        f"Bad request version {version!r}")
+                        return False
+                elif len(parts) == 2:
+                    command, path = parts
+                else:
+                    self.send_error(400, f"Bad request syntax {raw!r}")
+                    return False
+                self.command, self.path = command, path
+                self.request_version = version
+                headers = HeaderDict()
+                n_headers = 0
+                while True:
+                    line = self.rfile.readline(65537)
+                    if len(line) > 65536:
+                        self.send_error(431, "header line too long")
+                        return False
+                    if line in (b"\r\n", b"\n", b"", b"\r"):
+                        break
+                    n_headers += 1
+                    if n_headers > 100:  # stdlib _MAXHEADERS parity
+                        self.send_error(431, "too many headers")
+                        return False
+                    k, sep, v = line.decode("latin-1").partition(":")
+                    if sep:
+                        headers.add(k.strip(), v.strip())
+                self.headers = headers
+                conn = (headers.get("Connection") or "").lower()
+                if version >= "HTTP/1.1":
+                    self.close_connection = conn == "close"
+                else:
+                    self.close_connection = conn != "keep-alive"
+                if version >= "HTTP/1.1" and \
+                        headers.get("Expect", "").lower() == "100-continue":
+                    if not self.handle_expect_100():
+                        return False
+                return True
 
             def _dispatch(self):
                 length = int(self.headers.get("Content-Length") or 0)
@@ -298,6 +387,9 @@ def parse_byte_range(spec: str, total: int) -> Optional[tuple[int, int]]:
             n = int(hi_s)
             if n <= 0:
                 return None
+            if total == 0:
+                # no last-N bytes of an empty entity (AWS: 416)
+                raise RangeNotSatisfiable(spec)
             return max(0, total - n), total - 1
         lo = int(lo_s)
         hi = int(hi_s) if hi_s else total - 1
@@ -329,16 +421,122 @@ class HttpError(Exception):
 _conn_local = threading.local()
 
 
-def _make_conn(netloc: str, timeout: float):
-    import http.client
+class RawHttpConnection:
+    """Minimal pooled HTTP/1.1 client connection. Replaces
+    http.client on the hot data path: no email-parser response
+    headers, no per-response makefile, one buffered reader for the
+    connection's lifetime. Handles Content-Length, chunked and
+    read-to-close bodies, keep-alive, and 1xx skipping."""
 
-    class NoDelayConn(http.client.HTTPConnection):
-        def connect(self):
-            super().connect()
-            self.sock.setsockopt(socket.IPPROTO_TCP,
-                                 socket.TCP_NODELAY, 1)
+    def __init__(self, netloc: str, timeout: float):
+        self.netloc = netloc
+        host, port = netloc, 80
+        if netloc.startswith("["):  # IPv6 literal [::1]:8080
+            host, _, rest = netloc[1:].partition("]")
+            if rest.startswith(":"):
+                port = int(rest[1:])
+        elif ":" in netloc:
+            host, _, p = netloc.rpartition(":")
+            port = int(p)
+        self.sock = socket.create_connection((host or "127.0.0.1", port),
+                                             timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._rfile = self.sock.makefile("rb", buffering=65536)
 
-    return NoDelayConn(netloc, timeout=timeout)
+    def close(self) -> None:
+        sock, self.sock = self.sock, None
+        if sock is None:
+            return  # already closed
+        for closer in (self._rfile.close, sock.close):
+            try:
+                closer()
+            except OSError:
+                pass
+
+    def _read_exact(self, n: int) -> bytes:
+        data = self._rfile.read(n)
+        if data is None or len(data) < n:
+            raise ConnectionError("short HTTP body")
+        return data
+
+    def _read_chunked(self) -> bytes:
+        out = bytearray()
+        while True:
+            size_line = self._rfile.readline(1026)
+            if not size_line:
+                raise ConnectionError("EOF in chunked body")
+            n = int(size_line.split(b";")[0].strip() or b"0", 16)
+            if n == 0:
+                while self._rfile.readline(65537) not in (b"\r\n", b"\n",
+                                                          b""):
+                    pass  # discard trailers
+                return bytes(out)
+            out += self._read_exact(n)
+            self._rfile.readline(3)  # chunk CRLF
+
+    def send_request(self, method: str, target: str,
+                     body: Optional[bytes],
+                     headers: Optional[dict]) -> None:
+        buf = [f"{method} {target} HTTP/1.1\r\n"]
+        has_len = has_host = False
+        for k, v in (headers or {}).items():
+            lk = k.lower()
+            if lk == "content-length":
+                has_len = True
+            elif lk == "host":
+                has_host = True  # caller-set (SigV4 signs it): no dup
+            buf.append(f"{k}: {v}\r\n")
+        if not has_host:
+            buf.append(f"Host: {self.netloc}\r\n")
+        if not has_len and (body or method not in ("GET", "HEAD")):
+            buf.append(f"Content-Length: {len(body or b'')}\r\n")
+        buf.append("\r\n")
+        msg = "".join(buf).encode("latin-1")
+        self.sock.sendall(msg + body if body else msg)
+
+    def read_response(self, method: str) -> tuple[int, bytes, dict, bool]:
+        """Returns (status, body, headers, will_close)."""
+        while True:  # skip 1xx interim responses
+            line = self._rfile.readline(65537)
+            if not line:
+                raise ConnectionError("no HTTP status line")
+            parts = line.decode("latin-1").split(None, 2)
+            if len(parts) < 2 or not parts[0].startswith("HTTP/"):
+                raise ConnectionError(f"bad status line {line!r}")
+            version, status = parts[0], int(parts[1])
+            resp = HeaderDict()
+            n_headers = 0
+            while True:
+                hl = self._rfile.readline(65537)
+                if hl in (b"\r\n", b"\n", b""):
+                    break
+                n_headers += 1
+                if n_headers > 100:  # stdlib _MAXHEADERS parity
+                    raise ConnectionError("too many response headers")
+                k, sep, v = hl.decode("latin-1").partition(":")
+                if sep:
+                    resp.add(k.strip(), v.strip())
+            if status >= 200:
+                break
+        conn_hdr = (resp.get("Connection") or "").lower()
+        will_close = (conn_hdr == "close"
+                      or (version == "HTTP/1.0"
+                          and conn_hdr != "keep-alive"))
+        te = (resp.get("Transfer-Encoding") or "").lower()
+        if method == "HEAD" or status in (204, 304):
+            data = b""
+        elif "chunked" in te:
+            data = self._read_chunked()
+        elif resp.get("Content-Length") is not None:
+            data = self._read_exact(int(resp["Content-Length"]))
+        else:  # body delimited by connection close (HTTP/1.0 style)
+            data = self._rfile.read()
+            will_close = True
+        return status, data, dict(resp.items()), will_close
+
+
+def _make_conn(netloc: str, timeout: float) -> RawHttpConnection:
+    return RawHttpConnection(netloc, timeout)
 
 
 def _pooled_conn(netloc: str, timeout: float):
@@ -409,23 +607,26 @@ def http_call(method: str, url: str, body: Optional[bytes] = None,
     if parsed.query:
         target += "?" + parsed.query
     method = method.upper()
-    import http.client
     last_err = None
     for attempt in (0, 1):
-        conn, reused = _pooled_conn(parsed.netloc, timeout)
         sent = False
+        reused = False
         try:
-            conn.request(method, target, body=body, headers=headers or {})
+            # inside the try: connection setup itself can raise
+            # (SYN timeout, DNS failure, bad netloc) and must surface
+            # as ConnectionError like every other transport failure
+            conn, reused = _pooled_conn(parsed.netloc, timeout)
+            conn.send_request(method, target, body, headers)
             sent = True
-            r = conn.getresponse()
-            data = r.read()
-            resp_headers = dict(r.headers)
-            if r.will_close:
+            status, data, resp_headers, will_close = \
+                conn.read_response(method)
+            if will_close:
                 _drop_conn(parsed.netloc)
-            return r.status, data, resp_headers
-        except (http.client.HTTPException, BrokenPipeError,
-                ConnectionResetError, ConnectionRefusedError,
-                ConnectionAbortedError, socket.timeout, OSError) as e:
+            return status, data, resp_headers
+        except (BrokenPipeError, ConnectionResetError,
+                ConnectionRefusedError, ConnectionAbortedError,
+                ConnectionError, socket.timeout, ValueError,
+                OSError) as e:
             _drop_conn(parsed.netloc)
             last_err = e
             # Replay rules (Go http.Transport's): only on a REUSED

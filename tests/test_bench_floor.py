@@ -1,10 +1,13 @@
-"""HTTP/TCP data-path throughput floors (round-2/3 verdict weak #2/#9).
+"""HTTP/TCP data-path throughput floors (round-2/3 verdict weak #2/#9,
+round-5 item 7).
 
 Loose floors — a fraction of measured rates on a single shared core —
 that catch data-path regressions (per-request connections, Nagle
 stalls, lock races) without flaking on loaded CI hardware.
-Measured on 1 vCPU (client+master+volume sharing the core):
-HTTP 1.4k writes/s / 2.8k reads/s; TCP 7.1k/10.8k (PERF.md §HTTP).
+Round-5 path work (batched assigns, replica-lookup cache, fast request
+parse, raw pooled HTTP client replacing http.client) took the measured
+rates from 1.1k/3.5k to ~4.6k writes/s / ~6.4k reads/s on the dev box
+(PERF.md §HTTP); floors sit at ~1/8 of that.
 Reference (multi-core i7 MacBook): 15.7k/47k (BASELINE.md)."""
 
 import concurrent.futures
@@ -68,8 +71,8 @@ def test_http_data_path_floor(cluster):
     rps, _ = _run(read_one)
     # floors ~1/4 of measured single-core rates: regression guard, not
     # a benchmark (run `weed-tpu benchmark` for real numbers)
-    assert wps > 150, f"HTTP write path regressed: {wps:.0f} req/s"
-    assert rps > 300, f"HTTP read path regressed: {rps:.0f} req/s"
+    assert wps > 500, f"HTTP write path regressed: {wps:.0f} req/s"
+    assert rps > 900, f"HTTP read path regressed: {rps:.0f} req/s"
 
 
 def test_tcp_data_path_floor(cluster):
