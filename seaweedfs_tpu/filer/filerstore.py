@@ -147,10 +147,10 @@ def _lazy(module: str, cls: str):
 
 
 # The store registry — the analogue of the reference's blank-import
-# plugin table (weed/command/imports.go:17-36). Eight families:
+# plugin table (weed/command/imports.go:17-36). Nine families:
 # embedded (memory, sqlite, lsm) and wire-protocol (redis RESP2,
-# etcd gRPC, mysql, postgres, mongodb OP_MSG), plus the remote-filer
-# adapter used by gateway mode.
+# etcd gRPC, mysql, postgres, mongodb OP_MSG, cassandra CQL), plus
+# the remote-filer adapter used by gateway mode.
 STORES = {
     "memory": MemoryStore,
     "sqlite": _lazy("seaweedfs_tpu.filer.abstract_sql", "SqliteStore"),
@@ -162,11 +162,14 @@ STORES = {
                       "PostgresFilerStore"),
     "mongodb": _lazy("seaweedfs_tpu.filer.mongodb_store",
                      "MongoFilerStore"),
+    "cassandra": _lazy("seaweedfs_tpu.filer.cassandra_store",
+                       "CassandraFilerStore"),
     "remote": _lazy("seaweedfs_tpu.filer.remote_store",
                     "RemoteFilerStore"),
 }
 _ALIASES = {"mongo": "mongodb", "postgres2": "postgres",
-            "mysql2": "mysql", "redis2": "redis"}
+            "mysql2": "mysql", "redis2": "redis",
+            "cassandra2": "cassandra"}
 
 
 def __getattr__(name):

@@ -3,9 +3,10 @@ weed/filer/store_test/ runs the same test body over embedded stores;
 weed/command/imports.go:17-36 lists the 22 plugins this registry
 mirrors in families).
 
-Eight families run the identical contract body:
+Nine families run the identical contract body:
   memory, sqlite, lsm        — embedded
-  redis (RESP2), etcd (gRPC), mysql, postgres, mongodb (OP_MSG) — wire
+  redis (RESP2), etcd (gRPC), mysql, postgres, mongodb (OP_MSG),
+  cassandra (CQL v4)         — wire
 The wire stores talk to in-process mini servers speaking the real
 protocols, so framing and escaping are exercised end-to-end.
 """
@@ -16,7 +17,7 @@ from seaweedfs_tpu.filer.entry import Attr, Entry
 from seaweedfs_tpu.filer.filerstore import STORES, make_store
 
 FAMILIES = ["memory", "sqlite", "lsm", "redis", "etcd", "mysql",
-            "postgres", "mongodb"]
+            "postgres", "mongodb", "cassandra"]
 
 
 @pytest.fixture(params=FAMILIES)
@@ -47,6 +48,11 @@ def store(request, tmp_path):
         from seaweedfs_tpu.filer.mongodb_store import MiniMongoServer
         server = MiniMongoServer().start()
         s = make_store(kind, port=server.port)
+    elif kind == "cassandra":
+        from seaweedfs_tpu.filer.cassandra_store import \
+            MiniCassandraServer
+        server = MiniCassandraServer().start()
+        s = make_store(kind, port=server.port)
     else:
         s = make_store(kind)
     yield s
@@ -55,8 +61,8 @@ def store(request, tmp_path):
         server.stop()
 
 
-def test_registry_has_eight_families():
-    assert len([k for k in STORES if k != "remote"]) >= 8
+def test_registry_has_nine_families():
+    assert len([k for k in STORES if k != "remote"]) >= 9
 
 
 def test_insert_find_update_delete(store):
@@ -120,7 +126,10 @@ def test_delete_folder_children_recursive(store):
 def test_hostile_names_round_trip(store):
     # quoting/wildcard/escape hazards for SQL and key-range backends
     names = ["it's.txt", 'quo"te.txt', "100%.txt", "under_score.txt",
-             "bang!.txt", "sp ace.txt", "uni-号.txt"]
+             "bang!.txt", "sp ace.txt", "uni-号.txt",
+             # names shaped like qualified table references must not be
+             # rewritten by any SQL/CQL translation layer
+             "backup.kv", "from x.filemeta"]
     for n in names:
         store.insert_entry(Entry(f"/h/{n}", Attr(file_size=1)))
     listed = sorted(e.name for e in store.list_directory_entries("/h"))
