@@ -655,7 +655,8 @@ def main(argv=None):
     fl.add_argument("-master", default="127.0.0.1:9333")
     fl.add_argument("-store", default="memory",
                     choices=["memory", "sqlite", "lsm", "redis", "etcd",
-                             "mysql", "postgres", "mongodb", "cassandra"])
+                             "mysql", "postgres", "mongodb", "cassandra",
+                             "elastic"])
     fl.add_argument("-dir", default=".", help="store/state directory")
     fl.add_argument("-defaultReplication", default="")
     fl.add_argument("-encryptVolumeData", action="store_true",

@@ -147,9 +147,10 @@ def _lazy(module: str, cls: str):
 
 
 # The store registry — the analogue of the reference's blank-import
-# plugin table (weed/command/imports.go:17-36). Nine families:
+# plugin table (weed/command/imports.go:17-36). Ten families:
 # embedded (memory, sqlite, lsm) and wire-protocol (redis RESP2,
-# etcd gRPC, mysql, postgres, mongodb OP_MSG, cassandra CQL), plus
+# etcd gRPC, mysql, postgres, mongodb OP_MSG, cassandra CQL,
+# elasticsearch REST), plus
 # the remote-filer adapter used by gateway mode.
 STORES = {
     "memory": MemoryStore,
@@ -164,12 +165,14 @@ STORES = {
                      "MongoFilerStore"),
     "cassandra": _lazy("seaweedfs_tpu.filer.cassandra_store",
                        "CassandraFilerStore"),
+    "elastic": _lazy("seaweedfs_tpu.filer.elastic_store",
+                     "ElasticFilerStore"),
     "remote": _lazy("seaweedfs_tpu.filer.remote_store",
                     "RemoteFilerStore"),
 }
 _ALIASES = {"mongo": "mongodb", "postgres2": "postgres",
             "mysql2": "mysql", "redis2": "redis",
-            "cassandra2": "cassandra"}
+            "cassandra2": "cassandra", "elastic7": "elastic"}
 
 
 def __getattr__(name):

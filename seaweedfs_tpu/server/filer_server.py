@@ -83,7 +83,7 @@ class FilerServer:
             # (filer/remote_store.py); store_dir carries its address
             kwargs["filer_addr"] = store_dir
         elif store in ("redis", "etcd", "mysql", "postgres", "mongodb",
-                       "cassandra"):
+                       "cassandra", "elastic"):
             # store_dir carries the database address "host:port"
             # (reference filer.toml [redis2] address / [etcd] servers /
             # [mysql]/[postgres] hostname+port / [mongodb] uri); a
@@ -91,7 +91,7 @@ class FilerServer:
             # means localhost on the protocol's standard port
             default_port = {"redis": 6379, "etcd": 2379, "mysql": 3306,
                             "postgres": 5432, "mongodb": 27017,
-                            "cassandra": 9042}[store]
+                            "cassandra": 9042, "elastic": 9200}[store]
             addr = store_dir if store_dir and ":" in store_dir \
                 else f"127.0.0.1:{default_port}"
             db_host, _, db_port = addr.rpartition(":")

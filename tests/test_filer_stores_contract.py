@@ -3,10 +3,10 @@ weed/filer/store_test/ runs the same test body over embedded stores;
 weed/command/imports.go:17-36 lists the 22 plugins this registry
 mirrors in families).
 
-Nine families run the identical contract body:
+Ten families run the identical contract body:
   memory, sqlite, lsm        — embedded
   redis (RESP2), etcd (gRPC), mysql, postgres, mongodb (OP_MSG),
-  cassandra (CQL v4)         — wire
+  cassandra (CQL v4), elasticsearch (REST) — wire
 The wire stores talk to in-process mini servers speaking the real
 protocols, so framing and escaping are exercised end-to-end.
 """
@@ -17,7 +17,7 @@ from seaweedfs_tpu.filer.entry import Attr, Entry
 from seaweedfs_tpu.filer.filerstore import STORES, make_store
 
 FAMILIES = ["memory", "sqlite", "lsm", "redis", "etcd", "mysql",
-            "postgres", "mongodb", "cassandra"]
+            "postgres", "mongodb", "cassandra", "elastic"]
 
 
 @pytest.fixture(params=FAMILIES)
@@ -53,6 +53,10 @@ def store(request, tmp_path):
             MiniCassandraServer
         server = MiniCassandraServer().start()
         s = make_store(kind, port=server.port)
+    elif kind == "elastic":
+        from seaweedfs_tpu.filer.elastic_store import MiniElasticServer
+        server = MiniElasticServer().start()
+        s = make_store(kind, port=server.port)
     else:
         s = make_store(kind)
     yield s
@@ -61,8 +65,8 @@ def store(request, tmp_path):
         server.stop()
 
 
-def test_registry_has_nine_families():
-    assert len([k for k in STORES if k != "remote"]) >= 9
+def test_registry_has_ten_families():
+    assert len([k for k in STORES if k != "remote"]) >= 10
 
 
 def test_insert_find_update_delete(store):
