@@ -10,8 +10,8 @@ reads are immediately consistent (the reference sets Refresh the same
 way — a filer cannot serve stale listings).
 
 Doc model:
-  filer_entries/_doc/<hex(path)> = {directory, name, meta-json}
-  filer_kv/_doc/<hex(key)>       = {v: hex(value)}
+  filer_entries/_doc/<quote(path)> = {directory, name, meta-json}
+  filer_kv/_doc/<hex(key)>         = {v: hex(value)}
 
 MiniElasticServer implements the endpoint subset over in-memory dicts
 — the test double AND an embedded dev backend; point ElasticFilerStore
@@ -46,11 +46,16 @@ class ElasticFilerStore(FilerStore):
         # directory/name as text, breaking term/prefix queries and
         # sorts on a real Elasticsearch
         for index, props in (
+                # meta/v also disable doc_values: Lucene caps
+                # doc_values terms at 32KB and chunky entry meta (or
+                # hex-doubled kv blobs) legitimately exceeds that
                 (ENTRY_INDEX, {"directory": {"type": "keyword"},
                                "name": {"type": "keyword"},
                                "meta": {"type": "keyword",
-                                        "index": False}}),
-                (KV_INDEX, {"v": {"type": "keyword", "index": False}})):
+                                        "index": False,
+                                        "doc_values": False}}),
+                (KV_INDEX, {"v": {"type": "keyword", "index": False,
+                                  "doc_values": False}})):
             try:
                 self._call("PUT", f"/{index}",
                            {"mappings": {"properties": props}})
@@ -83,9 +88,10 @@ class ElasticFilerStore(FilerStore):
     def _doc_id(full_path: str) -> str:
         # url-quote like the reference store: near 1:1 for ASCII, so
         # paths stay inside ES's 512-byte _id limit (hex would halve
-        # the maximum path length)
+        # the maximum path length). Normalized here so insert/find/
+        # delete agree on trailing slashes.
         import urllib.parse
-        return urllib.parse.quote(full_path, safe="")
+        return urllib.parse.quote(full_path.rstrip("/") or "/", safe="")
 
     # ---- entry ops ----
     def insert_entry(self, entry: Entry) -> None:

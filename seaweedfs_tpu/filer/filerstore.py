@@ -1,12 +1,13 @@
 """FilerStore SPI + the store registry + MemoryStore.
 
 Functional equivalent of reference weed/filer/filerstore.go:21-44 plus
-the plugin table weed/command/imports.go:17-36. Eight store families
+the plugin table weed/command/imports.go:17-36. Ten store families
 register in STORES below: embedded (memory here; sqlite and the shared
 SQL mapping in abstract_sql.py; lsm_store.py) and wire-protocol
 (redis_store.py RESP2, etcd_store.py gRPC, mysql_store.py,
-postgres_store.py, mongodb_store.py OP_MSG). New stores implement the
-same five entry ops + kv + listing.
+postgres_store.py, mongodb_store.py OP_MSG, cassandra_store.py CQL,
+elastic_store.py REST). New stores implement the same five entry ops +
+kv + listing.
 """
 
 from __future__ import annotations

@@ -1,0 +1,133 @@
+"""RawHttpConnection + fast request parse edge cases (round-5 HTTP
+path rework). Our own servers always send Content-Length, so the
+chunked / read-to-close / 1xx branches of the pooled client — which
+exist for external endpoints like push gateways and S3 dialects — are
+exercised here against a hand-rolled socket server."""
+
+import socket
+import threading
+
+import pytest
+
+from seaweedfs_tpu.utils.httpd import (HeaderDict, HttpServer,
+                                       RangeNotSatisfiable, Response,
+                                       http_call, parse_byte_range)
+
+
+def _one_shot_server(raw_response: bytes, close_after: bool = True):
+    """Accepts one connection, reads the request, sends raw bytes."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def run():
+        conn, _ = srv.accept()
+        conn.settimeout(5)
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            got = conn.recv(65536)
+            if not got:
+                break
+            buf += got
+        conn.sendall(raw_response)
+        if close_after:
+            conn.close()
+        srv.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    return port
+
+
+def test_chunked_response_body():
+    port = _one_shot_server(
+        b"HTTP/1.1 200 OK\r\n"
+        b"Transfer-Encoding: chunked\r\n"
+        b"Connection: close\r\n\r\n"
+        b"5\r\nhello\r\n"
+        b"8;ext=1\r\n chunked\r\n"
+        b"0\r\n"
+        b"X-Trailer: t\r\n"
+        b"\r\n")
+    status, body, headers = http_call(
+        "GET", f"http://127.0.0.1:{port}/x")
+    assert status == 200
+    assert body == b"hello chunked"
+
+
+def test_read_to_close_body():
+    port = _one_shot_server(
+        b"HTTP/1.0 200 OK\r\n\r\n"
+        b"close-delimited body")
+    status, body, _ = http_call("GET", f"http://127.0.0.1:{port}/x")
+    assert status == 200
+    assert body == b"close-delimited body"
+
+
+def test_interim_1xx_skipped():
+    port = _one_shot_server(
+        b"HTTP/1.1 102 Processing\r\n\r\n"
+        b"HTTP/1.1 200 OK\r\n"
+        b"Content-Length: 4\r\n"
+        b"Connection: close\r\n\r\n"
+        b"real")
+    status, body, _ = http_call("GET", f"http://127.0.0.1:{port}/x")
+    assert status == 200 and body == b"real"
+
+
+def test_no_body_statuses():
+    port = _one_shot_server(
+        b"HTTP/1.1 204 No Content\r\n"
+        b"Connection: close\r\n\r\n")
+    status, body, _ = http_call("POST", f"http://127.0.0.1:{port}/x",
+                                body=b"ignored")
+    assert status == 204 and body == b""
+
+
+def test_header_dict_semantics():
+    h = HeaderDict()
+    h.add("ETag", '"abc"')
+    h.add("X-Multi", "a")
+    h.add("x-multi", "b")
+    assert h["etag"] == '"abc"'
+    assert h.get("ETAG") == '"abc"'
+    assert h.get("missing", "dflt") == "dflt"
+    assert h.get("X-Multi") == "a, b"  # RFC 7230 comma-join
+    assert "etag" in h and "nope" not in h
+    # items preserve wire case for pass-through dict() consumers
+    assert dict(h.items())["ETag"] == '"abc"'
+
+
+def test_server_rejects_header_flood():
+    srv = HttpServer()
+    srv.add("GET", "/ok", lambda req: Response({"ok": True}))
+    srv.start()
+    try:
+        sock = socket.create_connection((srv.host, srv.port), timeout=5)
+        req = b"GET /ok HTTP/1.1\r\nHost: x\r\n"
+        req += b"".join(b"X-H%d: v\r\n" % i for i in range(150))
+        req += b"\r\n"
+        sock.sendall(req)
+        reply = sock.recv(65536)
+        assert b"431" in reply.split(b"\r\n", 1)[0]
+        sock.close()
+    finally:
+        srv.stop()
+
+
+def test_parse_byte_range_matrix():
+    assert parse_byte_range("bytes=0-4", 10) == (0, 4)
+    assert parse_byte_range("bytes=4-", 10) == (4, 9)
+    assert parse_byte_range("bytes=-3", 10) == (7, 9)
+    assert parse_byte_range("bytes=-99", 10) == (0, 9)
+    assert parse_byte_range("bytes=5-99", 10) == (5, 9)
+    assert parse_byte_range("", 10) is None
+    assert parse_byte_range("bytes=x-y", 10) is None
+    assert parse_byte_range("bytes=7-4", 10) is None
+    with pytest.raises(RangeNotSatisfiable):
+        parse_byte_range("bytes=10-", 10)
+    with pytest.raises(RangeNotSatisfiable):
+        parse_byte_range("bytes=10-20", 10)
+    with pytest.raises(RangeNotSatisfiable):
+        parse_byte_range("bytes=-1", 0)
