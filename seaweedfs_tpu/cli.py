@@ -317,6 +317,114 @@ def cmd_version(args):
     }))
 
 
+def cmd_filer_replicate(args):
+    """One-way replication daemon: consume a filer's event stream and
+    apply it to the sink enabled in replication.toml (reference
+    command/filer_replicate.go wiring replication/replicator.go)."""
+    import time as _time
+
+    from seaweedfs_tpu.replication.sink import (Replicator,
+                                                make_sink_from_config)
+    from seaweedfs_tpu.replication.sync import subscribe_meta_events
+    from seaweedfs_tpu.utils import config as cfg
+    from seaweedfs_tpu.utils import glog
+    conf = cfg.load_configuration("replication", required=True)
+    sink = make_sink_from_config(conf)
+    if sink is None:
+        raise SystemExit("replication.toml enables no sink "
+                         "(sink.filer/local/s3/azure)")
+    from seaweedfs_tpu.utils.httpd import HttpError
+    rep = Replicator(sink, args.filer, path_prefix=args.path)
+    since = int(_time.time() * 1e9) if args.fromNow else args.sinceNs
+    print(f"filer.replicate {args.filer}{args.path} -> "
+          f"{sink.name} sink")
+    for ev in subscribe_meta_events(args.filer, since_ns=since,
+                                    path_prefix=args.path):
+        if ev is None:
+            continue
+        while True:
+            try:
+                rep.apply_event(ev)
+                break
+            except (ConnectionError, HttpError) as e:
+                # transient sink failure: retry the SAME event rather
+                # than silently diverging the replica (FilerSync holds
+                # its cursor for exactly this reason)
+                glog.warning("replicate: sink unavailable at %s, "
+                             "retrying: %s", ev.get("tsns"), e)
+                _time.sleep(2.0)
+            except Exception as e:
+                glog.error("replicate: event at %s failed "
+                           "permanently, skipping: %s",
+                           ev.get("tsns"), e)
+                break
+
+
+def cmd_master_follower(args):
+    """Read-only follower master (reference command/master_follower.go):
+    serves lookups from a vidMap — push-fed over the masters' gRPC
+    KeepConnected stream when -grpcAddresses is given, else a TTL'd
+    pull cache — and answers writes 409 with a leader hint so clients
+    redirect."""
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer,
+                                           Response, http_json)
+    mc = MasterClient(args.masters.split(","),
+                      grpc_address=(args.grpcAddresses.split(",")
+                                    if args.grpcAddresses else None))
+    srv = HttpServer(args.ip, args.port)
+
+    def lookup(req):
+        vid = int(req.query.get("volumeId", "0"))
+        try:
+            locs = mc.lookup_volume(vid, req.query.get("collection", ""))
+        except HttpError:
+            locs = []
+        if not locs:
+            return Response({"volumeId": vid, "locations": [],
+                             "error": "volume not found"}, status=404)
+        return Response({"volumeId": vid, "locations": locs})
+
+    def lookup_ec(req):
+        vid = int(req.query.get("volumeId", "0"))
+        try:
+            shards = mc.lookup_ec_volume(vid)
+        except HttpError:
+            shards = []
+        return Response({"volumeId": vid, "shards": shards})
+
+    def proxy_status(req):
+        return Response(http_json(
+            "GET", f"http://{mc.leader}/dir/status"))
+
+    def not_leader(req):
+        return Response({"error": "not leader", "leader": mc.leader},
+                        status=409)
+
+    srv.add("GET", "/dir/lookup", lookup)
+    srv.add("GET", "/dir/lookup_ec", lookup_ec)
+    srv.add("GET", "/dir/status", proxy_status)
+    srv.add("GET", "/cluster/status", lambda req: Response(
+        {"IsLeader": False, "Leader": mc.leader, "Peers": []}))
+    for method, path in (("GET", "/dir/assign"), ("POST", "/dir/assign"),
+                         ("POST", "/vol/grow")):
+        srv.add(method, path, not_leader)
+    srv.start()
+    print(f"master.follower on {srv.host}:{srv.port}, "
+          f"following {args.masters}")
+    _wait_forever()
+
+
+def cmd_autocomplete(args):
+    """Emit a bash completion script (reference command/autocomplete.go
+    via posener/complete; here a plain `complete -W` wordlist)."""
+    cmds = sorted(args._subcommands)
+    wordlist = " ".join(cmds)
+    print("# source this file, or add to ~/.bashrc:")
+    print(f"complete -W '{wordlist}' weed-tpu")
+    print(f"# complete -W '{wordlist}' python -m seaweedfs_tpu.cli")
+
+
 def cmd_fuse(args):
     """fstab-style mount (reference command/fuse.go): options ride -o."""
     opts = dict(kv.split("=", 1) for kv in args.o.split(",")
@@ -697,6 +805,31 @@ def main(argv=None):
                      help="start cursor (ns); 0 = replay everything")
     fsy.set_defaults(fn=cmd_filer_sync)
 
+    frp = sub.add_parser(
+        "filer.replicate",
+        help="apply a filer's event stream to the replication.toml sink")
+    frp.add_argument("-filer", default="127.0.0.1:8888")
+    frp.add_argument("-path", default="/", help="source path filter")
+    frp.add_argument("-sinceNs", type=int, default=0,
+                     help="start cursor (ns); 0 = replay everything")
+    frp.add_argument("-fromNow", action="store_true",
+                     help="skip history, replicate new events only")
+    frp.set_defaults(fn=cmd_filer_replicate)
+
+    mf = sub.add_parser(
+        "master.follower",
+        help="read-only master follower serving lookups from a "
+             "push-fed vidMap")
+    mf.add_argument("-ip", default="127.0.0.1")
+    mf.add_argument("-port", type=int, default=9334)
+    mf.add_argument("-masters", default="127.0.0.1:9333",
+                    help="comma-separated master group urls")
+    mf.add_argument("-grpcAddresses", default="",
+                    help="masters' gRPC addresses (port+10000 when "
+                         "started with -grpc): enables the push-fed "
+                         "vidMap instead of cached pull lookups")
+    mf.set_defaults(fn=cmd_master_follower)
+
     fbk = sub.add_parser("filer.backup",
                          help="continuous filer backup to a sink")
     fbk.add_argument("-filer", default="127.0.0.1:8888")
@@ -752,6 +885,10 @@ def main(argv=None):
     im.add_argument("-filer", default="127.0.0.1:8888")
     im.add_argument("-master", default="127.0.0.1:9333")
     im.set_defaults(fn=cmd_iam)
+
+    ac = sub.add_parser("autocomplete",
+                        help="emit a bash completion wordlist")
+    ac.set_defaults(fn=cmd_autocomplete)
 
     ver = sub.add_parser("version", help="print version info")
     ver.set_defaults(fn=cmd_version)
@@ -843,6 +980,7 @@ def main(argv=None):
     b.set_defaults(fn=cmd_benchmark)
 
     args = p.parse_args(argv)
+    args._subcommands = list(sub.choices)
     from seaweedfs_tpu.utils import glog
     glog.set_verbosity(args.verbosity)
     if args.vmodule:

@@ -159,6 +159,11 @@ class MasterClient:
                             candidates.append(hint)
                         if hint:
                             self._leader = hint
+                    elif e.status < 500:
+                        # a definitive client-error answer (404 unknown
+                        # volume, 400 bad request) — retrying other
+                        # masters/rounds would just repeat it slowly
+                        raise
                     last_err = e
                 except ConnectionError as e:
                     last_err = e

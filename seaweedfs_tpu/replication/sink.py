@@ -168,6 +168,36 @@ class AzureSink(ReplicationSink):
         self.client.remove_file(self._key(path))
 
 
+def make_sink_from_config(conf: dict):
+    """First enabled sink in replication.toml (reference
+    replication/sink/*.go registration through sub_config)."""
+    from seaweedfs_tpu.utils import config as cfg
+    if cfg.get(conf, "sink.filer.enabled"):
+        return FilerSink(
+            cfg.get(conf, "sink.filer.url", "localhost:8888"),
+            path_prefix=cfg.get(conf, "sink.filer.directory", "") or "")
+    if cfg.get(conf, "sink.local.enabled"):
+        return LocalSink(cfg.get(conf, "sink.local.directory",
+                                 "/data/backup"))
+    if cfg.get(conf, "sink.s3.enabled"):
+        return S3Sink(
+            cfg.get(conf, "sink.s3.endpoint", "http://localhost:8333"),
+            cfg.get(conf, "sink.s3.bucket", "backup"),
+            prefix=cfg.get(conf, "sink.s3.directory", "") or "",
+            access_key=cfg.get(conf, "sink.s3.aws_access_key_id", ""),
+            secret_key=cfg.get(conf, "sink.s3.aws_secret_access_key",
+                               ""),
+            region=cfg.get(conf, "sink.s3.region", "us-east-1"))
+    if cfg.get(conf, "sink.azure.enabled"):
+        return AzureSink(
+            cfg.get(conf, "sink.azure.endpoint", ""),
+            cfg.get(conf, "sink.azure.container", "backup"),
+            cfg.get(conf, "sink.azure.account_name", ""),
+            cfg.get(conf, "sink.azure.account_key", ""),
+            prefix=cfg.get(conf, "sink.azure.directory", "") or "")
+    return None
+
+
 class Replicator:
     """Apply a stream of filer meta events to a sink
     (reference replication/replicator.go)."""

@@ -59,6 +59,7 @@ dbFile = "./filer.db"
 [sink.filer]
 enabled = false
 url = "localhost:8888"
+directory = ""            # destination path prefix
 
 [sink.local]
 enabled = false
@@ -68,6 +69,18 @@ directory = "/data/backup"
 enabled = false
 endpoint = "http://localhost:8333"
 bucket = "backup"
+directory = ""            # key prefix
+aws_access_key_id = ""
+aws_secret_access_key = ""
+region = "us-east-1"
+
+[sink.azure]
+enabled = false
+endpoint = ""             # https://<account>.blob.core.windows.net
+container = "backup"
+account_name = ""
+account_key = ""          # base64 SharedKey
+directory = ""
 """,
     "notification": """\
 # notification.toml — filer event publishing
