@@ -360,6 +360,80 @@ def cmd_filer_replicate(args):
                 break
 
 
+def cmd_filer_remote_gateway(args):
+    """Bucket-aware remote mirror daemon (reference
+    command/filer_remote_gateway.go): newly created buckets under
+    /buckets auto-mount onto the configured remote, deleted buckets
+    unmount, and local writes under /buckets continuously write back —
+    the S3-gateway-to-cloud bridge. The data/credential plane stays in
+    the filer (the /__api/remote endpoints), like filer.remote.sync."""
+    import time as _time
+
+    from seaweedfs_tpu.replication.remote_sync import FilerRemoteSync
+    from seaweedfs_tpu.replication.sync import subscribe_meta_events
+    from seaweedfs_tpu.utils import glog
+    from seaweedfs_tpu.utils.httpd import HttpError, http_json
+    import fnmatch
+
+    base = f"http://{args.filer}/__api/remote"
+
+    def mount_bucket(bucket: str) -> None:
+        if args.bucketPattern and not fnmatch.fnmatch(
+                bucket, args.bucketPattern):
+            return
+        # each bucket dir maps to a same-named path on the remote —
+        # works for any remote type (reference -createBucketAt keeps
+        # local and remote bucket names 1:1 the same way)
+        http_json("POST", f"{base}/mount",
+                  {"dir": f"/buckets/{bucket}",
+                   "remote_name": args.remote, "remote_path": bucket})
+
+    # mount every pre-existing bucket first, then watch for churn
+    try:
+        listing = http_json("GET", f"http://{args.filer}/buckets/")
+        existing = [e["FullPath"].rsplit("/", 1)[1]
+                    for e in listing.get("Entries", [])
+                    if e.get("IsDirectory")]
+    except (ConnectionError, HttpError):
+        existing = []
+    for bucket in existing:
+        try:
+            mount_bucket(bucket)
+        except (ConnectionError, HttpError) as e:
+            raise SystemExit(f"mounting bucket {bucket} failed: {e}")
+    print(f"filer.remote.gateway: mounted {existing}")
+    sync = FilerRemoteSync(args.filer, "/buckets")
+    sync.start(since_ns=int(_time.time() * 1e9))  # write-back plane
+    for ev in subscribe_meta_events(args.filer,
+                                    since_ns=int(_time.time() * 1e9),
+                                    path_prefix="/buckets"):
+        if ev is None:
+            continue
+        old, new = ev.get("old_entry"), ev.get("new_entry")
+
+        def bucket_of(entry):
+            if entry is None:
+                return None
+            p = entry["full_path"]
+            if (p.startswith("/buckets/") and p.count("/") == 2
+                    and entry.get("attr", {}).get("is_directory")):
+                return p
+            return None
+
+        created, deleted = bucket_of(new), bucket_of(old)
+        try:
+            if created and not deleted:
+                mount_bucket(created.rsplit("/", 1)[1])
+                glog.info("gateway: mounted new bucket %s", created)
+            elif deleted and new is None:
+                http_json("POST", f"{base}/unmount", {"dir": deleted})
+                glog.info("gateway: unmounted deleted bucket %s",
+                          deleted)
+        except (ConnectionError, HttpError) as e:
+            glog.warning("gateway: bucket churn for %s failed: %s",
+                         created or deleted, e)
+
+
 def cmd_master_follower(args):
     """Read-only follower master (reference command/master_follower.go):
     serves lookups from a vidMap — push-fed over the masters' gRPC
@@ -815,6 +889,17 @@ def main(argv=None):
     frp.add_argument("-fromNow", action="store_true",
                      help="skip history, replicate new events only")
     frp.set_defaults(fn=cmd_filer_replicate)
+
+    frg = sub.add_parser(
+        "filer.remote.gateway",
+        help="auto-mount new buckets to the remote and write back "
+             "(S3-gateway-to-cloud bridge)")
+    frg.add_argument("-filer", default="127.0.0.1:8888")
+    frg.add_argument("-remote", required=True,
+                     help="configured remote name (remote.configure)")
+    frg.add_argument("-bucketPattern", default="",
+                     help="only bridge buckets matching this glob")
+    frg.set_defaults(fn=cmd_filer_remote_gateway)
 
     mf = sub.add_parser(
         "master.follower",

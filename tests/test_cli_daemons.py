@@ -116,3 +116,49 @@ def test_autocomplete_lists_subcommands():
     for cmd in ("master", "volume", "filer", "filer.replicate",
                 "master.follower", "shell", "benchmark"):
         assert cmd in out.stdout
+
+
+def test_filer_remote_gateway_bridges_buckets(stack, tmp_path):
+    """New buckets auto-mount to the remote and their objects write
+    back (reference command/filer_remote_gateway.go)."""
+    ms, vs, fs = stack
+    cloud = tmp_path / "cloud"
+    cloud.mkdir()
+    http_json("POST", f"http://{fs.url}/__api/remote/configure",
+              {"name": "gwcloud", "type": "local", "root": str(cloud)})
+    # a bucket that exists BEFORE the gateway starts
+    http_call("POST", f"http://{fs.url}/buckets/pre?mkdir=true", body=b"")
+    proc = _spawn(["filer.remote.gateway", "-filer", fs.url,
+                   "-remote", "gwcloud"])
+    try:
+        line = proc.stdout.readline()
+        assert "mounted" in line and "pre" in line, line
+        time.sleep(1.0)  # let the watchers attach
+        # the pre-existing bucket was mounted at startup
+        out = http_json("GET", f"http://{fs.url}/__api/remote/status")
+        assert "/buckets/pre" in out.get("mappings", {}), out
+        # create a bucket AFTER: the daemon mounts it on the event
+        http_call("POST", f"http://{fs.url}/buckets/post?mkdir=true",
+                  body=b"")
+        deadline = time.time() + 20
+        dirs: list = []
+        while time.time() < deadline:
+            out = http_json("GET",
+                            f"http://{fs.url}/__api/remote/status")
+            dirs = list(out.get("mappings", {}).keys())
+            if "/buckets/post" in dirs:
+                break
+            time.sleep(0.2)
+        assert "/buckets/post" in dirs, out
+        # an object written into the new bucket writes back to the cloud
+        http_call("POST", f"http://{fs.url}/buckets/post/obj.bin",
+                  body=b"bridged bytes")
+        target = cloud / "post" / "obj.bin"
+        deadline = time.time() + 20
+        while time.time() < deadline and not target.exists():
+            time.sleep(0.2)
+        assert target.exists(), "write-back never reached the remote"
+        assert target.read_bytes() == b"bridged bytes"
+    finally:
+        proc.kill()
+        proc.wait()
