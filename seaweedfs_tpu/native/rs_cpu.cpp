@@ -82,26 +82,43 @@ static inline word x2(word v) {
     return lo ^ ((hi >> 7) * 0x1D);
 }
 
+// All internal kernels take the row pitch (`stride`, bytes between the
+// starts of consecutive shard rows) separately from the byte count to
+// process (`n`). gf_apply passes stride == n; gf_apply_strided points the
+// bases at a column offset inside wider matrices so worker threads can
+// shard one row batch by column range with zero copies.
+
 // table-driven tail for bytes [from, n) that the vector strides didn't cover
 static void gf_tail(const uint8_t* mat, int64_t m, int64_t k,
-                    const uint8_t* data, uint8_t* out, int64_t n,
-                    int64_t from) {
+                    const uint8_t* data, uint8_t* out, int64_t stride,
+                    int64_t n, int64_t from) {
     gf_init();
     for (int64_t t = from; t < n; t++) {
         for (int64_t i = 0; i < m; i++) {
-            uint8_t acc = out[i * n + t];
+            uint8_t acc = out[i * stride + t];
             for (int64_t j = 0; j < k; j++)
-                acc ^= gf_mul_tab[mat[i * k + j]][data[j * n + t]];
-            out[i * n + t] = acc;
+                acc ^= gf_mul_tab[mat[i * k + j]][data[j * stride + t]];
+            out[i * stride + t] = acc;
         }
     }
 }
 
 static void gf_apply_scalar(const uint8_t* mat, int64_t m, int64_t k,
-                            const uint8_t* data, uint8_t* out, int64_t n) {
+                            const uint8_t* data, uint8_t* out,
+                            int64_t stride, int64_t n) {
     // the doubling-chain tables assume m <= 64 (uint64 row bitmask) and
     // k <= 256; anything bigger runs the unbounded table path
-    if (m > 64 || k > 256) { gf_tail(mat, m, k, data, out, n, 0); return; }
+    if (m > 64 || k > 256) {
+        gf_tail(mat, m, k, data, out, stride, n, 0);
+        return;
+    }
+    // word loads require 8-aligned row starts; a misaligned column offset
+    // (never produced by the Python sharder, which aligns to 64) degrades
+    // to the byte-table path rather than faulting on strict platforms
+    if (((uintptr_t)data | (uintptr_t)out | (uint64_t)stride) & 7) {
+        gf_tail(mat, m, k, data, out, stride, n, 0);
+        return;
+    }
     const int64_t nw = n / 8;
     // per (j, bit): bitmask over i of parities that need this doubled
     // version (m <= 64)
@@ -116,7 +133,7 @@ static void gf_apply_scalar(const uint8_t* mat, int64_t m, int64_t k,
         }
     }
     for (int64_t j = 0; j < k; j++) {
-        const word* src = reinterpret_cast<const word*>(data + j * n);
+        const word* src = reinterpret_cast<const word*>(data + j * stride);
         for (int64_t w = 0; w < nw; w++) {
             word d = src[w];
             for (int b = 0; b < 8; b++) {
@@ -124,14 +141,14 @@ static void gf_apply_scalar(const uint8_t* mat, int64_t m, int64_t k,
                 while (mask) {
                     int i = __builtin_ctzll(mask);
                     mask &= mask - 1;
-                    reinterpret_cast<word*>(out + i * n)[w] ^= d;
+                    reinterpret_cast<word*>(out + i * stride)[w] ^= d;
                 }
                 d = x2(d);
             }
         }
     }
     // byte tail (n not multiple of 8)
-    gf_tail(mat, m, k, data, out, n, nw * 8);
+    gf_tail(mat, m, k, data, out, stride, n, nw * 8);
 }
 
 #ifdef RS_X86
@@ -148,12 +165,16 @@ static void make_nibble_tables(uint8_t c, uint8_t lo[16], uint8_t hi[16]) {
 
 __attribute__((target("avx2")))
 static void gf_apply_avx2(const uint8_t* mat, int64_t m, int64_t k,
-                          const uint8_t* data, uint8_t* out, int64_t n) {
+                          const uint8_t* data, uint8_t* out,
+                          int64_t stride, int64_t n) {
     gf_init();
     // heap-allocated tables, 64B per matrix entry (typical RS use is
     // m*k = 4*10); the scalar path handles anything bigger than 1024
     // entries where table setup would dominate
-    if (m * k > 1024) { gf_apply_scalar(mat, m, k, data, out, n); return; }
+    if (m * k > 1024) {
+        gf_apply_scalar(mat, m, k, data, out, stride, n);
+        return;
+    }
     __m256i* tlo = (__m256i*)_mm_malloc(m * k * sizeof(__m256i), 32);
     __m256i* thi = (__m256i*)_mm_malloc(m * k * sizeof(__m256i), 32);
     for (int64_t e = 0; e < m * k; e++) {
@@ -168,13 +189,13 @@ static void gf_apply_avx2(const uint8_t* mat, int64_t m, int64_t k,
     int64_t pos = 0;
     for (; pos + 64 <= n; pos += 64) {
         for (int64_t i = 0; i < m; i++) {
-            uint8_t* o = out + i * n + pos;
+            uint8_t* o = out + i * stride + pos;
             __m256i acc0 = _mm256_loadu_si256((const __m256i*)o);
             __m256i acc1 = _mm256_loadu_si256((const __m256i*)(o + 32));
             const __m256i* te_lo = tlo + i * k;
             const __m256i* te_hi = thi + i * k;
             for (int64_t j = 0; j < k; j++) {
-                const uint8_t* s = data + j * n + pos;
+                const uint8_t* s = data + j * stride + pos;
                 __m256i d0 = _mm256_loadu_si256((const __m256i*)s);
                 __m256i d1 = _mm256_loadu_si256((const __m256i*)(s + 32));
                 __m256i lo0 = _mm256_and_si256(d0, mask0f);
@@ -196,7 +217,7 @@ static void gf_apply_avx2(const uint8_t* mat, int64_t m, int64_t k,
     }
     _mm_free(tlo);
     _mm_free(thi);
-    gf_tail(mat, m, k, data, out, n, pos);
+    gf_tail(mat, m, k, data, out, stride, n, pos);
 }
 
 #if defined(__clang__) || (defined(__GNUC__) && __GNUC__ >= 10)
@@ -225,22 +246,26 @@ static uint64_t gfni_matrix(uint8_t c) {
 
 __attribute__((target("avx512f,avx512bw,gfni")))
 static void gf_apply_gfni(const uint8_t* mat, int64_t m, int64_t k,
-                          const uint8_t* data, uint8_t* out, int64_t n) {
+                          const uint8_t* data, uint8_t* out,
+                          int64_t stride, int64_t n) {
     gf_init();
     // same >1024-entry guard as the AVX2 tier (matrix setup dominates)
-    if (m * k > 1024) { gf_apply_scalar(mat, m, k, data, out, n); return; }
+    if (m * k > 1024) {
+        gf_apply_scalar(mat, m, k, data, out, stride, n);
+        return;
+    }
     __m512i* mt = (__m512i*)_mm_malloc(m * k * sizeof(__m512i), 64);
     for (int64_t e = 0; e < m * k; e++)
         mt[e] = _mm512_set1_epi64((int64_t)gfni_matrix(mat[e]));
     int64_t pos = 0;
     for (; pos + 128 <= n; pos += 128) {
         for (int64_t i = 0; i < m; i++) {
-            uint8_t* o = out + i * n + pos;
+            uint8_t* o = out + i * stride + pos;
             __m512i acc0 = _mm512_loadu_si512(o);
             __m512i acc1 = _mm512_loadu_si512(o + 64);
             const __m512i* me = mt + i * k;
             for (int64_t j = 0; j < k; j++) {
-                const uint8_t* s = data + j * n + pos;
+                const uint8_t* s = data + j * stride + pos;
                 __m512i d0 = _mm512_loadu_si512(s);
                 __m512i d1 = _mm512_loadu_si512(s + 64);
                 acc0 = _mm512_xor_si512(
@@ -253,7 +278,7 @@ static void gf_apply_gfni(const uint8_t* mat, int64_t m, int64_t k,
         }
     }
     _mm_free(mt);
-    gf_tail(mat, m, k, data, out, n, pos);
+    gf_tail(mat, m, k, data, out, stride, n, pos);
 }
 #endif  // RS_HAVE_GFNI
 
@@ -269,7 +294,7 @@ static int g_selected = 0;            // resolved tier, 0 = not yet probed
 static std::atomic<int> g_fast{0};    // lock-free mirror for the hot path
 
 typedef void (*gf_fn)(const uint8_t*, int64_t, int64_t,
-                      const uint8_t*, uint8_t*, int64_t);
+                      const uint8_t*, uint8_t*, int64_t, int64_t);
 
 static bool self_test(gf_fn fn) {
     // 4x10 over 300 bytes — longer than every tier's vector stride (128
@@ -287,9 +312,22 @@ static bool self_test(gf_fn fn) {
     }
     memset(want, 0, sizeof(want));
     memset(got, 0, sizeof(got));
-    gf_apply_scalar(mat, 4, 10, data, want, N);
-    fn(mat, 4, 10, data, got, N);
-    return memcmp(want, got, sizeof(got)) == 0;
+    gf_apply_scalar(mat, 4, 10, data, want, N, N);
+    fn(mat, 4, 10, data, got, N, N);
+    if (memcmp(want, got, sizeof(got)) != 0) return false;
+    // strided: columns [64, 64+89) only, full-row pitch — the shape the
+    // multi-core column sharder drives
+    memset(got, 0, sizeof(got));
+    fn(mat, 4, 10, data + 64, got + 64, N, 89);
+    for (int i = 0; i < 4; i++) {
+        if (memcmp(want + i * N + 64, got + i * N + 64, 89) != 0)
+            return false;
+        for (int t = 0; t < N; t++) {
+            if ((t < 64 || t >= 64 + 89) && got[i * N + t] != 0)
+                return false;  // wrote outside its column range
+        }
+    }
+    return true;
 }
 
 // capability + self-test probe for one tier; GF_SCALAR always passes
@@ -341,12 +379,32 @@ void gf_apply(const uint8_t* mat, int64_t m, int64_t k,
               const uint8_t* data, uint8_t* out, int64_t n) {
     switch (resolve_impl()) {
 #if defined(RS_X86) && defined(RS_HAVE_GFNI)
-        case GF_GFNI: gf_apply_gfni(mat, m, k, data, out, n); break;
+        case GF_GFNI: gf_apply_gfni(mat, m, k, data, out, n, n); break;
 #endif
 #ifdef RS_X86
-        case GF_AVX2: gf_apply_avx2(mat, m, k, data, out, n); break;
+        case GF_AVX2: gf_apply_avx2(mat, m, k, data, out, n, n); break;
 #endif
-        default:      gf_apply_scalar(mat, m, k, data, out, n); break;
+        default:      gf_apply_scalar(mat, m, k, data, out, n, n); break;
+    }
+}
+
+// Column-sharded variant for multi-threaded callers: process only columns
+// [col0, col0+len) of (k, stride) data into (m, stride) out, reading and
+// writing nothing outside that range. Disjoint column ranges are safe to
+// run concurrently from different threads (ctypes releases the GIL).
+void gf_apply_strided(const uint8_t* mat, int64_t m, int64_t k,
+                      const uint8_t* data, uint8_t* out, int64_t stride,
+                      int64_t col0, int64_t len) {
+    const uint8_t* d = data + col0;
+    uint8_t* o = out + col0;
+    switch (resolve_impl()) {
+#if defined(RS_X86) && defined(RS_HAVE_GFNI)
+        case GF_GFNI: gf_apply_gfni(mat, m, k, d, o, stride, len); break;
+#endif
+#ifdef RS_X86
+        case GF_AVX2: gf_apply_avx2(mat, m, k, d, o, stride, len); break;
+#endif
+        default:      gf_apply_scalar(mat, m, k, d, o, stride, len); break;
     }
 }
 

@@ -57,6 +57,12 @@ def _bind(lib) -> None:
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
     ]
     lib.gf_apply.restype = None
+    lib.gf_apply_strided.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.gf_apply_strided.restype = None
     lib.crc32c.argtypes = [ctypes.c_uint32, ctypes.c_void_p, ctypes.c_int64]
     lib.crc32c.restype = ctypes.c_uint32
     lib.gf_force_impl.argtypes = [ctypes.c_int]
@@ -107,6 +113,27 @@ def gf_apply(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
     out = np.zeros((m, n), dtype=np.uint8)
     lib.gf_apply(mat.ctypes.data, m, k, data.ctypes.data, out.ctypes.data, n)
     return out
+
+
+def gf_apply_into(mat: np.ndarray, data: np.ndarray, out: np.ndarray,
+                  col0: int = 0, length: int | None = None) -> None:
+    """Accumulate mat (m,k) x data (k,n) into columns [col0, col0+length)
+    of out (m,n), which must be zero there (or hold a partial sum). The
+    call releases the GIL and touches nothing outside its column range, so
+    disjoint ranges may run concurrently from a thread pool."""
+    lib = _load()
+    assert lib is not None
+    assert mat.dtype == np.uint8 and mat.flags.c_contiguous
+    assert data.dtype == np.uint8 and data.flags.c_contiguous
+    assert out.dtype == np.uint8 and out.flags.c_contiguous
+    m, k = mat.shape
+    k2, n = data.shape
+    assert k == k2 and out.shape == (m, n)
+    if length is None:
+        length = n - col0
+    assert 0 <= col0 and col0 + length <= n
+    lib.gf_apply_strided(mat.ctypes.data, m, k, data.ctypes.data,
+                         out.ctypes.data, n, col0, length)
 
 
 IMPL_AUTO, IMPL_SCALAR, IMPL_AVX2, IMPL_GFNI = 0, 1, 2, 3

@@ -87,6 +87,35 @@ def _mul_table() -> np.ndarray:
 MUL_TABLE = _mul_table()
 
 
+@functools.lru_cache(maxsize=None)
+def nibble_tables(c: int) -> tuple[np.ndarray, np.ndarray]:
+    """Split-nibble tables for constant c: (low, high), 16 entries each,
+    with c*d == low[d & 0xF] ^ high[d >> 4]. This is the table shape the
+    PSHUFB/VGF2P8 kernels consume (native/rs_cpu.cpp make_nibble_tables);
+    exposed here for the pure-numpy fallback and its cross-validation."""
+    low = MUL_TABLE[c, :16].copy()
+    high = MUL_TABLE[c, [v << 4 for v in range(16)]].copy()
+    low.setflags(write=False)
+    high.setflags(write=False)
+    return low, high
+
+
+@functools.lru_cache(maxsize=None)
+def pair_table(c: int) -> np.ndarray:
+    """65536-entry uint16 table applying c bytewise to a little-endian
+    byte pair: pair_table(c)[b0 | b1<<8] == (c*b0) | (c*b1)<<8.
+
+    One gather per TWO bytes — the numpy analogue of widening the
+    split-nibble trick to byte granularity (numpy has no in-register
+    shuffle, so fewer/larger gathers beat two 16-entry lookups; measured
+    3.1x over the single-byte MUL_TABLE gather, see PERF.md round 6).
+    128KiB per cached coefficient; an RS(10,4) parity matrix uses <=40."""
+    row = MUL_TABLE[c].astype(np.uint16)
+    tab = (row[None, :] | (row[:, None] << 8)).reshape(-1)
+    tab.setflags(write=False)
+    return tab
+
+
 def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     """Matrix product over GF(256). a: (m, k) uint8, b: (k, n) uint8 -> (m, n)."""
     a = np.asarray(a, dtype=np.uint8)

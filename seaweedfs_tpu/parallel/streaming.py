@@ -1,11 +1,29 @@
-"""Host->HBM streaming EC pipelines for volumes larger than device memory.
+"""Staged EC pipelines: overlapped read -> code -> write for whole volumes.
 
 BASELINE.json configs 2 and 4: a 30GB volume cannot sit in a v5e's 16GB
 HBM, so ec.encode streams column-aligned batches disk -> host -> HBM with
-a reader thread prefetching batch N+1 while the device computes batch N
-(the async JAX dispatch queue is the second pipeline stage). The batched
-API encodes many volumes concurrently by stacking them on a leading axis
-the device iterates with one program.
+reader threads prefetching batch N+1 while the coder works on batch N and
+a writer thread drains batch N-1 to the shard files. The same three-stage
+shape serves the CPU coder (whose native kernel releases the GIL, so the
+reader/writer threads genuinely overlap the GF compute) and the JAX coder
+(whose async dispatch overlaps host->device transfer with device compute;
+the writer's np.asarray() is the synchronization point).
+
+Stage plumbing invariants:
+  - every inter-stage queue is BOUNDED (maxsize=prefetch): a slow writer
+    backpressures the coder, a slow coder backpressures the readers, so
+    peak memory is O(prefetch * batch) regardless of volume size;
+  - a failing stage records its exception in the _Pipeline and trips the
+    shared abort event; every blocking put/get polls that event, so all
+    threads unwind promptly and the first error is re-raised to the caller;
+  - shard outputs go to `.tmp` names and are renamed into place only after
+    every stage has finished cleanly — an interrupted pipeline never
+    leaves a truncated file under a final shard name;
+  - buffers are pooled and recycled writer -> reader, so steady-state
+    allocation is zero.
+
+The batched API at the bottom encodes many volumes concurrently by
+stacking them on a leading axis the device iterates with one program.
 """
 
 from __future__ import annotations
@@ -13,88 +31,394 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import Optional, Sequence
 
 import numpy as np
 
-from seaweedfs_tpu.models.coder import DEFAULT_SCHEME, RSScheme
+from seaweedfs_tpu.models.coder import DEFAULT_SCHEME, ErasureCoder, RSScheme
 from seaweedfs_tpu.storage.erasure_coding import layout
+
+DEFAULT_PIPE_BATCH = 16 * 1024 * 1024
+
+
+class PipelineError(RuntimeError):
+    """A pipeline stage failed; the original exception is the __cause__."""
+
+
+class _Aborted(Exception):
+    """Internal control flow: the shared abort event tripped."""
+
+
+class _Pipeline:
+    """Shared failure state for one pipeline run: first-error capture plus
+    an abort event that every blocking queue operation polls."""
+
+    _POLL = 0.05
+
+    def __init__(self):
+        self.abort = threading.Event()
+        self._lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self._threads: list[threading.Thread] = []
+
+    def fail(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = exc
+        self.abort.set()
+
+    def check(self) -> None:
+        if self._error is not None:
+            raise PipelineError(
+                f"pipeline stage failed: {self._error!r}") from self._error
+
+    def put(self, q: "queue.Queue", item) -> None:
+        while True:
+            if self.abort.is_set():
+                raise _Aborted()
+            try:
+                q.put(item, timeout=self._POLL)
+                return
+            except queue.Full:
+                continue
+
+    def get(self, q: "queue.Queue"):
+        while True:
+            if self.abort.is_set():
+                raise _Aborted()
+            try:
+                return q.get(timeout=self._POLL)
+            except queue.Empty:
+                continue
+
+    def spawn(self, fn, *args) -> threading.Thread:
+        """Run fn(*args) in a daemon thread; any exception trips abort."""
+        def run():
+            try:
+                fn(*args)
+            except _Aborted:
+                pass
+            except BaseException as e:  # noqa: BLE001 — must reach caller
+                self.fail(e)
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return t
+
+    def join(self) -> None:
+        for t in self._threads:
+            t.join()
+        self.check()
+
+
+class _BufferPool:
+    """Recycles equal-shaped uint8 arrays writer -> reader. get() falls
+    back to allocation on shape change (large rows -> small-row tail)."""
+
+    def __init__(self):
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+
+    def get(self, shape: tuple[int, ...]) -> np.ndarray:
+        try:
+            while True:
+                buf = self._q.get_nowait()
+                if buf.shape == shape:
+                    return buf
+                # stale shape from a previous block tier — drop it
+        except queue.Empty:
+            return np.empty(shape, dtype=np.uint8)
+
+    def put(self, buf: np.ndarray) -> None:
+        self._q.put(buf)
+
+
+class AtomicFileGroup:
+    """A set of output files written under `.tmp` names and renamed into
+    place together on commit(). discard() removes the temporaries; either
+    way no truncated file is ever visible under a final name."""
+
+    def __init__(self, paths: Sequence[str]):
+        self.paths = list(paths)
+        self._tmps = [p + ".tmp" for p in self.paths]
+        self.files = [open(t, "wb") for t in self._tmps]
+        self._open = True
+
+    def _close(self) -> None:
+        if self._open:
+            for f in self.files:
+                f.close()
+            self._open = False
+
+    def commit(self) -> None:
+        self._close()
+        for tmp, final in zip(self._tmps, self.paths):
+            os.replace(tmp, final)
+
+    def discard(self) -> None:
+        self._close()
+        for tmp in self._tmps:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _merge_stats(stats: Optional[dict], lock: threading.Lock,
+                 **deltas) -> None:
+    if stats is None:
+        return
+    with lock:
+        for key, v in deltas.items():
+            stats[key] = stats.get(key, 0) + v
+
+
+def _read_rows(f, buf: np.ndarray, desc, k: int) -> None:
+    """Fill buf (k, step) with the descriptor's per-shard slices of the
+    .dat, zero-filling past EOF (encodeDataOneBatch semantics)."""
+    row_off, block, b, step = desc
+    for i in range(k):
+        f.seek(row_off + i * block + b)
+        got = f.readinto(memoryview(buf[i]))
+        if got < step:
+            buf[i, got:] = 0
 
 
 def pipelined_encode_file(base_file_name: str,
                           scheme: RSScheme = DEFAULT_SCHEME,
                           large_block: int = layout.LARGE_BLOCK_SIZE,
                           small_block: int = layout.SMALL_BLOCK_SIZE,
-                          batch_size: int = 16 * 1024 * 1024,
-                          prefetch: int = 2) -> None:
-    """write_ec_files with a prefetching reader thread feeding the TPU
-    parity kernel; produces the identical on-disk layout."""
-    import jax
+                          batch_size: int = DEFAULT_PIPE_BATCH,
+                          prefetch: int = 2,
+                          coder: Optional[ErasureCoder] = None,
+                          readers: int = 1,
+                          stats: Optional[dict] = None) -> None:
+    """write_ec_files as a staged pipeline; identical on-disk output.
 
-    from seaweedfs_tpu.ops.rs_jax import parity_fn
-
-    fn = parity_fn(scheme)  # row-based: fn(*rows) -> tuple of parity rows
+    coder=None keeps the original behaviour (the JAX parity kernel);
+    passing an ErasureCoder (typically CpuCoder / CpuCoderMT) runs its
+    encode on the main thread between the reader and writer stages.
+    `stats`, when a dict, receives per-stage busy seconds (read_s /
+    encode_s / write_s), wall_s, bytes_in and batches — the numbers
+    tools/ec_profile.py prints."""
+    if coder is not None:
+        scheme = coder.scheme
     k = scheme.data_shards
     total = scheme.total_shards
+    m = total - k
     dat_path = base_file_name + ".dat"
     dat_size = os.path.getsize(dat_path)
+    descs = list(layout.iter_encode_batches(dat_size, large_block,
+                                            small_block, batch_size, k))
+    readers = max(1, min(readers, len(descs) or 1))
 
-    work: "queue.Queue" = queue.Queue(maxsize=prefetch)
+    fn = None
+    if coder is None:
+        from seaweedfs_tpu.ops.rs_jax import parity_fn
+        fn = parity_fn(scheme)  # fn(*rows) -> tuple of parity rows
 
-    def reader():
+    pl = _Pipeline()
+    read_q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+    write_q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+    data_pool = _BufferPool()
+    parity_pool = _BufferPool()
+    slock = threading.Lock()
+    wall0 = time.perf_counter()
+
+    def reader_stage(rid: int):
+        busy = 0.0
         with open(dat_path, "rb") as f:
-            processed = 0
-            remaining = dat_size
-            while remaining > 0:
-                block = large_block if remaining > large_block * k \
-                    else small_block
-                step = min(batch_size, block)
-                if block % step:
-                    step = block
-                for b in range(0, block, step):
-                    data = np.zeros((k, step), dtype=np.uint8)
-                    for i in range(k):
-                        f.seek(processed + i * block + b)
-                        buf = f.read(step)
-                        if buf:
-                            data[i, :len(buf)] = np.frombuffer(
-                                buf, dtype=np.uint8)
-                    work.put(data)
-                processed += block * k
-                remaining -= block * k
-        work.put(None)
+            for seq in range(rid, len(descs), readers):
+                t0 = time.perf_counter()
+                buf = data_pool.get((k, descs[seq][3]))
+                _read_rows(f, buf, descs[seq], k)
+                busy += time.perf_counter() - t0
+                pl.put(read_q, (seq, buf))
+        _merge_stats(stats, slock, read_s=busy)
 
-    t = threading.Thread(target=reader, daemon=True)
-    t.start()
-
-    outs = [open(base_file_name + layout.shard_ext(i), "wb")
-            for i in range(total)]
-    inflight: list[tuple[np.ndarray, object]] = []
-    try:
+    def writer_stage(outs: AtomicFileGroup):
+        busy = 0.0
         while True:
-            item = work.get()
+            item = pl.get(write_q)
             if item is None:
                 break
-            words = item.view(np.uint32)
-            rows = [jax.device_put(words[i]) for i in range(k)]
-            parity = fn(*rows)  # async dispatch, flat-row layout
-            inflight.append((item, parity))
-            if len(inflight) > prefetch:
-                self_drain(inflight, outs, k)
-        while inflight:
-            self_drain(inflight, outs, k)
-    finally:
-        for o in outs:
-            o.close()
-        t.join(timeout=10)
+            data, parity = item
+            t0 = time.perf_counter()
+            if fn is not None:
+                # materialize BEFORE recycling: on the CPU jax backend
+                # device_put may alias the host buffer, so the data array
+                # must stay untouched until the parity is out
+                parity = [np.asarray(p).view(np.uint8) for p in parity]
+            for i in range(k):
+                outs.files[i].write(data[i])
+            for r in range(m):
+                outs.files[k + r].write(parity[r])
+            busy += time.perf_counter() - t0
+            data_pool.put(data)
+            if isinstance(parity, np.ndarray):
+                parity_pool.put(parity)
+        _merge_stats(stats, slock, write_s=busy)
+
+    outs = AtomicFileGroup([base_file_name + layout.shard_ext(i)
+                            for i in range(total)])
+    try:
+        writer_t = pl.spawn(writer_stage, outs)
+        for rid in range(readers):
+            pl.spawn(reader_stage, rid)
+
+        encode_busy = 0.0
+        stash: dict[int, np.ndarray] = {}
+        for expected in range(len(descs)):
+            while expected not in stash:
+                seq, buf = pl.get(read_q)
+                stash[seq] = buf
+            data = stash.pop(expected)
+            t0 = time.perf_counter()
+            if fn is not None:
+                words = data.view(np.uint32)
+                import jax
+                rows = [jax.device_put(words[i]) for i in range(k)]
+                parity = fn(*rows)  # async dispatch; writer synchronizes
+            else:
+                pbuf = parity_pool.get((m, data.shape[1]))
+                if hasattr(coder, "encode_into"):
+                    parity = coder.encode_into(data, pbuf)
+                else:
+                    parity = np.asarray(coder.encode_array(data))
+            encode_busy += time.perf_counter() - t0
+            pl.put(write_q, (data, parity))
+        pl.put(write_q, None)
+        writer_t.join()
+        pl.join()
+        _merge_stats(stats, slock, encode_s=encode_busy,
+                     wall_s=time.perf_counter() - wall0,
+                     bytes_in=dat_size, batches=len(descs))
+        outs.commit()
+    except _Aborted:
+        # a stage failed and tripped abort while the main thread blocked;
+        # surface the stage's exception, not the control-flow marker
+        _unwind(pl, outs)
+    except BaseException:
+        pl.abort.set()
+        _unwind(pl, outs, reraise=False)
+        raise
 
 
-def self_drain(inflight, outs, k):
-    data, parity = inflight.pop(0)
-    for i in range(k):
-        outs[i].write(data[i].tobytes())
-    for i, prow in enumerate(parity):
-        outs[k + i].write(np.asarray(prow).view(np.uint8).tobytes())
+def _unwind(pl: _Pipeline, outs: "AtomicFileGroup",
+            reraise: bool = True) -> None:
+    for t in pl._threads:
+        t.join(timeout=5)
+    outs.discard()
+    if reraise:
+        pl.check()
+        raise PipelineError("pipeline aborted without a recorded error")
+
+
+def pipelined_rebuild_files(base_file_name: str,
+                            coder: ErasureCoder,
+                            batch_size: int = DEFAULT_PIPE_BATCH,
+                            prefetch: int = 2,
+                            stats: Optional[dict] = None) -> list[int]:
+    """Regenerate missing .ecNN files from survivors with overlapped
+    shard reads, GF reconstruction and writes. Returns generated ids.
+
+    The coefficient matrix mapping the first k surviving shards to every
+    missing shard is computed ONCE (CpuCoder.rebuild_matrix) and streamed
+    over the batches — the serial path re-derives it per batch through
+    the bytes API."""
+    k = coder.scheme.data_shards
+    total = coder.scheme.total_shards
+    present = [i for i in range(total)
+               if os.path.exists(base_file_name + layout.shard_ext(i))]
+    missing = [i for i in range(total) if i not in present]
+    if not missing:
+        return []
+    if len(present) < k:
+        raise ValueError(f"need {k} shards, have {len(present)}")
+
+    if not hasattr(coder, "rebuild_matrix"):
+        from seaweedfs_tpu.ops.rs_cpu import CpuCoder
+        coder = CpuCoder(coder.scheme, workers="auto")
+    rmat = coder.rebuild_matrix(present, missing)
+    src = sorted(present)[:k]
+
+    shard_size = os.path.getsize(base_file_name + layout.shard_ext(src[0]))
+    offs = list(range(0, shard_size, batch_size))
+
+    pl = _Pipeline()
+    read_q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+    write_q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+    data_pool = _BufferPool()
+    out_pool = _BufferPool()
+    slock = threading.Lock()
+    wall0 = time.perf_counter()
+
+    def reader_stage():
+        busy = 0.0
+        ins = [open(base_file_name + layout.shard_ext(i), "rb") for i in src]
+        try:
+            for off in offs:
+                n = min(batch_size, shard_size - off)
+                t0 = time.perf_counter()
+                buf = data_pool.get((k, n))
+                for r, f in enumerate(ins):
+                    f.seek(off)
+                    got = f.readinto(memoryview(buf[r]))
+                    if got < n:
+                        raise IOError(
+                            f"short read on {base_file_name}"
+                            f"{layout.shard_ext(src[r])} at {off}")
+                busy += time.perf_counter() - t0
+                pl.put(read_q, buf)
+            pl.put(read_q, None)
+        finally:
+            for f in ins:
+                f.close()
+        _merge_stats(stats, slock, read_s=busy)
+
+    def writer_stage(outs: AtomicFileGroup):
+        busy = 0.0
+        while True:
+            item = pl.get(write_q)
+            if item is None:
+                break
+            t0 = time.perf_counter()
+            for r in range(len(missing)):
+                outs.files[r].write(item[r])
+            busy += time.perf_counter() - t0
+            out_pool.put(item)
+        _merge_stats(stats, slock, write_s=busy)
+
+    outs = AtomicFileGroup([base_file_name + layout.shard_ext(i)
+                            for i in missing])
+    try:
+        writer_t = pl.spawn(writer_stage, outs)
+        pl.spawn(reader_stage)
+        busy = 0.0
+        while True:
+            buf = pl.get(read_q)
+            if buf is None:
+                break
+            t0 = time.perf_counter()
+            rec = coder.reconstruct_rows(
+                buf, rmat, out_pool.get((len(missing), buf.shape[1])))
+            busy += time.perf_counter() - t0
+            pl.put(write_q, rec)
+            data_pool.put(buf)
+        pl.put(write_q, None)
+        writer_t.join()
+        pl.join()
+        _merge_stats(stats, slock, encode_s=busy,
+                     wall_s=time.perf_counter() - wall0,
+                     bytes_in=shard_size * k, batches=len(offs))
+        outs.commit()
+    except _Aborted:
+        _unwind(pl, outs)
+    except BaseException:
+        pl.abort.set()
+        _unwind(pl, outs, reraise=False)
+        raise
+    return missing
 
 
 def batch_encode_volumes(data_batch: np.ndarray,

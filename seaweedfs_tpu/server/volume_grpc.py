@@ -216,16 +216,20 @@ class VolumeGrpc:
     # ---- EC unary RPCs ----
     @_guard
     def ec_generate(self, request, context):
+        # gRPC plane always takes the pipelined encoder (overlapped
+        # I/O + compute; serial is reachable via the HTTP admin flag)
         body = _check(self.vs._ec_generate(LocalRequest(
             {"volume_id": request.volume_id,
-             "collection": request.collection})))
+             "collection": request.collection,
+             "pipelined": True})))
         return pb.VolumeEcShardsGenerateResponse(base=body.get("base", ""))
 
     @_guard
     def ec_rebuild(self, request, context):
         body = _check(self.vs._ec_rebuild(LocalRequest(
             {"volume_id": request.volume_id,
-             "collection": request.collection})))
+             "collection": request.collection,
+             "pipelined": True})))
         return pb.VolumeEcShardsRebuildResponse(
             rebuilt_shard_ids=body.get("rebuilt_shard_ids", []))
 

@@ -939,14 +939,16 @@ class VolumeServer:
     # ---- EC rpcs (reference volume_grpc_erasure_coding.go) ----
     def _ec_generate(self, req: Request) -> Response:
         b = req.json()
-        base = self.store.generate_ec_shards(b["volume_id"])
+        base = self.store.generate_ec_shards(
+            b["volume_id"], pipelined=b.get("pipelined", True))
         return Response({"base": os.path.basename(base)})
 
     def _ec_rebuild(self, req: Request) -> Response:
         b = req.json()
         vid = b["volume_id"]
         base = self._ec_base_name(vid, b.get("collection", ""))
-        rebuilt = ecenc.rebuild_ec_files(base, self.store.coder)
+        rebuilt = ecenc.rebuild_ec_files(base, self.store.coder,
+                                         pipelined=b.get("pipelined", True))
         ecenc.rebuild_ecx_file(base)
         return Response({"rebuilt_shard_ids": rebuilt})
 
@@ -1032,7 +1034,8 @@ class VolumeServer:
         collection = b.get("collection", "")
         base = self._ec_base_name(vid, collection)
         dat_size = ecdec.find_dat_file_size(base, base)
-        ecdec.write_dat_file(base, dat_size)
+        ecdec.write_dat_file(base, dat_size,
+                             pipelined=b.get("pipelined", True))
         ecdec.write_idx_file_from_ec_index(base)
         # unmount EC view, load as normal volume
         self.store.unmount_ec_shards(

@@ -607,18 +607,20 @@ class ShellContext:
 
     # ---- ec.encode (reference command_ec_encode.go doEcEncode) ----
     def ec_encode(self, vid: Optional[int] = None, collection: str = "",
-                  delete_source: bool = True) -> list[dict]:
+                  delete_source: bool = True,
+                  pipelined: bool = True) -> list[dict]:
         topo = self.topology()
         vids = [vid] if vid is not None else \
             ec_plan.collect_volume_ids_for_ec_encode(topo, collection)
         results = []
         for v in vids:
-            results.append(self._ec_encode_one(topo, v, delete_source))
+            results.append(self._ec_encode_one(topo, v, delete_source,
+                                               pipelined))
             topo = self.topology()  # refresh between volumes
         return results
 
-    def _ec_encode_one(self, topo: dict, vid: int,
-                       delete_source: bool) -> dict:
+    def _ec_encode_one(self, topo: dict, vid: int, delete_source: bool,
+                       pipelined: bool = True) -> dict:
         plan = ec_plan.plan_ec_encode(topo, vid)
         source = plan["source"]
         collection = ""
@@ -634,8 +636,11 @@ class ShellContext:
             self._vs(replica, "/admin/mark_readonly",
                      {"volume_id": vid, "read_only": True})
         # 2. generate shards on the source
+        # pipelined=False forces the server's serial encoder (benchmark
+        # comparator / minimal path); default overlaps I/O with compute
         self._vs(source, "/admin/ec/generate",
-                 {"volume_id": vid, "collection": collection})
+                 {"volume_id": vid, "collection": collection,
+                  "pipelined": pipelined})
         # 3. spread: copy to targets, mount
         by_target: dict[str, list[int]] = defaultdict(list)
         for mv in plan["moves"]:
@@ -666,7 +671,8 @@ class ShellContext:
                 "placement": {t: sorted(s) for t, s in by_target.items()}}
 
     # ---- ec.rebuild (reference command_ec_rebuild.go) ----
-    def ec_rebuild(self, apply: bool = True) -> list[dict]:
+    def ec_rebuild(self, apply: bool = True,
+                   pipelined: bool = True) -> list[dict]:
         topo = self.topology()
         plans = ec_plan.plan_ec_rebuild(topo)
         if not apply:
@@ -683,7 +689,8 @@ class ShellContext:
                          {"volume_id": plan["vid"], "shard_ids": sids,
                           "source_data_node": source, "copy_ecx_file": True})
             out = self._vs(rebuilder, "/admin/ec/rebuild",
-                           {"volume_id": plan["vid"]})
+                           {"volume_id": plan["vid"],
+                            "pipelined": pipelined})
             plan["rebuilt"] = out.get("rebuilt_shard_ids", [])
             self._vs(rebuilder, "/admin/ec/mount",
                      {"volume_id": plan["vid"],
@@ -715,7 +722,7 @@ class ShellContext:
         return moves
 
     # ---- ec.decode (reference command_ec_decode.go) ----
-    def ec_decode(self, vid: int) -> dict:
+    def ec_decode(self, vid: int, pipelined: bool = True) -> dict:
         topo = self.topology()
         plan = ec_plan.plan_ec_decode(topo, vid)
         collector = plan["collector"]
@@ -728,7 +735,8 @@ class ShellContext:
                       "source_data_node": source, "copy_ecx_file": True})
             self._vs(collector, "/admin/ec/mount",
                      {"volume_id": vid, "shard_ids": sids})
-        out = self._vs(collector, "/admin/ec/to_volume", {"volume_id": vid})
+        out = self._vs(collector, "/admin/ec/to_volume",
+                       {"volume_id": vid, "pipelined": pipelined})
         # clean up shards everywhere else
         for sid, owner_list in plan["all_owners"].items():
             for owner in owner_list:

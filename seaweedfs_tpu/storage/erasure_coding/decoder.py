@@ -48,31 +48,98 @@ def read_ec_volume_version(base_file_name: str) -> int:
     return sb.version
 
 
+def _iter_dat_pieces(dat_file_size: int, large_block: int,
+                     small_block: int, k: int):
+    """Yield (shard_id, take) pieces reassembling the .dat in order.
+
+    Row split comes from layout.row_counts — the ENCODER-consistent rule
+    (large rows while remaining > large_row, strictly). The old loop here
+    used `>=`, so a .dat of exactly k*large_block bytes (which the encoder
+    writes as small rows) was misread as one large row, scrambling the
+    reassembly. The final partial small row stops as soon as the size is
+    exhausted; trailing shard padding is never read."""
+    n_large, n_small = layout.row_counts(dat_file_size, large_block,
+                                         small_block, k)
+    remaining = dat_file_size
+    for block, rows in ((large_block, n_large), (small_block, n_small)):
+        for _ in range(rows):
+            for i in range(k):
+                take = min(remaining, block)
+                if take <= 0:
+                    return
+                yield i, take
+                remaining -= take
+
+
 def write_dat_file(base_file_name: str, dat_file_size: int,
                    large_block: int = layout.LARGE_BLOCK_SIZE,
-                   small_block: int = layout.SMALL_BLOCK_SIZE) -> None:
+                   small_block: int = layout.SMALL_BLOCK_SIZE,
+                   pipelined: bool = True) -> None:
     """Reassemble .dat from data shards .ec00-.ec09 by walking rows
     (reference ec_decoder.go:154-195). Note the reference reads shards
-    sequentially, so the per-shard read cursor advances across rows."""
+    sequentially, so the per-shard read cursor advances across rows.
+
+    The output goes to .dat.tmp and is renamed into place on success, so
+    an interrupted decode never leaves a truncated .dat. With
+    pipelined=True a reader thread prefetches shard chunks through a
+    bounded queue while the main thread writes (overlapped I/O)."""
     k = layout.DATA_SHARDS_COUNT
     ins = [open(base_file_name + layout.shard_ext(i), "rb") for i in range(k)]
+    tmp = base_file_name + ".dat.tmp"
     try:
-        with open(base_file_name + ".dat", "wb") as out:
-            remaining = dat_file_size
-            while remaining >= k * large_block:
-                for i in range(k):
-                    _copy_n(ins[i], out, large_block)
-                    remaining -= large_block
-            while remaining > 0:
-                for i in range(k):
-                    to_read = min(remaining, small_block)
-                    if to_read <= 0:
-                        break
-                    _copy_n(ins[i], out, to_read)
-                    remaining -= to_read
+        with open(tmp, "wb") as out:
+            if pipelined:
+                _pipelined_reassemble(ins, out, dat_file_size, large_block,
+                                      small_block, k)
+            else:
+                for i, take in _iter_dat_pieces(dat_file_size, large_block,
+                                                small_block, k):
+                    _copy_n(ins[i], out, take)
+        os.replace(tmp, base_file_name + ".dat")
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
     finally:
         for f in ins:
             f.close()
+
+
+def _pipelined_reassemble(ins, out, dat_file_size: int, large_block: int,
+                          small_block: int, k: int,
+                          prefetch: int = 4) -> None:
+    """Reader thread pulls _COPY_CHUNK-sized pieces off the shard files
+    into a bounded queue; the caller's thread drains it to the output."""
+    from seaweedfs_tpu.parallel.streaming import _Aborted, _Pipeline
+    import queue as _q
+
+    pl = _Pipeline()
+    work: "_q.Queue" = _q.Queue(maxsize=prefetch)
+
+    def reader():
+        for i, take in _iter_dat_pieces(dat_file_size, large_block,
+                                        small_block, k):
+            left = take
+            while left > 0:
+                chunk = ins[i].read(min(left, _COPY_CHUNK))
+                if not chunk:
+                    raise IOError(f"unexpected EOF with {left} bytes left")
+                left -= len(chunk)
+                pl.put(work, chunk)
+        pl.put(work, None)
+
+    pl.spawn(reader)
+    try:
+        while True:
+            chunk = pl.get(work)
+            if chunk is None:
+                break
+            out.write(chunk)
+    except _Aborted:
+        pass
+    pl.join()
 
 
 def _copy_n(src, dst, n: int) -> None:

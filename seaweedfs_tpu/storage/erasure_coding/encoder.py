@@ -11,7 +11,7 @@ bit-identical (see layout.py).
 from __future__ import annotations
 
 import os
-from typing import Optional, Sequence
+from typing import Optional
 
 import numpy as np
 
@@ -60,62 +60,69 @@ def _read_block(f, offset: int, length: int) -> np.ndarray:
 def write_ec_files(base_file_name: str, coder: Optional[ErasureCoder] = None,
                    large_block: int = layout.LARGE_BLOCK_SIZE,
                    small_block: int = layout.SMALL_BLOCK_SIZE,
-                   batch_size: int = DEFAULT_BATCH_SIZE) -> None:
+                   batch_size: int = DEFAULT_BATCH_SIZE,
+                   pipelined: bool = False,
+                   readers: int = 1,
+                   stats: Optional[dict] = None) -> None:
     """Encode <base>.dat into <base>.ec00 .. .ec13 (WriteEcFiles
-    equivalent, reference ec_encoder.go:56-59,194-231)."""
+    equivalent, reference ec_encoder.go:56-59,194-231).
+
+    pipelined=True runs the staged reader/coder/writer pipeline from
+    parallel/streaming.py (overlapped I/O + compute, same bits on disk —
+    both paths iterate layout.iter_encode_batches). The serial path is
+    kept as the benchmark comparator and the minimal-dependency fallback.
+    Either way shards are written to .tmp names and renamed into place, so
+    an interrupted encode never leaves a truncated .ecNN behind."""
     coder = coder or make_coder("cpu")
+    if pipelined:
+        from seaweedfs_tpu.parallel import streaming
+        streaming.pipelined_encode_file(
+            base_file_name, coder.scheme, large_block, small_block,
+            batch_size, coder=coder, readers=readers, stats=stats)
+        return
+    from seaweedfs_tpu.parallel.streaming import AtomicFileGroup
     k = coder.scheme.data_shards
     total = coder.scheme.total_shards
     dat_path = base_file_name + ".dat"
     dat_size = os.path.getsize(dat_path)
 
-    outs = [open(base_file_name + layout.shard_ext(i), "wb")
-            for i in range(total)]
+    outs = AtomicFileGroup([base_file_name + layout.shard_ext(i)
+                            for i in range(total)])
     try:
         with open(dat_path, "rb") as f:
-            processed = 0
-            remaining = dat_size
-            while remaining > large_block * k:
-                _encode_row(f, coder, processed, large_block, batch_size, outs)
-                processed += large_block * k
-                remaining -= large_block * k
-            while remaining > 0:
-                _encode_row(f, coder, processed, small_block, batch_size, outs)
-                processed += small_block * k
-                remaining -= small_block * k
-    finally:
-        for o in outs:
-            o.close()
-
-
-def _encode_row(f, coder: ErasureCoder, start_offset: int, block_size: int,
-                batch_size: int, outs: Sequence) -> None:
-    """One row: data block i lives at start_offset + i*block_size; append
-    one full block to every shard file, parity computed column-wise."""
-    k = coder.scheme.data_shards
-    batch = min(batch_size, block_size)
-    assert block_size % batch == 0 or batch == block_size, \
-        f"batch {batch} must divide block {block_size}"
-    if block_size % batch != 0:
-        batch = block_size
-    for b in range(0, block_size, batch):
-        data = np.stack([
-            _read_block(f, start_offset + i * block_size + b, batch)
-            for i in range(k)])
-        parity = np.asarray(coder.encode_array(data))
-        for i in range(k):
-            outs[i].write(data[i].tobytes())
-        for i in range(parity.shape[0]):
-            outs[k + i].write(parity[i].tobytes())
+            for row_off, block, b, step in layout.iter_encode_batches(
+                    dat_size, large_block, small_block, batch_size, k):
+                data = np.stack([
+                    _read_block(f, row_off + i * block + b, step)
+                    for i in range(k)])
+                parity = np.asarray(coder.encode_array(data))
+                for i in range(k):
+                    outs.files[i].write(data[i].tobytes())
+                for i in range(parity.shape[0]):
+                    outs.files[k + i].write(parity[i].tobytes())
+    except BaseException:
+        outs.discard()
+        raise
+    outs.commit()
 
 
 def rebuild_ec_files(base_file_name: str, coder: Optional[ErasureCoder] = None,
-                     batch_size: int = DEFAULT_BATCH_SIZE) -> list[int]:
+                     batch_size: int = DEFAULT_BATCH_SIZE,
+                     pipelined: bool = False,
+                     stats: Optional[dict] = None) -> list[int]:
     """Regenerate missing .ecNN files from the survivors (RebuildEcFiles
     equivalent, reference ec_encoder.go:61-63,233-287). Returns generated
     shard ids. Requires >= data_shards survivors; all shard files have
-    equal size by construction."""
+    equal size by construction.
+
+    pipelined=True overlaps survivor reads, GF reconstruction and writes
+    (parallel/streaming.pipelined_rebuild_files) and computes the rebuild
+    coefficient matrix once instead of per batch."""
     coder = coder or make_coder("cpu")
+    if pipelined:
+        from seaweedfs_tpu.parallel import streaming
+        return streaming.pipelined_rebuild_files(
+            base_file_name, coder, batch_size, stats=stats)
     total = coder.scheme.total_shards
     k = coder.scheme.data_shards
 

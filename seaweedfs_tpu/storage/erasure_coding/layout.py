@@ -54,6 +54,38 @@ def shard_file_size(dat_size: int, large_block: int = LARGE_BLOCK_SIZE,
     return nl * large_block + ns * small_block
 
 
+def iter_encode_batches(dat_size: int, large_block: int = LARGE_BLOCK_SIZE,
+                        small_block: int = SMALL_BLOCK_SIZE,
+                        batch_size: int = 0,
+                        data_shards: int = DATA_SHARDS_COUNT):
+    """The encoder's traversal plan: yields (row_offset, block_size,
+    batch_offset, batch_len) descriptors in on-disk order. Data shard i's
+    bytes for a descriptor live at row_offset + i*block_size + batch_offset
+    in the .dat (zero-filled past EOF); each descriptor appends batch_len
+    bytes to every shard file.
+
+    Both the serial encoder (encoder.write_ec_files) and the pipelined one
+    (parallel/streaming.py) iterate THIS plan, which is what makes their
+    shard output bit-identical: same row split (strict `>` large-row rule,
+    see row_counts), same batch boundaries, same zero padding.
+
+    batch_size <= 0 means one batch per block."""
+    if batch_size <= 0:
+        batch_size = large_block
+    remaining = dat_size
+    processed = 0
+    while remaining > 0:
+        block = large_block if remaining > large_block * data_shards \
+            else small_block
+        step = min(batch_size, block)
+        if block % step:
+            step = block
+        for b in range(0, block, step):
+            yield processed, block, b, step
+        processed += block * data_shards
+        remaining -= block * data_shards
+
+
 @dataclasses.dataclass
 class Interval:
     """One contiguous piece of a logical [offset, offset+size) range, local

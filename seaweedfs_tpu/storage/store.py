@@ -49,7 +49,9 @@ class Store:
                          disk_type=types[i] or "hdd",
                          needle_map_kind=needle_map_kind)
             for i, d in enumerate(directories)]
-        self.coder = coder or make_coder("cpu")
+        # multi-core CPU coder by default: bit-identical to "cpu",
+        # shards each encode batch across the visible cores
+        self.coder = coder or make_coder("cpu-mt")
         self.remote_shard_reader: Optional[RemoteShardReader] = None
         self._lock = threading.RLock()
         # delta channels to master (drained by the heartbeat loop)
@@ -254,7 +256,8 @@ class Store:
                 except FileNotFoundError:
                     continue
 
-    def generate_ec_shards(self, vid: int) -> str:
+    def generate_ec_shards(self, vid: int, pipelined: bool = True,
+                           stats: Optional[dict] = None) -> str:
         """VolumeEcShardsGenerate equivalent: write .ec00-.ec13 + .ecx +
         .vif next to the volume's files (reference
         server/volume_grpc_erasure_coding.go:38-81). Returns the base file
@@ -269,7 +272,8 @@ class Store:
         v.sync()
         base = v.file_name()
         ecenc.write_sorted_ecx(base)
-        ecenc.write_ec_files(base, self.coder)
+        ecenc.write_ec_files(base, self.coder, pipelined=pipelined,
+                             stats=stats)
         with open(base + ".vif", "w") as f:
             json.dump({"version": v.version}, f)
         return base

@@ -11,7 +11,10 @@ from __future__ import annotations
 
 import os
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ModuleNotFoundError:  # container has OpenSSL but not the wheel
+    from seaweedfs_tpu.utils.aesgcm_compat import AESGCM
 
 KEY_SIZE = 32
 NONCE_SIZE = 12

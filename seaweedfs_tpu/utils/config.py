@@ -8,8 +8,12 @@ default tomls). Python 3.11+ tomllib reads; scaffold emits the defaults.
 from __future__ import annotations
 
 import os
-import tomllib
 from typing import Any, Optional
+
+try:
+    import tomllib  # Python 3.11+
+except ModuleNotFoundError:  # 3.10 container: bundled subset reader
+    from seaweedfs_tpu.utils import toml_compat as tomllib
 
 SEARCH_PATHS = [".", os.path.expanduser("~/.seaweedfs-tpu"),
                 "/etc/seaweedfs-tpu"]

@@ -98,7 +98,12 @@ def generate_self_signed(out_dir: str, roles: tuple[str, ...] = (
         host: str = "127.0.0.1") -> dict[str, TlsConfig]:
     """Write ca.crt + <role>.crt/<role>.key under out_dir; returns a
     TlsConfig per role. Test/dev helper (the reference documents using
-    openssl/easyrsa; same output shape)."""
+    openssl/easyrsa; same output shape). Uses `cryptography` when
+    installed, else the openssl CLI."""
+    try:
+        from cryptography import x509  # noqa: F401
+    except ModuleNotFoundError:
+        return _generate_via_openssl_cli(out_dir, roles, host)
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
@@ -152,4 +157,43 @@ def generate_self_signed(out_dir: str, roles: tuple[str, ...] = (
                 serialization.NoEncryption()))
         out[role] = TlsConfig(ca_file=ca_path, cert_file=cert_path,
                               key_file=key_path)
+    return out
+
+
+def _generate_via_openssl_cli(out_dir: str, roles: tuple[str, ...],
+                              host: str) -> dict[str, TlsConfig]:
+    """Same chain via the openssl binary (always present in this
+    container; `cryptography` is not)."""
+    import subprocess
+
+    def run(*args: str) -> None:
+        subprocess.run(["openssl", *args], check=True, capture_output=True)
+
+    os.makedirs(out_dir, exist_ok=True)
+    ca_path = os.path.join(out_dir, "ca.crt")
+    ca_key = os.path.join(out_dir, "ca.key")
+    # note: req -x509 already emits basicConstraints critical,CA:TRUE;
+    # adding it again via -addext duplicates the extension and OpenSSL
+    # then refuses to chain to the CA (verify error 20)
+    run("req", "-x509", "-newkey", "rsa:2048", "-nodes", "-sha256",
+        "-keyout", ca_key, "-out", ca_path, "-days", "30",
+        "-subj", "/CN=seaweedfs-tpu-test-ca")
+    ext_path = os.path.join(out_dir, "san.cnf")
+    with open(ext_path, "w") as f:
+        f.write(f"subjectAltName=DNS:localhost,IP:{host}\n")
+    out: dict[str, TlsConfig] = {}
+    for role in roles:
+        key_path = os.path.join(out_dir, f"{role}.key")
+        cert_path = os.path.join(out_dir, f"{role}.crt")
+        csr_path = os.path.join(out_dir, f"{role}.csr")
+        run("req", "-newkey", "rsa:2048", "-nodes", "-sha256",
+            "-keyout", key_path, "-out", csr_path,
+            "-subj", f"/CN=seaweedfs-tpu-{role}")
+        run("x509", "-req", "-in", csr_path, "-CA", ca_path,
+            "-CAkey", ca_key, "-CAcreateserial", "-sha256",
+            "-out", cert_path, "-days", "30", "-extfile", ext_path)
+        os.remove(csr_path)
+        out[role] = TlsConfig(ca_file=ca_path, cert_file=cert_path,
+                              key_file=key_path)
+    os.remove(ext_path)
     return out

@@ -1,0 +1,236 @@
+"""Minimal TOML reader for Python < 3.11 (no stdlib tomllib, and the
+container bakes no third-party toml package).
+
+Covers the subset this project's configs use — tables, arrays of
+tables, dotted headers, basic/literal strings, ints/floats/bools,
+(nested) arrays, inline tables, comments. Raises ValueError on
+anything outside that subset rather than guessing.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class TomlError(ValueError):
+    pass
+
+
+def load(fp) -> dict:
+    data = fp.read()
+    if isinstance(data, bytes):
+        data = data.decode("utf-8")
+    return loads(data)
+
+
+def loads(text: str) -> dict:
+    root: dict[str, Any] = {}
+    current = root
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = _strip_comment(raw).strip()
+        if not line:
+            continue
+        try:
+            if line.startswith("[["):
+                if not line.endswith("]]"):
+                    raise TomlError("unterminated table-array header")
+                current = _enter(root, line[2:-2].strip(), array=True)
+            elif line.startswith("["):
+                if not line.endswith("]"):
+                    raise TomlError("unterminated table header")
+                current = _enter(root, line[1:-1].strip(), array=False)
+            else:
+                key, eq, rest = line.partition("=")
+                if not eq:
+                    raise TomlError("expected 'key = value'")
+                value, tail = _parse_value(rest.strip())
+                if tail.strip():
+                    raise TomlError(f"trailing garbage {tail.strip()!r}")
+                _assign(current, key.strip(), value)
+        except TomlError as e:
+            raise TomlError(f"TOML parse error on line {lineno}: {e}") from None
+    return root
+
+
+def _strip_comment(line: str) -> str:
+    out = []
+    in_str: str | None = None
+    i = 0
+    while i < len(line):
+        ch = line[i]
+        if in_str:
+            out.append(ch)
+            if ch == "\\" and in_str == '"' and i + 1 < len(line):
+                out.append(line[i + 1])
+                i += 2
+                continue
+            if ch == in_str:
+                in_str = None
+        elif ch in ("'", '"'):
+            in_str = ch
+            out.append(ch)
+        elif ch == "#":
+            break
+        else:
+            out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def _split_dotted(key: str) -> list[str]:
+    parts: list[str] = []
+    buf = []
+    in_str: str | None = None
+    for ch in key:
+        if in_str:
+            if ch == in_str:
+                in_str = None
+            else:
+                buf.append(ch)
+        elif ch in ("'", '"'):
+            in_str = ch
+        elif ch == ".":
+            parts.append("".join(buf).strip())
+            buf = []
+        else:
+            buf.append(ch)
+    parts.append("".join(buf).strip())
+    if in_str or any(p == "" for p in parts):
+        raise TomlError(f"bad key {key!r}")
+    return parts
+
+
+def _enter(root: dict, dotted: str, array: bool) -> dict:
+    parts = _split_dotted(dotted)
+    cur = root
+    for p in parts[:-1]:
+        nxt = cur.setdefault(p, {})
+        if isinstance(nxt, list):
+            nxt = nxt[-1]
+        if not isinstance(nxt, dict):
+            raise TomlError(f"key {p!r} is not a table")
+        cur = nxt
+    leaf = parts[-1]
+    if array:
+        arr = cur.setdefault(leaf, [])
+        if not isinstance(arr, list):
+            raise TomlError(f"key {leaf!r} is not a table array")
+        arr.append({})
+        return arr[-1]
+    tbl = cur.setdefault(leaf, {})
+    if isinstance(tbl, list):
+        tbl = tbl[-1]
+    if not isinstance(tbl, dict):
+        raise TomlError(f"key {leaf!r} is not a table")
+    return tbl
+
+
+def _assign(table: dict, key: str, value: Any) -> None:
+    parts = _split_dotted(key)
+    for p in parts[:-1]:
+        table = table.setdefault(p, {})
+        if not isinstance(table, dict):
+            raise TomlError(f"key {p!r} is not a table")
+    table[parts[-1]] = value
+
+
+def _parse_value(s: str) -> tuple[Any, str]:
+    """Parse one value at the head of `s`; returns (value, rest)."""
+    if not s:
+        raise TomlError("missing value")
+    ch = s[0]
+    if ch == '"':
+        return _parse_basic_string(s)
+    if ch == "'":
+        end = s.find("'", 1)
+        if end < 0:
+            raise TomlError("unterminated literal string")
+        return s[1:end], s[end + 1:]
+    if ch == "[":
+        return _parse_array(s)
+    if ch == "{":
+        return _parse_inline_table(s)
+    # bare token: up to a delimiter
+    end = len(s)
+    for i, c in enumerate(s):
+        if c in ",]}":
+            end = i
+            break
+    tok, rest = s[:end].strip(), s[end:]
+    if tok == "true":
+        return True, rest
+    if tok == "false":
+        return False, rest
+    tok_num = tok.replace("_", "")
+    try:
+        if tok_num.lower().lstrip("+-").startswith(("0x", "0o", "0b")):
+            return int(tok_num, 0), rest
+        return int(tok_num), rest
+    except ValueError:
+        try:
+            return float(tok_num), rest
+        except ValueError:
+            raise TomlError(f"unsupported value {tok!r}") from None
+
+
+_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\",
+            "b": "\b", "f": "\f"}
+
+
+def _parse_basic_string(s: str) -> tuple[str, str]:
+    out = []
+    i = 1
+    while i < len(s):
+        ch = s[i]
+        if ch == "\\":
+            if i + 1 >= len(s):
+                raise TomlError("dangling escape")
+            nxt = s[i + 1]
+            if nxt == "u" and i + 5 < len(s):
+                out.append(chr(int(s[i + 2:i + 6], 16)))
+                i += 6
+                continue
+            if nxt not in _ESCAPES:
+                raise TomlError(f"unknown escape \\{nxt}")
+            out.append(_ESCAPES[nxt])
+            i += 2
+            continue
+        if ch == '"':
+            return "".join(out), s[i + 1:]
+        out.append(ch)
+        i += 1
+    raise TomlError("unterminated string")
+
+
+def _parse_array(s: str) -> tuple[list, str]:
+    vals: list[Any] = []
+    rest = s[1:].strip()
+    while True:
+        if not rest:
+            raise TomlError("unterminated array (multiline arrays must "
+                            "close on the same line in this reader)")
+        if rest[0] == "]":
+            return vals, rest[1:]
+        v, rest = _parse_value(rest)
+        vals.append(v)
+        rest = rest.strip()
+        if rest.startswith(","):
+            rest = rest[1:].strip()
+
+
+def _parse_inline_table(s: str) -> tuple[dict, str]:
+    tbl: dict[str, Any] = {}
+    rest = s[1:].strip()
+    while True:
+        if not rest:
+            raise TomlError("unterminated inline table")
+        if rest[0] == "}":
+            return tbl, rest[1:]
+        key, eq, rest = rest.partition("=")
+        if not eq:
+            raise TomlError("expected 'key = value' in inline table")
+        v, rest = _parse_value(rest.strip())
+        _assign(tbl, key.strip(), v)
+        rest = rest.strip()
+        if rest.startswith(","):
+            rest = rest[1:].strip()

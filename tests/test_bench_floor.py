@@ -108,3 +108,19 @@ def test_tcp_data_path_floor(cluster):
         c.close()
     assert wps > 300, f"TCP write path regressed: {wps:.0f} req/s"
     assert rps > 700, f"TCP read path regressed: {rps:.0f} req/s"
+
+
+def test_ec_volume_encode_floor(monkeypatch):
+    """End-to-end pipelined ec.encode floor (CPU only, small volume so it
+    stays tier-1-fast). Measured on the 1-core dev box: ~820-1290 MB/s
+    pipelined vs ~170-230 MB/s serial (PERF.md round 6); floors at a
+    fraction of that so a loaded CI core doesn't flake, while still
+    catching a fallback to the serial walk or a broken overlap."""
+    import bench
+
+    monkeypatch.delenv("SEAWEEDFS_TPU_BENCH_EC_MB", raising=False)
+    out = bench.bench_volume_encode(size_mb=48)
+    assert out["ec_volume_encode_mbps"] > 150, out
+    # The pipeline must actually beat the serial comparator; 1.2x is far
+    # under the ~3.5x measured, but still fails if overlap stops working.
+    assert out["ec_volume_encode_speedup"] > 1.2, out

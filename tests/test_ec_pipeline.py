@@ -1,0 +1,265 @@
+"""Staged EC pipeline: bit-identity, crash-safety, decoder tails.
+
+The contract under test (parallel/streaming.py + encoder/decoder):
+  * pipelined and serial paths produce byte-identical shards — both walk
+    the single layout.iter_encode_batches plan;
+  * an interrupted pipeline (any stage) leaves NO .ecNN / .dat under a
+    final name and no .tmp litter (AtomicFileGroup);
+  * decoder.write_dat_file reassembles every tail shape, including the
+    exactly-k*large_block size the old `>=` row loop misread.
+
+Blocks are scaled down (LB=640/SB=160 vs 1GB/1MB) so the full two-tier
+row structure — multiple large rows, small rows, partial tail — fits in
+kilobytes; layout.py keeps the same strict-> split at any scale.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.models.coder import make_coder
+from seaweedfs_tpu.parallel import streaming
+from seaweedfs_tpu.storage.erasure_coding import decoder as ecdec
+from seaweedfs_tpu.storage.erasure_coding import encoder as ecenc
+from seaweedfs_tpu.storage.erasure_coding import layout
+
+LB, SB = 640, 160
+K = layout.DATA_SHARDS_COUNT
+TOTAL = layout.TOTAL_SHARDS_COUNT
+
+
+def _make_dat(base: str, size: int, seed: int = 0) -> bytes:
+    dat = np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+    with open(base + ".dat", "wb") as f:
+        f.write(dat)
+    return dat
+
+
+def _shards(base: str) -> list[bytes]:
+    return [open(base + layout.shard_ext(i), "rb").read()
+            for i in range(TOTAL)]
+
+
+def _leftovers(d) -> list[str]:
+    return sorted(os.path.basename(p) for p in glob.glob(str(d) + "/*")
+                  if not p.endswith((".dat", ".keep")))
+
+
+# ---- bit-identity: serial vs pipelined, all coder/reader variants ----
+
+@pytest.mark.parametrize("size", [
+    1,                          # single byte
+    SB * K - 7,                 # partial small row, non-multiple of k*SB
+    2 * LB * K,                 # exactly k*large_block (the `>=` bug size)
+    2 * LB * K + 3,
+    2 * LB * K + 3 * SB * K + 77,
+])
+def test_pipelined_matches_serial(tmp_path, size):
+    sbase, pbase = str(tmp_path / "s"), str(tmp_path / "p")
+    for b in (sbase, pbase):
+        _make_dat(b, size, seed=size)
+    ecenc.write_ec_files(sbase, make_coder("cpu"), LB, SB, batch_size=SB)
+    ecenc.write_ec_files(pbase, make_coder("cpu-mt"), LB, SB,
+                         batch_size=SB, pipelined=True)
+    assert _shards(sbase) == _shards(pbase)
+
+
+def test_pipelined_multi_reader_matches(tmp_path):
+    sbase, pbase = str(tmp_path / "s"), str(tmp_path / "p")
+    size = 3 * LB * K + 2 * SB * K + 11
+    for b in (sbase, pbase):
+        _make_dat(b, size, seed=2)
+    ecenc.write_ec_files(sbase, make_coder("cpu"), LB, SB, batch_size=SB)
+    # readers=2 interleave by sequence number; the coder stage reorders
+    ecenc.write_ec_files(pbase, make_coder("cpu"), LB, SB, batch_size=SB,
+                         pipelined=True, readers=2)
+    assert _shards(sbase) == _shards(pbase)
+
+
+def test_pipelined_odd_batch_snaps_to_block(tmp_path):
+    # batch_size not dividing the block must snap to one-batch-per-block,
+    # never split a row unevenly (layout.iter_encode_batches contract)
+    sbase, pbase = str(tmp_path / "s"), str(tmp_path / "p")
+    size = LB * K + SB * K + 5
+    for b in (sbase, pbase):
+        _make_dat(b, size, seed=3)
+    ecenc.write_ec_files(sbase, make_coder("cpu"), LB, SB, batch_size=LB)
+    ecenc.write_ec_files(pbase, make_coder("cpu"), LB, SB, batch_size=77,
+                         pipelined=True)
+    assert _shards(sbase) == _shards(pbase)
+
+
+# ---- crash-safety: no truncated shard ever visible ----
+
+class _BoomCoder:
+    """Wraps a real coder; fails on the Nth encode call."""
+
+    def __init__(self, blow_at: int):
+        self._inner = make_coder("cpu")
+        self.scheme = self._inner.scheme
+        self.calls = 0
+        self.blow_at = blow_at
+
+    def encode_into(self, data, out):
+        self.calls += 1
+        if self.calls >= self.blow_at:
+            raise RuntimeError("disk on fire")
+        return np.asarray(self._inner.encode_array(data))
+
+    def encode_array(self, data):
+        self.calls += 1
+        if self.calls >= self.blow_at:
+            raise RuntimeError("disk on fire")
+        return self._inner.encode_array(data)
+
+
+def test_pipelined_encode_crash_leaves_nothing(tmp_path):
+    base = str(tmp_path / "v")
+    _make_dat(base, 2 * LB * K + SB * K)
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        ecenc.write_ec_files(base, _BoomCoder(blow_at=3), LB, SB,
+                             batch_size=SB, pipelined=True)
+    assert _leftovers(tmp_path) == []
+
+
+def test_serial_encode_crash_leaves_nothing(tmp_path):
+    base = str(tmp_path / "v")
+    _make_dat(base, 2 * LB * K + SB * K)
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        ecenc.write_ec_files(base, _BoomCoder(blow_at=3), LB, SB,
+                             batch_size=SB)
+    assert _leftovers(tmp_path) == []
+
+
+def test_pipelined_reader_stage_crash_raises_pipeline_error(
+        tmp_path, monkeypatch):
+    base = str(tmp_path / "v")
+    _make_dat(base, 2 * LB * K + 2 * SB * K)
+    real = streaming._read_rows
+    state = {"n": 0}
+
+    def flaky(f, buf, desc, k):
+        state["n"] += 1
+        if state["n"] == 4:
+            raise IOError("surprise EIO")
+        real(f, buf, desc, k)
+
+    monkeypatch.setattr(streaming, "_read_rows", flaky)
+    with pytest.raises(streaming.PipelineError) as ei:
+        ecenc.write_ec_files(base, make_coder("cpu"), LB, SB,
+                             batch_size=SB, pipelined=True)
+    assert isinstance(ei.value.__cause__, IOError)
+    assert _leftovers(tmp_path) == []
+
+
+def test_rebuild_crash_on_truncated_survivor(tmp_path):
+    base = str(tmp_path / "v")
+    _make_dat(base, LB * K + 3 * SB * K)
+    ecenc.write_ec_files(base, make_coder("cpu"), LB, SB, batch_size=SB)
+    os.remove(base + layout.shard_ext(12))
+    # survivor .ec05 loses its tail -> reader short-read -> abort
+    # (not .ec00: the first source shard DEFINES shard_size, so its
+    # truncation just shortens the walk instead of erroring)
+    sz = os.path.getsize(base + layout.shard_ext(5))
+    with open(base + layout.shard_ext(5), "r+b") as f:
+        f.truncate(sz - 16)
+    with pytest.raises(streaming.PipelineError):
+        ecenc.rebuild_ec_files(base, make_coder("cpu"), batch_size=SB,
+                               pipelined=True)
+    assert not os.path.exists(base + layout.shard_ext(12))
+    assert not glob.glob(str(tmp_path) + "/*.tmp")
+
+
+# ---- pipelined rebuild / decode identity ----
+
+@pytest.mark.parametrize("drop", [[1, 11], [0, 2, 11, 13]])
+def test_pipelined_rebuild_matches_originals(tmp_path, drop):
+    base = str(tmp_path / "v")
+    _make_dat(base, 2 * LB * K + SB * K + 9, seed=5)
+    ecenc.write_ec_files(base, make_coder("cpu"), LB, SB, batch_size=SB)
+    want = _shards(base)
+    for i in drop:
+        os.remove(base + layout.shard_ext(i))
+    got_ids = ecenc.rebuild_ec_files(base, make_coder("cpu-mt"),
+                                     batch_size=SB, pipelined=True)
+    assert sorted(got_ids) == sorted(drop)
+    assert _shards(base) == want
+
+
+@pytest.mark.parametrize("size", [
+    1,
+    SB * K - 7,
+    SB * K * 5 + SB // 2,
+    2 * LB * K,                 # regression: old `>=` read this as a
+    2 * LB * K + 3,             # large row and scrambled the reassembly
+    2 * LB * K + 3 * SB * K + 77,
+])
+@pytest.mark.parametrize("pipelined", [False, True])
+def test_write_dat_file_roundtrip(tmp_path, size, pipelined):
+    base = str(tmp_path / "v")
+    dat = _make_dat(base, size, seed=size % 97)
+    ecenc.write_ec_files(base, make_coder("cpu"), LB, SB, batch_size=SB)
+    os.remove(base + ".dat")
+    ecdec.write_dat_file(base, size, LB, SB, pipelined=pipelined)
+    assert open(base + ".dat", "rb").read() == dat
+
+
+@pytest.mark.parametrize("pipelined", [False, True])
+def test_write_dat_file_crash_removes_tmp(tmp_path, pipelined):
+    base = str(tmp_path / "v")
+    size = LB * K + SB * K
+    _make_dat(base, size)
+    ecenc.write_ec_files(base, make_coder("cpu"), LB, SB, batch_size=SB)
+    os.remove(base + ".dat")
+    sz = os.path.getsize(base + layout.shard_ext(0))
+    with open(base + layout.shard_ext(0), "r+b") as f:
+        f.truncate(sz - 8)      # reader hits EOF before `take` satisfied
+    with pytest.raises((IOError, streaming.PipelineError)):
+        ecdec.write_dat_file(base, size, LB, SB, pipelined=pipelined)
+    assert not os.path.exists(base + ".dat")
+    assert not os.path.exists(base + ".dat.tmp")
+
+
+# ---- multi-core CpuCoder sharding ----
+
+def test_cpu_workers_bit_identical():
+    from seaweedfs_tpu.ops import rs_cpu
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, (K, 1 << 17), dtype=np.uint8)
+    base = make_coder("cpu").encode_array(data)
+    for native in (True, False):
+        if native and rs_cpu._native() is None:
+            continue
+        mt = rs_cpu.CpuCoder(use_native=native, workers=3)
+        assert np.array_equal(mt.encode_array(data), base), native
+
+
+def test_cpu_mt_registered_and_auto_workers():
+    from seaweedfs_tpu.ops import rs_cpu
+    mt = make_coder("cpu-mt")
+    assert mt.workers == rs_cpu.auto_workers() >= 1
+    rng = np.random.default_rng(10)
+    data = rng.integers(0, 256, (K, 4096), dtype=np.uint8)
+    assert np.array_equal(mt.encode_array(data),
+                          make_coder("cpu").encode_array(data))
+
+
+def test_numpy_fallback_methods_agree():
+    """pair16 (production fallback) vs split-nibble (independent method)
+    vs the native kernel: three GF(256) matrix-apply implementations,
+    one answer."""
+    from seaweedfs_tpu.ops import rs_cpu
+    from seaweedfs_tpu.ops.gf256 import rs_matrix
+    rng = np.random.default_rng(11)
+    mat = np.asarray(rs_matrix(10, 14))[10:]
+    for n in (1, 2, 63, 64, 65, 4097):
+        data = rng.integers(0, 256, (10, n), dtype=np.uint8)
+        out = np.zeros((4, n), dtype=np.uint8)
+        rs_cpu._gf_apply_numpy_into(mat, data, out)
+        assert np.array_equal(out, rs_cpu._gf_apply_nibble(mat, data)), n
+        if rs_cpu._native() is not None:
+            assert np.array_equal(
+                out, rs_cpu._gf_apply(mat, data, use_native=True)), n

@@ -77,7 +77,7 @@ def test_iam_user_and_key_lifecycle(stack):
 
 
 def _sigv4_headers(method, host_url, path, akid, secret, body=b""):
-    amz_date = datetime.datetime.now(datetime.UTC).strftime("%Y%m%dT%H%M%SZ")
+    amz_date = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
     date = amz_date[:8]
     region, service = "us-east-1", "s3"
     payload_hash = hashlib.sha256(body).hexdigest()

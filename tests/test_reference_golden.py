@@ -216,6 +216,23 @@ def test_reference_dat_ec_encode_matches_goldens(tmp_path):
 
 
 @needs_fixture
+def test_reference_dat_pipelined_encode_matches_goldens(tmp_path):
+    """The staged pipeline (overlapped I/O + multi-core coder) against
+    the same Go-produced goldens: the perf path may not drift a bit."""
+    from seaweedfs_tpu.models.coder import make_coder
+    from seaweedfs_tpu.storage.erasure_coding import encoder
+
+    base = str(tmp_path / "1")
+    shutil.copy(REF_DAT, base + ".dat")
+    encoder.write_ec_files(base, coder=make_coder("cpu-mt"), pipelined=True,
+                           readers=2)
+    for i in range(14):
+        digest = hashlib.sha256(
+            open(base + f".ec{i:02d}", "rb").read()).hexdigest()
+        assert digest == GOLDEN_SHARDS[i], f"pipelined shard {i} drifted"
+
+
+@needs_fixture
 def test_reference_needles_survive_ec_roundtrip(tmp_path):
     """Mirror of the reference's ec_test.go end-to-end assertion: encode,
     drop 4 shards, reconstruct, and read needles byte-identically from

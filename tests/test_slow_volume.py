@@ -152,10 +152,12 @@ def test_gb_volume_ec_lifecycle(tmp_path):
         while chunk := f.read(1 << 24):
             h_stream.update(chunk)
 
-    # drop 4 shards, rebuild, verify needle bytes survive
+    # drop 4 shards, rebuild (staged pipeline + multi-core coder — the
+    # production path), verify needle bytes survive
     for i in (0, 5, 11, 13):
         os.remove(base + layout.shard_ext(i))
-    rebuilt = encoder.rebuild_ec_files(base)
+    rebuilt = encoder.rebuild_ec_files(base, make_coder("cpu-mt"),
+                                       pipelined=True)
     assert sorted(rebuilt) == [0, 5, 11, 13]
     h_rebuilt = hashlib.sha256()
     with open(base + layout.shard_ext(13), "rb") as f:

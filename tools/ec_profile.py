@@ -1,0 +1,114 @@
+"""Per-stage profile of the pipelined EC volume encode (and rebuild).
+
+Answers "where does the wall time go?" for the staged pipeline in
+parallel/streaming.py: per-stage busy seconds (read / encode / write),
+wall time, and the serial comparator. Busy seconds can legitimately sum
+past the wall time — that's the overlap working.
+
+Usage:
+  PYTHONPATH=. JAX_PLATFORMS=cpu python tools/ec_profile.py [size_mb]
+  PYTHONPATH=. ... python tools/ec_profile.py --dat /path/to/base  # existing .dat
+
+Prints a table plus one JSON line for scripts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def build_volume(base: str, size: int) -> None:
+    rng = np.random.default_rng(11)
+    with open(base + ".dat", "wb") as f:
+        left = size
+        while left:
+            n = min(1 << 24, left)
+            f.write(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+            left -= n
+
+
+def profile(base: str, keep_shards: bool = False) -> dict:
+    from seaweedfs_tpu.models.coder import make_coder
+    from seaweedfs_tpu.storage.erasure_coding import encoder as ecenc
+    from seaweedfs_tpu.storage.erasure_coding import layout
+
+    size = os.path.getsize(base + ".dat")
+
+    def clean():
+        if keep_shards:
+            return
+        for i in range(layout.TOTAL_SHARDS_COUNT):
+            p = base + layout.shard_ext(i)
+            if os.path.exists(p):
+                os.remove(p)
+
+    t0 = time.perf_counter()
+    ecenc.write_ec_files(base, make_coder("cpu"))
+    serial_s = time.perf_counter() - t0
+    clean()
+
+    coder = make_coder("cpu-mt")
+    stats: dict = {}
+    t0 = time.perf_counter()
+    ecenc.write_ec_files(base, coder, pipelined=True, stats=stats)
+    pipe_s = time.perf_counter() - t0
+
+    # rebuild profile: drop two shards, pipeline them back
+    for sid in (1, 11):
+        os.remove(base + layout.shard_ext(sid))
+    rstats: dict = {}
+    t0 = time.perf_counter()
+    ecenc.rebuild_ec_files(base, coder, pipelined=True, stats=rstats)
+    rebuild_s = time.perf_counter() - t0
+    clean()
+
+    return {
+        "size_mb": round(size / 1e6, 1),
+        "workers": coder.workers,
+        "serial_s": round(serial_s, 3),
+        "pipelined_s": round(pipe_s, 3),
+        "speedup": round(serial_s / pipe_s, 2),
+        "encode_mbps": round(size / pipe_s / 1e6, 1),
+        "stages_s": {k: round(stats.get(k, 0.0), 3)
+                     for k in ("read_s", "encode_s", "write_s", "wall_s")},
+        "rebuild_s": round(rebuild_s, 3),
+        "rebuild_stages_s": {k: round(rstats.get(k, 0.0), 3)
+                             for k in ("read_s", "encode_s", "write_s",
+                                       "wall_s")},
+    }
+
+
+def main(argv: list[str]) -> int:
+    if argv and argv[0] == "--dat":
+        out = profile(argv[1], keep_shards=False)
+    else:
+        size_mb = int(argv[0]) if argv else 256
+        with tempfile.TemporaryDirectory() as d:
+            base = os.path.join(d, "prof")
+            build_volume(base, size_mb * 1024 * 1024)
+            out = profile(base)
+
+    st, rst = out["stages_s"], out["rebuild_stages_s"]
+    print(f"volume: {out['size_mb']} MB   coder workers: {out['workers']}")
+    print(f"serial encode    : {out['serial_s']:8.3f}s")
+    print(f"pipelined encode : {out['pipelined_s']:8.3f}s "
+          f"({out['speedup']}x, {out['encode_mbps']} MB/s)")
+    print("  stage busy (overlap makes these sum past wall):")
+    for k in ("read_s", "encode_s", "write_s"):
+        print(f"    {k:9s}: {st[k]:8.3f}s")
+    print(f"    wall     : {st['wall_s']:8.3f}s")
+    print(f"pipelined rebuild of 2 shards: {out['rebuild_s']:8.3f}s "
+          f"(read {rst['read_s']}s, gf {rst['encode_s']}s, "
+          f"write {rst['write_s']}s)")
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
