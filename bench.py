@@ -173,6 +173,41 @@ def bench_volume_encode(size_mb: int = 256) -> dict:
     }
 
 
+def bench_scrub(size_mb: int = 64) -> dict:
+    """Scrub read path throughput with the rate limiter OFF: build a
+    synthetic volume of 1MB needles, then time one full Scrubber pass
+    (superblock walk + per-needle CRC32-C re-verify). This is the
+    integrity subsystem's raw ceiling; production runs throttled.
+
+    SEAWEEDFS_TPU_BENCH_SCRUB_MB overrides the volume size."""
+    import tempfile
+
+    from seaweedfs_tpu.scrub import Scrubber
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+
+    size_mb = int(os.environ.get("SEAWEEDFS_TPU_BENCH_SCRUB_MB", size_mb))
+    rng = np.random.default_rng(7)
+    with tempfile.TemporaryDirectory() as d:
+        store = Store([d])
+        store.add_volume(1)
+        for i in range(size_mb):
+            data = rng.integers(0, 256, 1024 * 1024,
+                                dtype=np.uint8).tobytes()
+            store.write_volume_needle(
+                1, Needle(id=i + 1, cookie=1, data=data))
+        scrubber = Scrubber(store, rate_bytes_per_sec=0)
+        t0 = time.perf_counter()
+        out = scrubber.run_once()
+        dt = time.perf_counter() - t0
+        store.close()
+    if out["corruptions"]:
+        raise RuntimeError(f"scrub bench found phantom corruption: "
+                           f"{out['corruptions'][:3]}")
+    return {"scrub_mbps": round(out["bytes"] / dt / 1e6, 1),
+            "scrub_mb": size_mb}
+
+
 def tpu_probe_with_retries(delays=TPU_ATTEMPT_DELAYS,
                            timeout=TPU_ATTEMPT_TIMEOUT,
                            argv_prefix=None, sleep=time.sleep):
@@ -221,6 +256,7 @@ def main(argv=None):
         return 0
     cpu = bench_cpu()  # measured first; never discarded
     e2e = bench_volume_encode()  # CPU-only, also never discarded
+    e2e.update(bench_scrub())  # CPU-only integrity read path
     tpu, attempts, err = tpu_probe_with_retries()
     if tpu is not None:
         print(json.dumps({

@@ -623,8 +623,10 @@ def cmd_mount(args):
 
 def cmd_fix(args):
     from seaweedfs_tpu.storage.maintenance import fix_volume
-    live = fix_volume(args.base)
-    print(json.dumps({"base": args.base, "live_entries": live}))
+    stats = {}
+    live = fix_volume(args.base, stats=stats)
+    print(json.dumps({"base": args.base, "live_entries": live,
+                      "crc_errors": stats.get("crc_errors", 0)}))
 
 
 def cmd_export(args):

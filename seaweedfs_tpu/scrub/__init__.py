@@ -1,0 +1,5 @@
+"""Background integrity subsystem: volume-server scrubber + master-side
+repair scheduler (see ARCHITECTURE.md "Integrity & repair")."""
+
+from seaweedfs_tpu.scrub.scrubber import Scrubber  # noqa: F401
+from seaweedfs_tpu.scrub.repair_queue import RepairQueue  # noqa: F401

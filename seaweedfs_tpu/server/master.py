@@ -69,6 +69,8 @@ class MasterServer:
         self._grow_lock = threading.Lock()
         self._admin_lock_holder: Optional[str] = None
         self._admin_lock_ts = 0.0
+        from seaweedfs_tpu.scrub import RepairQueue
+        self.repair_queue = RepairQueue(self)
         self._register_routes()
         self._stop = threading.Event()
         self._pruner: Optional[threading.Thread] = None
@@ -104,6 +106,7 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self.repair_queue.stop()
         self.metrics.stop_push()
         self._save_state()
         if self.raft is not None:
@@ -122,6 +125,8 @@ class MasterServer:
             ticks += 1
             self.topo.prune_dead_nodes()
             self._save_state()
+            if self.is_leader():
+                self.repair_queue.tick()
             if ticks % 12 == 0 and self.is_leader():
                 self._auto_vacuum()
 
@@ -314,6 +319,9 @@ class MasterServer:
         r("POST", "/col/delete", self._handle_col_delete)
         r("GET", "/ui", self._handle_ui)
         r("GET", "/", self._handle_ui)
+        r("POST", "/scrub/report", self._handle_scrub_report)
+        r("GET", "/ec/repair/status", self._handle_repair_status)
+        r("POST", "/ec/repair/kick", self._handle_repair_kick)
         r("POST", "/raft/vote", self._handle_raft("on_request_vote"))
         r("POST", "/raft/append", self._handle_raft("on_append_entries"))
         r("POST", "/raft/snapshot", self._handle_raft("on_install_snapshot"))
@@ -334,6 +342,22 @@ class MasterServer:
     def _handle_metrics(self, req: Request) -> Response:
         return Response(self.metrics.expose_text(),
                         content_type="text/plain; version=0.0.4")
+
+    # ---- integrity & repair (scrub reports feed the repair queue) ----
+    def _handle_scrub_report(self, req: Request) -> Response:
+        """A volume server found corruption. Leader-only: the queue
+        lives with the leader; followers redirect like /heartbeat."""
+        if not self.is_leader():
+            return self._not_leader()
+        return Response(self.repair_queue.report(req.json() or {}))
+
+    def _handle_repair_status(self, req: Request) -> Response:
+        return Response(self.repair_queue.status())
+
+    def _handle_repair_kick(self, req: Request) -> Response:
+        if not self.is_leader():
+            return self._not_leader()
+        return Response(self.repair_queue.kick())
 
     def _handle_dir_leave(self, req: Request) -> Response:
         """A volume server announcing a graceful exit: drop its volumes

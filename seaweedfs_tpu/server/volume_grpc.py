@@ -543,6 +543,17 @@ class VolumeGrpc:
                 yield pb.QueriedStripe(
                     records=("\n".join(out) + "\n").encode())
 
+    # ---- integrity scrub (JSON codec: these RPCs postdate the vendored
+    # pb modules and the container has no protoc to regenerate them) ----
+    @_guard
+    def volume_scrub(self, request, context):
+        return _check(self.vs._admin_scrub(LocalRequest(request or {})))
+
+    @_guard
+    def scrub_status(self, request, context):
+        return _check(self.vs._admin_scrub_status(
+            LocalRequest(method="GET", path="/admin/scrub/status")))
+
     # ---- registration ----
     def handlers(self) -> grpc.GenericRpcHandler:
         def unary(fn, req_cls, resp_cls):
@@ -554,6 +565,14 @@ class VolumeGrpc:
             return grpc.unary_stream_rpc_method_handler(
                 fn, request_deserializer=req_cls.FromString,
                 response_serializer=resp_cls.SerializeToString)
+
+        def junary(fn):
+            # JSON-bytes codec for RPCs without vendored pb messages
+            return grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=lambda raw:
+                    json.loads(raw.decode()) if raw else {},
+                response_serializer=lambda d: json.dumps(d).encode())
 
         rpcs = {
             "AllocateVolume": unary(self.allocate_volume,
@@ -655,6 +674,8 @@ class VolumeGrpc:
             "Ping": unary(self.ping, pb.PingRequest, pb.PingResponse),
             "Query": ustream(self.query, pb.QueryRequest,
                              pb.QueriedStripe),
+            "VolumeScrub": junary(self.volume_scrub),
+            "ScrubStatus": junary(self.scrub_status),
         }
         return grpc.method_handlers_generic_handler(SERVICE, rpcs)
 
@@ -957,7 +978,20 @@ class GrpcVolumeClient:
                             collection=b.get("collection", "")),
                         pb.VolumeEcShardsToVolumeResponse)
             return {}
+        if path == "/admin/scrub":
+            return self._json_unary("VolumeScrub", b)
+        if path == "/admin/scrub/status":
+            return self._json_unary("ScrubStatus", b)
         raise KeyError(f"no gRPC mapping for {path}")
+
+    def _json_unary(self, method: str, body: dict,
+                    timeout: float = 300) -> dict:
+        fn = self.channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=lambda d: json.dumps(d or {}).encode(),
+            response_deserializer=lambda raw:
+                json.loads(raw.decode()) if raw else {})
+        return fn(body or {}, timeout=timeout)
 
     def close(self):
         self.channel.close()

@@ -37,6 +37,15 @@ from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
 PULSE_SECONDS = 2.0
 
 
+def _human_bytes(n: int) -> str:
+    f = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if f < 1024 or unit == "TiB":
+            return f"{f:.1f} {unit}" if unit != "B" else f"{int(f)} B"
+        f /= 1024
+    return f"{int(n)} B"
+
+
 class VolumeServer:
     def __init__(self, directories: list[str], master_url: str | list,
                  host: str = "127.0.0.1", port: int = 0,
@@ -50,7 +59,9 @@ class VolumeServer:
                  concurrent_download_limit_mb: int = 256,
                  file_size_limit_mb: int = 256,
                  inflight_timeout: float = 30.0,
-                 disk_types: Optional[list[str]] = None):
+                 disk_types: Optional[list[str]] = None,
+                 scrub_rate_mbps: float = 8.0,
+                 scrub_interval_s: float = 600.0):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
         the volume_server_pb gRPC admin plane (0 = ephemeral).
@@ -60,7 +71,12 @@ class VolumeServer:
         to inflight_timeout then get 429 (reference
         weed/server/volume_server.go:23-30 + `weed volume
         -concurrentUploadLimitMB`). file_size_limit_mb rejects a single
-        oversized upload with 413 (`-fileSizeLimitMB`). 0 = unlimited."""
+        oversized upload with 413 (`-fileSizeLimitMB`). 0 = unlimited.
+
+        scrub_rate_mbps throttles the background integrity scrubber's
+        reads (<= 0 = unthrottled); scrub_interval_s is the idle gap
+        between passes (<= 0 disables the scrubber thread; run_once via
+        /admin/scrub still works)."""
         urls = (master_url.split(",") if isinstance(master_url, str)
                 else list(master_url))
         self.master_urls = [u.strip() for u in urls if u.strip()]
@@ -101,6 +117,9 @@ class VolumeServer:
         self.http.body_gate = self._upload_gate
         # vid -> (expires_monotonic, [peer urls]) for replica fan-out
         self._replica_cache: dict[int, tuple[float, list]] = {}
+        self._scrub_rate = scrub_rate_mbps * 1024 * 1024
+        self._scrub_interval = scrub_interval_s
+        self.scrubber = None
         from seaweedfs_tpu.utils.metrics import Registry
         self.metrics = Registry()
         self._m_req = self.metrics.counter(
@@ -146,11 +165,21 @@ class VolumeServer:
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True)
         self._hb_thread.start()
+        from seaweedfs_tpu.scrub import Scrubber
+        self.scrubber = Scrubber(self.store,
+                                 rate_bytes_per_sec=self._scrub_rate,
+                                 interval_s=self._scrub_interval,
+                                 report_fn=self._report_scrub,
+                                 metrics=self.metrics)
+        if self._scrub_interval > 0:
+            self.scrubber.start()
         glog.info("volume server up at %s (dirs=%s, master=%s)",
                   self.url, ",".join(self._store_dirs), self.master_url)
 
     def stop(self) -> None:
         self._stop.set()
+        if self.scrubber is not None:
+            self.scrubber.stop()
         self.metrics.stop_push()
         if self.tcp_server is not None:
             self.tcp_server.stop()
@@ -317,6 +346,9 @@ class VolumeServer:
         r("POST", "/admin/ec/blob_delete", self._ec_blob_delete)
         r("GET", "/admin/ec/shard_read", self._ec_shard_read)
         r("GET", "/admin/ec/shard_file", self._ec_shard_file)
+        # integrity scrub
+        r("POST", "/admin/scrub", self._admin_scrub)
+        r("GET", "/admin/scrub/status", self._admin_scrub_status)
 
     def _refresh_gauges(self) -> None:
         # runs before every exposition (scrape AND push-gateway loop)
@@ -343,27 +375,117 @@ class VolumeServer:
                         content_type="text/plain; version=0.0.4")
 
     def _handle_ui(self, req: Request) -> Response:
+        """Status page (reference weed/server/volume_server_ui/): disk,
+        concurrency, scrub progress, volumes, EC shards — server-side
+        rendered, zero assets."""
         hb = self.store.collect_heartbeat()
         rows = "".join(
             f"<tr><td>{v['id']}</td><td>{v['collection']}</td>"
-            f"<td>{v['size']}</td><td>{v['file_count']}</td>"
+            f"<td>{_human_bytes(v['size'])}</td><td>{v['file_count']}</td>"
             f"<td>{v['delete_count']}</td>"
             f"<td>{v.get('disk_type', 'hdd')}</td>"
             f"<td>{'RO' if v['read_only'] else 'RW'}</td></tr>"
             for v in hb["volumes"])
         ec_rows = "".join(
-            f"<tr><td>{e['id']}</td><td>{bin(e['ec_index_bits'])}</td></tr>"
+            f"<tr><td>{e['id']}</td>"
+            f"<td>{bin(e['ec_index_bits']).count('1')}</td>"
+            f"<td><code>{e['ec_index_bits']:014b}</code></td></tr>"
             for e in hb["ec_shards"])
+        disk_rows = []
+        for d in self._store_dirs:
+            try:
+                st = os.statvfs(d)
+                free = st.f_bavail * st.f_frsize
+                total = st.f_blocks * st.f_frsize
+                disk_rows.append(
+                    f"<tr><td>{d}</td><td>{_human_bytes(total)}</td>"
+                    f"<td>{_human_bytes(free)}</td></tr>")
+            except OSError:
+                disk_rows.append(f"<tr><td>{d}</td><td>?</td><td>?</td></tr>")
+        scrub = self.scrubber.status() if self.scrubber else {}
+        cur = scrub.get("current")
+        if cur and cur.get("size"):
+            pct = 100.0 * cur["offset"] / cur["size"]
+            progress = (f"vol {cur['volume_id']} ({cur['kind']}) "
+                        f"{pct:.1f}% ({_human_bytes(cur['offset'])} / "
+                        f"{_human_bytes(cur['size'])})")
+        else:
+            progress = "idle"
+        scrub_rows = (
+            f"<tr><th>state</th><td>"
+            f"{'running' if scrub.get('running') else 'stopped'}</td></tr>"
+            f"<tr><th>progress</th><td>{progress}</td></tr>"
+            f"<tr><th>rate limit</th><td>"
+            f"{_human_bytes(int(scrub.get('rate_bytes_per_sec', 0)))}/s"
+            f"</td></tr>"
+            f"<tr><th>bytes scrubbed</th><td>"
+            f"{_human_bytes(scrub.get('bytes_scrubbed', 0))}</td></tr>"
+            f"<tr><th>corruptions found</th><td>"
+            f"{scrub.get('corruptions_found', 0)}</td></tr>"
+            f"<tr><th>passes completed</th><td>"
+            f"{scrub.get('passes_completed', 0)}</td></tr>")
         html = (
-            "<html><head><title>seaweedfs-tpu volume server</title></head>"
+            "<html><head><title>seaweedfs-tpu volume server</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse;margin-bottom:1.5em}"
+            "td,th{border:1px solid #999;padding:3px 10px;"
+            "text-align:left}</style></head>"
             f"<body><h1>Volume Server {self.url}</h1>"
-            f"<p>master: {self.master_url} | rack: {self.store.rack}</p>"
-            "<h2>Volumes</h2><table border=1><tr><th>id</th>"
+            f"<p>master: {self.master_url} | rack: {self.store.rack}"
+            f" | dc: {self.store.data_center}"
+            f" | grpc: {self.grpc_port or '-'}"
+            f" | tcp: {self.tcp_server.port if self.tcp_server else '-'}"
+            "</p>"
+            "<h2>Disk</h2><table><tr><th>dir</th><th>total</th>"
+            f"<th>free</th></tr>{''.join(disk_rows)}</table>"
+            "<h2>Concurrency</h2><table>"
+            f"<tr><th>upload in-flight</th>"
+            f"<td>{_human_bytes(self.upload_limiter.in_flight)}</td></tr>"
+            f"<tr><th>download in-flight</th>"
+            f"<td>{_human_bytes(self.download_limiter.in_flight)}</td>"
+            "</tr></table>"
+            f"<h2>Scrub</h2><table>{scrub_rows}</table>"
+            f"<h2>Volumes ({len(hb['volumes'])})</h2>"
+            "<table><tr><th>id</th>"
             "<th>collection</th><th>size</th><th>files</th><th>deleted</th>"
             f"<th>disk</th><th>mode</th></tr>{rows}</table>"
-            "<h2>EC shards</h2><table border=1><tr><th>vid</th>"
-            f"<th>shard bits</th></tr>{ec_rows}</table></body></html>")
+            f"<h2>EC shards ({len(hb['ec_shards'])} vols)</h2>"
+            "<table><tr><th>vid</th><th>shards</th>"
+            f"<th>bits</th></tr>{ec_rows}</table></body></html>")
         return Response(html, content_type="text/html")
+
+    # ---- integrity scrub ----
+    def _admin_scrub(self, req: Request) -> Response:
+        """Trigger a synchronous scrub pass (optionally one volume).
+        The background thread keeps its own schedule; this is the
+        operator/shell entry point."""
+        b = req.json() if req.body else {}
+        vid = b.get("volume_id")
+        result = self.scrubber.run_once(
+            volume_id=int(vid) if vid is not None else None,
+            use_cursor=bool(b.get("use_cursor", True)))
+        return Response(result)
+
+    def _admin_scrub_status(self, req: Request) -> Response:
+        return Response(self.scrubber.status())
+
+    def _report_scrub(self, report: dict) -> None:
+        """Forward a corruption report to the master's repair queue,
+        following a leader redirect like the heartbeat path does."""
+        body = {"url": self.url, **report}
+        for _attempt in range(2):
+            try:
+                http_json("POST",
+                          f"http://{self.master_url}/scrub/report", body,
+                          timeout=5)
+                return
+            except HttpError as e:
+                old = self.master_url
+                self._follow_leader_hint(e)
+                if self.master_url == old:
+                    return
+            except ConnectionError:
+                self._fail_over()
 
     def _check_jwt(self, req: Request) -> Optional[Response]:
         if not self.jwt_signing_key or req.query.get("type") == "replicate":
@@ -950,7 +1072,16 @@ class VolumeServer:
         rebuilt = ecenc.rebuild_ec_files(base, self.store.coder,
                                          pipelined=b.get("pipelined", True))
         ecenc.rebuild_ecx_file(base)
-        return Response({"rebuilt_shard_ids": rebuilt})
+        # shard_size lets the caller (the master's repair queue) account
+        # the bytes this repair moved over the wire
+        shard_size = 0
+        for sid in rebuilt:
+            p = base + layout.shard_ext(sid)
+            if os.path.exists(p):
+                shard_size = os.path.getsize(p)
+                break
+        return Response({"rebuilt_shard_ids": rebuilt,
+                         "shard_size": shard_size})
 
     def _ec_base_name(self, vid: int, collection: str = "") -> str:
         name = f"{collection}_{vid}" if collection else str(vid)

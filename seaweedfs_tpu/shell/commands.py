@@ -697,6 +697,40 @@ class ShellContext:
                       "shard_ids": plan["rebuilt"]})
         return plans
 
+    # ---- integrity scrub & repair ----
+    def volume_scrub(self, node: str = "",
+                     volume_id: Optional[int] = None) -> list[dict]:
+        """Trigger a synchronous scrub pass on one node (or every node)
+        and collect the per-node results. Corruption found here flows to
+        the master's repair queue exactly as a background pass would."""
+        if node:
+            targets = [node]
+        else:
+            topo = self.topology()
+            targets = [n["id"]
+                       for dc in topo.get("data_centers", [])
+                       for rack in dc.get("racks", [])
+                       for n in rack.get("nodes", [])]
+        body: dict = {}
+        if volume_id is not None:
+            body["volume_id"] = int(volume_id)
+        out = []
+        for nd in targets:
+            try:
+                res = self._vs(nd, "/admin/scrub", body, timeout=3600)
+            except Exception as e:
+                res = {"error": str(e)}
+            out.append({"node": nd, **res})
+        return out
+
+    def ec_repair_status(self) -> dict:
+        return http_json(
+            "GET", f"http://{self.master_url}/ec/repair/status")
+
+    def ec_repair_kick(self) -> dict:
+        return http_json(
+            "POST", f"http://{self.master_url}/ec/repair/kick", {})
+
     # ---- ec.balance (reference command_ec_balance.go) ----
     def ec_balance(self, apply: bool = True) -> list[ec_plan.ShardMove]:
         topo = self.topology()

@@ -53,6 +53,9 @@ HELP = """commands:
   ec.rebuild [-n]
   ec.balance [-n]
   ec.decode -volumeId N
+  ec.repair.status                  master repair queue depth/lag/backoffs
+  ec.repair.kick                    clear backoffs, dispatch queued repairs
+  volume.scrub [-node HOST:PORT] [-volumeId N]   synchronous integrity pass
   lock / unlock
   help / exit
 """
@@ -593,6 +596,13 @@ def run_command(sh: ShellContext, line: str):
         return [vars(m) for m in sh.ec_balance(apply=apply)]
     if cmd == "ec.decode":
         return sh.ec_decode(int(flags["volumeId"]))
+    if cmd == "ec.repair.status":
+        return sh.ec_repair_status()
+    if cmd == "ec.repair.kick":
+        return sh.ec_repair_kick()
+    if cmd == "volume.scrub":
+        vid = int(flags["volumeId"]) if "volumeId" in flags else None
+        return sh.volume_scrub(node=flags.get("node", ""), volume_id=vid)
     raise ValueError(f"unknown command {cmd!r}; `help` lists commands")
 
 

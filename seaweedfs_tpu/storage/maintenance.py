@@ -12,16 +12,25 @@ import struct
 from typing import Callable, Iterator, Optional
 
 from seaweedfs_tpu.storage import types as t
-from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.needle import CrcError, Needle
 from seaweedfs_tpu.storage.super_block import SuperBlock
 
 
 def scan_volume_file(dat_path: str,
-                     check_crc: bool = False
+                     check_crc: bool = False,
+                     stats: Optional[dict] = None
                      ) -> Iterator[tuple[int, Needle]]:
     """Walk every needle record in a .dat, yielding (offset, needle).
-    Deletion records (size==0) are yielded too."""
+    Deletion records (size==0) are yielded too.
+
+    With check_crc, records whose body fails its CRC32-C are counted in
+    stats["crc_errors"] and SKIPPED — the header framing is still intact
+    so the walk continues at the next record instead of truncating the
+    scan at the first flipped bit. Structural damage (unparseable
+    header/body) still ends the walk."""
     size = os.path.getsize(dat_path)
+    if stats is not None:
+        stats.setdefault("crc_errors", 0)
     with open(dat_path, "rb") as f:
         sb = SuperBlock.parse(f.read(super_len := 8 + 65536)[:8 + 65536])
         # needle records are 8-byte aligned; a superblock with extra
@@ -45,6 +54,11 @@ def scan_volume_file(dat_path: str,
             try:
                 needle = Needle.from_bytes(blob, n.size, version,
                                            check_crc=check_crc)
+            except CrcError:
+                if stats is not None:
+                    stats["crc_errors"] += 1
+                offset += record_len
+                continue
             except Exception:
                 break
             yield offset, needle
@@ -63,13 +77,17 @@ def detect_offset_bytes(base_path: str) -> int:
         return 4
 
 
-def fix_volume(base_path: str) -> int:
+def fix_volume(base_path: str, stats: Optional[dict] = None) -> int:
     """Rebuild <base>.idx from <base>.dat (reference command/fix.go:62).
-    Returns number of live entries written."""
+    Returns number of live entries written. Body CRCs are verified while
+    scanning: a bit-rotted needle is dropped from the rebuilt index
+    (reads would fail its checksum anyway) and counted in
+    stats["crc_errors"]."""
     from seaweedfs_tpu.storage.needle_map import MemDb
     width = detect_offset_bytes(base_path)
     db = MemDb()
-    for offset, n in scan_volume_file(base_path + ".dat"):
+    for offset, n in scan_volume_file(base_path + ".dat", check_crc=True,
+                                      stats=stats):
         if n.size > 0:
             db.set(n.id, t.actual_to_offset(offset), n.size)
         else:

@@ -13,6 +13,60 @@ import threading
 import time
 
 
+class TokenBucket:
+    """Bytes/sec token bucket for background work (the scrubber's read
+    throttle). rate <= 0 means unlimited, matching InFlightLimiter.
+
+    The bucket starts EMPTY (initial=0) so a consumer of T total bytes
+    is guaranteed to take >= T/rate seconds — the property the scrub
+    rate-limit contract is stated in — instead of getting a free burst
+    up front. A request larger than the capacity is allowed to drive
+    the balance negative (debt), which later consumers pay off, so the
+    long-run rate still holds for any chunk size."""
+
+    def __init__(self, rate_bytes_per_sec: float, capacity: float = None,
+                 initial: float = 0.0):
+        self.rate = float(rate_bytes_per_sec)
+        self.capacity = float(capacity if capacity is not None
+                              else max(self.rate, 1.0))
+        self._tokens = float(initial)
+        self._ts = time.monotonic()
+        self._lock = threading.Lock()
+
+    def set_rate(self, rate_bytes_per_sec: float) -> None:
+        with self._lock:
+            self._refill()
+            self.rate = float(rate_bytes_per_sec)
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        if self.rate > 0:
+            self._tokens = min(self.capacity,
+                               self._tokens + (now - self._ts) * self.rate)
+        self._ts = now
+
+    def consume(self, n: int, stop: "threading.Event" = None) -> bool:
+        """Block until n tokens are available (or the debt is payable),
+        then take them. Returns False only if `stop` was set while
+        waiting."""
+        if self.rate <= 0 or n <= 0:
+            return True
+        need = min(float(n), self.capacity)
+        while True:
+            with self._lock:
+                self._refill()
+                if self._tokens >= need:
+                    self._tokens -= float(n)
+                    return True
+                wait = (need - self._tokens) / self.rate
+            wait = min(wait, 0.2)
+            if stop is not None:
+                if stop.wait(wait):
+                    return False
+            else:
+                time.sleep(wait)
+
+
 class InFlightLimiter:
     def __init__(self, limit_bytes: int, timeout: float = 30.0):
         self.limit = limit_bytes  # <= 0 means unlimited

@@ -124,3 +124,15 @@ def test_ec_volume_encode_floor(monkeypatch):
     # The pipeline must actually beat the serial comparator; 1.2x is far
     # under the ~3.5x measured, but still fails if overlap stops working.
     assert out["ec_volume_encode_speedup"] > 1.2, out
+
+
+def test_scrub_throughput_floor(monkeypatch):
+    """Unthrottled scrub read path (needle walk + CRC32-C re-verify).
+    Measured ~440 MB/s on the 1-core dev box with the native CRC
+    kernel; the numpy fallback is ~1 MB/s, so a 60 MB/s floor catches
+    both a fallback and a broken walk while leaving ~7x CI slack."""
+    import bench
+
+    monkeypatch.delenv("SEAWEEDFS_TPU_BENCH_SCRUB_MB", raising=False)
+    out = bench.bench_scrub(size_mb=16)
+    assert out["scrub_mbps"] > 60, out

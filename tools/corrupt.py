@@ -1,0 +1,133 @@
+"""Deterministic corruption injector for .dat volumes and .ec* shards.
+
+Fault injection for scrub/repair tests and chaos drills: flip bits,
+truncate files, delete shard files, or corrupt a specific needle body —
+all seeded, so a failure reproduces byte-for-byte.
+
+Usage:
+  PYTHONPATH=. python tools/corrupt.py flip <path> [--offset N] [--bits K] [--seed S]
+  PYTHONPATH=. python tools/corrupt.py truncate <path> --bytes N
+  PYTHONPATH=. python tools/corrupt.py delete-shard <base> --shard-id S
+  PYTHONPATH=. python tools/corrupt.py needle <base.dat> [--index I] [--seed S]
+
+Each command prints one JSON line describing exactly what was damaged
+(path, offsets, original byte values) so a test can assert the repair
+restored bit-identity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+
+
+def flip_bits(path: str, offset: int = -1, bits: int = 1,
+              seed: int = 42) -> dict:
+    """Flip `bits` random (seeded) bits at/after `offset` (-1: anywhere
+    in the file). Returns the damage record."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"{path} is empty")
+    rng = random.Random(seed)
+    lo = 0 if offset < 0 else min(offset, size - 1)
+    flips = []
+    with open(path, "r+b") as f:
+        for _ in range(bits):
+            pos = rng.randrange(lo, size)
+            bit = rng.randrange(8)
+            f.seek(pos)
+            orig = f.read(1)[0]
+            f.seek(pos)
+            f.write(bytes([orig ^ (1 << bit)]))
+            flips.append({"offset": pos, "bit": bit, "original": orig})
+    return {"op": "flip", "path": path, "seed": seed, "flips": flips}
+
+
+def truncate_file(path: str, nbytes: int) -> dict:
+    """Chop `nbytes` off the end (torn write / lost tail)."""
+    size = os.path.getsize(path)
+    new = max(0, size - nbytes)
+    with open(path, "r+b") as f:
+        f.truncate(new)
+    return {"op": "truncate", "path": path, "old_size": size,
+            "new_size": new}
+
+
+def delete_shard(base: str, shard_id: int) -> dict:
+    """Remove one .ecNN shard file of EC volume base path `base`."""
+    from seaweedfs_tpu.storage.erasure_coding import layout
+    path = base + layout.shard_ext(shard_id)
+    size = os.path.getsize(path)
+    os.remove(path)
+    return {"op": "delete-shard", "path": path, "shard_id": shard_id,
+            "size": size}
+
+
+def corrupt_needle(dat_path: str, index: int = 0, seed: int = 42) -> dict:
+    """Flip one seeded bit inside the BODY of the index-th needle record
+    (skipping the header, so the walk still frames correctly and the
+    damage is a pure CRC mismatch)."""
+    from seaweedfs_tpu.storage import types as t
+    from seaweedfs_tpu.storage.maintenance import scan_volume_file
+    from seaweedfs_tpu.storage.super_block import SuperBlock
+    with open(dat_path, "rb") as f:
+        version = SuperBlock.parse(f.read(8 + 65536)).version
+    records = [(off, n) for off, n in scan_volume_file(dat_path)
+               if n.size > 0]
+    if index >= len(records):
+        raise IndexError(f"needle index {index} out of {len(records)}")
+    offset, n = records[index]
+    body_start = offset + t.NEEDLE_HEADER_SIZE
+    body_len = n.size
+    rng = random.Random(seed)
+    pos = body_start + rng.randrange(max(1, body_len))
+    bit = rng.randrange(8)
+    with open(dat_path, "r+b") as f:
+        f.seek(pos)
+        orig = f.read(1)[0]
+        f.seek(pos)
+        f.write(bytes([orig ^ (1 << bit)]))
+    return {"op": "needle", "path": dat_path, "needle_id": n.id,
+            "record_offset": offset, "offset": pos, "bit": bit,
+            "original": orig, "seed": seed}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    f = sub.add_parser("flip", help="flip random bits in a file")
+    f.add_argument("path")
+    f.add_argument("--offset", type=int, default=-1)
+    f.add_argument("--bits", type=int, default=1)
+    f.add_argument("--seed", type=int, default=42)
+
+    tr = sub.add_parser("truncate", help="chop bytes off the end")
+    tr.add_argument("path")
+    tr.add_argument("--bytes", type=int, required=True, dest="nbytes")
+
+    d = sub.add_parser("delete-shard", help="remove one .ecNN file")
+    d.add_argument("base")
+    d.add_argument("--shard-id", type=int, required=True)
+
+    nd = sub.add_parser("needle", help="flip a bit in one needle body")
+    nd.add_argument("dat_path")
+    nd.add_argument("--index", type=int, default=0)
+    nd.add_argument("--seed", type=int, default=42)
+
+    args = p.parse_args()
+    if args.cmd == "flip":
+        out = flip_bits(args.path, args.offset, args.bits, args.seed)
+    elif args.cmd == "truncate":
+        out = truncate_file(args.path, args.nbytes)
+    elif args.cmd == "delete-shard":
+        out = delete_shard(args.base, args.shard_id)
+    else:
+        out = corrupt_needle(args.dat_path, args.index, args.seed)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
