@@ -208,6 +208,143 @@ def bench_scrub(size_mb: int = 64) -> dict:
             "scrub_mb": size_mb}
 
 
+def _free_port() -> int:
+    """Reserve a port number for a server created behind a proxy: the
+    proxy must know the target port before HttpServer binds it."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _p99_ms(samples_s: list) -> float:
+    xs = sorted(samples_s)
+    return round(xs[min(len(xs) - 1, int(len(xs) * 0.99))] * 1000, 1)
+
+
+def bench_degraded_read(n_reads: int = 30,
+                        straggler_ms: float = 200.0) -> dict:
+    """EC degraded-read tail latency under one injected straggler.
+
+    In-process cluster: vs1 holds 13 of 14 shards of an EC needle; the
+    one shard the needle's data lives in exists only on vs2 (reached
+    through a netchaos proxy adding `straggler_ms` latency) and vs3
+    (fast). Every read of the needle on vs1 therefore takes one remote
+    shard hop. Measured twice over the same layout:
+
+      baseline  resilient_reads=False — the pre-resilience serial walk
+                in master-lookup order, which hits the straggler first
+                on every read (~straggler_ms tail);
+      hedged    resilient_reads=True — breaker-ranked candidates +
+                adaptive hedging cut the tail to the hedge delay once,
+                then to the fast peer's latency.
+
+    SEAWEEDFS_TPU_BENCH_DEGRADED_READS overrides n_reads."""
+    import tempfile
+
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.shell.commands import ShellContext
+    from seaweedfs_tpu.storage.file_id import parse_needle_id_cookie
+    from seaweedfs_tpu.utils.httpd import http_call, http_json
+    from tools.netchaos import ChaosProxy
+
+    n_reads = int(os.environ.get("SEAWEEDFS_TPU_BENCH_DEGRADED_READS",
+                                 n_reads))
+    rng = np.random.default_rng(11)
+    with tempfile.TemporaryDirectory() as d:
+        master = MasterServer(volume_size_limit_mb=64)
+        master.start()
+        vs1 = VolumeServer([os.path.join(d, "v1")], master.url)
+        vs1.start()
+
+        # one needle big enough to span real shard rows
+        data = rng.integers(0, 256, 600 * 1024, dtype=np.uint8).tobytes()
+        mc = MasterClient(master.url, cache_ttl=0.0)
+        res = operation.upload_data(mc, data)
+        fid = res.fid
+        vid = int(fid.split(",")[0])
+        nid, _cookie = parse_needle_id_cookie(fid.split(",", 1)[1])
+
+        # encode while vs1 is the only node: all 14 shards stay local
+        sh = ShellContext(master.url, use_grpc=False)
+        sh.ec_encode(vid=vid)
+        ev = vs1.store.find_ec_volume(vid)
+        intervals, _off, _size = ev.locate_needle(nid)
+        sids = sorted({iv.to_shard_id_and_offset()[0]
+                       for iv in intervals})
+        sid = sids[0]  # the data shard vs1 will lose
+
+        # vs2 joins behind a straggler proxy (advertised = proxy addr);
+        # vs3 joins fast; both get the shard, then vs1 drops it
+        vs2_port = _free_port()
+        proxy = ChaosProxy("127.0.0.1", vs2_port,
+                           latency_s=straggler_ms / 1000.0).start()
+        vs2 = VolumeServer([os.path.join(d, "v2")], master.url,
+                           port=vs2_port, advertise=proxy.url)
+        vs2.start()
+        vs3 = VolumeServer([os.path.join(d, "v3")], master.url)
+        vs3.start()
+        for vs in (vs2, vs3):  # setup bypasses the proxy: direct addr
+            direct = f"{vs.http.host}:{vs.http.port}"
+            http_json("POST", f"http://{direct}/admin/ec/copy",
+                      {"volume_id": vid, "shard_ids": [sid],
+                       "source_data_node": f"{vs1.http.host}:"
+                                           f"{vs1.http.port}"})
+            http_json("POST", f"http://{direct}/admin/ec/mount",
+                      {"volume_id": vid, "shard_ids": [sid]})
+        http_json("POST", f"http://{vs1.url}/admin/ec/unmount",
+                  {"volume_id": vid, "shard_ids": [sid]})
+        http_json("POST", f"http://{vs1.url}/admin/ec/delete_shards",
+                  {"volume_id": vid, "shard_ids": [sid]})
+        time.sleep(0.2)  # let heartbeats register the new holders
+
+        def measure() -> list:
+            # fresh health + location state per mode: the comparison
+            # must not inherit the other mode's learned rankings
+            # (metrics=None — re-registering gauges is not idempotent)
+            vs1.peer_health = type(vs1.peer_health)()
+            vs1.store.peer_health = vs1.peer_health
+            vs1._shard_loc_cache.clear()
+            samples = []
+            for _ in range(n_reads):
+                t0 = time.perf_counter()
+                status, body, _hdr = http_call(
+                    "GET", f"http://{vs1.url}/{fid}", timeout=30)
+                samples.append(time.perf_counter() - t0)
+                if status != 200 or body != data:
+                    raise RuntimeError(
+                        f"degraded read failed: HTTP {status}")
+            return samples
+
+        try:
+            vs1.resilient_reads = False
+            vs1.store.resilient_reads = False
+            base = measure()
+            vs1.resilient_reads = True
+            vs1.store.resilient_reads = True
+            hedged = measure()
+        finally:
+            mc.stop()
+            for vs in (vs3, vs2, vs1):
+                vs.stop()
+            proxy.stop()
+            master.stop()
+    base_p99, hedged_p99 = _p99_ms(base), _p99_ms(hedged)
+    return {
+        "degraded_read_p99_ms": hedged_p99,
+        "degraded_read_nohedge_p99_ms": base_p99,
+        "degraded_read_speedup": round(base_p99 / max(hedged_p99, 0.001),
+                                       2),
+        "degraded_read_straggler_ms": straggler_ms,
+        "degraded_read_n": n_reads,
+    }
+
+
 def tpu_probe_with_retries(delays=TPU_ATTEMPT_DELAYS,
                            timeout=TPU_ATTEMPT_TIMEOUT,
                            argv_prefix=None, sleep=time.sleep):
@@ -257,6 +394,7 @@ def main(argv=None):
     cpu = bench_cpu()  # measured first; never discarded
     e2e = bench_volume_encode()  # CPU-only, also never discarded
     e2e.update(bench_scrub())  # CPU-only integrity read path
+    e2e.update(bench_degraded_read())  # hedged EC read tail latency
     tpu, attempts, err = tpu_probe_with_retries()
     if tpu is not None:
         print(json.dumps({

@@ -38,6 +38,10 @@ def _add_common_volume_args(p):
     p.add_argument("-fileSizeLimitMB", type=int, default=256,
                    help="reject single uploads over this size "
                         "(reference -fileSizeLimitMB)")
+    p.add_argument("-advertise", default="",
+                   help="host:port to register with the master instead of "
+                        "ip:port (e.g. a tools/netchaos.py proxy, so peer "
+                        "traffic routes through injected faults)")
     p.add_argument("-grpc", action="store_true",
                    help="serve the volume_server_pb gRPC admin plane on "
                         "port+10000")
@@ -63,7 +67,8 @@ def cmd_master(args):
                       volume_size_limit_mb=args.volumeSizeLimitMB,
                       default_replication=args.defaultReplication,
                       meta_dir=args.mdir,
-                      grpc_port=args.port + 10000 if args.grpc else None)
+                      grpc_port=args.port + 10000 if args.grpc else None,
+                      repair_rate_mbps=args.repairRateMBps)
     ms.start()
     _start_push(args, ("master", ms))
     if args.peers:
@@ -91,7 +96,8 @@ def cmd_volume(args):
                       grpc_port=args.port + 10000 if args.grpc else None,
                       concurrent_upload_limit_mb=args.concurrentUploadLimitMB,
                       concurrent_download_limit_mb=args.concurrentDownloadLimitMB,
-                      file_size_limit_mb=args.fileSizeLimitMB)
+                      file_size_limit_mb=args.fileSizeLimitMB,
+                      advertise=args.advertise)
     vs.start()
     _start_push(args, ("volumeServer", vs))
     tcp = f", tcp {vs.tcp_server.port}" if vs.tcp_server else ""
@@ -817,6 +823,9 @@ def main(argv=None):
                    help="also serve the gRPC plane on port+10000")
     m.add_argument("-peers", default="",
                    help="comma-separated master group urls (raft HA)")
+    m.add_argument("-repairRateMBps", type=float, default=0.0,
+                   help="cluster-wide EC repair bandwidth budget shared "
+                        "across concurrent rebuilds (0 = unlimited)")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")

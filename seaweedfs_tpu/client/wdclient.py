@@ -18,6 +18,7 @@ import time
 from typing import Optional
 
 from seaweedfs_tpu.utils.httpd import HttpError, http_json
+from seaweedfs_tpu.utils.resilience import RetryPolicy
 
 
 class MasterClient:
@@ -28,6 +29,9 @@ class MasterClient:
             master_urls = [master_urls]
         self.master_urls = master_urls
         self._leader = master_urls[0]
+        # full-jitter backoff + per-master retry budget: after a master
+        # restart, a fleet of clients must NOT reconnect in lockstep
+        self.retry = RetryPolicy(attempts=3, base=0.2, cap=2.0)
         self.cache_ttl = cache_ttl
         self._cache: dict[int, tuple[float, list[dict]]] = {}
         self._ec_cache: dict[int, tuple[float, list[dict]]] = {}
@@ -53,7 +57,7 @@ class MasterClient:
     def _keep_connected_loop(self, addresses: list[str], client_type: str,
                              client_address: str) -> None:
         from seaweedfs_tpu.server.master_grpc import GrpcMasterClient
-        backoff = 0.2
+        failures = 0
         idx = 0
         while not self._stop.is_set():
             address = addresses[idx % len(addresses)]
@@ -84,7 +88,7 @@ class MasterClient:
                             with self._lock:
                                 self._vidmap.clear()
                         got_data = True
-                        backoff = 0.2
+                        failures = 0
                         self._apply_volume_location(vl)
             except Exception:
                 pass
@@ -96,8 +100,12 @@ class MasterClient:
                 if not got_data:
                     # dead or follower master: try the next address
                     idx += 1
-                    backoff = min(backoff * 2, 2.0)
-                time.sleep(backoff)
+                    failures += 1
+                # FULL-JITTER backoff, uniform(0, min(cap, base*2^n)):
+                # the old fixed 0.2*2^n doubling resynchronized every
+                # disconnected client onto the same retry instants
+                # after a master restart (thundering herd)
+                time.sleep(self.retry.backoff(failures))
 
     def _apply_volume_location(self, vl) -> None:
         loc = {"url": vl.url, "publicUrl": vl.public_url or vl.url}
@@ -144,6 +152,7 @@ class MasterClient:
                                            if u != self._leader]
             for url in candidates:
                 try:
+                    self.retry.record_call(url)
                     out = http_json(method, f"http://{url}{path}", body)
                     self._leader = url
                     return out
@@ -168,7 +177,11 @@ class MasterClient:
                 except ConnectionError as e:
                     last_err = e
             if attempt + 1 < rounds:
-                time.sleep(0.4 * (attempt + 1))
+                # retry budget: a cluster-wide master outage drains the
+                # per-destination tokens and stops the retry storm early
+                if not self.retry.allow_retry(self._leader):
+                    break
+                time.sleep(self.retry.backoff(attempt))
         raise last_err
 
     def lookup_volume(self, vid: int, collection: str = "") -> list[dict]:

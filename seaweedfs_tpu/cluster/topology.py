@@ -38,6 +38,9 @@ class DataNode:
         self.ec_shards: dict[int, int] = {}  # vid -> shard bits
         self.rack: Optional["Rack"] = None
         self.last_seen = time.time()
+        # mid-scrub-pass right now (rides heartbeats): repair dispatch
+        # avoids piling rebuild I/O onto a disk being swept
+        self.scrubbing = False
 
     @property
     def id(self) -> str:
@@ -247,6 +250,7 @@ class Topology:
                 hb["ip"], hb["port"], hb.get("public_url", ""),
                 hb.get("max_volume_count", 8))
             node.last_seen = time.time()
+            node.scrubbing = bool(hb.get("scrubbing", False))
             node.grpc_port = hb.get("grpc_port", 0)
             node.max_volume_count = hb.get("max_volume_count",
                                            node.max_volume_count)
@@ -291,6 +295,8 @@ class Topology:
     def incremental_sync(self, node: DataNode, deltas: dict) -> None:
         with self.lock:
             node.last_seen = time.time()
+            if "scrubbing" in deltas:
+                node.scrubbing = bool(deltas["scrubbing"])
             new_vids, deleted_vids = set(), set()
             new_ec_vids, deleted_ec_vids = set(), set()
             # deletes BEFORE adds: a disk-tier move reports the same

@@ -24,6 +24,8 @@ import time
 from seaweedfs_tpu.storage.erasure_coding import layout
 from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.utils.httpd import http_json
+from seaweedfs_tpu.utils.limiter import TokenBucket
+from seaweedfs_tpu.utils.resilience import Deadline
 
 MAX_RECENT_NEEDLE_REPORTS = 64
 
@@ -59,18 +61,25 @@ class RepairTask:
 class RepairQueue:
     def __init__(self, master, max_concurrent: int = 2,
                  backoff_base: float = 2.0, backoff_max: float = 300.0,
-                 scan_grace_s: float = 60.0):
+                 scan_grace_s: float = 60.0,
+                 repair_rate_mbps: float = 0.0):
         """scan_grace_s: how long a volume must stay CONTINUOUSLY
         degraded in the heartbeat shard map before the scanner enqueues
         it — transient states (a node mid-restart, an operator running
         ec.rebuild/ec.decode by hand) must not trigger a competing
         automatic rebuild. Scrub corruption reports skip the grace:
-        bit rot never heals itself."""
+        bit rot never heals itself.
+
+        repair_rate_mbps: CLUSTER-WIDE repair bandwidth budget — one
+        token bucket shared by every concurrent rebuild's copy and
+        rebuild traffic, so N parallel repairs split the budget instead
+        of each taking the full rate (<= 0 = unlimited)."""
         self.master = master
         self.max_concurrent = max_concurrent
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
         self.scan_grace_s = scan_grace_s
+        self.bandwidth = TokenBucket(repair_rate_mbps * 1024 * 1024)
         self._degraded_since: dict[int, float] = {}
         self._lock = threading.Lock()
         self._tasks: dict[int, RepairTask] = {}
@@ -94,6 +103,9 @@ class RepairQueue:
         self._c_reports = m.counter("master", "scrub_reports_total",
                                     "scrub corruption reports received",
                                     ("type",))
+        self._g_budget = m.gauge(
+            "master", "ec_repair_budget_remaining_bytes",
+            "cluster-wide repair bandwidth budget remaining")
         m.on_expose(self._refresh_gauges)
 
     # ---- intake ----
@@ -281,21 +293,27 @@ class RepairQueue:
             for n in shard_owners[sid]:
                 counts[n.url] = counts.get(n.url, 0) + 1
                 node_by_url[n.url] = n
-        rebuilder_url = max(counts, key=lambda u: counts[u])
+        rebuilder_url = self._pick_rebuilder(counts, node_by_url)
         have = {sid for sid in present
                 if any(n.url == rebuilder_url
                        for n in shard_owners[sid])}
         need = sorted(present - have)
 
-        copies = 0
+        moved = 0
         for sid in need:
-            src = shard_owners[sid][0]
-            self._node_post(rebuilder_url, "/admin/ec/copy",
-                            {"volume_id": vid, "collection": collection,
-                             "shard_ids": [sid],
-                             "source_data_node": src.url,
-                             "copy_ecx_file": True})
-            copies += 1
+            src = self._pick_source(shard_owners[sid])
+            resp = self._node_post(rebuilder_url, "/admin/ec/copy",
+                                   {"volume_id": vid,
+                                    "collection": collection,
+                                    "shard_ids": [sid],
+                                    "source_data_node": src.url,
+                                    "copy_ecx_file": True})
+            # charge the copy against the shared budget AFTER the
+            # transfer: the next copy (of ANY concurrent repair) waits
+            # until the long-run rate catches up
+            copied = int(resp.get("bytes", 0))
+            moved += copied
+            self.bandwidth.consume(copied, self._stop)
         resp = self._node_post(rebuilder_url, "/admin/ec/rebuild",
                                {"volume_id": vid,
                                 "collection": collection},
@@ -309,12 +327,37 @@ class RepairQueue:
         self._node_post(rebuilder_url, "/admin/ec/mount",
                         {"volume_id": vid, "collection": collection,
                          "shard_ids": rebuilt})
-        return shard_size * (copies + len(rebuilt))
+        moved += shard_size * len(rebuilt)
+        self.bandwidth.consume(shard_size * len(rebuilt), self._stop)
+        return moved
+
+    @staticmethod
+    def _scrubbing(node) -> bool:
+        return bool(getattr(node, "scrubbing", False))
+
+    def _pick_rebuilder(self, counts: dict, node_by_url: dict) -> str:
+        """Most-shards-first among nodes NOT mid-scrub-pass — a rebuild
+        hammers the same disks the scrubber is sweeping. Falls back to
+        the plain most-shards winner when every holder is scrubbing
+        (repair beats politeness)."""
+        idle = {u: c for u, c in counts.items()
+                if not self._scrubbing(node_by_url[u])}
+        pool = idle or counts
+        return max(pool, key=lambda u: pool[u])
+
+    def _pick_source(self, nodes: list):
+        """Copy source for one shard: any non-scrubbing holder, unless
+        no other holder exists."""
+        for n in nodes:
+            if not self._scrubbing(n):
+                return n
+        return nodes[0]
 
     def _node_post(self, url: str, path: str, body: dict,
                    timeout: float = 120) -> dict:
         resp = http_json("POST", f"http://{url}{path}", body,
-                         timeout=timeout)
+                         timeout=timeout,
+                         deadline=Deadline.after(timeout))
         if isinstance(resp, dict) and resp.get("error"):
             raise RuntimeError(f"{url}{path}: {resp['error']}")
         return resp if isinstance(resp, dict) else {}
@@ -338,6 +381,12 @@ class RepairQueue:
                 "in_flight": [t.to_info()
                               for t in self._in_flight.values()],
                 "max_concurrent": self.max_concurrent,
+                "active": len(self._in_flight),
+                "queued": len(self._tasks),
+                "repair_rate_bytes_per_sec": self.bandwidth.rate,
+                "budget_remaining_bytes":
+                    (round(self.bandwidth.peek())
+                     if self.bandwidth.rate > 0 else None),
                 "repaired_total": self.repaired_total,
                 "failed_total": self.failed_total,
                 "bytes_moved": self.bytes_moved,
@@ -351,6 +400,8 @@ class RepairQueue:
         with self._lock:
             depth = len(self._tasks) + len(self._in_flight)
         self._g_depth.set(value=depth)
+        self._g_budget.set(value=self.bandwidth.peek()
+                           if self.bandwidth.rate > 0 else 0.0)
 
     def stop(self) -> None:
         self._stop.set()

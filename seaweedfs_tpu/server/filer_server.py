@@ -34,9 +34,12 @@ from seaweedfs_tpu.filer.filerstore import make_store
 from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call)
+from seaweedfs_tpu.utils.resilience import (Deadline, current_deadline,
+                                            deadline_scope)
 
 CHUNK_SIZE = 4 * 1024 * 1024
 INLINE_LIMIT = 2048  # small content stored in the entry itself
+READ_DEADLINE_S = 30.0  # edge deadline for a filer GET without one
 
 
 def _ttl_seconds(ttl: str) -> int:
@@ -365,7 +368,12 @@ class FilerServer:
                 "Entries": [self._entry_json(e) for e in entries],
                 "ShouldDisplayLoadMore": len(entries) == limit,
             })
-        data = self._read_entry_bytes(entry)
+        # edge deadline: honors an inbound X-Weed-Deadline (propagated
+        # budget) or mints the default; every chunk fetch below inherits
+        # the remaining time instead of its own full 30s
+        with deadline_scope(Deadline.from_headers(req.headers,
+                                                  default=READ_DEADLINE_S)):
+            data = self._read_entry_bytes(entry)
         return Response(data, content_type=entry.attr.mime
                         or "application/octet-stream",
                         headers={"Content-Disposition":
@@ -388,11 +396,21 @@ class FilerServer:
         """One real network fetch of a chunk's stored bytes (the
         ReaderCache guarantees a single flight per fid)."""
         jwt = self._read_jwt_for(fid)
-        for url in self.mc.lookup_file_id(fid):
+        dl = current_deadline() or Deadline.after(READ_DEADLINE_S)
+        urls = self.mc.lookup_file_id(fid)
+        for i, url in enumerate(urls):
+            if dl.expired():
+                break
+            # leave budget for the remaining locations: a blackholed
+            # first holder must not consume the whole deadline
+            left = len(urls) - i
+            sub = dl if left <= 1 else dl.sub(
+                max(0.5, dl.remaining() / left))
             try:
                 sep = "&" if "?" in url else "?"
                 status, body, _ = http_call(
-                    "GET", url + (f"{sep}jwt={jwt}" if jwt else ""))
+                    "GET", url + (f"{sep}jwt={jwt}" if jwt else ""),
+                    deadline=sub)
             except ConnectionError:
                 continue
             if status == 200:

@@ -28,6 +28,7 @@ from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
 from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.utils.httpd import (HttpServer, Request, Response,
                                        http_json)
+from seaweedfs_tpu.utils.resilience import Deadline, PeerHealth
 import random
 
 
@@ -38,7 +39,8 @@ class MasterServer:
                  garbage_threshold: float = 0.3,
                  jwt_signing_key: str = "",
                  whitelist: Optional[list] = None,
-                 meta_dir: str = "", grpc_port: Optional[int] = None):
+                 meta_dir: str = "", grpc_port: Optional[int] = None,
+                 repair_rate_mbps: float = 0.0):
         self.topo = Topology(volume_size_limit=volume_size_limit_mb * 1024 * 1024)
         self.jwt_signing_key = jwt_signing_key
         from seaweedfs_tpu.utils.metrics import Registry
@@ -62,6 +64,9 @@ class MasterServer:
         self._m_is_leader = self.metrics.gauge(
             "master", "is_leader", "1 when this master leads")
         self.metrics.on_expose(self._refresh_gauges)
+        # breaker/health table for the nodes this master dials
+        # (vacuum, repair dispatch, collection delete, leader proxy)
+        self.peer_health = PeerHealth(metrics=self.metrics)
         self.sequencer = MemorySequencer()
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
@@ -70,7 +75,8 @@ class MasterServer:
         self._admin_lock_holder: Optional[str] = None
         self._admin_lock_ts = 0.0
         from seaweedfs_tpu.scrub import RepairQueue
-        self.repair_queue = RepairQueue(self)
+        self.repair_queue = RepairQueue(
+            self, repair_rate_mbps=repair_rate_mbps)
         self._register_routes()
         self._stop = threading.Event()
         self._pruner: Optional[threading.Thread] = None
@@ -138,10 +144,19 @@ class MasterServer:
                 try:
                     check = http_json(
                         "POST", f"http://{node.url}/admin/vacuum",
-                        {"volume_id": vid, "check_only": True}, timeout=10)
+                        {"volume_id": vid, "check_only": True},
+                        deadline=Deadline.after(10.0))
                     if check.get("garbage_ratio", 0) > self.garbage_threshold:
                         http_json("POST", f"http://{node.url}/admin/vacuum",
-                                  {"volume_id": vid}, timeout=600)
+                                  {"volume_id": vid},
+                                  timeout=600,
+                                  deadline=Deadline.after(600.0))
+                    self.peer_health.record(node.url, True)
+                except ConnectionError as e:
+                    self.peer_health.record(node.url, False)
+                    glog.warning("auto-vacuum of %d on %s failed: %s",
+                                 vid, node.url, e)
+                    continue
                 except Exception as e:
                     glog.warning("auto-vacuum of %d on %s failed: %s",
                                  vid, node.url, e)
@@ -305,6 +320,7 @@ class MasterServer:
         r("GET", "/dir/status", self._handle_dir_status)
         r("POST", "/vol/grow", self._handle_grow)
         r("GET", "/cluster/status", self._handle_cluster_status)
+        r("GET", "/cluster/health", self._handle_cluster_health)
         r("GET", "/cluster/raft/ps", self._handle_raft_ps)
         r("POST", "/cluster/raft/add", self._handle_raft_change("add"))
         r("POST", "/cluster/raft/remove",
@@ -416,7 +432,8 @@ class MasterServer:
             try:
                 http_json("POST",
                           f"http://{node.url}/admin/delete_volume",
-                          {"volume_id": vid}, timeout=30)
+                          {"volume_id": vid},
+                          deadline=Deadline.after(30.0))
             except Exception as e:
                 glog.warning("collection delete: volume %d on %s: %s",
                              vid, node.url, e)
@@ -561,8 +578,12 @@ class MasterServer:
                       f"http://{node.url}/admin/allocate_volume",
                       {"volume_id": vid, "collection": collection,
                        "replication": rp, "ttl": ttl,
-                       "disk_type": disk})
+                       "disk_type": disk},
+                      deadline=Deadline.after(30.0))
+            self.peer_health.record(node.url, True)
         except Exception as e:
+            if isinstance(e, ConnectionError):
+                self.peer_health.record(node.url, False)
             glog.error("volume growth: allocate %d on %s failed: %s",
                        vid, node.url, e)
             return False
@@ -599,7 +620,8 @@ class MasterServer:
         try:
             status, body, _ = http_call(
                 "GET", f"http://{leader}{req.path}?{qs}",
-                headers={"X-Weed-Proxied": "1"}, timeout=10)
+                headers={"X-Weed-Proxied": "1"},
+                deadline=Deadline.after(10.0))
             parsed = json.loads(body) if body else {}
         except (ConnectionError, ValueError):
             # leader unreachable or spoke garbage (e.g. a stale
@@ -685,6 +707,36 @@ class MasterServer:
             "Leader": self.leader,
             "Peers": self.peers,
             "MaxVolumeId": self.topo.max_volume_id,
+        })
+
+    def _handle_cluster_health(self, req: Request) -> Response:
+        """Resilience rollup for the cluster.health shell command: per
+        registered node (liveness, scrub state, load), this master's
+        breaker/health table, and the repair bandwidth budget."""
+        now = time.time()
+        with self.topo.lock:
+            nodes = [{
+                "url": n.url,
+                "last_seen_s": round(now - n.last_seen, 1),
+                "scrubbing": bool(getattr(n, "scrubbing", False)),
+                "volumes": len(n.volumes),
+                "ec_shards": n.ec_shard_count(),
+            } for n in self.topo.all_nodes()]
+        st = self.repair_queue.status()
+        return Response({
+            "master": self.url,
+            "leader": self.leader,
+            "is_leader": self.is_leader(),
+            "nodes": nodes,
+            "peers": self.peer_health.snapshot(),
+            "repair": {
+                "rate_bytes_per_sec":
+                    st.get("repair_rate_bytes_per_sec", 0),
+                "budget_remaining_bytes":
+                    st.get("budget_remaining_bytes"),
+                "active": st.get("active", 0),
+                "queued": st.get("queued", 0),
+            },
         })
 
     def _handle_lock(self, req: Request) -> Response:

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Optional
 
 from seaweedfs_tpu.models.coder import ErasureCoder
@@ -33,8 +34,16 @@ from seaweedfs_tpu.storage.volume import (CookieMismatchError, DeletedError,
 from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call, http_json)
+from seaweedfs_tpu.utils.resilience import (Deadline, PeerHealth,
+                                            RetryPolicy, deadline_scope,
+                                            hedged)
 
 PULSE_SECONDS = 2.0
+# Default edge budget for a public read that arrives without a
+# propagated X-Weed-Deadline: bounds the whole local -> remote ->
+# degraded-reconstruction chain (was: unbounded handler + timeout=30
+# per remote leg, which could stack).
+READ_DEADLINE_S = 30.0
 
 
 def _human_bytes(n: int) -> str:
@@ -61,7 +70,9 @@ class VolumeServer:
                  inflight_timeout: float = 30.0,
                  disk_types: Optional[list[str]] = None,
                  scrub_rate_mbps: float = 8.0,
-                 scrub_interval_s: float = 600.0):
+                 scrub_interval_s: float = 600.0,
+                 advertise: str = "",
+                 resilient_reads: bool = True):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
         the volume_server_pb gRPC admin plane (0 = ephemeral).
@@ -76,7 +87,15 @@ class VolumeServer:
         scrub_rate_mbps throttles the background integrity scrubber's
         reads (<= 0 = unthrottled); scrub_interval_s is the idle gap
         between passes (<= 0 disables the scrubber thread; run_once via
-        /admin/scrub still works)."""
+        /admin/scrub still works).
+
+        advertise ("host:port") overrides the address this server
+        registers with the master — peers then reach it through that
+        address instead of the listening socket (how chaos tests and
+        bench interpose a tools/netchaos.py proxy on the peer path).
+        resilient_reads toggles health-ranked + hedged remote-shard
+        fetching (off = the serial lookup-order walk, kept as the
+        bench comparator)."""
         urls = (master_url.split(",") if isinstance(master_url, str)
                 else list(master_url))
         self.master_urls = [u.strip() for u in urls if u.strip()]
@@ -117,6 +136,13 @@ class VolumeServer:
         self.http.body_gate = self._upload_gate
         # vid -> (expires_monotonic, [peer urls]) for replica fan-out
         self._replica_cache: dict[int, tuple[float, list]] = {}
+        self.advertise = advertise
+        self.resilient_reads = resilient_reads
+        # per-peer circuit breakers + latency health, fed by every
+        # outbound call (masters and peer volume servers alike)
+        self.retry = RetryPolicy()
+        # vid -> (expires_monotonic, {shard_id: [peer urls]})
+        self._shard_loc_cache: dict[int, tuple[float, dict]] = {}
         self._scrub_rate = scrub_rate_mbps * 1024 * 1024
         self._scrub_interval = scrub_interval_s
         self.scrubber = None
@@ -138,19 +164,30 @@ class VolumeServer:
             "volumeServer", "disk_free_bytes", "statvfs free bytes",
             ("dir",))
         self.metrics.on_expose(self._refresh_gauges)
+        self.peer_health = PeerHealth(metrics=self.metrics)
 
     # ---- lifecycle ----
     def start(self) -> None:
         self.http.start()
+        # register the ADVERTISED address with the master when one is
+        # set, so peers route to us through it (chaos-proxy interpose)
+        if self.advertise:
+            adv_host, adv_port = self.advertise.rsplit(":", 1)
+            reg_host, reg_port = adv_host, int(adv_port)
+        else:
+            reg_host, reg_port = self.http.host, self.http.port
         self.store = Store(
             self._store_dirs, self._max_volume_counts,
-            ip=self.http.host, port=self.http.port,
-            public_url=self._public_url or f"{self.http.host}:{self.http.port}",
+            ip=reg_host, port=reg_port,
+            public_url=self._public_url or f"{reg_host}:{reg_port}",
             rack=self._rack, data_center=self._dc, coder=self._coder,
             needle_map_kind=self._needle_map_kind,
             disk_types=self._disk_types)
         self.store.load_existing_volumes()
         self.store.remote_shard_reader = self._remote_shard_reader
+        self.store.peer_health = self.peer_health
+        self.store.shard_locations = self._shard_locations
+        self.store.resilient_reads = self.resilient_reads
         if self._tcp_port >= 0:
             from seaweedfs_tpu.server.volume_tcp import TcpDataServer
             self.tcp_server = TcpDataServer(self.store, self.http.host,
@@ -191,18 +228,57 @@ class VolumeServer:
 
     @property
     def url(self) -> str:
-        return f"{self.http.host}:{self.http.port}"
+        """Cluster-facing identity: the advertised address when set
+        (so peers dial through the interposed proxy), else the socket."""
+        return self.advertise or f"{self.http.host}:{self.http.port}"
+
+    def _is_self(self, url: str) -> bool:
+        return url in (self.advertise,
+                       f"{self.http.host}:{self.http.port}") and bool(url)
+
+    def _master_json(self, method: str, path: str, body=None,
+                     timeout: float = 5.0, deadline=None):
+        """One master RPC with a deadline cap and breaker bookkeeping.
+        An HttpError still counts as transport-healthy (the master
+        answered); only ConnectionError marks the peer down."""
+        t0 = time.monotonic()
+        try:
+            out = http_json(method, f"http://{self.master_url}{path}",
+                            body, timeout=timeout, deadline=deadline)
+        except HttpError:
+            self.peer_health.record(self.master_url, True,
+                                    time.monotonic() - t0)
+            raise
+        except ConnectionError:
+            self.peer_health.record(self.master_url, False)
+            raise
+        self.peer_health.record(self.master_url, True,
+                                time.monotonic() - t0)
+        return out
+
+    def _is_scrubbing(self) -> bool:
+        """Mid-scrub-pass right now? Rides every heartbeat so the
+        master's repair dispatch can avoid piling rebuild I/O onto a
+        disk that the scrubber is already sweeping."""
+        s = self.scrubber
+        if s is None:
+            return False
+        try:
+            return bool(s.status().get("current"))
+        except Exception:
+            return False
 
     # ---- heartbeat (reference volume_grpc_client_to_master.go) ----
     def heartbeat_once(self) -> None:
         hb = self.store.collect_heartbeat()
+        hb["scrubbing"] = self._is_scrubbing()
         if self.grpc_port:
             hb["grpc_port"] = self.grpc_port
         for _attempt in range(2):  # second try after a leader redirect
             try:
-                reply = http_json(
-                    "POST", f"http://{self.master_url}/heartbeat", hb,
-                    timeout=5)
+                reply = self._master_json(
+                    "POST", "/heartbeat", hb,
+                    deadline=Deadline.after(2 * PULSE_SECONDS))
                 if reply:
                     self.volume_size_limit = reply.get(
                         "volume_size_limit", 0)
@@ -236,10 +312,13 @@ class VolumeServer:
             if url == self.master_url:
                 continue
             try:
-                http_json("GET", f"http://{url}/cluster/status", timeout=2)
+                http_json("GET", f"http://{url}/cluster/status",
+                          deadline=Deadline.after(2.0))
+                self.peer_health.record(url, True)
                 self.master_url = url
                 return
             except (ConnectionError, HttpError):
+                self.peer_health.record(url, False)
                 continue
 
     def _push_deltas(self) -> None:
@@ -250,10 +329,11 @@ class VolumeServer:
         if not any(deltas.values()):
             return
         body = {"ip": self.store.ip, "port": self.store.port,
-                "is_delta": True, **deltas}
+                "is_delta": True, "scrubbing": self._is_scrubbing(),
+                **deltas}
         try:
-            http_json("POST", f"http://{self.master_url}/heartbeat", body,
-                      timeout=5)
+            self._master_json("POST", "/heartbeat", body,
+                              deadline=Deadline.after(2 * PULSE_SECONDS))
         except HttpError as e:
             if e.status == 409:
                 self._follow_leader_hint(e)
@@ -280,10 +360,11 @@ class VolumeServer:
             try:
                 if has_delta:
                     body = {"ip": self.store.ip, "port": self.store.port,
-                            "is_delta": True, **deltas}
-                    reply = http_json(
-                        "POST", f"http://{self.master_url}/heartbeat", body,
-                        timeout=5)
+                            "is_delta": True,
+                            "scrubbing": self._is_scrubbing(), **deltas}
+                    reply = self._master_json(
+                        "POST", "/heartbeat", body,
+                        deadline=Deadline.after(2 * PULSE_SECONDS))
                 else:
                     self.heartbeat_once()
             except HttpError as e:
@@ -349,6 +430,13 @@ class VolumeServer:
         # integrity scrub
         r("POST", "/admin/scrub", self._admin_scrub)
         r("GET", "/admin/scrub/status", self._admin_scrub_status)
+        # per-peer breaker/health table (cluster.health shell command)
+        r("GET", "/admin/health", self._admin_health)
+
+    def _admin_health(self, req: Request) -> Response:
+        return Response({"url": self.url,
+                         "scrubbing": self._is_scrubbing(),
+                         "peers": self.peer_health.snapshot()})
 
     def _refresh_gauges(self) -> None:
         # runs before every exposition (scrape AND push-gateway loop)
@@ -475,9 +563,8 @@ class VolumeServer:
         body = {"url": self.url, **report}
         for _attempt in range(2):
             try:
-                http_json("POST",
-                          f"http://{self.master_url}/scrub/report", body,
-                          timeout=5)
+                self._master_json("POST", "/scrub/report", body,
+                                  deadline=Deadline.after(5.0))
                 return
             except HttpError as e:
                 old = self.master_url
@@ -612,7 +699,13 @@ class VolumeServer:
             return Response({"error": "too many requests"}, status=429,
                             headers={"Retry-After": "2"})
         try:
-            resp = self._handle_read_inner(req)
+            # request edge: inherit the caller's propagated budget or
+            # mint a fresh one; every nested hop (remote shard fetch,
+            # degraded recovery, master lookup) reads this scope
+            dl = Deadline.from_headers(req.headers,
+                                       default=READ_DEADLINE_S)
+            with deadline_scope(dl):
+                resp = self._handle_read_inner(req)
         except BaseException:
             self.download_limiter.release(est)
             raise
@@ -719,20 +812,18 @@ class VolumeServer:
         master /dir/lookup per write would cost more than the write
         itself (the reference's writers resolve replicas through the
         wdclient vidMap cache the same way)."""
-        import time as _time
-        now = _time.monotonic()
+        now = time.monotonic()
         cached = self._replica_cache.get(vid)
         if cached is not None and cached[0] > now:
             return cached[1]
         try:
-            locs = http_json(
-                "GET",
-                f"http://{self.master_url}/dir/lookup?volumeId={vid}",
-                timeout=5)
+            locs = self._master_json(
+                "GET", f"/dir/lookup?volumeId={vid}",
+                deadline=Deadline.after(5.0))
         except (ConnectionError, HttpError):
             return []  # nobody to replicate to (not registered yet)
         others = [l["url"] for l in locs.get("locations", [])
-                  if l["url"] != self.url]
+                  if not self._is_self(l["url"])]
         self._replica_cache[vid] = (now + self.REPLICA_CACHE_TTL, others)
         return others
 
@@ -1104,10 +1195,15 @@ class VolumeServer:
         if b.get("copy_ecx_file", True):
             exts += [".ecx"]
         exts += [e for e in (".ecj", ".vif") if b.get("copy_aux", True)]
+        copied = 0
         for ext in exts:
             url = (f"http://{src}/admin/ec/shard_file?volumeId={vid}"
                    f"&ext={ext}&collection={b.get('collection', '')}")
-            status, body, _ = http_call("GET", url, timeout=120)
+            # idempotent GET: jittered budget-gated retries ride out a
+            # transient peer blip mid-repair instead of failing the
+            # whole copy step
+            status, body, _ = self.retry.call(
+                lambda: http_call("GET", url, timeout=120), dest=src)
             if status == 404 and ext in (".ecj", ".vif"):
                 continue
             if status >= 400:
@@ -1115,7 +1211,10 @@ class VolumeServer:
                                 status=500)
             with open(base + ext, "wb") as f:
                 f.write(body)
-        return Response({})
+            copied += len(body)
+        # bytes moved over the wire: the master's repair queue charges
+        # this against the cluster-wide repair bandwidth budget
+        return Response({"bytes": copied})
 
     def _ec_shard_file(self, req: Request) -> Response:
         vid = int(req.query["volumeId"])
@@ -1200,34 +1299,72 @@ class VolumeServer:
                         content_type="application/octet-stream")
 
     # ---- EC client-side helpers ----
+    SHARD_LOC_TTL = 5.0  # matches the replica-lookup cache tier
+
+    def _shard_locations(self, vid: int) -> dict:
+        """{shard_id: [peer urls]} for an EC volume via the master's
+        /dir/lookup_ec, self excluded, behind a short-TTL cache — a
+        degraded read touches up to k+ shards and must not pay one
+        master round-trip per column."""
+        now = time.monotonic()
+        cached = self._shard_loc_cache.get(vid)
+        if cached is not None and cached[0] > now:
+            return cached[1]
+        info = self._master_json("GET", f"/dir/lookup_ec?volumeId={vid}",
+                                 deadline=Deadline.after(5.0))
+        locs: dict[int, list[str]] = {}
+        for entry in info.get("shards", []):
+            urls = [l["url"] for l in entry["locations"]
+                    if not self._is_self(l["url"])]
+            if urls:
+                locs[entry["shard_id"]] = urls
+        self._shard_loc_cache[vid] = (now + self.SHARD_LOC_TTL, locs)
+        return locs
+
     def _remote_shard_reader(self, vid: int, shard_id: int, offset: int,
                              size: int) -> Optional[bytes]:
-        """Find the shard's server via the master and fetch the range
-        (reference store_ec.go readRemoteEcShardInterval:270)."""
+        """Find the shard's holders via the master and fetch the range
+        (reference store_ec.go readRemoteEcShardInterval:270).
+        Resilient mode fans out HEDGED across holders ranked by breaker
+        health — a backup request fires after the primary's observed
+        p95 and the first success wins; legacy mode walks the holders
+        serially in lookup order (the bench comparator)."""
         try:
-            info = http_json(
-                "GET",
-                f"http://{self.master_url}/dir/lookup_ec?volumeId={vid}",
-                timeout=5)
+            locs = self._shard_locations(vid)
         except (ConnectionError, HttpError):
             return None
-        for entry in info.get("shards", []):
-            if entry["shard_id"] != shard_id:
-                continue
-            for loc in entry["locations"]:
-                if loc["url"] == self.url:
-                    continue
+        urls = locs.get(shard_id) or []
+        if not urls:
+            return None
+
+        def fetch(url: str) -> Optional[bytes]:
+            status, body, _ = http_call(
+                "GET",
+                f"http://{url}/admin/ec/shard_read"
+                f"?volumeId={vid}&shardId={shard_id}"
+                f"&offset={offset}&size={size}", timeout=30)
+            if status == 200 and len(body) == size:
+                return body
+            return None
+
+        if not self.resilient_reads:
+            for url in urls:
                 try:
-                    status, body, _ = http_call(
-                        "GET",
-                        f"http://{loc['url']}/admin/ec/shard_read"
-                        f"?volumeId={vid}&shardId={shard_id}"
-                        f"&offset={offset}&size={size}", timeout=30)
+                    out = fetch(url)
                 except ConnectionError:
                     continue
-                if status == 200:
-                    return body
-        return None
+                if out is not None:
+                    return out
+            return None
+        # cap this direct fetch under the edge budget: a blackholed
+        # holder must leave room for the degraded-reconstruction
+        # fallback that runs after we give up here
+        from seaweedfs_tpu.utils.resilience import current_deadline
+        dl = current_deadline()
+        sub = dl.sub(max(0.5, 0.4 * dl.remaining())) \
+            if dl is not None else None
+        return hedged(fetch, self.peer_health.rank(urls),
+                      health=self.peer_health, deadline=sub)
 
     def _ec_delete_fanout(self, vid: int, key: int, cookie: int) -> int:
         """Cookie-check locally then fan the tombstone to every shard
@@ -1235,10 +1372,9 @@ class VolumeServer:
         n = self.store.read_ec_shard_needle(vid, key, cookie)
         size = len(n.data)
         try:
-            info = http_json(
-                "GET",
-                f"http://{self.master_url}/dir/lookup_ec?volumeId={vid}",
-                timeout=5)
+            info = self._master_json(
+                "GET", f"/dir/lookup_ec?volumeId={vid}",
+                deadline=Deadline.after(5.0))
         except (ConnectionError, HttpError):
             info = {"shards": []}
         done = set()
@@ -1246,16 +1382,22 @@ class VolumeServer:
         if ev is not None:
             ev.delete_needle(key)
             done.add(self.url)
+            done.add(f"{self.http.host}:{self.http.port}")
         for entry in info.get("shards", []):
             for loc in entry["locations"]:
-                if loc["url"] in done:
+                if loc["url"] in done or self._is_self(loc["url"]):
                     continue
                 done.add(loc["url"])
+                t0 = time.monotonic()
                 try:
                     http_json("POST",
                               f"http://{loc['url']}/admin/ec/blob_delete",
                               {"volume_id": vid, "needle_id": key},
-                              timeout=10)
-                except (ConnectionError, HttpError):
+                              deadline=Deadline.after(10.0))
+                    self.peer_health.record(loc["url"], True,
+                                            time.monotonic() - t0)
+                except ConnectionError:
+                    self.peer_health.record(loc["url"], False)
+                except HttpError:
                     pass
         return size

@@ -731,6 +731,22 @@ class ShellContext:
         return http_json(
             "POST", f"http://{self.master_url}/ec/repair/kick", {})
 
+    def cluster_health(self) -> dict:
+        """Resilience view of the cluster: master's per-peer breaker
+        snapshot + repair budget, enriched with each volume server's own
+        /admin/health (its breakers toward its peers and scrub state).
+        A node that can't answer is reported, not fatal — this command
+        exists precisely for partially-broken clusters."""
+        out = http_json("GET",
+                        f"http://{self.master_url}/cluster/health")
+        for node in out.get("nodes", []):
+            try:
+                node["health"] = http_json(
+                    "GET", f"http://{node['url']}/admin/health")
+            except Exception as e:
+                node["health"] = {"error": type(e).__name__}
+        return out
+
     # ---- ec.balance (reference command_ec_balance.go) ----
     def ec_balance(self, apply: bool = True) -> list[ec_plan.ShardMove]:
         topo = self.topology()

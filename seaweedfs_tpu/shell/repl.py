@@ -55,6 +55,8 @@ HELP = """commands:
   ec.decode -volumeId N
   ec.repair.status                  master repair queue depth/lag/backoffs
   ec.repair.kick                    clear backoffs, dispatch queued repairs
+  cluster.health                    per-peer circuit breakers, scrub state,
+                                    repair bandwidth budget
   volume.scrub [-node HOST:PORT] [-volumeId N]   synchronous integrity pass
   lock / unlock
   help / exit
@@ -598,6 +600,8 @@ def run_command(sh: ShellContext, line: str):
         return sh.ec_decode(int(flags["volumeId"]))
     if cmd == "ec.repair.status":
         return sh.ec_repair_status()
+    if cmd == "cluster.health":
+        return sh.cluster_health()
     if cmd == "ec.repair.kick":
         return sh.ec_repair_kick()
     if cmd == "volume.scrub":

@@ -53,6 +53,14 @@ class Store:
         # shards each encode batch across the visible cores
         self.coder = coder or make_coder("cpu-mt")
         self.remote_shard_reader: Optional[RemoteShardReader] = None
+        # Injected by the volume server (optional): per-peer breaker
+        # registry, a vid -> {shard_id: [urls]} locator, and the switch
+        # that turns on health-ranked + straggler-hedged recovery.
+        # Without them the degraded path keeps its original
+        # fan-out-everything behavior (tests inject bare readers).
+        self.peer_health = None
+        self.shard_locations: Optional[Callable[[int], dict]] = None
+        self.resilient_reads = True
         self._lock = threading.RLock()
         # delta channels to master (drained by the heartbeat loop)
         self.new_volumes: list[dict] = []
@@ -370,28 +378,8 @@ class Store:
             elif self.remote_shard_reader is not None:
                 remote_sids.append(sid)
         if len(bufs) < k and remote_sids:
-            from concurrent.futures import as_completed
-            # shared bounded pool: per-read fan-out (like the
-            # reference's goroutine-per-source-shard) without letting a
-            # wedged peer accumulate unbounded abandoned threads across
-            # many degraded reads — stragglers occupy pool slots until
-            # their own network timeout, which is the backpressure
-            pool = self._recover_pool()
-            futs = {pool.submit(self.remote_shard_reader,
-                                ev.volume_id, sid, shard_off,
-                                iv.size): sid
-                    for sid in remote_sids}
-            for fut in as_completed(futs):
-                try:
-                    got = fut.result()
-                except Exception:
-                    continue
-                if got is not None and len(got) == iv.size:
-                    bufs[futs[fut]] = got
-                    if len(bufs) >= k:
-                        break  # stragglers are abandoned
-            for fut in futs:
-                fut.cancel()  # drop the ones still queued
+            self._fetch_remote_shards(ev, iv, shard_off, bufs,
+                                      remote_sids, k)
         if len(bufs) < k:
             raise NotFoundError(
                 f"ec volume {ev.volume_id}: only {len(bufs)} shards "
@@ -401,6 +389,103 @@ class Store:
             shards[sid] = b
         full = self.coder.reconstruct(shards)
         return full[wanted_shard]
+
+    def _rank_remote_sids(self, vid: int,
+                          sids: list[int]) -> tuple[list[int], int]:
+        """Order remote shard candidates by the health of their BEST
+        holder (closed circuits first, open last) and decide how many
+        extra columns to over-request. Returns (ordered_sids, extra):
+        legacy mode (no health/locator, or resilient_reads off) keeps
+        the original fan-out-everything behavior via extra=len(sids);
+        resilient mode over-requests one column only when a straggler
+        is predicted among the holders it is about to use."""
+        health, locator = self.peer_health, self.shard_locations
+        if health is None or locator is None or not self.resilient_reads:
+            return list(sids), len(sids)
+        try:
+            locs = locator(vid) or {}
+        except Exception:
+            return list(sids), len(sids)
+        from seaweedfs_tpu.utils.resilience import CLOSED
+
+        def sid_key(sid: int) -> tuple[int, float]:
+            urls = locs.get(sid) or []
+            if not urls:
+                return (3, float("inf"))  # no known holder: try last
+            br = health.breaker(health.rank(urls)[0])
+            if br.state == CLOSED:
+                return (0, br.score())
+            if br.probe_ripe():
+                return (1, br.score())
+            return (2, br.score())
+
+        keys = {sid: sid_key(sid) for sid in sids}
+        ordered = sorted(sids, key=lambda s: keys[s])
+        # straggler predicted: any holder we are about to lean on is
+        # not healthy-closed, or is far slower than the best candidate
+        head = ordered[:max(1, len(ordered))]
+        best_score = keys[ordered[0]][1] if ordered else 0.0
+        predicted = any(
+            keys[s][0] > 0
+            or (best_score > 0 and keys[s][1] > 3.0 * best_score)
+            for s in head)
+        return ordered, 1 if predicted else 0
+
+    def _fetch_remote_shards(self, ev: EcVolume, iv: layout.Interval,
+                             shard_off: int, bufs: dict,
+                             remote_sids: list[int], k: int) -> None:
+        """Concurrent first-k-wins fetch into `bufs`, via the shared
+        bounded pool: per-read fan-out (like the reference's
+        goroutine-per-source-shard) without letting a wedged peer
+        accumulate unbounded abandoned threads across many degraded
+        reads — stragglers occupy pool slots until their own network
+        timeout, which is the backpressure. In resilient mode the
+        initial wave is only (needed + predicted-straggler hedge) of
+        the HEALTH-RANKED candidates; failures backfill from the
+        ranked queue, and the ambient deadline bounds the whole wait."""
+        from concurrent.futures import FIRST_COMPLETED, wait
+
+        from seaweedfs_tpu.utils import resilience
+
+        pool = self._recover_pool()
+        dl = resilience.current_deadline()
+        queue, extra = self._rank_remote_sids(ev.volume_id, remote_sids)
+        need = k - len(bufs)
+        inflight: dict = {}
+
+        def submit(sid: int) -> None:
+            def run():
+                # contextvars don't cross into pool threads on their
+                # own: re-enter the caller's deadline scope
+                with resilience.deadline_scope(dl):
+                    return self.remote_shard_reader(
+                        ev.volume_id, sid, shard_off, iv.size)
+            inflight[pool.submit(run)] = sid
+
+        for _ in range(min(len(queue), need + extra)):
+            submit(queue.pop(0))
+        while inflight and len(bufs) < k:
+            timeout = None
+            if dl is not None:
+                timeout = dl.remaining()
+                if timeout <= 0:
+                    break
+            done, _ = wait(inflight, timeout=timeout,
+                           return_when=FIRST_COMPLETED)
+            if not done:
+                break  # deadline expired mid-wait
+            for fut in done:
+                sid = inflight.pop(fut)
+                try:
+                    got = fut.result()
+                except Exception:
+                    got = None
+                if got is not None and len(got) == iv.size:
+                    bufs[sid] = got
+                elif queue:
+                    submit(queue.pop(0))  # backfill the failure
+        for fut in inflight:
+            fut.cancel()  # losers/stragglers are abandoned
 
     def delete_ec_shard_needle(self, vid: int, needle_id: int,
                                cookie: Optional[int] = None) -> int:

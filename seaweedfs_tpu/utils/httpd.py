@@ -19,7 +19,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
 
-from seaweedfs_tpu.utils import glog
+from seaweedfs_tpu.utils import glog, resilience
 
 
 class Request:
@@ -587,7 +587,21 @@ def _drop_conn(netloc: str) -> None:
 
 def http_call(method: str, url: str, body: Optional[bytes] = None,
               json_body: Any = None, timeout: float = 30.0,
-              headers: Optional[dict] = None) -> tuple[int, bytes, dict]:
+              headers: Optional[dict] = None,
+              deadline=None) -> tuple[int, bytes, dict]:
+    # Deadline propagation: `timeout` becomes a CAP under the caller's
+    # remaining budget (explicit `deadline` arg, else the ambient
+    # request-scope one), and the remaining seconds ride along in the
+    # X-Weed-Deadline header so the callee inherits the same budget.
+    # An already-expired deadline raises DeadlineExceeded (a
+    # ConnectionError) before any bytes hit the wire.
+    if deadline is None:
+        deadline = resilience.current_deadline()
+    if deadline is not None:
+        timeout = deadline.timeout(cap=timeout)
+        headers = dict(headers or {})
+        headers.setdefault(resilience.DEADLINE_HEADER,
+                           deadline.header_value())
     if json_body is not None:
         body = json.dumps(json_body).encode()
         headers = dict(headers or {})
@@ -644,9 +658,9 @@ def http_call(method: str, url: str, body: Optional[bytes] = None,
 
 
 def http_json(method: str, url: str, json_body: Any = None,
-              timeout: float = 30.0) -> Any:
+              timeout: float = 30.0, deadline=None) -> Any:
     status, body, _ = http_call(method, url, json_body=json_body,
-                                timeout=timeout)
+                                timeout=timeout, deadline=deadline)
     if status >= 400:
         raise HttpError(status, body)
     return json.loads(body) if body else None

@@ -45,6 +45,13 @@ class TokenBucket:
                                self._tokens + (now - self._ts) * self.rate)
         self._ts = now
 
+    def peek(self) -> float:
+        """Current token balance (bytes) after refill; negative when in
+        debt. Status/observability only — does not take tokens."""
+        with self._lock:
+            self._refill()
+            return self._tokens
+
     def consume(self, n: int, stop: "threading.Event" = None) -> bool:
         """Block until n tokens are available (or the debt is payable),
         then take them. Returns False only if `stop` was set while

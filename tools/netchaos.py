@@ -1,0 +1,269 @@
+"""Fault-injecting TCP proxy for cluster chaos drills.
+
+Sits between a client and one real server (volume, master, filer) and
+degrades the wire in controlled, runtime-mutable ways:
+
+  pass       forward bytes, optionally with added latency/jitter per
+             client->server chunk and a bandwidth cap server->client
+  blackhole  accept the connection, swallow everything, never answer
+             (the classic wedged-peer / dropped-SYN-ACK shape: the
+             caller only escapes via its own deadline)
+  reset      accept then immediately RST (SO_LINGER 0 close)
+  http_error read the request, reply `http_status` (default 503), close
+
+Composes with tools/corrupt.py: corrupt damages bytes at rest, netchaos
+damages the path to them — together they exercise detect/repair under
+the network conditions repair actually runs in.
+
+Usage (also importable: `with ChaosProxy(host, port, latency_s=0.2) as p:`):
+  PYTHONPATH=. python tools/netchaos.py <target_host> <target_port> \
+      [--listen-port N] [--latency MS] [--jitter MS] [--bandwidth BPS] \
+      [--mode pass|blackhole|reset|http_error] [--http-status 503] [--seed S]
+
+Prints one JSON line with the listen address and the active fault, then
+serves until SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from seaweedfs_tpu.utils.limiter import TokenBucket  # noqa: E402
+
+CHUNK = 16384
+
+
+class ChaosProxy:
+    """One listener -> one upstream target, N concurrent connections.
+
+    All fault knobs are runtime-mutable via set_fault(), so a test can
+    blackhole a peer mid-flight and then heal it to watch a half-open
+    breaker probe succeed. Latency/jitter apply per client->server
+    chunk (request direction — models a slow path to the peer);
+    the bandwidth cap applies server->client (response payloads,
+    where EC shard bytes flow)."""
+
+    def __init__(self, target_host: str, target_port: int,
+                 listen_host: str = "127.0.0.1", listen_port: int = 0,
+                 latency_s: float = 0.0, jitter_s: float = 0.0,
+                 bandwidth_bps: float = 0.0, mode: str = "pass",
+                 http_status: int = 503, seed: int = 42):
+        self.target = (target_host, int(target_port))
+        self.mode = mode
+        self.latency_s = float(latency_s)
+        self.jitter_s = float(jitter_s)
+        self.http_status = int(http_status)
+        self._bucket = TokenBucket(float(bandwidth_bps),
+                                   initial=float(bandwidth_bps))
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
+        self.stats = {"connections": 0, "bytes_up": 0, "bytes_down": 0,
+                      "resets": 0, "blackholed": 0, "http_errors": 0}
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((listen_host, int(listen_port)))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()[:2]
+        self.url = f"{self.host}:{self.port}"
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"netchaos:{self.port}")
+
+    # -- lifecycle --
+    def start(self) -> "ChaosProxy":
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def __enter__(self) -> "ChaosProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- control --
+    def set_fault(self, mode: str = None, latency_s: float = None,
+                  jitter_s: float = None, bandwidth_bps: float = None,
+                  http_status: int = None) -> None:
+        """Mutate the active fault; existing blackholed/reset
+        connections are torn down so the next dial sees the new mode."""
+        with self._lock:
+            if mode is not None:
+                self.mode = mode
+            if latency_s is not None:
+                self.latency_s = float(latency_s)
+            if jitter_s is not None:
+                self.jitter_s = float(jitter_s)
+            if http_status is not None:
+                self.http_status = int(http_status)
+            conns = list(self._conns)
+        if bandwidth_bps is not None:
+            self._bucket.set_rate(float(bandwidth_bps))
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    # -- plumbing --
+    def _track(self, sock: socket.socket) -> None:
+        with self._lock:
+            self._conns.append(sock)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            self.stats["connections"] += 1
+            t = threading.Thread(target=self._handle, args=(client,),
+                                 daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    def _handle(self, client: socket.socket) -> None:
+        self._track(client)
+        mode = self.mode
+        try:
+            if mode == "reset":
+                self.stats["resets"] += 1
+                # RST instead of FIN: linger-0 abortive close
+                client.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_LINGER,
+                    b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                client.close()
+                return
+            if mode == "blackhole":
+                self.stats["blackholed"] += 1
+                client.settimeout(0.2)
+                while not self._stop.is_set():
+                    try:
+                        if client.recv(CHUNK) == b"":
+                            return  # peer gave up
+                    except socket.timeout:
+                        continue
+                    except OSError:
+                        return
+                return
+            if mode == "http_error":
+                self.stats["http_errors"] += 1
+                try:
+                    client.settimeout(2.0)
+                    client.recv(CHUNK)  # drain request head
+                    body = b'{"error": "injected"}'
+                    client.sendall(
+                        b"HTTP/1.1 %d Injected\r\n"
+                        b"Content-Type: application/json\r\n"
+                        b"Content-Length: %d\r\nConnection: close\r\n"
+                        b"\r\n%s" % (self.http_status, len(body), body))
+                finally:
+                    client.close()
+                return
+            # pass-through with degradation
+            upstream = socket.create_connection(self.target, timeout=5.0)
+            self._track(upstream)
+            up = threading.Thread(
+                target=self._pump, args=(client, upstream, True),
+                daemon=True)
+            up.start()
+            self._pump(upstream, client, False)
+            up.join(timeout=2.0)
+        except OSError:
+            pass
+        finally:
+            try:
+                client.close()
+            except OSError:
+                pass
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              request_dir: bool) -> None:
+        counter = "bytes_up" if request_dir else "bytes_down"
+        try:
+            while not self._stop.is_set():
+                data = src.recv(CHUNK)
+                if not data:
+                    break
+                if request_dir and (self.latency_s or self.jitter_s):
+                    time.sleep(self.latency_s
+                               + self._rng.uniform(0.0, self.jitter_s))
+                if not request_dir:
+                    self._bucket.consume(len(data), self._stop)
+                dst.sendall(data)
+                self.stats[counter] += len(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("target_host")
+    p.add_argument("target_port", type=int)
+    p.add_argument("--listen-host", default="127.0.0.1")
+    p.add_argument("--listen-port", type=int, default=0)
+    p.add_argument("--latency", type=float, default=0.0,
+                   help="added ms per request-direction chunk")
+    p.add_argument("--jitter", type=float, default=0.0,
+                   help="extra uniform(0,J) ms on top of --latency")
+    p.add_argument("--bandwidth", type=float, default=0.0,
+                   help="response-direction cap, bytes/sec (0 = off)")
+    p.add_argument("--mode", default="pass",
+                   choices=("pass", "blackhole", "reset", "http_error"))
+    p.add_argument("--http-status", type=int, default=503)
+    p.add_argument("--seed", type=int, default=42)
+    args = p.parse_args()
+
+    proxy = ChaosProxy(
+        args.target_host, args.target_port,
+        listen_host=args.listen_host, listen_port=args.listen_port,
+        latency_s=args.latency / 1000.0, jitter_s=args.jitter / 1000.0,
+        bandwidth_bps=args.bandwidth, mode=args.mode,
+        http_status=args.http_status, seed=args.seed).start()
+    print(json.dumps({
+        "listen": proxy.url, "target": f"{args.target_host}:{args.target_port}",
+        "mode": args.mode, "latency_ms": args.latency,
+        "jitter_ms": args.jitter, "bandwidth_bps": args.bandwidth}),
+        flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        proxy.stop()
+
+
+if __name__ == "__main__":
+    main()
