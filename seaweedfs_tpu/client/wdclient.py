@@ -270,6 +270,45 @@ class MasterClient:
             self._assign_pools[key] = (now + self.ASSIGN_POOL_TTL, fids)
         return first
 
+    # One multi-chunk upload maps to one volume at most this many
+    # sequential keys per master round trip; wider uploads assign in
+    # waves so a huge PUT doesn't pin hundreds of ids to one volume.
+    ASSIGN_MANY_MAX = 64
+
+    def assign_many(self, n: int, collection: str = "",
+                    replication: str = "", ttl: str = "",
+                    disk: str = "") -> list[dict]:
+        """Exactly `n` assign results in as few master round trips as
+        possible (count=N key derivation, same contract as
+        assign_batched but returning the whole batch — the filer's
+        parallel chunk uploader needs all fids up front). Each element
+        is a normal assign dict; an element with "error" set means the
+        remainder was not assigned. JWT clusters fall back to per-fid
+        assigns (a minted token covers only the base fid)."""
+        from seaweedfs_tpu.storage.file_id import (
+            format_needle_id_cookie, parse_needle_id_cookie)
+        out: list[dict] = []
+        while len(out) < n:
+            want = min(n - len(out), self.ASSIGN_MANY_MAX)
+            if self._assign_jwt_mode:
+                want = 1
+            a = self.assign(count=want, collection=collection,
+                            replication=replication, ttl=ttl, disk=disk)
+            if a.get("error"):
+                out.append(a)
+                return out
+            if a.get("auth"):
+                self._assign_jwt_mode = True
+                out.append(a)
+                continue
+            vid, rest = a["fid"].split(",", 1)
+            nkey, cookie = parse_needle_id_cookie(rest)
+            got = max(1, min(int(a.get("count", 1)), want))
+            out.extend(dict(a, fid=f"{vid},"
+                            f"{format_needle_id_cookie(nkey + i, cookie)}")
+                       for i in range(got))
+        return out[:n]
+
     def cluster_status(self) -> dict:
         return self._call("GET", "/cluster/status")
 

@@ -15,8 +15,11 @@ Functional equivalent of reference weed/server/filer_server*.go:
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
+from concurrent.futures import CancelledError, ThreadPoolExecutor, \
+    as_completed
 from typing import Optional
 
 from seaweedfs_tpu.client import operation
@@ -34,12 +37,19 @@ from seaweedfs_tpu.filer.filerstore import make_store
 from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call)
-from seaweedfs_tpu.utils.resilience import (Deadline, current_deadline,
-                                            deadline_scope)
+from seaweedfs_tpu.utils.resilience import (Deadline, PeerHealth,
+                                            current_deadline,
+                                            deadline_scope, hedged)
 
 CHUNK_SIZE = 4 * 1024 * 1024
 INLINE_LIMIT = 2048  # small content stored in the entry itself
 READ_DEADLINE_S = 30.0  # edge deadline for a filer GET without one
+# Concurrent chunk uploads per filer process (reference
+# filer_server_handlers_write_upload.go uploads via a bounded
+# goroutine pool); shared across requests so a burst of PUTs can't
+# multiply into unbounded sockets/threads.
+UPLOAD_WORKERS = int(os.environ.get("SEAWEEDFS_TPU_FILER_UPLOAD_WORKERS",
+                                    "8"))
 
 
 def _ttl_seconds(ttl: str) -> int:
@@ -124,6 +134,13 @@ class FilerServer:
             "filer", "request_total", "filer requests", ("type",))
         self._m_lat = self.metrics.histogram(
             "filer", "request_seconds", "filer request latency", ("type",))
+        # parallel_uploads=False keeps the serial per-chunk
+        # assign+upload loop as the bench comparator
+        self.parallel_uploads = True
+        self._upload_pool: Optional[ThreadPoolExecutor] = None
+        self._upload_pool_lock = threading.Lock()
+        # per-volume-server breakers/latency for hedged chunk fetches
+        self.peer_health = PeerHealth(metrics=self.metrics)
         self.http = HttpServer(host, port)
         # metrics ride their own listener (reference filer -metricsPort):
         # every path on the main port is user namespace, so a /metrics
@@ -196,6 +213,8 @@ class FilerServer:
         # not hit a closed notification socket
         if getattr(self, "_notify_queue", None) is not None:
             self._notify_queue.close()
+        if self._upload_pool is not None:
+            self._upload_pool.shutdown(wait=False)
         self.reader_cache.close()
         self.filer.close()
 
@@ -317,6 +336,15 @@ class FilerServer:
             return Response({"error": "is a directory"}, status=409)
         return Response({"name": entry.name, "size": len(data)}, status=201)
 
+    def _get_upload_pool(self) -> ThreadPoolExecutor:
+        if self._upload_pool is None:
+            with self._upload_pool_lock:
+                if self._upload_pool is None:
+                    self._upload_pool = ThreadPoolExecutor(
+                        max_workers=UPLOAD_WORKERS,
+                        thread_name_prefix="chunk-upload")
+        return self._upload_pool
+
     def _upload_chunks(self, data: bytes, collection: str,
                        replication: str, ttl: str = "",
                        disk_type: str = "") -> list[FileChunk]:
@@ -324,16 +352,80 @@ class FilerServer:
         (reference filer_server_handlers_write_upload.go:32-140). Wide
         chunk lists collapse into manifest chunks (filechunk_manifest.go).
         disk_type routes the assigns to that storage tier (per-path
-        filer.conf rule, reference -disk)."""
-        chunks = []
-        for off in range(0, len(data), CHUNK_SIZE):
-            piece = data[off:off + CHUNK_SIZE]
-            chunks.append(self._save_chunk(piece, off, collection,
-                                           replication, ttl, disk_type))
-        return maybe_manifestize(
-            lambda blob: self._save_chunk(blob, 0, collection,
-                                          replication, ttl, disk_type),
-            chunks)
+        filer.conf rule, reference -disk).
+
+        Multi-chunk uploads run concurrently: fids are minted in
+        batches (master assign count=N), the pieces go through the
+        shared bounded pool, and the chunk list is assembled by index
+        so offsets/ordering are identical to the serial loop. On the
+        first error the remaining uploads are cancelled, every chunk
+        that already landed is deleted (no orphans), and the error
+        propagates. The S3 gateway PUT/multipart and WebDAV paths ride
+        this same code."""
+        offsets = list(range(0, len(data), CHUNK_SIZE))
+        save_one = lambda blob: self._save_chunk(  # noqa: E731
+            blob, 0, collection, replication, ttl, disk_type)
+        if len(offsets) <= 1 or not self.parallel_uploads:
+            chunks = [self._save_chunk(data[off:off + CHUNK_SIZE], off,
+                                       collection, replication, ttl,
+                                       disk_type)
+                      for off in offsets]
+            return maybe_manifestize(save_one, chunks)
+        assigns = self.mc.assign_many(len(offsets), collection=collection,
+                                      replication=replication, ttl=ttl,
+                                      disk=disk_type)
+        if assigns and assigns[0].get("error"):
+            raise HttpError(500, assigns[0]["error"].encode())
+        if len(assigns) < len(offsets) or any(a.get("error")
+                                              for a in assigns):
+            # partial batch (JWT-mode flip mid-call or master error
+            # tail): the serial path handles its own assigns fine
+            chunks = [self._save_chunk(data[off:off + CHUNK_SIZE], off,
+                                       collection, replication, ttl,
+                                       disk_type)
+                      for off in offsets]
+            return maybe_manifestize(save_one, chunks)
+        pool = self._get_upload_pool()
+        chunks: list[Optional[FileChunk]] = [None] * len(offsets)
+        futures = {
+            pool.submit(self._upload_one_chunk, assigns[i],
+                        data[off:off + CHUNK_SIZE], off): i
+            for i, off in enumerate(offsets)}
+        first_err: Optional[Exception] = None
+        for fut in as_completed(futures):
+            try:
+                chunks[futures[fut]] = fut.result()
+            except CancelledError:
+                pass
+            except Exception as e:
+                if first_err is None:
+                    first_err = e
+                    for g in futures:
+                        g.cancel()
+        if first_err is not None:
+            # as_completed drained every future, so `chunks` now holds
+            # exactly the uploads that landed — GC them
+            self._delete_chunks([c.fid for c in chunks if c is not None])
+            if isinstance(first_err, HttpError):
+                raise first_err
+            raise HttpError(500, f"chunk upload failed: "
+                                 f"{first_err}".encode())
+        return maybe_manifestize(save_one, chunks)
+
+    def _upload_one_chunk(self, a: dict, piece: bytes,
+                          offset: int) -> FileChunk:
+        """Encrypt (when enabled) + upload one piece against an
+        already-minted assignment."""
+        key = b""
+        if self.cipher:
+            from seaweedfs_tpu.utils import cipher as _cipher
+            blob, key = _cipher.encrypt(piece)
+        else:
+            blob = piece
+        operation.upload_to(a["fid"], a["url"], blob,
+                            auth=a.get("auth", ""))
+        return FileChunk(fid=a["fid"], offset=offset, size=len(piece),
+                         cipher_key=key, mtime_ns=time.time_ns())
 
     def _save_chunk(self, piece: bytes, offset: int, collection: str,
                     replication: str, ttl: str = "",
@@ -342,15 +434,7 @@ class FilerServer:
                            ttl=ttl, disk=disk_type)
         if a.get("error"):
             raise HttpError(500, a["error"].encode())
-        key = b""
-        if self.cipher:
-            from seaweedfs_tpu.utils import cipher as _cipher
-            blob, key = _cipher.encrypt(piece)
-        else:
-            blob = piece
-        operation.upload_to(a["fid"], a["url"], blob)
-        return FileChunk(fid=a["fid"], offset=offset, size=len(piece),
-                         cipher_key=key, mtime_ns=time.time_ns())
+        return self._upload_one_chunk(a, piece, offset)
 
     # ---- read ----
     def _handle_read(self, req: Request) -> Response:
@@ -394,28 +478,32 @@ class FilerServer:
 
     def _fetch_chunk_remote(self, fid: str) -> bytes:
         """One real network fetch of a chunk's stored bytes (the
-        ReaderCache guarantees a single flight per fid)."""
+        ReaderCache guarantees a single flight per fid).
+
+        Replica holders are breaker-ranked (learned per-peer health
+        fronts the fastest live server) and straggler-hedged: if the
+        first pick stalls past the adaptive hedge delay, a backup
+        fetch races it on the next-ranked peer — same machinery the
+        volume servers use for degraded EC reads."""
         jwt = self._read_jwt_for(fid)
         dl = current_deadline() or Deadline.after(READ_DEADLINE_S)
-        urls = self.mc.lookup_file_id(fid)
-        for i, url in enumerate(urls):
-            if dl.expired():
-                break
-            # leave budget for the remaining locations: a blackholed
-            # first holder must not consume the whole deadline
-            left = len(urls) - i
-            sub = dl if left <= 1 else dl.sub(
-                max(0.5, dl.remaining() / left))
-            try:
-                sep = "&" if "?" in url else "?"
-                status, body, _ = http_call(
-                    "GET", url + (f"{sep}jwt={jwt}" if jwt else ""),
-                    deadline=sub)
-            except ConnectionError:
-                continue
-            if status == 200:
-                return body
-        raise HttpError(500, f"chunk {fid} unreachable".encode())
+        vid = int(fid.split(",")[0])
+        peers = [l["url"] for l in self.mc.lookup_volume(vid)]
+
+        def fetch(peer: str) -> Optional[bytes]:
+            target = (f"http://{peer}/{fid}"
+                      + (f"?jwt={jwt}" if jwt else ""))
+            status, body, _ = http_call("GET", target, deadline=dl)
+            return body if status == 200 else None
+
+        out = hedged(fetch, self.peer_health.rank(peers),
+                     health=self.peer_health, deadline=dl)
+        if out is None:
+            # the holder set may have changed (moved/grown volume):
+            # don't let a stale lookup cache pin the failure
+            self.mc.invalidate(vid)
+            raise HttpError(500, f"chunk {fid} unreachable".encode())
+        return out
 
     def _read_chunk_blob(self, fid: str) -> bytes:
         """Raw stored bytes of a chunk (ciphertext when encrypted);

@@ -35,8 +35,8 @@ from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call, http_json)
 from seaweedfs_tpu.utils.resilience import (Deadline, PeerHealth,
-                                            RetryPolicy, deadline_scope,
-                                            hedged)
+                                            RetryPolicy, current_deadline,
+                                            deadline_scope, hedged)
 
 PULSE_SECONDS = 2.0
 # Default edge budget for a public read that arrives without a
@@ -72,7 +72,9 @@ class VolumeServer:
                  scrub_rate_mbps: float = 8.0,
                  scrub_interval_s: float = 600.0,
                  advertise: str = "",
-                 resilient_reads: bool = True):
+                 resilient_reads: bool = True,
+                 parallel_replication: bool = True,
+                 fsync: bool = False):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
         the volume_server_pb gRPC admin plane (0 = ephemeral).
@@ -95,7 +97,12 @@ class VolumeServer:
         bench interpose a tools/netchaos.py proxy on the peer path).
         resilient_reads toggles health-ranked + hedged remote-shard
         fetching (off = the serial lookup-order walk, kept as the
-        bench comparator)."""
+        bench comparator).
+        parallel_replication toggles the concurrent replica fan-out
+        (off = the one-at-a-time peer loop, kept as the bench
+        comparator). fsync forces a durable fsync per commit batch on
+        every volume (reference `weed volume -fsync`; group commit in
+        storage/volume.py amortizes it across concurrent writers)."""
         urls = (master_url.split(",") if isinstance(master_url, str)
                 else list(master_url))
         self.master_urls = [u.strip() for u in urls if u.strip()]
@@ -138,6 +145,11 @@ class VolumeServer:
         self._replica_cache: dict[int, tuple[float, list]] = {}
         self.advertise = advertise
         self.resilient_reads = resilient_reads
+        self.parallel_replication = parallel_replication
+        self._fsync = fsync
+        # lazily-built shared pool for the concurrent replica fan-out
+        self._replicate_pool: Optional[object] = None
+        self._replicate_pool_lock = threading.Lock()
         # per-peer circuit breakers + latency health, fed by every
         # outbound call (masters and peer volume servers alike)
         self.retry = RetryPolicy()
@@ -182,7 +194,7 @@ class VolumeServer:
             public_url=self._public_url or f"{reg_host}:{reg_port}",
             rack=self._rack, data_center=self._dc, coder=self._coder,
             needle_map_kind=self._needle_map_kind,
-            disk_types=self._disk_types)
+            disk_types=self._disk_types, fsync=self._fsync)
         self.store.load_existing_volumes()
         self.store.remote_shard_reader = self._remote_shard_reader
         self.store.peer_health = self.peer_health
@@ -217,6 +229,8 @@ class VolumeServer:
         self._stop.set()
         if self.scrubber is not None:
             self.scrubber.stop()
+        if self._replicate_pool is not None:
+            self._replicate_pool.shutdown(wait=False)
         self.metrics.stop_push()
         if self.tcp_server is not None:
             self.tcp_server.stop()
@@ -827,9 +841,27 @@ class VolumeServer:
         self._replica_cache[vid] = (now + self.REPLICA_CACHE_TTL, others)
         return others
 
+    # Edge budget for one replica fan-out when the client sent none:
+    # bounds the whole concurrent batch, not each leg.
+    REPLICATE_DEADLINE_S = 20.0
+
+    def _replicate_pool_get(self):
+        if self._replicate_pool is None:
+            with self._replicate_pool_lock:
+                if self._replicate_pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+                    self._replicate_pool = ThreadPoolExecutor(
+                        max_workers=16, thread_name_prefix="replicate")
+        return self._replicate_pool
+
     def _replicate(self, req: Request, op: str) -> Optional[str]:
         """Synchronous fan-out to the other replicas
-        (reference topology/store_replicate.go:58-110)."""
+        (reference topology/store_replicate.go:58-110), posted to ALL
+        peers concurrently so a replicated write costs ~max(peers)
+        instead of sum(peers). Per-peer circuit breakers fail fast on
+        known-down replicas; any failure drops the cached peer list so
+        the next write re-resolves the (possibly moved) topology
+        instead of pinning the error for the cache TTL."""
         vid = int(req.match.group(1))
         vol = self.store.find_volume(vid)
         if vol is not None and \
@@ -837,20 +869,44 @@ class VolumeServer:
             # single-copy volume: no peers can exist, skip the lookup
             return None
         others = self._replica_peers(vid)
+        if not others:
+            return None
         qs = "&".join(f"{k}={v}" for k, v in req.query.items()
                       if k != "type")
         sep = "&" if qs else ""
-        for url in others:
+        dl = current_deadline() or Deadline.after(self.REPLICATE_DEADLINE_S)
+
+        def send(url: str) -> Optional[str]:
+            if not self.peer_health.allow(url):
+                return f"replica {url}: circuit open"
             target = (f"http://{url}{req.path}?{qs}{sep}type=replicate")
+            t0 = time.monotonic()
             try:
                 if op == "write":
-                    status, body, _ = http_call("POST", target, body=req.body)
+                    status, _body, _ = http_call("POST", target,
+                                                 body=req.body,
+                                                 deadline=dl)
                 else:
-                    status, body, _ = http_call("DELETE", target)
-                if status >= 400 and status != 404:
-                    return f"replica {url}: HTTP {status}"
+                    status, _body, _ = http_call("DELETE", target,
+                                                 deadline=dl)
             except ConnectionError as e:
+                self.peer_health.record(url, False)
                 return f"replica {url}: {e}"
+            # an HTTP answer means the peer is up (same convention as
+            # _master_json); the write itself may still have failed
+            self.peer_health.record(url, True, time.monotonic() - t0)
+            if status >= 400 and status != 404:
+                return f"replica {url}: HTTP {status}"
+            return None
+
+        if len(others) == 1 or not self.parallel_replication:
+            errs = [send(u) for u in others]
+        else:
+            errs = list(self._replicate_pool_get().map(send, others))
+        errs = [e for e in errs if e]
+        if errs:
+            self._replica_cache.pop(vid, None)
+            return "; ".join(errs)
         return None
 
     def _handle_status(self, req: Request) -> Response:

@@ -19,8 +19,10 @@ _EC_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.ec(?P<shard>\d{2})$")
 
 class DiskLocation:
     def __init__(self, directory: str, max_volume_count: int = 8,
-                 disk_type: str = "hdd", needle_map_kind: str = "memory"):
+                 disk_type: str = "hdd", needle_map_kind: str = "memory",
+                 fsync: bool = False):
         self.needle_map_kind = needle_map_kind
+        self.fsync = fsync
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_volume_count = max_volume_count
@@ -55,7 +57,8 @@ class DiskLocation:
                     if vid not in self.volumes:
                         self.volumes[vid] = Volume(
                             self.directory, col, vid,
-                            needle_map_kind=self.needle_map_kind)
+                            needle_map_kind=self.needle_map_kind,
+                            fsync=self.fsync)
             self.load_all_ec_shards()
 
     def load_all_ec_shards(self) -> None:

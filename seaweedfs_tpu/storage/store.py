@@ -33,9 +33,13 @@ class Store:
                  public_url: str = "", rack: str = "", data_center: str = "",
                  coder: Optional[ErasureCoder] = None,
                  needle_map_kind: str = "memory",
-                 disk_types: Optional[list[str]] = None):
+                 disk_types: Optional[list[str]] = None,
+                 fsync: bool = False):
         self.ip = ip
         self.needle_map_kind = needle_map_kind
+        # fsync per commit batch on every volume (reference -fsync);
+        # group commit in volume.py amortizes it across writers
+        self.fsync = fsync
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
         self.rack = rack
@@ -47,7 +51,7 @@ class Store:
         self.locations = [
             DiskLocation(d, (max_volume_counts or [8] * len(directories))[i],
                          disk_type=types[i] or "hdd",
-                         needle_map_kind=needle_map_kind)
+                         needle_map_kind=needle_map_kind, fsync=fsync)
             for i, d in enumerate(directories)]
         # multi-core CPU coder by default: bit-identical to "cpu",
         # shards each encode batch across the visible cores
@@ -92,7 +96,8 @@ class Store:
             vol = Volume(loc.directory, collection, vid,
                          ReplicaPlacement.parse(replica_placement),
                          TTL.parse(ttl),
-                         needle_map_kind=self.needle_map_kind)
+                         needle_map_kind=self.needle_map_kind,
+                         fsync=self.fsync)
             loc.add_volume(vol)
             self.new_volumes.append(self.volume_info(vol))
             return vol
@@ -150,7 +155,8 @@ class Store:
                     if not os.path.exists(base + ".idx"):
                         continue
                     vol = Volume(loc.directory, col, vid,
-                                 needle_map_kind=self.needle_map_kind)
+                                 needle_map_kind=self.needle_map_kind,
+                                 fsync=self.fsync)
                     loc.add_volume(vol)
                     self.new_volumes.append(self.volume_info(vol))
                     return True
@@ -212,7 +218,8 @@ class Store:
                     os.rename(os.path.join(src_loc.directory, fname),
                               os.path.join(dst_loc.directory, fname))
             vol = Volume(dst_loc.directory, collection, vid,
-                         needle_map_kind=self.needle_map_kind)
+                         needle_map_kind=self.needle_map_kind,
+                         fsync=self.fsync)
             dst_loc.add_volume(vol)
             # delta: the volume's disk_type changed
             self.deleted_volumes.append(old_info)

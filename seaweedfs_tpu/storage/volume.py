@@ -44,12 +44,16 @@ class Volume:
     def __init__(self, directory: str, collection: str, volume_id: int,
                  replica_placement: Optional[ReplicaPlacement] = None,
                  ttl: Optional[TTL] = None, version: int = CURRENT_VERSION,
-                 needle_map_kind: str = "memory", offset_bytes: int = 4):
+                 needle_map_kind: str = "memory", offset_bytes: int = 4,
+                 fsync: bool = False):
         """needle_map_kind selects the index structure (reference
         NeedleMapKind, weed/storage/needle_map.go:13-19):
         "memory" = CompactMap, "ldb" = disk-backed LSM map (the LevelDB
         analogue), "sorted" = readonly sorted-file map.
-        offset_bytes=5 gives 8TB volumes (17-byte index entries)."""
+        offset_bytes=5 gives 8TB volumes (17-byte index entries).
+        fsync=True forces an fsync of .dat/.idx per commit batch
+        (reference `weed volume -fsync`); the group-commit protocol
+        below amortizes it across concurrent writers."""
         self.directory = directory
         self.collection = collection
         self.id = volume_id
@@ -59,6 +63,20 @@ class Volume:
         self._lock = threading.RLock()
         self.last_append_at_ns = 0
         self.is_compacting = False
+        # group-commit state: appends take a sequence number under
+        # _lock; durability (flush/fsync) is settled afterwards under
+        # _flush_cond so one leader's flush covers every append that
+        # landed before it (reference topology/store_replicate.go keeps
+        # one flush per write; coalescing is this port's concession to
+        # Python's buffered file objects + thread-per-request server)
+        self._fsync = fsync
+        self._flush_cond = threading.Condition()
+        self._appended_seq = 0   # last sequence handed to an append
+        self._flushed_seq = 0    # highest sequence known durable
+        self._flush_leader = False
+        self.flush_count = 0     # flush batches actually performed
+        self.flush_s = 0.0       # wall seconds inside those batches
+        self.commit_waits = 0    # appends that rode another's flush
 
         base = self.file_name()
         exists = (os.path.exists(base + ".dat")
@@ -189,12 +207,57 @@ class Volume:
             self.nm.set(n.id, off_units, n.size)
             self._idx.write(t.pack_entry(n.id, off_units, n.size,
                                          self.offset_bytes))
-            # push both appends to the OS page cache so they survive
-            # process death (the Go reference's unbuffered writes do —
-            # Python's buffered writers would silently drop them)
-            self._dat.flush()
-            self._idx.flush()
-            return n.size
+            self._appended_seq += 1
+            seq = self._appended_seq
+        # push both appends to the OS page cache so they survive
+        # process death (the Go reference's unbuffered writes do —
+        # Python's buffered writers would silently drop them). Done
+        # OUTSIDE the append lock via group commit: N concurrent
+        # writers share ~1 flush instead of paying one each.
+        self._group_commit(seq)
+        return n.size
+
+    def _group_commit(self, seq: int) -> None:
+        """Make the append with sequence `seq` durable, coalescing with
+        concurrent appends. A writer returns once a flush covering its
+        sequence has completed; it either (a) finds one already done,
+        (b) waits for the in-progress flush if that flush will cover it
+        (the leader flushes everything appended before it starts), or
+        (c) becomes the leader itself. The leader re-takes the append
+        lock for the flush so a flush never runs concurrently with a
+        buffered write (BufferedRandom is not thread-safe), but waiters
+        never hold it — so appends keep landing while a flush is in
+        flight, which is exactly what the next batch coalesces."""
+        with self._flush_cond:
+            while True:
+                if self._flushed_seq >= seq:
+                    self.commit_waits += 1
+                    return
+                if not self._flush_leader:
+                    self._flush_leader = True
+                    break
+                # a flush is in flight; it may or may not cover seq —
+                # re-check when it finishes
+                self._flush_cond.wait()
+        covered = None
+        try:
+            t0 = time.monotonic()
+            with self._lock:
+                high = self._appended_seq
+                self._dat.flush()
+                self._idx.flush()
+                if self._fsync:
+                    os.fsync(self._dat.fileno())
+                    os.fsync(self._idx.fileno())
+                covered = high  # only on flush success
+            self.flush_s += time.monotonic() - t0
+        finally:
+            with self._flush_cond:
+                self._flush_leader = False
+                if covered is not None:
+                    self._flushed_seq = max(self._flushed_seq, covered)
+                    self.flush_count += 1
+                self._flush_cond.notify_all()
 
     # ---- read ----
     def read_needle(self, needle_id: int, cookie: Optional[int] = None,
@@ -268,9 +331,10 @@ class Volume:
             self.nm.deleted_bytes += size
             self._idx.write(t.pack_entry(needle_id, 0, t.TOMBSTONE_FILE_SIZE,
                                          self.offset_bytes))
-            self._dat.flush()
-            self._idx.flush()
-            return size
+            self._appended_seq += 1
+            seq = self._appended_seq
+        self._group_commit(seq)
+        return size
 
     # ---- stats ----
     def content_size(self) -> int:

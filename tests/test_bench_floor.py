@@ -155,3 +155,34 @@ def test_degraded_read_floor(monkeypatch):
     # hedged tail must also beat the straggler in absolute terms
     assert out["degraded_read_p99_ms"] < \
         out["degraded_read_straggler_ms"], out
+
+
+def test_filer_put_floor(monkeypatch):
+    """Concurrent chunk upload vs the serial per-chunk loop, with a
+    15ms injected filer->volume RTT (the host is single-core, so the
+    win is latency overlap — deterministic under CI load). Measured
+    ~7.4x on the dev box; the acceptance bar is 2x. Byte identity of
+    the read-back is asserted inside the bench for both modes."""
+    import bench
+
+    monkeypatch.delenv("SEAWEEDFS_TPU_BENCH_PUT_MB", raising=False)
+    out = bench.bench_filer_put(size_mb=2)
+    assert out["filer_put_speedup"] > 2.0, out
+    assert out["filer_put_mbps"] > out["filer_put_serial_mbps"], out
+
+
+def test_replicated_write_floor(monkeypatch):
+    """Concurrent replica fan-out must pay ~max(peers), not
+    sum(peers): with two 40ms replicas the serial loop's p99 sits at
+    ~2x40ms while the fan-out sits at ~40ms (measured 99.5ms vs
+    44ms). 1.4x in-run margin + an absolute sum-of-peers ceiling keep
+    CI noise out while failing hard if the fan-out serializes."""
+    import bench
+
+    monkeypatch.delenv("SEAWEEDFS_TPU_BENCH_REPL_WRITES", raising=False)
+    out = bench.bench_replicated_write(n_writes=15)
+    assert out["replicated_write_p99_ms"] * 1.4 <= \
+        out["replicated_write_serial_p99_ms"], out
+    # concurrent fan-out must beat the serial sum of the two slow legs
+    assert out["replicated_write_p99_ms"] < \
+        2 * out["replicated_write_slow_ms"], out

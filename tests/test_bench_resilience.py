@@ -87,3 +87,36 @@ def test_retry_schedule_spans_sixty_seconds():
     # The verdict's floor: >= 3 attempts over >= 60s.
     assert len(bench.TPU_ATTEMPT_DELAYS) >= 3
     assert sum(bench.TPU_ATTEMPT_DELAYS) >= 60
+
+
+def test_probe_fails_fast_after_first_timeout():
+    # BENCH_r05 postmortem: a hung relay ate 4 x 300s. A timeout is a
+    # hang, not a flake — one is enough; the remaining schedule must
+    # NOT run (fast-fail to the cpu backend).
+    mbps, attempts, err = bench.tpu_probe_with_retries(
+        delays=(0, 0, 0, 0), timeout=1,
+        argv_prefix=[sys.executable, "-c",
+                     "import time; time.sleep(30)"],
+        sleep=lambda s: None)
+    assert mbps is None
+    assert attempts == 1
+    assert "timeout" in err
+
+
+def test_probe_outcome_cached_for_process(tmp_path):
+    # The detection outcome is cached per (command, schedule): a second
+    # call must not re-spawn the probe subprocess.
+    marker = tmp_path / "probe_runs"
+    script = (
+        "import json, pathlib\n"
+        f"p = pathlib.Path({str(marker)!r})\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        "print(json.dumps({'tpu_mbps': 55.0}))\n"
+    )
+    args = dict(delays=(0,), argv_prefix=[sys.executable, "-c", script],
+                sleep=lambda s: None)
+    first = bench.tpu_probe_with_retries(**args)
+    second = bench.tpu_probe_with_retries(**args)
+    assert first == second == (55.0, 1, None)
+    assert marker.read_text() == "1"

@@ -1,0 +1,321 @@
+"""Parallel write path (PR 4): concurrent chunk upload with
+order-preserving assembly + orphan cleanup, batched fid assigns,
+concurrent replica fan-out with cache invalidation, needle-log group
+commit, and the hedged filer chunk fetch."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import seaweedfs_tpu.server.filer_server as fsrv
+from seaweedfs_tpu.client import operation
+from seaweedfs_tpu.client.wdclient import MasterClient
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils.httpd import http_call
+
+
+@pytest.fixture
+def cluster(tmp_path, monkeypatch):
+    # 64KB chunks so a ~1MB body exercises a wide multi-chunk upload
+    monkeypatch.setattr(fsrv, "CHUNK_SIZE", 64 * 1024)
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    yield master, vs, fs
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def _entry_chunks(fs, path):
+    st, body, _ = http_call(
+        "GET", f"http://{fs.url}/__api/entry?path={path}")
+    assert st == 200, body
+    return json.loads(body)["entry"]["chunks"]
+
+
+def _put(fs, path, data, expect=201):
+    st, body, _ = http_call("POST", f"http://{fs.url}{path}", body=data,
+                            timeout=60)
+    assert st == expect, (st, body)
+    return body
+
+
+def _get(fs, path):
+    st, body, _ = http_call("GET", f"http://{fs.url}{path}", timeout=60)
+    assert st == 200, st
+    return body
+
+
+def test_parallel_put_identical_to_serial(cluster):
+    """The concurrent uploader must produce a byte- and order-identical
+    result to the serial loop: same chunk offsets/sizes in the same
+    list order, same read-back bytes."""
+    master, vs, fs = cluster
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, 1024 * 1024 + 999,
+                        dtype=np.uint8).tobytes()
+    _put(fs, "/id/par.bin", data)
+    fs.parallel_uploads = False
+    _put(fs, "/id/ser.bin", data)
+    fs.parallel_uploads = True
+    par = [(c["offset"], c["size"]) for c in _entry_chunks(fs, "/id/par.bin")]
+    ser = [(c["offset"], c["size"]) for c in _entry_chunks(fs, "/id/ser.bin")]
+    assert par == ser
+    assert par == sorted(par)  # ascending offsets
+    # contiguous coverage of the whole body
+    assert par[0][0] == 0
+    assert sum(s for _, s in par) == len(data)
+    assert _get(fs, "/id/par.bin") == data
+    assert _get(fs, "/id/ser.bin") == data
+
+
+def test_concurrent_puts_stress(cluster):
+    """Many writers at once: every body reads back exactly, every chunk
+    list stays ordered (the pool is shared across requests)."""
+    master, vs, fs = cluster
+    rng = np.random.default_rng(6)
+    bodies = {f"/stress/f{i}.bin":
+              rng.integers(0, 256, 256 * 1024 + i * 1000,
+                           dtype=np.uint8).tobytes()
+              for i in range(6)}
+    errs = []
+
+    def put_one(path):
+        try:
+            _put(fs, path, bodies[path])
+        except Exception as e:  # surfaced after join
+            errs.append((path, e))
+
+    threads = [threading.Thread(target=put_one, args=(p,))
+               for p in bodies]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    for path, data in bodies.items():
+        assert _get(fs, path) == data
+        offs = [(c["offset"], c["size"]) for c in _entry_chunks(fs, path)]
+        assert offs == sorted(offs)
+        assert sum(s for _, s in offs) == len(data)
+
+
+def test_parallel_put_batches_assigns(cluster):
+    """A 16-chunk PUT mints its fids in one master round trip (assign
+    count=N), not one RPC per chunk like the serial loop."""
+    master, vs, fs = cluster
+    calls = []
+    real_assign = fs.mc.assign
+    fs.mc.assign = lambda **kw: (calls.append(kw), real_assign(**kw))[1]
+    data = bytes(range(256)) * 4096  # 1MB = 16 x 64KB chunks
+    _put(fs, "/batch/a.bin", data)
+    assert len(calls) == 1, calls
+    assert calls[0]["count"] == 16
+    fs.parallel_uploads = False
+    calls.clear()
+    _put(fs, "/batch/b.bin", data)
+    assert len(calls) == 16
+
+
+def test_upload_failure_cancels_and_cleans_orphans(cluster, monkeypatch):
+    """One chunk upload failing mid-flight must fail the PUT, delete
+    every chunk that already landed (no orphans), and create no
+    entry."""
+    master, vs, fs = cluster
+    uploaded, deleted = [], []
+    lock = threading.Lock()
+    calls = [0]
+    real_upload = operation.upload_to
+
+    def flaky_upload(fid, server_url, blob, **kw):
+        with lock:
+            calls[0] += 1
+            mine = calls[0]
+        if mine == 3:
+            raise RuntimeError("injected upload failure")
+        out = real_upload(fid, server_url, blob, **kw)
+        with lock:
+            uploaded.append(fid)
+        return out
+
+    monkeypatch.setattr(operation, "upload_to", flaky_upload)
+    # synchronous recorder instead of the async GC thread
+    fs._delete_chunks = lambda fids: deleted.extend(fids)
+    data = bytes(range(256)) * 4096
+    st, body, _ = http_call("POST", f"http://{fs.url}/orphan/x.bin",
+                            body=data, timeout=60)
+    assert st == 500, (st, body)
+    assert b"chunk upload failed" in body
+    assert sorted(deleted) == sorted(uploaded)
+    st, _, _ = http_call("GET", f"http://{fs.url}/orphan/x.bin")
+    assert st == 404
+
+
+def test_assign_many_mints_sequential_fids(cluster):
+    master, vs, fs = cluster
+    mc = MasterClient(master.url)
+    out = mc.assign_many(5)
+    assert len(out) == 5
+    fids = [a["fid"] for a in out]
+    assert len(set(fids)) == 5
+    vids = {f.split(",")[0] for f in fids}
+    assert len(vids) == 1  # one batch = one volume
+    # every fid is writable
+    for a in out:
+        operation.upload_to(a["fid"], a["url"], b"payload",
+                            auth=a.get("auth", ""))
+    mc.stop()
+
+
+def test_replica_write_failure_invalidates_cache(tmp_path):
+    """One replica answering 5xx fails the client write AND drops the
+    cached peer list, so the next write re-resolves topology instead of
+    pinning the error for the cache TTL."""
+    from tools.netchaos import ChaosProxy
+    import bench
+
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs1 = VolumeServer([str(tmp_path / "v1")], master.url)
+    vs1.start()
+    peer_port = bench._free_port()
+    proxy = ChaosProxy("127.0.0.1", peer_port).start()
+    vs2 = VolumeServer([str(tmp_path / "v2")], master.url,
+                       port=peer_port, advertise=proxy.url)
+    vs2.start()
+    mc = MasterClient(master.url, cache_ttl=0.0)
+    try:
+        a = mc.assign(replication="001")
+        assert not a.get("error"), a
+        vid = int(a["fid"].split(",")[0])
+        vs1_direct = f"{vs1.http.host}:{vs1.http.port}"
+        st, _, _ = http_call("POST", f"http://{vs1_direct}/{a['fid']}",
+                             body=b"ok write")
+        assert st == 201
+        assert vid in vs1._replica_cache  # warmed by the fan-out
+
+        proxy.set_fault(mode="http_error", http_status=500)
+        a2 = mc.assign(replication="001")
+        st, body, _ = http_call("POST", f"http://{vs1_direct}/{a2['fid']}",
+                                body=b"failing write")
+        assert st == 500
+        assert b"replica" in body and proxy.url.encode() in body
+        assert vid not in vs1._replica_cache  # invalidated
+
+        proxy.set_fault(mode="pass")
+        a3 = mc.assign(replication="001")
+        st, _, _ = http_call("POST", f"http://{vs1_direct}/{a3['fid']}",
+                             body=b"recovered write")
+        assert st == 201  # cache refreshed, peer reachable again
+        assert vid in vs1._replica_cache
+    finally:
+        mc.stop()
+        vs2.stop()
+        vs1.stop()
+        proxy.stop()
+        master.stop()
+
+
+def test_group_commit_durable_and_coalesced(tmp_path, monkeypatch):
+    """K threads x M writes each: every needle survives a reopen, and
+    the flush count lands well under K*M (writers ride each other's
+    batches). fsync is slowed to force real overlap on a 1-core box."""
+    import os as _os
+
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    real_fsync = _os.fsync
+    monkeypatch.setattr(
+        _os, "fsync",
+        lambda fd: (time.sleep(0.002), real_fsync(fd))[0])
+    vol = Volume(str(tmp_path), "", 1, fsync=True)
+    K, M = 8, 20
+    errs = []
+
+    def writer(tid):
+        try:
+            for i in range(M):
+                vol.write_needle(Needle(id=tid * 1000 + i + 1, cookie=9,
+                                        data=b"gc" * 64))
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(K)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    assert vol.file_count() == K * M
+    assert vol.flush_count + vol.commit_waits == K * M
+    assert vol.flush_count <= K * M // 2, \
+        f"no coalescing: {vol.flush_count} flushes for {K * M} writes"
+    assert vol.commit_waits > 0
+    vol.close()
+
+    reopened = Volume(str(tmp_path), "", 1)
+    assert reopened.file_count() == K * M
+    for tid in range(K):
+        for i in range(M):
+            n = reopened.read_needle(tid * 1000 + i + 1)
+            assert n.data == b"gc" * 64
+    reopened.close()
+
+
+def test_fetch_chunk_hedged_failover(tmp_path, monkeypatch):
+    """With a replicated chunk, the filer read path must survive one
+    holder dying: the hedged fetch fails over to the live replica and
+    records the outcome in the filer's peer health."""
+    monkeypatch.setattr(fsrv, "CHUNK_SIZE", 64 * 1024)
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs1 = VolumeServer([str(tmp_path / "v1")], master.url)
+    vs1.start()
+    vs2 = VolumeServer([str(tmp_path / "v2")], master.url)
+    vs2.start()
+    fs = FilerServer(master.url, default_replication="001")
+    fs.start()
+    try:
+        rng = np.random.default_rng(8)
+        data = rng.integers(0, 256, 200 * 1024, dtype=np.uint8).tobytes()
+        _put(fs, "/ha/f.bin", data)
+        assert _get(fs, "/ha/f.bin") == data
+        vs2.stop()
+        # drop the warm chunk cache so the read truly re-fetches
+        # (_read_chunk resolves self.reader_cache at call time)
+        from seaweedfs_tpu.utils.chunk_cache import TieredChunkCache
+        from seaweedfs_tpu.filer.reader_cache import ReaderCache
+        fs.reader_cache.close()
+        fs.chunk_cache = TieredChunkCache()
+        fs.reader_cache = ReaderCache(fs._fetch_chunk_remote,
+                                      fs.chunk_cache)
+        assert _get(fs, "/ha/f.bin") == data
+        snap = fs.peer_health.snapshot()
+        assert snap, "hedged fetch recorded no peer outcomes"
+    finally:
+        fs.stop()
+        vs1.stop()
+        master.stop()
+
+
+def test_put_profile_smoke():
+    from tools import put_profile
+
+    out = put_profile.profile(size_mb=1, chunk_kb=128, rtt_ms=0.0)
+    assert out["speedup"] > 0
+    assert set(out["stages_s"]) == {"assign_s", "upload_s",
+                                    "replicate_s", "flush_s"}
+    assert out["stages_s"]["assign_s"] > 0
+    assert out["stages_s"]["upload_s"] > 0
+    assert out["flush_batches"] > 0

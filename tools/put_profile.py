@@ -1,0 +1,187 @@
+"""Per-stage profile of a filer PUT: where does ingest wall time go?
+
+Spins up an in-process master + volume servers + filer, instruments the
+four write-path stages, and PUTs one multi-chunk body through the
+parallel uploader (plus the serial comparator):
+
+  assign     master fid minting (filer -> master RPCs)
+  upload     chunk bytes filer -> volume server (client side, network
+             included)
+  replicate  volume-server replica fan-out (when --replication is set)
+  flush      needle-log group-commit batches (.dat/.idx flush + fsync)
+
+Stage numbers are BUSY seconds summed across threads — with the
+concurrent uploader they legitimately sum past the wall time; that's
+the overlap working (same convention as tools/ec_profile.py).
+
+Usage:
+  PYTHONPATH=. JAX_PLATFORMS=cpu python tools/put_profile.py [size_mb]
+      [--chunk-kb N] [--rtt-ms MS] [--replication XYZ]
+
+--rtt-ms interposes a netchaos latency proxy on every filer->volume and
+replica hop, standing in for a real network. Prints a table plus one
+JSON line for scripts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+
+def profile(size_mb: int = 4, chunk_kb: int = 256, rtt_ms: float = 0.0,
+            replication: str = "") -> dict:
+    import seaweedfs_tpu.client.operation as operation
+    import seaweedfs_tpu.server.filer_server as fsrv
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.utils.httpd import http_call
+    from tools.netchaos import ChaosProxy
+
+    n_servers = 1 + sum(int(c) for c in (replication or "0"))
+    size = size_mb * 1024 * 1024
+    rng = np.random.default_rng(17)
+    data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+
+    stages = {"assign_s": 0.0, "upload_s": 0.0, "replicate_s": 0.0}
+    stage_lock = threading.Lock()
+
+    def timed(name, fn):
+        def wrapped(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                with stage_lock:
+                    stages[name] += time.perf_counter() - t0
+        return wrapped
+
+    saved_chunk = fsrv.CHUNK_SIZE
+    saved_upload = operation.upload_to
+    fsrv.CHUNK_SIZE = chunk_kb * 1024
+    operation.upload_to = timed("upload_s", saved_upload)
+    proxies = []
+    with tempfile.TemporaryDirectory() as d:
+        master = MasterServer(volume_size_limit_mb=256)
+        master.start()
+        servers = []
+        for i in range(n_servers):
+            kwargs = {}
+            if rtt_ms > 0:
+                # netchaos proxy on the advertised address: every hop
+                # to this server (chunk upload, replica leg) pays rtt
+                import bench
+                port = bench._free_port()
+                proxy = ChaosProxy("127.0.0.1", port,
+                                   latency_s=rtt_ms / 1000.0).start()
+                proxies.append(proxy)
+                kwargs = {"port": port, "advertise": proxy.url}
+            vs = VolumeServer([os.path.join(d, f"v{i}")], master.url,
+                              **kwargs)
+            vs.start()
+            vs._replicate = timed("replicate_s", vs._replicate)
+            servers.append(vs)
+        fs = FilerServer(master.url, default_replication=replication)
+        fs.start()
+        fs.mc.assign = timed("assign_s", fs.mc.assign)
+        try:
+            t0 = time.perf_counter()
+            status, body, _ = http_call(
+                "POST", f"http://{fs.url}/prof/parallel.bin", body=data,
+                timeout=600)
+            wall_s = time.perf_counter() - t0
+            if status != 201:
+                raise RuntimeError(f"PUT failed: HTTP {status} {body!r}")
+            status, got, _ = http_call(
+                "GET", f"http://{fs.url}/prof/parallel.bin", timeout=600)
+            if status != 200 or got != data:
+                raise RuntimeError("read-back mismatch")
+
+            fs.parallel_uploads = False
+            t0 = time.perf_counter()
+            status, body, _ = http_call(
+                "POST", f"http://{fs.url}/prof/serial.bin", body=data,
+                timeout=600)
+            serial_s = time.perf_counter() - t0
+            if status != 201:
+                raise RuntimeError(
+                    f"serial PUT failed: HTTP {status} {body!r}")
+
+            flush_s = flush_count = flush_waits = 0.0
+            for vs in servers:
+                for loc in vs.store.locations:
+                    for vol in loc.volumes.values():
+                        flush_s += vol.flush_s
+                        flush_count += vol.flush_count
+                        flush_waits += vol.commit_waits
+        finally:
+            fs.stop()
+            for vs in servers:
+                vs.stop()
+            for proxy in proxies:
+                proxy.stop()
+            master.stop()
+            fsrv.CHUNK_SIZE = saved_chunk
+            operation.upload_to = saved_upload
+
+    return {
+        "size_mb": size_mb,
+        "chunk_kb": chunk_kb,
+        "rtt_ms": rtt_ms,
+        "replication": replication or "000",
+        "upload_workers": fsrv.UPLOAD_WORKERS,
+        "parallel_s": round(wall_s, 3),
+        "serial_s": round(serial_s, 3),
+        "speedup": round(serial_s / wall_s, 2),
+        "put_mbps": round(size / wall_s / 1e6, 1),
+        "stages_s": {
+            "assign_s": round(stages["assign_s"], 3),
+            "upload_s": round(stages["upload_s"], 3),
+            "replicate_s": round(stages["replicate_s"], 3),
+            "flush_s": round(flush_s, 3),
+        },
+        "flush_batches": int(flush_count),
+        "flush_waits": int(flush_waits),
+    }
+
+
+def main(argv: list[str]) -> int:
+    size_mb, chunk_kb, rtt_ms, replication = 4, 256, 10.0, ""
+    it = iter(argv)
+    for a in it:
+        if a == "--chunk-kb":
+            chunk_kb = int(next(it))
+        elif a == "--rtt-ms":
+            rtt_ms = float(next(it))
+        elif a == "--replication":
+            replication = next(it)
+        else:
+            size_mb = int(a)
+    out = profile(size_mb, chunk_kb, rtt_ms, replication)
+
+    st = out["stages_s"]
+    n_chunks = (size_mb * 1024 + chunk_kb - 1) // chunk_kb
+    print(f"body: {size_mb} MB in {n_chunks} x {chunk_kb} KB chunks   "
+          f"rtt: {rtt_ms} ms   replication: {out['replication']}   "
+          f"workers: {out['upload_workers']}")
+    print(f"serial PUT   : {out['serial_s']:8.3f}s")
+    print(f"parallel PUT : {out['parallel_s']:8.3f}s "
+          f"({out['speedup']}x, {out['put_mbps']} MB/s)")
+    print("  stage busy (both PUTs; overlap sums past wall):")
+    for k in ("assign_s", "upload_s", "replicate_s", "flush_s"):
+        print(f"    {k:12s}: {st[k]:8.3f}s")
+    print(f"  flush batches: {out['flush_batches']} "
+          f"(writers that rode one: {out['flush_waits']})")
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
