@@ -306,7 +306,7 @@ def bench_degraded_read(n_reads: int = 30,
         def measure() -> list:
             # fresh health + location state per mode: the comparison
             # must not inherit the other mode's learned rankings
-            # (metrics=None — re-registering gauges is not idempotent)
+            # (metrics=None: a throwaway health table needs no series)
             vs1.peer_health = type(vs1.peer_health)()
             vs1.store.peer_health = vs1.peer_health
             vs1._shard_loc_cache.clear()
@@ -458,8 +458,8 @@ def bench_replicated_write(n_writes: int = 20,
         vs1_direct = f"{vs1.http.host}:{vs1.http.port}"
 
         def measure() -> list:
-            # fresh learned state per mode (metrics=None: re-registering
-            # gauges is not idempotent)
+            # fresh learned state per mode (metrics=None: a throwaway
+            # health table needs no series)
             vs1.peer_health = type(vs1.peer_health)()
             vs1.store.peer_health = vs1.peer_health
             vs1._replica_cache.clear()
@@ -500,6 +500,148 @@ def bench_replicated_write(n_writes: int = 20,
         "replicated_write_slow_ms": slow_ms,
         "replicated_write_replicas": 2,
         "replicated_write_n": n_writes,
+    }
+
+
+def bench_overload(n_reads: int = 12, n_bg: int = 24,
+                   blob_kb: int = 600) -> dict:
+    """Interactive tail latency while background readers overload one
+    volume server — the QoS subsystem's acceptance number.
+
+    The scarce resource is request-processing capacity: EC reads
+    (interval locate + shard reassembly) are CPU-bound Python on this
+    single-core host, so every concurrently admitted request inflates
+    every other request's service time roughly linearly — measured
+    here, a ~1ms solo EC read costs ~11ms with twelve riders. `n_bg`
+    background threads loop EC GETs tagged X-Weed-Class: background
+    while two interactive threads time EC GETs to success; both
+    classes honor Retry-After on shed:
+
+      qos on   limit pinned at 4 -> background holds at most 1 of the
+               class-weighted slots, the rest are shed at the socket
+               edge before buying any CPU; interactive shares the
+               core with ~2 requests;
+      qos off  every background reader is admitted and interactive
+               queues behind ~n_bg concurrent reassemblies.
+
+    overload_goodput_ratio = nqos_p99 / qos_p99 (the floor test wants
+    >= 2x) and background progress under QoS must stay > 0 (throttled,
+    never starved). SEAWEEDFS_TPU_BENCH_OVERLOAD_READS overrides
+    n_reads."""
+    import tempfile
+    import threading
+
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.shell.commands import ShellContext
+    from seaweedfs_tpu.utils.httpd import http_call, retry_after_hint
+
+    n_reads = int(os.environ.get("SEAWEEDFS_TPU_BENCH_OVERLOAD_READS",
+                                 n_reads))
+    n_reads = max(2, n_reads // 2 * 2)  # two interactive threads
+    rng = np.random.default_rng(17)
+    blob = rng.integers(0, 256, blob_kb * 1024, dtype=np.uint8).tobytes()
+    with tempfile.TemporaryDirectory() as d:
+        master = MasterServer(volume_size_limit_mb=64)
+        master.start()
+        vs = VolumeServer([d], master.url)
+        vs.start()
+        mc = MasterClient(master.url, cache_ttl=0.0)
+        a = operation.upload_data(mc, blob)
+        b = operation.upload_data(mc, blob)
+        # EC-encode every touched volume: reads now walk the shard
+        # reassembly path, whose cost is what overload amplifies
+        sh = ShellContext(master.url, use_grpc=False)
+        for vid in sorted({int(a.fid.split(",")[0]),
+                           int(b.fid.split(",")[0])}):
+            sh.ec_encode(vid=vid)
+        bg_url = f"http://{vs.url}/{a.fid}"
+        int_url = f"http://{vs.url}/{b.fid}"
+        # pin the concurrency limit: this bench demonstrates the class
+        # weighting (bg_cap = max(1, 4//4) = 1 slot; interactive keeps
+        # room for two in-flight), not the adaptive gradient — a moving
+        # limit would make the comparison unrepeatable
+        vs.qos.configure(min_limit=4, max_limit=4, limit=4)
+
+        def bg_loop(stop: threading.Event, done: list) -> None:
+            while not stop.is_set():
+                try:
+                    status, _b, hdr = http_call(
+                        "GET", bg_url,
+                        headers={"X-Weed-Class": "background"},
+                        timeout=30)
+                except (ConnectionError, OSError):
+                    stop.wait(0.1)
+                    continue
+                if status == 200:
+                    done.append(1)
+                else:  # shed (503) or in-flight timeout (429)
+                    ra = retry_after_hint(status, hdr)
+                    stop.wait(min(ra if ra is not None else 0.5, 1.0))
+
+        def timed_get() -> float:
+            t0 = time.perf_counter()
+            give_up = t0 + 20.0
+            while True:
+                try:
+                    status, _b, hdr = http_call("GET", int_url,
+                                                timeout=30)
+                except (ConnectionError, OSError):
+                    status, hdr = 503, {}
+                if status == 200 or time.perf_counter() > give_up:
+                    return time.perf_counter() - t0
+                ra = retry_after_hint(status, hdr)
+                time.sleep(min(ra if ra is not None else 0.5, 0.5))
+
+        def run_phase() -> tuple:
+            stop = threading.Event()
+            done: list = []
+            bgs = [threading.Thread(target=bg_loop, args=(stop, done),
+                                    daemon=True) for _ in range(n_bg)]
+            for t in bgs:
+                t.start()
+            time.sleep(1.0)  # let the overload establish before sampling
+            samples: list = []
+            lock = threading.Lock()
+
+            def interactive() -> None:
+                for _ in range(n_reads // 2):
+                    dt = timed_get()
+                    with lock:
+                        samples.append(dt)
+
+            its = [threading.Thread(target=interactive)
+                   for _ in range(2)]
+            for t in its:
+                t.start()
+            for t in its:
+                t.join()
+            stop.set()
+            for t in bgs:
+                t.join(timeout=5)
+            return samples, len(done)
+
+        try:
+            qos_samples, bg_qos = run_phase()
+            vs.qos.enabled = False
+            nqos_samples, bg_nqos = run_phase()
+        finally:
+            mc.stop()
+            vs.stop()
+            master.stop()
+    qos_p99 = _p99_ms(qos_samples)
+    nqos_p99 = _p99_ms(nqos_samples)
+    return {
+        "overload_qos_interactive_p99_ms": qos_p99,
+        "overload_nqos_interactive_p99_ms": nqos_p99,
+        "overload_goodput_ratio": round(nqos_p99 / max(qos_p99, 0.001),
+                                        2),
+        "overload_bg_progress_qos": bg_qos,
+        "overload_bg_progress_nqos": bg_nqos,
+        "overload_bg_readers": n_bg,
+        "overload_n": n_reads,
     }
 
 
@@ -577,6 +719,7 @@ def main(argv=None):
     e2e.update(bench_degraded_read())  # hedged EC read tail latency
     e2e.update(bench_filer_put())  # parallel chunk-upload write path
     e2e.update(bench_replicated_write())  # concurrent replica fan-out
+    e2e.update(bench_overload())  # QoS admission under overload
     tpu, attempts, err = tpu_probe_with_retries()
     if tpu is not None:
         print(json.dumps({

@@ -42,6 +42,9 @@ def _add_common_volume_args(p):
                    help="host:port to register with the master instead of "
                         "ip:port (e.g. a tools/netchaos.py proxy, so peer "
                         "traffic routes through injected faults)")
+    p.add_argument("-fsync", action="store_true",
+                   help="fsync after every write before acking "
+                        "(reference -fsync; default trusts the page cache)")
     p.add_argument("-grpc", action="store_true",
                    help="serve the volume_server_pb gRPC admin plane on "
                         "port+10000")
@@ -97,6 +100,7 @@ def cmd_volume(args):
                       concurrent_upload_limit_mb=args.concurrentUploadLimitMB,
                       concurrent_download_limit_mb=args.concurrentDownloadLimitMB,
                       file_size_limit_mb=args.fileSizeLimitMB,
+                      fsync=args.fsync,
                       advertise=args.advertise)
     vs.start()
     _start_push(args, ("volumeServer", vs))
@@ -127,7 +131,8 @@ def cmd_server(args):
                       grpc_port=args.port + 10000 if args.grpc else None,
                       concurrent_upload_limit_mb=args.concurrentUploadLimitMB,
                       concurrent_download_limit_mb=args.concurrentDownloadLimitMB,
-                      file_size_limit_mb=args.fileSizeLimitMB)
+                      file_size_limit_mb=args.fileSizeLimitMB,
+                      fsync=args.fsync)
     vs.start()
     print(f"master {ms.url}; volume {vs.url}")
     extra = []

@@ -13,6 +13,7 @@ from typing import Optional
 
 from seaweedfs_tpu.client.wdclient import MasterClient
 from seaweedfs_tpu.utils.httpd import HttpError, http_call
+from seaweedfs_tpu.utils.resilience import hedged
 
 
 class UploadResult:
@@ -63,19 +64,38 @@ def upload_to(fid: str, server_url: str, data: bytes, name: str = "",
 
 
 def read_data(mc: MasterClient, fid: str) -> bytes:
-    last: Exception = RuntimeError("no locations")
+    """Read one needle. Replica holders are ranked by the client's
+    learned per-peer health (breakers screen recently-failing servers)
+    and a stalled first pick triggers a hedged backup fetch on the
+    next-ranked replica — the serial walk failed over only after a
+    full timeout, paying the slowest server's tail on every read.
+    delete_file below stays serial: deletes are not safe to race."""
     vid = int(fid.split(",")[0])
-    for loc in mc.lookup_volume(vid):
+    urls = [loc["url"] for loc in mc.lookup_volume(vid)]
+    if not urls:
+        raise RuntimeError("no locations")
+    errors: list[Exception] = []
+
+    def fetch(url: str) -> Optional[bytes]:
         try:
-            status, body, headers = http_call(
-                "GET", f"http://{loc['url']}/{fid}")
+            status, body, _ = http_call("GET", f"http://{url}/{fid}")
         except ConnectionError as e:
-            last = e
-            continue
+            errors.append(e)
+            return None
         if status == 200:
             return body
-        last = HttpError(status, body)
-    raise last
+        errors.append(HttpError(status, body))
+        return None
+
+    health = mc.peer_health
+    out = hedged(fetch, health.rank(urls), health=health)
+    if out is not None:
+        return out
+    # every replica failed: the holder set may have moved — drop the
+    # cached lookup so the next attempt sees fresh locations
+    mc.invalidate(vid)
+    raise errors[-1] if errors else RuntimeError(
+        f"no replica of {fid} answered")
 
 
 def delete_file(mc: MasterClient, fid: str) -> bool:

@@ -38,6 +38,7 @@ class MasterClient:
         # (collection, replication, ttl, disk) -> (expires, [fid dicts])
         self._assign_pools: dict[tuple, tuple[float, list[dict]]] = {}
         self._assign_jwt_mode = False  # JWT replies disable pooling
+        self._peer_health = None  # lazy; see peer_health
         self._lock = threading.Lock()
         # push-mode state
         self._vidmap: dict[int, list[dict]] = {}
@@ -183,6 +184,18 @@ class MasterClient:
                     break
                 time.sleep(self.retry.backoff(attempt))
         raise last_err
+
+    @property
+    def peer_health(self):
+        """Learned per-volume-server breakers/latency, shared across
+        this client's reads (ranks replica holders, feeds hedging).
+        Lazy: most client uses (assign/upload) never dial replicas."""
+        if self._peer_health is None:
+            from seaweedfs_tpu.utils.resilience import PeerHealth
+            with self._lock:
+                if self._peer_health is None:
+                    self._peer_health = PeerHealth()
+        return self._peer_health
 
     def lookup_volume(self, vid: int, collection: str = "") -> list[dict]:
         with self._lock:

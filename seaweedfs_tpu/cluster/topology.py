@@ -41,6 +41,10 @@ class DataNode:
         # mid-scrub-pass right now (rides heartbeats): repair dispatch
         # avoids piling rebuild I/O onto a disk being swept
         self.scrubbing = False
+        # local QoS overload pressure [0,1] (rides heartbeats): the
+        # repair scheduler backs its bandwidth budget off when serving
+        # nodes are shedding interactive load
+        self.qos_pressure = 0.0
 
     @property
     def id(self) -> str:
@@ -251,6 +255,7 @@ class Topology:
                 hb.get("max_volume_count", 8))
             node.last_seen = time.time()
             node.scrubbing = bool(hb.get("scrubbing", False))
+            node.qos_pressure = float(hb.get("qos_pressure", 0.0))
             node.grpc_port = hb.get("grpc_port", 0)
             node.max_volume_count = hb.get("max_volume_count",
                                            node.max_volume_count)
@@ -297,6 +302,8 @@ class Topology:
             node.last_seen = time.time()
             if "scrubbing" in deltas:
                 node.scrubbing = bool(deltas["scrubbing"])
+            if "qos_pressure" in deltas:
+                node.qos_pressure = float(deltas["qos_pressure"])
             new_vids, deleted_vids = set(), set()
             new_ec_vids, deleted_ec_vids = set(), set()
             # deletes BEFORE adds: a disk-tier move reports the same

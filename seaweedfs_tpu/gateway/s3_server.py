@@ -34,6 +34,7 @@ from typing import Optional
 
 from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
 from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.qos import INTERACTIVE, WRITE, QosGovernor
 from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.utils.httpd import HttpServer, Request, Response
 
@@ -98,7 +99,8 @@ def _err(code: str, message: str, status: int) -> Response:
 class S3Server:
     def __init__(self, filer_server, host: str = "127.0.0.1", port: int = 0,
                  access_key: str = "", secret_key: str = "",
-                 circuit_breaker: Optional[CircuitBreaker] = None):
+                 circuit_breaker: Optional[CircuitBreaker] = None,
+                 qos: bool = True):
         # filer_server: in-process FilerServer (gateway composes chunk
         # lists directly; the data path still flows through volume servers)
         self.fs = filer_server
@@ -124,6 +126,16 @@ class S3Server:
         # unauthenticated clients
         self.metrics_http = HttpServer(host, 0)
         self.metrics_http.add("GET", "/metrics", self._handle_metrics)
+        # gateway-edge admission: class-weighted adaptive concurrency
+        # (GET/HEAD = interactive, everything else = write) plus
+        # per-tenant buckets keyed by the request's access key.
+        # qos=False is the bit-for-bit comparator switch.
+        self.qos = QosGovernor(metrics=self.metrics, enabled=qos)
+        # operator surface rides the private metrics listener — every
+        # path on the public port is bucket namespace
+        self.metrics_http.add("GET", "/admin/qos", self._handle_qos)
+        self.metrics_http.add("POST", "/admin/qos",
+                              self._handle_qos_configure)
         self._register_routes()
 
     def start(self) -> None:
@@ -156,6 +168,44 @@ class S3Server:
     def _handle_metrics(self, req: Request) -> Response:
         return Response(self.metrics.expose_text(),
                         content_type="text/plain; version=0.0.4")
+
+    # ---- QoS admission ----
+    def _handle_qos(self, req: Request) -> Response:
+        return Response({"url": self.url, **self.qos.snapshot()})
+
+    def _handle_qos_configure(self, req: Request) -> Response:
+        return Response({"url": self.url,
+                         **self.qos.configure(**(req.json() or {}))})
+
+    @staticmethod
+    def _tenant_of(req: Request) -> str:
+        """Bucket key for per-tenant quotas: the request's access key
+        (unverified — a wrong signature still *bills* that key's bucket
+        and then fails auth), falling back to client IP for anonymous
+        traffic."""
+        auth = req.headers.get("Authorization", "")
+        if auth.startswith("AWS4-HMAC-SHA256 "):
+            m = re.search(r"Credential=([^/,]+)", auth)
+            if m:
+                return m.group(1)
+        cred = req.query.get("X-Amz-Credential", "")
+        if cred:
+            return cred.split("/")[0]
+        if req.handler is not None:
+            return req.handler.client_address[0]
+        return "anonymous"
+
+    def _admit(self, req: Request):
+        """(release_fn, None) when admitted, (None, Response) on shed.
+        Runs BEFORE signature verification: an overloaded gateway
+        sheds without paying the HMAC cost."""
+        cls = INTERACTIVE if req.method in ("GET", "HEAD") else WRITE
+        grant = self.qos.admit(cls, tenant=self._tenant_of(req))
+        if grant.ok:
+            return grant.release, None
+        resp = _err("SlowDown", "reduce your request rate", 503)
+        resp.headers["Retry-After"] = f"{grant.retry_after:.2f}"
+        return None, resp
 
     # ---- auth (SigV4 subset; static key or IAM identities) ----
     def _secret_for(self, access_key: str) -> Optional[str]:
@@ -260,6 +310,15 @@ class S3Server:
         return Response(_xml(root), content_type="application/xml")
 
     def _bucket_dispatch(self, req: Request) -> Response:
+        release, shed = self._admit(req)
+        if shed is not None:
+            return shed
+        try:
+            return self._bucket_dispatch_inner(req)
+        finally:
+            release()
+
+    def _bucket_dispatch_inner(self, req: Request) -> Response:
         bucket = req.match.group(1)
         if req.method == "POST" and "delete" not in req.query:
             ctype = req.headers.get("Content-Type", "")
@@ -534,20 +593,26 @@ class S3Server:
 
     # ---- objects ----
     def _object_dispatch(self, req: Request) -> Response:
-        denied = self._check_auth(req)
-        if denied:
-            return denied
-        bucket, key = req.match.group(1), req.match.group(2)
-        action = "Read" if req.method in ("GET", "HEAD") else "Write"
-        self._m_req.inc(action, bucket)
-        self._refresh_breaker()
-        if not self.breaker.acquire(bucket, action):
-            return _err("TooManyRequests", "circuit breaker open", 503)
+        release, shed = self._admit(req)
+        if shed is not None:
+            return shed
         try:
-            with self._m_lat.time(action):
-                return self._object_dispatch_inner(req, bucket, key)
+            denied = self._check_auth(req)
+            if denied:
+                return denied
+            bucket, key = req.match.group(1), req.match.group(2)
+            action = "Read" if req.method in ("GET", "HEAD") else "Write"
+            self._m_req.inc(action, bucket)
+            self._refresh_breaker()
+            if not self.breaker.acquire(bucket, action):
+                return _err("TooManyRequests", "circuit breaker open", 503)
+            try:
+                with self._m_lat.time(action):
+                    return self._object_dispatch_inner(req, bucket, key)
+            finally:
+                self.breaker.release(bucket, action)
         finally:
-            self.breaker.release(bucket, action)
+            release()
 
     def _object_dispatch_inner(self, req: Request, bucket: str,
                                key: str) -> Response:
@@ -581,7 +646,16 @@ class S3Server:
                     "ETag": f'"{entry.attr.md5.hex()}"',
                     "Last-Modified": _http_date(entry.attr.mtime),
                 })
-            data = self.fs._read_entry_bytes(entry)
+            # edge deadline, same contract as the filer's GET: honor an
+            # inbound X-Weed-Deadline (or mint the default) so chunk
+            # fetches behind a dead volume server give up inside the
+            # caller's budget instead of each burning a full timeout
+            from seaweedfs_tpu.server.filer_server import READ_DEADLINE_S
+            from seaweedfs_tpu.utils.resilience import (Deadline,
+                                                        deadline_scope)
+            with deadline_scope(Deadline.from_headers(
+                    req.headers, default=READ_DEADLINE_S)):
+                data = self.fs._read_entry_bytes(entry)
             from seaweedfs_tpu.utils.httpd import (RangeNotSatisfiable,
                                                    parse_byte_range)
             try:

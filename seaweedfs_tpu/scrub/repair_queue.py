@@ -21,6 +21,7 @@ from __future__ import annotations
 import threading
 import time
 
+from seaweedfs_tpu.qos import BACKGROUND, class_scope
 from seaweedfs_tpu.storage.erasure_coding import layout
 from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.utils.httpd import http_json
@@ -79,7 +80,11 @@ class RepairQueue:
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
         self.scan_grace_s = scan_grace_s
-        self.bandwidth = TokenBucket(repair_rate_mbps * 1024 * 1024)
+        self._base_rate = repair_rate_mbps * 1024 * 1024
+        self.bandwidth = TokenBucket(self._base_rate)
+        # max qos_pressure over live nodes, refreshed each tick(): the
+        # budget backs off up to 80% while serving nodes shed load
+        self.cluster_pressure = 0.0
         self._degraded_since: dict[int, float] = {}
         self._lock = threading.Lock()
         self._tasks: dict[int, RepairTask] = {}
@@ -164,13 +169,34 @@ class RepairQueue:
 
     # ---- scheduling ----
     def tick(self) -> None:
-        """Called from the master's prune loop while leader: scan for
-        degraded volumes, then dispatch whatever is ready."""
+        """Called from the master's prune loop while leader: refresh
+        cluster QoS pressure (throttling the bandwidth budget), scan
+        for degraded volumes, then dispatch whatever is ready."""
+        try:
+            self._apply_pressure()
+        except Exception as e:
+            glog.warning("repair pressure refresh failed: %s", e)
         try:
             self._scan()
         except Exception as e:
             glog.warning("repair scan failed: %s", e)
         self._dispatch()
+
+    def _apply_pressure(self) -> None:
+        """Subscribe the repair budget to cluster QoS pressure: the
+        effective rate is base * (1 - 0.8*max_pressure), floored at 20%
+        of base so repairs always creep forward: a cluster that never
+        heals is worse than one that heals slowly."""
+        if self._base_rate <= 0:
+            return
+        topo = self.master.topo
+        with topo.lock:
+            p = max((n.qos_pressure for n in topo.all_nodes()), default=0.0)
+        p = max(0.0, min(1.0, float(p)))
+        if abs(p - self.cluster_pressure) < 0.01:
+            return
+        self.cluster_pressure = p
+        self.bandwidth.set_rate(self._base_rate * max(0.2, 1.0 - 0.8 * p))
 
     def _scan(self) -> None:
         topo = self.master.topo
@@ -355,9 +381,13 @@ class RepairQueue:
 
     def _node_post(self, url: str, path: str, body: dict,
                    timeout: float = 120) -> dict:
-        resp = http_json("POST", f"http://{url}{path}", body,
-                         timeout=timeout,
-                         deadline=Deadline.after(timeout))
+        # repair traffic declares itself background: the receiving
+        # node's admission gate may shed it while overloaded (the
+        # task's backoff re-dispatches later)
+        with class_scope(BACKGROUND):
+            resp = http_json("POST", f"http://{url}{path}", body,
+                             timeout=timeout,
+                             deadline=Deadline.after(timeout))
         if isinstance(resp, dict) and resp.get("error"):
             raise RuntimeError(f"{url}{path}: {resp['error']}")
         return resp if isinstance(resp, dict) else {}
@@ -384,6 +414,8 @@ class RepairQueue:
                 "active": len(self._in_flight),
                 "queued": len(self._tasks),
                 "repair_rate_bytes_per_sec": self.bandwidth.rate,
+                "base_rate_bytes_per_sec": self._base_rate,
+                "cluster_qos_pressure": round(self.cluster_pressure, 4),
                 "budget_remaining_bytes":
                     (round(self.bandwidth.peek())
                      if self.bandwidth.rate > 0 else None),

@@ -41,13 +41,24 @@ class Scrubber:
                  report_fn: Optional[Callable[[dict], None]] = None,
                  metrics=None, ec_chunk_bytes: int = 1024 * 1024,
                  ec_sample_every: int = 1,
-                 cursor_flush_bytes: int = 8 * 1024 * 1024):
+                 cursor_flush_bytes: int = 8 * 1024 * 1024,
+                 pressure_fn: Optional[Callable[[], float]] = None):
         """ec_sample_every=N checks every Nth row group of an EC volume
         per pass (1 = full coverage); successive passes rotate the
-        sampled groups so N passes cover everything."""
+        sampled groups so N passes cover everything.
+
+        pressure_fn (the QoS governor's pressure(), [0,1]) makes the
+        scrubber yield to foreground load: the effective read rate is
+        base * (1 - 0.9*pressure), floored at 10% of base so a pass
+        always finishes eventually. No effect when unthrottled
+        (rate<=0, the bench mode) or when no fn is wired."""
         self.store = store
         self.interval_s = interval_s
         self.report_fn = report_fn
+        self.pressure_fn = pressure_fn
+        self._base_rate = float(rate_bytes_per_sec)
+        self._pressure = 0.0
+        self._pressure_checked = 0.0
         self.ec_chunk_bytes = ec_chunk_bytes
         self.ec_sample_every = max(1, ec_sample_every)
         self.cursor_flush_bytes = cursor_flush_bytes
@@ -178,6 +189,7 @@ class Scrubber:
                 record_len = t.get_actual_size(hn.size, version)
                 if offset + record_len > size:
                     break
+                self._apply_pressure()
                 if not self.bucket.consume(record_len, self._stop):
                     break
                 blob = os.pread(fd, record_len, offset)
@@ -251,6 +263,7 @@ class Scrubber:
                 continue
             self._set_current(vid, "ec", offset, shard_size)
             read_n = length * (k + len(parity_present))
+            self._apply_pressure()
             if not self.bucket.consume(read_n, self._stop):
                 break
             rows: list = [None] * total
@@ -331,6 +344,25 @@ class Scrubber:
         return []
 
     # ---- bookkeeping ----
+    def _apply_pressure(self) -> None:
+        """Re-derive the effective bucket rate from local QoS pressure,
+        at most twice a second (called on every consume; the lookup
+        must stay off the hot path's critical cost)."""
+        if self.pressure_fn is None or self._base_rate <= 0:
+            return
+        now = time.monotonic()
+        if now - self._pressure_checked < 0.5:
+            return
+        self._pressure_checked = now
+        try:
+            p = max(0.0, min(1.0, float(self.pressure_fn())))
+        except Exception:
+            return
+        if abs(p - self._pressure) < 0.01:
+            return
+        self._pressure = p
+        self.bucket.set_rate(self._base_rate * max(0.1, 1.0 - 0.9 * p))
+
     def _corrupt(self, rep: dict, event: dict) -> None:
         rep["corruptions"].append(event)
         with self._lock:
@@ -362,6 +394,8 @@ class Scrubber:
                 "running": self._thread is not None
                 and self._thread.is_alive(),
                 "rate_bytes_per_sec": self.bucket.rate,
+                "base_rate_bytes_per_sec": self._base_rate,
+                "qos_pressure": round(self._pressure, 4),
                 "interval_s": self.interval_s,
                 "bytes_scrubbed": self.bytes_scrubbed,
                 "corruptions_found": self.corruptions_found,

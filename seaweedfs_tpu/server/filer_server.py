@@ -34,6 +34,8 @@ from seaweedfs_tpu.filer.filechunks import (non_overlapping_visible_intervals,
 from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.filer.filer_conf import FilerConf, PathConf
 from seaweedfs_tpu.filer.filerstore import make_store
+from seaweedfs_tpu.qos import (BACKGROUND, QosGovernor, class_scope,
+                               classify, current_class, from_headers)
 from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call)
@@ -73,7 +75,10 @@ class FilerServer:
                  port: int = 0, store: str = "memory",
                  store_dir: Optional[str] = None,
                  default_replication: str = "", cipher: bool = False,
-                 announce: bool = True, grpc_port: Optional[int] = None):
+                 announce: bool = True, grpc_port: Optional[int] = None,
+                 qos: bool = True):
+        # qos=False disables admission control entirely (the
+        # bit-for-bit comparator, same convention as parallel_uploads)
         # cipher=True encrypts every chunk (AES-256-GCM, per-chunk key in
         # the chunk metadata) so volume servers hold only ciphertext
         # (reference `weed filer -encryptVolumeData`)
@@ -141,7 +146,11 @@ class FilerServer:
         self._upload_pool_lock = threading.Lock()
         # per-volume-server breakers/latency for hedged chunk fetches
         self.peer_health = PeerHealth(metrics=self.metrics)
+        # admission control at the filer edge: class-weighted adaptive
+        # concurrency + per-tenant buckets keyed by client IP
+        self.qos = QosGovernor(metrics=self.metrics, enabled=qos)
         self.http = HttpServer(host, port)
+        self.http.admission_gate = self._admission_gate
         # metrics ride their own listener (reference filer -metricsPort):
         # every path on the main port is user namespace, so a /metrics
         # route there would shadow a stored file of that name
@@ -229,16 +238,22 @@ class FilerServer:
     # ---- chunk GC ----
     def _delete_chunks(self, fids: list[str]) -> None:
         def work():
-            for fid in fids:
-                try:
-                    operation.delete_file(self.mc, fid)
-                except Exception as e:
-                    glog.warning("chunk gc: delete %s failed: %s", fid, e)
+            # GC is background traffic: volume servers may shed it
+            # under load and the next pass will retry
+            with class_scope(BACKGROUND):
+                for fid in fids:
+                    try:
+                        operation.delete_file(self.mc, fid)
+                    except Exception as e:
+                        glog.warning("chunk gc: delete %s failed: %s",
+                                     fid, e)
         threading.Thread(target=work, daemon=True).start()
 
     # ---- routes ----
     def _register_routes(self) -> None:
         r = self.http.add
+        r("GET", "/__api/qos", self._api_qos)
+        r("POST", "/__api/qos", self._api_qos_configure)
         r("POST", "/__api/rename", self._api_rename)
         r("POST", "/__api/entry", self._api_put_entry)
         r("GET", "/__api/entry", self._api_get_entry)
@@ -273,6 +288,36 @@ class FilerServer:
     def _handle_metrics(self, req: Request) -> Response:
         return Response(self.metrics.expose_text(),
                         content_type="text/plain; version=0.0.4")
+
+    # ---- QoS admission ----
+    # exempt: the operator's escape hatch plus long-polls, whose
+    # held-open slots would both exhaust the limit and poison the
+    # adaptive limiter's latency estimate with 30s samples
+    QOS_EXEMPT = ("/__api/qos", "/__api/meta_events")
+
+    def _admission_gate(self, method, path, headers, client):
+        if not self.qos.enabled:
+            return None
+        for prefix in self.QOS_EXEMPT:
+            if path.startswith(prefix):
+                return None
+        cls = from_headers(headers) or classify(method, path)
+        grant = self.qos.admit(cls, tenant=client)
+        if not grant.ok:
+            self._m_req.inc("qos_shed")
+            return Response(
+                {"error": "overloaded", "class": cls,
+                 "reason": grant.reason},
+                status=503,
+                headers={"Retry-After": f"{grant.retry_after:.2f}"})
+        return grant.release
+
+    def _api_qos(self, req: Request) -> Response:
+        return Response({"url": self.url, **self.qos.snapshot()})
+
+    def _api_qos_configure(self, req: Request) -> Response:
+        return Response({"url": self.url,
+                         **self.qos.configure(**(req.json() or {}))})
 
     def _timed(self, kind: str, handler):
         def wrapped(req: Request) -> Response:
@@ -387,8 +432,18 @@ class FilerServer:
             return maybe_manifestize(save_one, chunks)
         pool = self._get_upload_pool()
         chunks: list[Optional[FileChunk]] = [None] * len(offsets)
+        # contextvars don't cross the pool: capture the request's QoS
+        # class here and re-enter it in each worker so the chunk PUTs
+        # carry the same X-Weed-Class as their parent (the deadline
+        # header rides the same pattern via Deadline propagation)
+        upload_cls = current_class()
+
+        def upload_in_class(a, piece, off):
+            with class_scope(upload_cls):
+                return self._upload_one_chunk(a, piece, off)
+
         futures = {
-            pool.submit(self._upload_one_chunk, assigns[i],
+            pool.submit(upload_in_class, assigns[i],
                         data[off:off + CHUNK_SIZE], off): i
             for i, off in enumerate(offsets)}
         first_err: Optional[Exception] = None

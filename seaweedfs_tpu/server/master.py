@@ -22,6 +22,7 @@ from typing import Optional
 
 from seaweedfs_tpu.cluster.sequence import MemorySequencer
 from seaweedfs_tpu.cluster.topology import Topology
+from seaweedfs_tpu.qos import BACKGROUND, WRITE, class_scope
 from seaweedfs_tpu.cluster.volume_growth import (NoFreeSpaceError,
                                                  grow_by_type)
 from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
@@ -138,7 +139,12 @@ class MasterServer:
 
     def _auto_vacuum(self) -> None:
         """Compact garbage-heavy volumes cluster-wide (reference master
-        vacuum loop, topology_vacuum.go)."""
+        vacuum loop, topology_vacuum.go). Vacuum is background traffic:
+        a loaded node may shed it and the next pass retries."""
+        with class_scope(BACKGROUND):
+            self._auto_vacuum_inner()
+
+    def _auto_vacuum_inner(self) -> None:
         for node in self.topo.all_nodes():
             for vid in list(node.volumes):
                 try:
@@ -321,6 +327,7 @@ class MasterServer:
         r("POST", "/vol/grow", self._handle_grow)
         r("GET", "/cluster/status", self._handle_cluster_status)
         r("GET", "/cluster/health", self._handle_cluster_health)
+        r("GET", "/cluster/qos", self._handle_cluster_qos)
         r("GET", "/cluster/raft/ps", self._handle_raft_ps)
         r("POST", "/cluster/raft/add", self._handle_raft_change("add"))
         r("POST", "/cluster/raft/remove",
@@ -574,12 +581,15 @@ class MasterServer:
         from seaweedfs_tpu.storage.super_block import (ReplicaPlacement,
                                                        TTL)
         try:
-            http_json("POST",
-                      f"http://{node.url}/admin/allocate_volume",
-                      {"volume_id": vid, "collection": collection,
-                       "replication": rp, "ttl": ttl,
-                       "disk_type": disk},
-                      deadline=Deadline.after(30.0))
+            # growth rides the assign path: write class, not the
+            # background that classify() would infer from /admin
+            with class_scope(WRITE):
+                http_json("POST",
+                          f"http://{node.url}/admin/allocate_volume",
+                          {"volume_id": vid, "collection": collection,
+                           "replication": rp, "ttl": ttl,
+                           "disk_type": disk},
+                          deadline=Deadline.after(30.0))
             self.peer_health.record(node.url, True)
         except Exception as e:
             if isinstance(e, ConnectionError):
@@ -719,6 +729,7 @@ class MasterServer:
                 "url": n.url,
                 "last_seen_s": round(now - n.last_seen, 1),
                 "scrubbing": bool(getattr(n, "scrubbing", False)),
+                "qos_pressure": round(getattr(n, "qos_pressure", 0.0), 4),
                 "volumes": len(n.volumes),
                 "ec_shards": n.ec_shard_count(),
             } for n in self.topo.all_nodes()]
@@ -736,6 +747,34 @@ class MasterServer:
                     st.get("budget_remaining_bytes"),
                 "active": st.get("active", 0),
                 "queued": st.get("queued", 0),
+            },
+        })
+
+    def _handle_cluster_qos(self, req: Request) -> Response:
+        """Cluster QoS rollup for the cluster.qos shell command:
+        per-node overload pressure (from heartbeats) and how far the
+        repair budget has backed off in response."""
+        now = time.time()
+        with self.topo.lock:
+            nodes = [{
+                "url": n.url,
+                "last_seen_s": round(now - n.last_seen, 1),
+                "qos_pressure": round(getattr(n, "qos_pressure", 0.0), 4),
+            } for n in self.topo.all_nodes()]
+        st = self.repair_queue.status()
+        return Response({
+            "master": self.url,
+            "is_leader": self.is_leader(),
+            "cluster_pressure": max(
+                (n["qos_pressure"] for n in nodes), default=0.0),
+            "nodes": nodes,
+            "repair": {
+                "base_rate_bytes_per_sec":
+                    st.get("base_rate_bytes_per_sec", 0),
+                "rate_bytes_per_sec":
+                    st.get("repair_rate_bytes_per_sec", 0),
+                "cluster_qos_pressure":
+                    st.get("cluster_qos_pressure", 0.0),
             },
         })
 

@@ -22,6 +22,8 @@ import time
 from typing import Optional
 
 from seaweedfs_tpu.models.coder import ErasureCoder
+from seaweedfs_tpu.qos import (WRITE, QosGovernor, class_scope, classify,
+                               current_class, from_headers)
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.erasure_coding import decoder as ecdec
 from seaweedfs_tpu.storage.erasure_coding import encoder as ecenc
@@ -74,7 +76,8 @@ class VolumeServer:
                  advertise: str = "",
                  resilient_reads: bool = True,
                  parallel_replication: bool = True,
-                 fsync: bool = False):
+                 fsync: bool = False,
+                 qos: bool = True):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
         the volume_server_pb gRPC admin plane (0 = ephemeral).
@@ -102,7 +105,11 @@ class VolumeServer:
         (off = the one-at-a-time peer loop, kept as the bench
         comparator). fsync forces a durable fsync per commit batch on
         every volume (reference `weed volume -fsync`; group commit in
-        storage/volume.py amortizes it across concurrent writers)."""
+        storage/volume.py amortizes it across concurrent writers).
+        qos toggles the admission-control governor (adaptive
+        concurrency limit + class-weighted shedding, see
+        seaweedfs_tpu/qos/); off = today's queue-everything behavior,
+        kept as the overload-bench comparator."""
         urls = (master_url.split(",") if isinstance(master_url, str)
                 else list(master_url))
         self.master_urls = [u.strip() for u in urls if u.strip()]
@@ -177,6 +184,11 @@ class VolumeServer:
             ("dir",))
         self.metrics.on_expose(self._refresh_gauges)
         self.peer_health = PeerHealth(metrics=self.metrics)
+        # admission control: class-weighted slots under an adaptive
+        # concurrency limit; shed requests get 503 + Retry-After at the
+        # socket edge, before their body is buffered
+        self.qos = QosGovernor(metrics=self.metrics, enabled=qos)
+        self.http.admission_gate = self._admission_gate
 
     # ---- lifecycle ----
     def start(self) -> None:
@@ -219,7 +231,8 @@ class VolumeServer:
                                  rate_bytes_per_sec=self._scrub_rate,
                                  interval_s=self._scrub_interval,
                                  report_fn=self._report_scrub,
-                                 metrics=self.metrics)
+                                 metrics=self.metrics,
+                                 pressure_fn=self.qos.pressure)
         if self._scrub_interval > 0:
             self.scrubber.start()
         glog.info("volume server up at %s (dirs=%s, master=%s)",
@@ -286,6 +299,9 @@ class VolumeServer:
     def heartbeat_once(self) -> None:
         hb = self.store.collect_heartbeat()
         hb["scrubbing"] = self._is_scrubbing()
+        # local overload pressure rides every heartbeat so the master's
+        # repair scheduler can back off nodes that are shedding load
+        hb["qos_pressure"] = round(self.qos.pressure(), 4)
         if self.grpc_port:
             hb["grpc_port"] = self.grpc_port
         for _attempt in range(2):  # second try after a leader redirect
@@ -344,6 +360,7 @@ class VolumeServer:
             return
         body = {"ip": self.store.ip, "port": self.store.port,
                 "is_delta": True, "scrubbing": self._is_scrubbing(),
+                "qos_pressure": round(self.qos.pressure(), 4),
                 **deltas}
         try:
             self._master_json("POST", "/heartbeat", body,
@@ -375,7 +392,9 @@ class VolumeServer:
                 if has_delta:
                     body = {"ip": self.store.ip, "port": self.store.port,
                             "is_delta": True,
-                            "scrubbing": self._is_scrubbing(), **deltas}
+                            "scrubbing": self._is_scrubbing(),
+                            "qos_pressure": round(self.qos.pressure(), 4),
+                            **deltas}
                     reply = self._master_json(
                         "POST", "/heartbeat", body,
                         deadline=Deadline.after(2 * PULSE_SECONDS))
@@ -446,11 +465,45 @@ class VolumeServer:
         r("GET", "/admin/scrub/status", self._admin_scrub_status)
         # per-peer breaker/health table (cluster.health shell command)
         r("GET", "/admin/health", self._admin_health)
+        # admission-control snapshot + runtime tuning (cluster.qos)
+        r("GET", "/admin/qos", self._admin_qos)
+        r("POST", "/admin/qos", self._admin_qos_configure)
 
     def _admin_health(self, req: Request) -> Response:
         return Response({"url": self.url,
                          "scrubbing": self._is_scrubbing(),
                          "peers": self.peer_health.snapshot()})
+
+    # paths the admission gate never sheds: observability and the tiny
+    # control endpoints an operator needs most exactly when the node is
+    # overloaded (shedding /admin/qos would saw off the escape hatch)
+    QOS_EXEMPT = ("/status", "/metrics", "/ui", "/debug",
+                  "/admin/qos", "/admin/health", "/admin/scrub/status")
+
+    def _admission_gate(self, method: str, path: str, headers, client):
+        """HttpServer admission hook: classify (propagated header wins
+        over the method/path default), ask the governor for a slot,
+        shed with 503 + Retry-After when it says no."""
+        if not self.qos.enabled:
+            return None
+        for p in self.QOS_EXEMPT:
+            if path.startswith(p):
+                return None
+        cls = from_headers(headers) or classify(method, path)
+        grant = self.qos.admit(cls)
+        if not grant.ok:
+            self._m_req.inc("qos_shed")
+            return Response(
+                {"error": "overloaded", "class": cls}, status=503,
+                headers={"Retry-After": f"{grant.retry_after:.2f}"})
+        return grant.release
+
+    def _admin_qos(self, req: Request) -> Response:
+        return Response({"url": self.url, **self.qos.snapshot()})
+
+    def _admin_qos_configure(self, req: Request) -> Response:
+        return Response({"url": self.url,
+                         **self.qos.configure(**(req.json() or {}))})
 
     def _refresh_gauges(self) -> None:
         # runs before every exposition (scrape AND push-gateway loop)
@@ -875,6 +928,9 @@ class VolumeServer:
                       if k != "type")
         sep = "&" if qs else ""
         dl = current_deadline() or Deadline.after(self.REPLICATE_DEADLINE_S)
+        # pool legs don't inherit contextvars: capture the ambient
+        # class (a replica leg of a client PUT stays write class)
+        cls = current_class() or WRITE
 
         def send(url: str) -> Optional[str]:
             if not self.peer_health.allow(url):
@@ -882,13 +938,14 @@ class VolumeServer:
             target = (f"http://{url}{req.path}?{qs}{sep}type=replicate")
             t0 = time.monotonic()
             try:
-                if op == "write":
-                    status, _body, _ = http_call("POST", target,
-                                                 body=req.body,
-                                                 deadline=dl)
-                else:
-                    status, _body, _ = http_call("DELETE", target,
-                                                 deadline=dl)
+                with class_scope(cls):
+                    if op == "write":
+                        status, _body, _ = http_call("POST", target,
+                                                     body=req.body,
+                                                     deadline=dl)
+                    else:
+                        status, _body, _ = http_call("DELETE", target,
+                                                     deadline=dl)
             except ConnectionError as e:
                 self.peer_health.record(url, False)
                 return f"replica {url}: {e}"

@@ -747,6 +747,34 @@ class ShellContext:
                 node["health"] = {"error": type(e).__name__}
         return out
 
+    def cluster_qos(self, configure: Optional[dict] = None,
+                    node: str = "") -> dict:
+        """QoS view of the cluster: the master's per-node pressure
+        rollup + repair-budget backoff, enriched with each volume
+        server's /admin/qos snapshot (limit, per-class inflight/shed,
+        tenant buckets). With `configure`, POSTs those settings to
+        every node's /admin/qos (or just `node`) and reports the
+        post-change snapshots. Unreachable nodes are reported, not
+        fatal — same contract as cluster.health."""
+        out = http_json("GET", f"http://{self.master_url}/cluster/qos")
+        nodes = out.get("nodes", [])
+        if node:
+            nodes = [n for n in nodes if n["url"] == node] \
+                or [{"url": node}]
+            out["nodes"] = nodes
+        for nd in nodes:
+            try:
+                if configure:
+                    nd["qos"] = http_json(
+                        "POST", f"http://{nd['url']}/admin/qos",
+                        configure)
+                else:
+                    nd["qos"] = http_json(
+                        "GET", f"http://{nd['url']}/admin/qos")
+            except Exception as e:
+                nd["qos"] = {"error": type(e).__name__}
+        return out
+
     # ---- ec.balance (reference command_ec_balance.go) ----
     def ec_balance(self, apply: bool = True) -> list[ec_plan.ShardMove]:
         topo = self.topology()

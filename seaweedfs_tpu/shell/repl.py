@@ -57,6 +57,10 @@ HELP = """commands:
   ec.repair.kick                    clear backoffs, dispatch queued repairs
   cluster.health                    per-peer circuit breakers, scrub state,
                                     repair bandwidth budget
+  cluster.qos [-node HOST:PORT] [-limit N] [-minLimit N] [-maxLimit N]
+              [-tenantRate R] [-tenantBurst B] [-enable|-disable]
+                                    per-node admission-control view; with
+                                    flags, reconfigures the governors
   volume.scrub [-node HOST:PORT] [-volumeId N]   synchronous integrity pass
   lock / unlock
   help / exit
@@ -602,6 +606,21 @@ def run_command(sh: ShellContext, line: str):
         return sh.ec_repair_status()
     if cmd == "cluster.health":
         return sh.cluster_health()
+    if cmd == "cluster.qos":
+        conf = {}
+        for flag, key, cast in (("limit", "limit", int),
+                                ("minLimit", "min_limit", int),
+                                ("maxLimit", "max_limit", int),
+                                ("tenantRate", "tenant_rate", float),
+                                ("tenantBurst", "tenant_burst", float)):
+            if flag in flags:
+                conf[key] = cast(flags[flag])
+        if "enable" in flags:
+            conf["enabled"] = True
+        if "disable" in flags:
+            conf["enabled"] = False
+        return sh.cluster_qos(configure=conf or None,
+                              node=flags.get("node", ""))
     if cmd == "ec.repair.kick":
         return sh.ec_repair_kick()
     if cmd == "volume.scrub":

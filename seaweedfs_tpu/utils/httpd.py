@@ -19,6 +19,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
 
+from seaweedfs_tpu.qos import classes as qos_classes
 from seaweedfs_tpu.utils import glog, resilience
 
 
@@ -142,6 +143,13 @@ class HttpServer:
         # accounting), or None to proceed unthrottled (reference
         # weed/server/volume_server_handlers.go inFlight*DataLimitCond).
         self.body_gate = None
+        # admission_gate(method, path, headers, client_ip) runs first,
+        # for EVERY method: the QoS governor's hook. Same verdict
+        # contract as body_gate — a Response sheds the request (503 +
+        # Retry-After) before its body is buffered, a callable releases
+        # the admission slot once the response is fully sent, None
+        # passes. See seaweedfs_tpu/qos/governor.py.
+        self.admission_gate = None
 
     def route(self, method: str, pattern: str):
         compiled = re.compile("^" + pattern + "$")
@@ -224,46 +232,62 @@ class HttpServer:
                         return False
                 return True
 
+            def _reject(self, verdict, length):
+                # reject WITHOUT buffering the body: drain it in
+                # discarded 64KB chunks (bounded memory) so the
+                # client finishes sending and can actually read
+                # the 413/429/503; truly huge payloads are cut off
+                # after a few MB like Go's http server does
+                remaining = min(length, 8 << 20)
+                try:
+                    while remaining > 0:
+                        got = self.rfile.read(min(remaining, 65536))
+                        if not got:
+                            break
+                        remaining -= len(got)
+                except OSError:
+                    pass
+                verdict.headers.setdefault("Connection", "close")
+                self.close_connection = True
+                self._send(verdict)
+
             def _dispatch(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 path = urllib.parse.unquote(
                     urllib.parse.urlparse(self.path).path)
-                on_sent = None
-                gate = server.body_gate
-                if gate is not None and length and \
-                        self.command in ("POST", "PUT"):
-                    verdict = gate(path, length)
+                release = None
+                agate = server.admission_gate
+                if agate is not None:
+                    verdict = agate(self.command, path, self.headers,
+                                    self.client_address[0])
                     if isinstance(verdict, Response):
-                        # reject WITHOUT buffering the body: drain it in
-                        # discarded 64KB chunks (bounded memory) so the
-                        # client finishes sending and can actually read
-                        # the 413/429; truly huge payloads are cut off
-                        # after a few MB like Go's http server does
-                        remaining = min(length, 8 << 20)
-                        try:
-                            while remaining > 0:
-                                got = self.rfile.read(min(remaining, 65536))
-                                if not got:
-                                    break
-                                remaining -= len(got)
-                        except OSError:
-                            pass
-                        verdict.headers.setdefault("Connection", "close")
-                        self.close_connection = True
-                        self._send(verdict)
+                        self._reject(verdict, length)
                         return
-                    on_sent = verdict
+                    release = verdict
+                on_sent = None
                 resp = None
                 t0 = time.perf_counter()
                 try:
+                    gate = server.body_gate
+                    if gate is not None and length and \
+                            self.command in ("POST", "PUT"):
+                        verdict = gate(path, length)
+                        if isinstance(verdict, Response):
+                            self._reject(verdict, length)
+                            return
+                        on_sent = verdict
                     body = self.rfile.read(length) if length else b""
+                    # propagated traffic class becomes ambient for the
+                    # handler, so its nested http_calls re-inject it
+                    cls = qos_classes.from_headers(self.headers)
                     for method, pattern, fn in routes:
                         if method != self.command:
                             continue
                         m = pattern.match(path)
                         if m:
                             try:
-                                resp = fn(Request(self, m, body))
+                                with qos_classes.class_scope(cls):
+                                    resp = fn(Request(self, m, body))
                             except Exception as e:  # surface as 500 JSON
                                 glog.exception(
                                     "handler error: %s %s -> %s",
@@ -286,6 +310,8 @@ class HttpServer:
                     cb = getattr(resp, "on_sent", None)
                     if cb is not None:
                         cb()
+                    if release is not None:
+                        release()
 
             def _send(self, resp):
                 try:
@@ -406,10 +432,30 @@ def parse_byte_range(spec: str, total: int) -> Optional[tuple[int, int]]:
 
 
 class HttpError(Exception):
-    def __init__(self, status: int, body: bytes):
+    def __init__(self, status: int, body: bytes,
+                 retry_after: Optional[float] = None):
         self.status = status
         self.body = body
+        # server-sent pacing hint (429/503): RetryPolicy sleeps this
+        # instead of its own computed backoff
+        self.retry_after = retry_after
         super().__init__(f"HTTP {status}: {body[:200]!r}")
+
+
+def retry_after_hint(status: int, resp_headers) -> Optional[float]:
+    """Seconds from a Retry-After header on a shed response (429/503
+    only — the statuses the limiters emit); None otherwise. Only the
+    delta-seconds form is parsed (what this codebase sends); an
+    HTTP-date or garbage value degrades to None, not an error."""
+    if status not in (429, 503) or not resp_headers:
+        return None
+    for k, v in resp_headers.items():
+        if k.lower() == "retry-after":
+            try:
+                return max(0.0, float(v))
+            except (TypeError, ValueError):
+                return None
+    return None
 
 
 # Thread-local keep-alive connection pool: one persistent HTTP/1.1
@@ -602,6 +648,12 @@ def http_call(method: str, url: str, body: Optional[bytes] = None,
         headers = dict(headers or {})
         headers.setdefault(resilience.DEADLINE_HEADER,
                            deadline.header_value())
+    # traffic class rides along exactly like the deadline: ambient
+    # scope -> X-Weed-Class header -> callee re-enters the scope
+    cls = qos_classes.current_class()
+    if cls is not None:
+        headers = dict(headers or {})
+        headers.setdefault(qos_classes.CLASS_HEADER, cls)
     if json_body is not None:
         body = json.dumps(json_body).encode()
         headers = dict(headers or {})
@@ -659,8 +711,10 @@ def http_call(method: str, url: str, body: Optional[bytes] = None,
 
 def http_json(method: str, url: str, json_body: Any = None,
               timeout: float = 30.0, deadline=None) -> Any:
-    status, body, _ = http_call(method, url, json_body=json_body,
-                                timeout=timeout, deadline=deadline)
+    status, body, resp_headers = http_call(method, url, json_body=json_body,
+                                           timeout=timeout,
+                                           deadline=deadline)
     if status >= 400:
-        raise HttpError(status, body)
+        raise HttpError(status, body,
+                        retry_after=retry_after_hint(status, resp_headers))
     return json.loads(body) if body else None

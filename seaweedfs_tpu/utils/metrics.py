@@ -146,7 +146,21 @@ class Registry:
             f"{self.namespace}_{subsystem}_{name}", help_, labels))
 
     def _add(self, m):
+        # Idempotent by metric name: a component rebuilt mid-process (a
+        # comparator bench swapping in a fresh PeerHealth, a reloaded
+        # subsystem) gets the already-registered collector back instead
+        # of appending a duplicate series to every exposition. A name
+        # collision with a different type or label set is a programming
+        # error and fails loudly.
         with self._lock:
+            for existing in self._metrics:
+                if existing.name == m.name:
+                    if (type(existing) is not type(m)
+                            or existing.label_names != m.label_names):
+                        raise ValueError(
+                            f"metric {m.name} re-registered with a "
+                            f"different type or label set")
+                    return existing
             self._metrics.append(m)
         return m
 

@@ -196,8 +196,16 @@ class RetryPolicy:
                         or not self.allow_retry(dest):
                     raise
                 delay = self.backoff(attempt)
+                # a shed response (429/503 from a limiter) carries the
+                # server's own pacing hint — obey it instead of our
+                # jitter, so retries land after the load has drained
+                ra = getattr(e, "retry_after", None)
+                if ra is not None:
+                    delay = max(0.0, float(ra))
                 if deadline is not None \
                         and delay >= deadline.remaining():
+                    # never sleep into (or retry inside) a budget that
+                    # cannot fit the server-requested wait
                     raise
                 time.sleep(delay)
         raise last  # pragma: no cover - loop always returns/raises

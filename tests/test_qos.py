@@ -1,0 +1,481 @@
+"""QoS & admission control: adaptive limiter, class-weighted slots,
+tenant buckets, class propagation, Retry-After honoring, backpressure
+subscribers (scrubber + repair queue), and the volume-server edge
+end to end."""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.qos import (BACKGROUND, INTERACTIVE, WRITE, QosGovernor,
+                               class_scope, classify, current_class,
+                               from_headers)
+from seaweedfs_tpu.qos.governor import _PASS, TenantBuckets
+from seaweedfs_tpu.qos.limiter import AdaptiveLimiter
+
+
+# ---------------- AdaptiveLimiter ----------------
+
+def test_limiter_shrinks_under_queueing():
+    lim = AdaptiveLimiter(initial=64, min_limit=8, max_limit=256)
+    for _ in range(64):  # establish a 10ms baseline
+        lim.observe(0.010)
+    before = lim.limit
+    for _ in range(64):  # latency spikes 20x over baseline: queueing
+        lim.observe(0.200)
+    assert lim.limit < before
+    assert lim.queue_delay() > 0.0
+
+
+def test_limiter_grows_with_headroom():
+    lim = AdaptiveLimiter(initial=16, min_limit=8, max_limit=256)
+    for _ in range(400):  # flat latency = headroom: additive probe up
+        lim.observe(0.010)
+    assert lim.limit > 16
+    assert lim.limit <= 256
+
+
+def test_limiter_clamps():
+    lim = AdaptiveLimiter(initial=9999, min_limit=8, max_limit=64)
+    assert lim.limit == 64  # ctor clamp
+    lim.set_limit(1)
+    assert lim.limit == 8
+    lim.set_limit(10_000)
+    assert lim.limit == 64
+    # sustained queueing can shrink to min_limit but never below
+    for _ in range(64):
+        lim.observe(0.010)
+    for _ in range(2000):
+        lim.observe(1.0)
+    assert lim.limit >= 8
+
+
+# ---------------- governor admission ----------------
+
+def _pinned(limit=8, **kw):
+    g = QosGovernor(enabled=True, **kw)
+    g.configure(min_limit=limit, max_limit=limit, limit=limit)
+    return g
+
+
+def test_background_capped_at_quarter():
+    g = _pinned(8)  # bg_cap = 2
+    grants = [g.admit(BACKGROUND) for _ in range(4)]
+    assert [x.ok for x in grants] == [True, True, False, False]
+    shed = grants[2]
+    assert shed.reason == "limit"
+    assert 0.2 <= shed.retry_after <= 5.0
+
+
+def test_interactive_headroom_no_inversion():
+    """Background + writes at their caps must leave interactive room."""
+    g = _pinned(8)  # bg_cap=2, lower_cap=6
+    bg = [g.admit(BACKGROUND) for _ in range(2)]
+    assert all(x.ok for x in bg)
+    writes = []
+    while True:
+        w = g.admit(WRITE)
+        if not w.ok:
+            break
+        writes.append(w)
+    assert len(writes) == 4  # (w+b) < lower_cap: writes stop at w=4
+    first = g.admit(INTERACTIVE)
+    assert first.ok  # the top quarter is not reachable by lower classes
+
+
+def test_background_never_starved():
+    """Writes can fill neither the lower pool nor the global limit."""
+    g = _pinned(8)
+    writes = [g.admit(WRITE) for _ in range(8)]
+    assert sum(1 for w in writes if w.ok) == 5  # w < lower_cap - 1
+    assert g.admit(BACKGROUND).ok  # the reserved slot is reachable
+    g2 = _pinned(8)
+    ints = [g2.admit(INTERACTIVE) for _ in range(8)]
+    assert sum(1 for x in ints if x.ok) == 7  # (i+w) < limit - 1
+    assert g2.admit(BACKGROUND).ok
+
+
+def test_unknown_class_coerced_to_background():
+    g = _pinned(8)
+    assert g.admit("rooot").ok
+    assert g.snapshot()["classes"][BACKGROUND]["admitted"] == 1
+
+
+def test_release_idempotent():
+    g = _pinned(8)
+    grant = g.admit(INTERACTIVE)
+    grant.release()
+    grant.release()
+    snap = g.snapshot()
+    assert snap["classes"][INTERACTIVE]["inflight"] == 0
+    assert snap["classes"][INTERACTIVE]["latency_ewma_ms"] >= 0.0
+
+
+def test_disabled_is_shared_noop_grant():
+    g = QosGovernor(enabled=False)
+    grants = [g.admit(INTERACTIVE), g.admit(BACKGROUND), g.admit("x")]
+    assert all(x is _PASS for x in grants)  # zero-allocation passthrough
+    for x in grants:
+        x.release()
+    snap = g.snapshot()
+    assert all(c["admitted"] == 0 for c in snap["classes"].values())
+    assert g.pressure() == 0.0
+
+
+def test_pressure_signal():
+    g = _pinned(8)
+    assert g.pressure() == 0.0
+    held = [g.admit(INTERACTIVE) for _ in range(7)]
+    assert g.pressure() > 0.5  # utilization term
+    for h in held:
+        h.release()
+    bg = [g.admit(BACKGROUND) for _ in range(3)]  # bg_cap=2: third sheds
+    assert not bg[2].ok
+    assert g.pressure() > 0.4  # recent-shed trace outlives the release
+    g.enabled = False
+    assert g.pressure() == 0.0
+
+
+def test_tenant_isolation():
+    g = QosGovernor(enabled=True, tenant_rate=1.0, tenant_burst=2.0)
+    a = [g.admit(INTERACTIVE, tenant="alice") for _ in range(4)]
+    oks = [x.ok for x in a]
+    assert oks[:2] == [True, True] and not all(oks)
+    shed = next(x for x in a if not x.ok)
+    assert shed.reason == "tenant" and shed.retry_after >= 0.05
+    # a noisy neighbor must not spend bob's tokens
+    assert g.admit(INTERACTIVE, tenant="bob").ok
+    assert g.snapshot()["shed_tenant"] >= 1
+
+
+def test_tenant_buckets_refill_and_unlimited():
+    tb = TenantBuckets(rate=100.0, burst=1.0)
+    ok, _ = tb.try_consume("k")
+    assert ok
+    ok, ra = tb.try_consume("k")
+    assert not ok and ra > 0
+    time.sleep(0.02)  # 100/s refills one token in 10ms
+    ok, _ = tb.try_consume("k")
+    assert ok
+    free = TenantBuckets(rate=0.0)
+    assert all(free.try_consume("k")[0] for _ in range(100))
+
+
+def test_configure_reclamps_and_snapshot_shape():
+    g = QosGovernor(enabled=True, initial_limit=32)
+    snap = g.configure(min_limit=4, max_limit=16)
+    assert snap["limit"] == 16  # old limit re-clamped into new bounds
+    snap = g.configure(limit=2)
+    assert snap["limit"] == 4
+    assert set(snap["classes"]) == {INTERACTIVE, WRITE, BACKGROUND}
+    assert "queue_delay_ms" in snap and "tenant_buckets" in snap
+
+
+# ---------------- classes & propagation ----------------
+
+def test_classify_defaults():
+    assert classify("GET", "/3,0123cafe") == INTERACTIVE
+    assert classify("HEAD", "/dir/file") == INTERACTIVE
+    assert classify("POST", "/3,0123cafe") == WRITE
+    assert classify("DELETE", "/x") == WRITE
+    assert classify("POST", "/admin/ec/copy") == BACKGROUND
+    assert classify("GET", "/admin/scrub/status") == BACKGROUND
+
+
+def test_from_headers_tolerates_garbage():
+    assert from_headers({"X-Weed-Class": " Background \n"}) == BACKGROUND
+    assert from_headers({"X-Weed-Class": "root"}) is None
+    assert from_headers({"X-Weed-Class": "root"}, WRITE) == WRITE
+    assert from_headers({}) is None
+    assert from_headers(None) is None
+
+
+def test_class_scope_nesting_and_none():
+    assert current_class() is None
+    with class_scope(WRITE):
+        assert current_class() == WRITE
+        with class_scope(BACKGROUND):
+            assert current_class() == BACKGROUND
+        with class_scope(None):  # None = keep ambient
+            assert current_class() == WRITE
+    assert current_class() is None
+
+
+def test_class_scope_does_not_cross_threads():
+    seen = []
+    with class_scope(BACKGROUND):
+        t = threading.Thread(target=lambda: seen.append(current_class()))
+        t.start()
+        t.join()
+    assert seen == [None]  # fan-out sites must re-enter explicitly
+
+
+# ---------------- Retry-After plumbing ----------------
+
+def test_retry_after_hint():
+    from seaweedfs_tpu.utils.httpd import retry_after_hint
+    assert retry_after_hint(503, {"Retry-After": "1.5"}) == 1.5
+    assert retry_after_hint(429, {"retry-after": "2"}) == 2.0
+    assert retry_after_hint(503, {"Retry-After": "soon"}) is None
+    assert retry_after_hint(200, {"Retry-After": "1"}) is None
+    assert retry_after_hint(503, {}) is None
+
+
+def test_retry_policy_honors_server_retry_after():
+    """A server-sent Retry-After overrides the computed backoff."""
+    from seaweedfs_tpu.utils.httpd import HttpError
+    from seaweedfs_tpu.utils.resilience import RetryPolicy
+
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 2:
+            e = HttpError(503, b"overloaded")
+            e.retry_after = 0.02
+            raise e
+        return "ok"
+
+    pol = RetryPolicy(attempts=3, base=30.0, cap=30.0)  # huge backoff
+    t0 = time.perf_counter()
+    out = pol.call(fn, dest="x", retry_on=(HttpError,))
+    assert out == "ok" and len(calls) == 2
+    assert time.perf_counter() - t0 < 1.0  # slept ~0.02s, not ~30s
+
+
+def test_retry_policy_never_sleeps_past_deadline():
+    from seaweedfs_tpu.utils.httpd import HttpError
+    from seaweedfs_tpu.utils.resilience import Deadline, RetryPolicy
+
+    def fn():
+        e = HttpError(503, b"overloaded")
+        e.retry_after = 10.0  # server asks for more than we have
+        raise e
+
+    pol = RetryPolicy(attempts=5, base=0.01)
+    t0 = time.perf_counter()
+    with pytest.raises(HttpError):
+        pol.call(fn, dest="x", deadline=Deadline.after(0.2),
+                 retry_on=(HttpError,))
+    assert time.perf_counter() - t0 < 1.0  # raised, did not stall
+
+
+# ---------------- backpressure subscribers ----------------
+
+def test_scrubber_self_throttles_under_pressure(tmp_path):
+    from seaweedfs_tpu.scrub.scrubber import Scrubber
+    from seaweedfs_tpu.storage.store import Store
+
+    pressure = [0.0]
+    store = Store([str(tmp_path)])
+    try:
+        sc = Scrubber(store, rate_bytes_per_sec=1_000_000,
+                      interval_s=0, pressure_fn=lambda: pressure[0])
+        sc._pressure_checked = 0.0
+        sc._apply_pressure()
+        assert sc.bucket.rate == 1_000_000
+        pressure[0] = 1.0
+        sc._pressure_checked = 0.0
+        sc._apply_pressure()
+        assert sc.bucket.rate == pytest.approx(100_000)  # 10% floor
+        pressure[0] = 0.5
+        sc._pressure_checked = 0.0
+        sc._apply_pressure()
+        assert sc.bucket.rate == pytest.approx(550_000)
+        pressure[0] = 0.0
+        sc._pressure_checked = 0.0
+        sc._apply_pressure()
+        assert sc.bucket.rate == 1_000_000  # recovers fully
+    finally:
+        store.close()
+
+
+def test_repair_queue_throttles_on_cluster_pressure():
+    from seaweedfs_tpu.scrub.repair_queue import RepairQueue
+    from seaweedfs_tpu.utils.metrics import Registry
+
+    class _Node:
+        qos_pressure = 0.0
+
+    class _Topo:
+        lock = threading.Lock()
+        nodes = [_Node()]
+
+        def all_nodes(self):
+            return self.nodes
+
+    class _Master:
+        metrics = Registry()
+        topo = _Topo()
+
+    m = _Master()
+    rq = RepairQueue(m, repair_rate_mbps=10.0)
+    base = 10.0 * 1024 * 1024
+    rq._apply_pressure()
+    assert rq.bandwidth.rate == base
+    m.topo.nodes[0].qos_pressure = 1.0
+    rq._apply_pressure()
+    assert rq.bandwidth.rate == pytest.approx(base * 0.2)  # 20% floor
+    assert rq.cluster_pressure == 1.0
+    m.topo.nodes[0].qos_pressure = 0.5
+    rq._apply_pressure()
+    assert rq.bandwidth.rate == pytest.approx(base * 0.6)
+    m.topo.nodes[0].qos_pressure = 0.0
+    rq._apply_pressure()
+    assert rq.bandwidth.rate == base
+    # status surfaces the subscription
+    st_keys = rq.status()
+    assert st_keys["base_rate_bytes_per_sec"] == base
+    assert st_keys["cluster_qos_pressure"] == 0.0
+
+
+# ---------------- volume-server edge, end to end ----------------
+
+@pytest.fixture
+def vs_cluster(tmp_path):
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    mc = MasterClient(master.url, cache_ttl=0.0)
+    yield master, vs, mc
+    mc.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_volume_server_sheds_with_retry_after(vs_cluster):
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.utils.httpd import http_call
+
+    _master, vs, mc = vs_cluster
+    res = operation.upload_data(mc, b"x" * 1024)
+    url = f"http://{vs.url}/{res.fid}"
+    vs.qos.configure(min_limit=8, max_limit=8, limit=8)
+    # saturate interactive+write admission from the inside
+    held = [vs.qos.admit(INTERACTIVE) for _ in range(7)]
+    assert all(h.ok for h in held)
+    status, body, hdrs = http_call("GET", url)
+    assert status == 503
+    ra = {k.lower(): v for k, v in hdrs.items()}.get("retry-after")
+    assert ra is not None and float(ra) >= 0.2
+    # the reserved background slot still admits (header rides the wire)
+    status, _, _ = http_call("GET", url,
+                             headers={"X-Weed-Class": "background"})
+    assert status == 200
+    # observability stays reachable while saturated
+    status, _, _ = http_call("GET", f"http://{vs.url}/status")
+    assert status == 200
+    for h in held:
+        h.release()
+    status, body, _ = http_call("GET", url)
+    assert status == 200 and body == b"x" * 1024
+
+
+def test_volume_server_admin_qos_roundtrip(vs_cluster):
+    from seaweedfs_tpu.utils.httpd import http_json
+
+    _master, vs, _mc = vs_cluster
+    snap = http_json("GET", f"http://{vs.url}/admin/qos")
+    assert snap["enabled"] is True and snap["limit"] >= 8
+    out = http_json("POST", f"http://{vs.url}/admin/qos",
+                    {"min_limit": 4, "max_limit": 16, "limit": 12,
+                     "tenant_rate": 50.0})
+    assert out["limit"] == 12 and out["min_limit"] == 4
+    assert out["tenant_buckets"]["rate"] == 50.0
+    out = http_json("POST", f"http://{vs.url}/admin/qos",
+                    {"enabled": False})
+    assert out["enabled"] is False
+    assert vs.qos.admit(INTERACTIVE) is _PASS
+
+
+def test_qos_disabled_preserves_serving(tmp_path):
+    """qos=False is the comparator: no gate, no counters, no shed."""
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.utils.httpd import http_call
+
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url, qos=False)
+    vs.start()
+    mc = MasterClient(master.url, cache_ttl=0.0)
+    try:
+        res = operation.upload_data(mc, b"y" * 64)
+        status, body, _ = http_call("GET", f"http://{vs.url}/{res.fid}")
+        assert status == 200 and body == b"y" * 64
+        snap = vs.qos.snapshot()
+        assert snap["enabled"] is False
+        assert all(c["admitted"] == 0 for c in snap["classes"].values())
+    finally:
+        mc.stop()
+        vs.stop()
+        master.stop()
+
+
+def test_incoming_class_header_reaches_governor(vs_cluster):
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.utils.httpd import http_call
+
+    _master, vs, mc = vs_cluster
+    res = operation.upload_data(mc, b"z" * 128)
+    before = vs.qos.snapshot()["classes"][BACKGROUND]["admitted"]
+    status, _, _ = http_call("GET", f"http://{vs.url}/{res.fid}",
+                             headers={"X-Weed-Class": "background"})
+    assert status == 200
+    after = vs.qos.snapshot()["classes"][BACKGROUND]["admitted"]
+    assert after == before + 1  # GET billed as background, not interactive
+
+
+def test_ambient_class_scope_injected_by_http_call(vs_cluster):
+    """class_scope -> http_call header -> server governor, no explicit
+    header anywhere: the propagation contract the repair/scrub paths
+    rely on."""
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.utils.httpd import http_call
+
+    _master, vs, mc = vs_cluster
+    res = operation.upload_data(mc, b"w" * 128)
+    before = vs.qos.snapshot()["classes"][BACKGROUND]["admitted"]
+    with class_scope(BACKGROUND):
+        status, _, _ = http_call("GET", f"http://{vs.url}/{res.fid}")
+    assert status == 200
+    after = vs.qos.snapshot()["classes"][BACKGROUND]["admitted"]
+    assert after == before + 1
+
+
+def test_metrics_expose_qos_series(vs_cluster):
+    from seaweedfs_tpu.utils.httpd import http_call
+
+    _master, vs, mc = vs_cluster
+    from seaweedfs_tpu.client import operation
+    res = operation.upload_data(mc, b"m" * 64)
+    http_call("GET", f"http://{vs.url}/{res.fid}")
+    status, body, _ = http_call("GET", f"http://{vs.url}/metrics")
+    text = body.decode()
+    assert status == 200
+    assert "qos_limit" in text
+    assert "qos_pressure" in text
+    assert 'qos_inflight{cls="interactive"}' in text
+
+
+def test_metrics_registry_idempotent_registration():
+    from seaweedfs_tpu.utils.metrics import Registry
+
+    r = Registry()
+    c1 = r.counter("t", "hits", "h", ("k",))
+    c2 = r.counter("t", "hits", "h", ("k",))
+    assert c1 is c2
+    c1.inc("a")
+    assert r.expose_text().count("SeaweedFS_TPU_t_hits{") == 1
+    with pytest.raises(ValueError):
+        r.gauge("t", "hits", "h", ("k",))  # same name, different type
+    with pytest.raises(ValueError):
+        r.counter("t", "hits", "h", ("other",))  # different labels

@@ -37,9 +37,45 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from seaweedfs_tpu.utils.limiter import TokenBucket  # noqa: E402
-
 CHUNK = 16384
+
+
+class _PacedLink:
+    """Shared-bandwidth cap as a FIFO transmit queue (virtual clock).
+
+    A real bottleneck link serializes frames in arrival order: each
+    chunk occupies the wire for len/rate seconds and everything behind
+    it waits exactly that long. An earlier version used a polling token
+    bucket here, which under contention turns into a lottery — a small
+    response could stall ~1s behind a dozen re-polling bulk streams, so
+    latency measured through the proxy reflected poll timing, not the
+    configured rate. Here every chunk reserves its slot on a shared
+    virtual clock under one lock (lock handoff is close enough to FIFO)
+    and then sleeps out its own transmit time. rate <= 0 = unlimited."""
+
+    def __init__(self, rate_bps: float):
+        self.rate = float(rate_bps)
+        self._lock = threading.Lock()
+        self._free_at = time.monotonic()
+
+    def set_rate(self, rate_bps: float) -> None:
+        with self._lock:
+            self.rate = float(rate_bps)
+            self._free_at = time.monotonic()
+
+    def send(self, n: int, stop: threading.Event) -> bool:
+        """Reserve wire time for n bytes, then wait until our slot has
+        elapsed. Returns False only if `stop` was set while waiting."""
+        with self._lock:
+            if self.rate <= 0 or n <= 0:
+                return True
+            now = time.monotonic()
+            start = max(now, self._free_at)
+            self._free_at = start + n / self.rate
+            wait = self._free_at - now
+        if wait <= 0:
+            return True
+        return not stop.wait(wait)
 
 
 class ChaosProxy:
@@ -62,8 +98,7 @@ class ChaosProxy:
         self.latency_s = float(latency_s)
         self.jitter_s = float(jitter_s)
         self.http_status = int(http_status)
-        self._bucket = TokenBucket(float(bandwidth_bps),
-                                   initial=float(bandwidth_bps))
+        self._link = _PacedLink(float(bandwidth_bps))
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -125,7 +160,7 @@ class ChaosProxy:
                 self.http_status = int(http_status)
             conns = list(self._conns)
         if bandwidth_bps is not None:
-            self._bucket.set_rate(float(bandwidth_bps))
+            self._link.set_rate(float(bandwidth_bps))
         for c in conns:
             try:
                 c.close()
@@ -216,7 +251,7 @@ class ChaosProxy:
                     time.sleep(self.latency_s
                                + self._rng.uniform(0.0, self.jitter_s))
                 if not request_dir:
-                    self._bucket.consume(len(data), self._stop)
+                    self._link.send(len(data), self._stop)
                 dst.sendall(data)
                 self.stats[counter] += len(data)
         except OSError:

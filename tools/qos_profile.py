@@ -1,0 +1,145 @@
+"""Live QoS profile: per-class admission/shed/latency breakdown.
+
+Polls the QoS snapshot endpoints (`/admin/qos` on volume servers and
+the S3 gateway's metrics port, `/__api/qos` on filers — both are tried)
+and prints one line per node per class with rates computed from
+successive samples: admitted/s, shed/s, in-flight, served-latency EWMA,
+plus the node's concurrency limit, queue delay, and pressure. This is
+the operator's "who is the governor actually shedding" view; the same
+numbers ride the `qos_*` Prometheus series for dashboards.
+
+Targets come from `--node HOST:PORT` (repeatable) or are discovered
+from a master via `--master HOST:PORT` (GET /cluster/qos).
+
+Usage:
+  PYTHONPATH=. python tools/qos_profile.py --master 127.0.0.1:9333 \
+      [--interval 2] [--duration 10] [--json]
+  PYTHONPATH=. python tools/qos_profile.py --node 127.0.0.1:8080 --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from seaweedfs_tpu.utils.httpd import http_json  # noqa: E402
+
+SNAPSHOT_PATHS = ("/admin/qos", "/__api/qos")
+
+
+def discover_nodes(master: str) -> list:
+    out = http_json("GET", f"http://{master}/cluster/qos", timeout=5.0)
+    return [n["url"] for n in out.get("nodes", [])]
+
+
+def fetch_snapshot(node: str) -> dict:
+    last_err: Exception = RuntimeError("no snapshot path answered")
+    for path in SNAPSHOT_PATHS:
+        try:
+            return http_json("GET", f"http://{node}{path}", timeout=5.0)
+        except Exception as e:  # noqa: BLE001 — try the next edge's path
+            last_err = e
+    raise last_err
+
+
+def _class_rows(node: str, prev: dict, cur: dict, dt: float) -> list:
+    rows = []
+    for cls, c in sorted(cur.get("classes", {}).items()):
+        p = (prev or {}).get("classes", {}).get(cls, {})
+        rows.append({
+            "node": node,
+            "class": cls,
+            "inflight": c.get("inflight", 0),
+            "admitted_per_s": round(
+                (c.get("admitted", 0) - p.get("admitted", 0)) / dt, 1),
+            "shed_per_s": round(
+                (c.get("shed", 0) - p.get("shed", 0)) / dt, 1),
+            "latency_ewma_ms": c.get("latency_ewma_ms", 0.0),
+        })
+    return rows
+
+
+def _print_table(ts: float, node: str, snap: dict, rows: list) -> None:
+    print(f"[{time.strftime('%H:%M:%S', time.localtime(ts))}] {node}  "
+          f"enabled={snap.get('enabled')}  limit={snap.get('limit')}  "
+          f"queue_delay_ms={snap.get('queue_delay_ms', 0.0):.1f}  "
+          f"pressure={snap.get('pressure', 0.0):.3f}  "
+          f"shed_tenant={snap.get('shed_tenant', 0)}")
+    for r in rows:
+        print(f"    {r['class']:<12} inflight={r['inflight']:<4} "
+              f"admitted/s={r['admitted_per_s']:<8} "
+              f"shed/s={r['shed_per_s']:<8} "
+              f"lat_ewma_ms={r['latency_ewma_ms']}")
+
+
+def run(nodes: list, interval: float, duration: float,
+        as_json: bool) -> int:
+    prev: dict = {}
+    prev_ts: dict = {}
+    deadline = time.monotonic() + duration
+    first = True
+    while True:
+        now = time.monotonic()
+        for node in nodes:
+            try:
+                snap = fetch_snapshot(node)
+            except Exception as e:  # noqa: BLE001 — keep polling others
+                print(json.dumps({"node": node,
+                                  "error": type(e).__name__}),
+                      flush=True)
+                continue
+            dt = max(now - prev_ts.get(node, now - interval), 1e-6)
+            rows = _class_rows(node, prev.get(node), snap, dt)
+            if as_json:
+                print(json.dumps({"ts": time.time(), "node": node,
+                                  "enabled": snap.get("enabled"),
+                                  "limit": snap.get("limit"),
+                                  "queue_delay_ms":
+                                      snap.get("queue_delay_ms"),
+                                  "pressure": snap.get("pressure"),
+                                  "classes": rows}), flush=True)
+            else:
+                _print_table(time.time(), node, snap, rows)
+            prev[node] = snap
+            prev_ts[node] = now
+        if first:
+            first = False
+        if time.monotonic() + interval > deadline:
+            return 0
+        time.sleep(interval)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--master", help="discover nodes via /cluster/qos")
+    p.add_argument("--node", action="append", default=[],
+                   help="poll this HOST:PORT directly (repeatable)")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--duration", type=float, default=10.0)
+    p.add_argument("--once", action="store_true",
+                   help="one sample per node, then exit")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="JSON lines instead of the table")
+    args = p.parse_args()
+
+    nodes = list(args.node)
+    if args.master:
+        try:
+            nodes.extend(u for u in discover_nodes(args.master)
+                         if u not in nodes)
+        except Exception as e:  # noqa: BLE001 — explicit nodes still go
+            print(json.dumps({"master": args.master,
+                              "error": type(e).__name__}), flush=True)
+    if not nodes:
+        p.error("no targets: pass --master and/or --node")
+    duration = 0.0 if args.once else args.duration
+    return run(nodes, args.interval, duration, args.as_json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
