@@ -63,8 +63,12 @@ def upload_to(fid: str, server_url: str, data: bytes, name: str = "",
     return UploadResult(fid, server_url, len(data))
 
 
-def read_data(mc: MasterClient, fid: str) -> bytes:
-    """Read one needle. Replica holders are ranked by the client's
+def read_data(mc: MasterClient, fid: str,
+              byte_range: Optional[tuple] = None) -> bytes:
+    """Read one needle (or, with ``byte_range=(lo, hi)`` inclusive, just
+    that slice of its payload — served via a Range request, which an EC
+    volume satisfies by reconstructing only the covering byte ranges on
+    degraded reads). Replica holders are ranked by the client's
     learned per-peer health (breakers screen recently-failing servers)
     and a stalled first pick triggers a hedged backup fetch on the
     next-ranked replica — the serial walk failed over only after a
@@ -75,14 +79,19 @@ def read_data(mc: MasterClient, fid: str) -> bytes:
     if not urls:
         raise RuntimeError("no locations")
     errors: list[Exception] = []
+    headers = {}
+    if byte_range is not None:
+        lo, hi = byte_range
+        headers["Range"] = f"bytes={lo}-{hi}"
 
     def fetch(url: str) -> Optional[bytes]:
         try:
-            status, body, _ = http_call("GET", f"http://{url}/{fid}")
+            status, body, _ = http_call("GET", f"http://{url}/{fid}",
+                                        headers=headers or None)
         except ConnectionError as e:
             errors.append(e)
             return None
-        if status == 200:
+        if status == 200 or (status == 206 and byte_range is not None):
             return body
         errors.append(HttpError(status, body))
         return None

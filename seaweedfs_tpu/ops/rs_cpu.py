@@ -175,6 +175,30 @@ def _gf_apply(mat: np.ndarray, data: np.ndarray, use_native: bool = True,
     return out
 
 
+def gf_partial_product(coeffs: np.ndarray, rows: np.ndarray,
+                       out: Optional[np.ndarray] = None,
+                       use_native: bool = True,
+                       workers: int = 1) -> np.ndarray:
+    """Partial-column product for distributed repair: out[i] ^=
+    XOR_j coeffs[i,j] * rows[j] over GF(256).
+
+    This is the per-holder half of a decode matmul split by column: a
+    shard holder applies its own columns of the rebuild matrix to its
+    local shard ranges and ships the pre-reduced (n_rows, n) result;
+    the rebuilder (or the next hop of a reduction chain) XOR-folds the
+    contributions, which is associative and commutative, so any
+    grouping of holders produces bytes identical to the one-machine
+    decode. `coeffs` may be 1-D (a single output row); a caller-provided
+    `out` must be zero-filled on first use (the kernels accumulate)."""
+    mat = np.asarray(coeffs, dtype=np.uint8)
+    if mat.ndim == 1:
+        mat = mat[None, :]
+    data = np.asarray(rows, dtype=np.uint8)
+    if data.ndim == 1:
+        data = data[None, :]
+    return _gf_apply(mat, data, use_native, workers, out)
+
+
 @register_coder("cpu")
 class CpuCoder(ErasureCoder):
     def __init__(self, scheme: RSScheme = DEFAULT_SCHEME,

@@ -119,6 +119,11 @@ class QosGovernor:
                                        min_limit=min_limit,
                                        max_limit=max_limit)
         self.tenants = TenantBuckets(tenant_rate, tenant_burst)
+        # per-CLASS tenant buckets: a tenant's background sweep can be
+        # rate-capped without touching its interactive reads. A class
+        # with a configured bucket uses it INSTEAD of the global one
+        # (the global stays the catch-all for unconfigured classes).
+        self.class_tenants: dict = {}
         self._lock = threading.Lock()
         self._inflight = {c: 0 for c in CLASSES}
         self._admitted = {c: 0 for c in CLASSES}
@@ -177,7 +182,8 @@ class QosGovernor:
         if cls not in self._inflight:
             cls = BACKGROUND
         if tenant is not None:
-            ok, ra = self.tenants.try_consume(tenant, cost)
+            bucket = self.class_tenants.get(cls, self.tenants)
+            ok, ra = bucket.try_consume(tenant, cost)
             if not ok:
                 with self._lock:
                     self._shed_tenant += 1
@@ -245,12 +251,17 @@ class QosGovernor:
                 "classes": classes,
                 "shed_tenant": shed_tenant,
                 "tenant_buckets": self.tenants.snapshot(),
+                "tenant_class_buckets": {
+                    c: b.snapshot()
+                    for c, b in sorted(self.class_tenants.items())},
                 **self.limiter.snapshot()}
 
     def configure(self, **kw) -> dict:
         """Runtime tuning (``POST /admin/qos`` and cluster.qos):
         enabled, limit, min_limit, max_limit, tenant_rate,
-        tenant_burst.  Returns the post-change snapshot."""
+        tenant_burst, tenant_class_rates ({class: req/s; <= 0 removes
+        the override}), tenant_class_bursts ({class: burst}).  Returns
+        the post-change snapshot."""
         if "enabled" in kw:
             self.enabled = bool(kw["enabled"])
         lim = self.limiter
@@ -266,4 +277,20 @@ class QosGovernor:
             self.tenants.configure(
                 float(kw.get("tenant_rate", self.tenants.rate)),
                 kw.get("tenant_burst"))
+        if "tenant_class_rates" in kw or "tenant_class_bursts" in kw:
+            rates = kw.get("tenant_class_rates") or {}
+            bursts = kw.get("tenant_class_bursts") or {}
+            for cls in set(rates) | set(bursts):
+                if cls not in CLASSES:
+                    continue
+                prev = self.class_tenants.get(cls)
+                rate = float(rates.get(cls, prev.rate if prev else 0.0))
+                if rate <= 0:
+                    self.class_tenants.pop(cls, None)
+                    continue
+                burst = bursts.get(cls)
+                if prev is None:
+                    self.class_tenants[cls] = TenantBuckets(rate, burst)
+                else:
+                    prev.configure(rate, burst)
         return self.snapshot()

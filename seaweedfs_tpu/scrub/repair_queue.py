@@ -63,7 +63,8 @@ class RepairQueue:
     def __init__(self, master, max_concurrent: int = 2,
                  backoff_base: float = 2.0, backoff_max: float = 300.0,
                  scan_grace_s: float = 60.0,
-                 repair_rate_mbps: float = 0.0):
+                 repair_rate_mbps: float = 0.0,
+                 partial_repair: bool = True):
         """scan_grace_s: how long a volume must stay CONTINUOUSLY
         degraded in the heartbeat shard map before the scanner enqueues
         it — transient states (a node mid-restart, an operator running
@@ -74,8 +75,15 @@ class RepairQueue:
         repair_rate_mbps: CLUSTER-WIDE repair bandwidth budget — one
         token bucket shared by every concurrent rebuild's copy and
         rebuild traffic, so N parallel repairs split the budget instead
-        of each taking the full rate (<= 0 = unlimited)."""
+        of each taking the full rate (<= 0 = unlimited).
+
+        partial_repair: try the network-frugal partial-column rebuild
+        (/admin/ec/rebuild_partial — the rebuilder pulls pre-reduced
+        columns through a reduction chain, ~1 shard-width received per
+        lost shard) before falling back to the legacy copy+rebuild
+        choreography (~k shard-widths staged on the rebuilder)."""
         self.master = master
+        self.partial_repair = partial_repair
         self.max_concurrent = max_concurrent
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
@@ -93,6 +101,12 @@ class RepairQueue:
         self.repaired_total = 0
         self.failed_total = 0
         self.bytes_moved = 0
+        self.partial_repairs = 0
+        self.partial_fallbacks = 0
+        # network bytes RECEIVED by the rebuilder per MiB of shard
+        # rebuilt, for the most recent repair (partial: ~1 shard-width
+        # per lost shard ≈ 1.0; legacy copy+rebuild: ≈ k/missing)
+        self.last_repair_network_bytes_per_mb = 0.0
         self.last_lag_s = 0.0
         self.scrub_reports = 0
         self.recent_needle_reports: list[dict] = []
@@ -111,6 +125,10 @@ class RepairQueue:
         self._g_budget = m.gauge(
             "master", "ec_repair_budget_remaining_bytes",
             "cluster-wide repair bandwidth budget remaining")
+        self._g_netmb = m.gauge(
+            "master", "ec_repair_network_bytes_per_mb",
+            "rebuilder-received network bytes per MiB rebuilt "
+            "(last repair)")
         m.on_expose(self._refresh_gauges)
 
     # ---- intake ----
@@ -325,6 +343,24 @@ class RepairQueue:
                        for n in shard_owners[sid])}
         need = sorted(present - have)
 
+        # 4a. network-frugal path: the rebuilder pulls pre-reduced
+        # partial columns through a reduction chain instead of staging
+        # `need` full shards (ladder rung 3 falls through to 4b)
+        if self.partial_repair:
+            try:
+                return self._repair_partial(vid, collection,
+                                            shard_owners, present,
+                                            missing, rebuilder_url)
+            except Exception as e:
+                with self._lock:
+                    self.partial_fallbacks += 1
+                glog.warning(
+                    "ec repair vol %d: partial rebuild on %s failed "
+                    "(%s); falling back to copy+rebuild",
+                    vid, rebuilder_url, e)
+
+        # 4b. legacy choreography: stage every needed shard, then
+        # rebuild locally
         moved = 0
         for sid in need:
             src = self._pick_source(shard_owners[sid])
@@ -353,9 +389,57 @@ class RepairQueue:
         self._node_post(rebuilder_url, "/admin/ec/mount",
                         {"volume_id": vid, "collection": collection,
                          "shard_ids": rebuilt})
+        self._note_network_cost(moved, shard_size, len(rebuilt))
         moved += shard_size * len(rebuilt)
         self.bandwidth.consume(shard_size * len(rebuilt), self._stop)
         return moved
+
+    def _repair_partial(self, vid: int, collection: str,
+                        shard_owners: dict, present: set,
+                        missing: list, rebuilder_url: str) -> int:
+        """Drive /admin/ec/rebuild_partial on the rebuilder, then
+        mount. Returns bytes accounted (network received + rebuilt
+        shard bytes, mirroring the legacy accounting). Raises on any
+        failure — the caller falls back to copy+rebuild."""
+        sources = {}
+        for sid in sorted(present):
+            urls = [n.url for n in shard_owners[sid]
+                    if n.url != rebuilder_url]
+            if urls:
+                sources[sid] = urls
+        resp = self._node_post(rebuilder_url, "/admin/ec/rebuild_partial",
+                               {"volume_id": vid,
+                                "collection": collection,
+                                "missing": missing,
+                                "sources": sources},
+                               timeout=600)
+        rebuilt = resp.get("rebuilt_shard_ids", [])
+        shard_size = int(resp.get("shard_size", 0))
+        net = int(resp.get("network_bytes", 0))
+        if set(missing) - set(rebuilt):
+            raise RuntimeError(
+                f"vol {vid}: partial rebuild produced {rebuilt}, "
+                f"still missing {sorted(set(missing) - set(rebuilt))}")
+        self._node_post(rebuilder_url, "/admin/ec/mount",
+                        {"volume_id": vid, "collection": collection,
+                         "shard_ids": rebuilt})
+        with self._lock:
+            self.partial_repairs += 1
+        if resp.get("fallbacks"):
+            glog.info("ec repair vol %d: partial rebuild degraded "
+                      "mid-chain (%s)", vid, resp["fallbacks"])
+        self._note_network_cost(net, shard_size, len(rebuilt))
+        self.bandwidth.consume(net + shard_size * len(rebuilt),
+                               self._stop)
+        return net + shard_size * len(rebuilt)
+
+    def _note_network_cost(self, net_bytes: int, shard_size: int,
+                           n_rebuilt: int) -> None:
+        mb = shard_size * n_rebuilt / (1024.0 * 1024.0)
+        per_mb = round(net_bytes / mb, 1) if mb else 0.0
+        with self._lock:
+            self.last_repair_network_bytes_per_mb = per_mb
+        self._g_netmb.set(value=per_mb)
 
     @staticmethod
     def _scrubbing(node) -> bool:
@@ -422,6 +506,11 @@ class RepairQueue:
                 "repaired_total": self.repaired_total,
                 "failed_total": self.failed_total,
                 "bytes_moved": self.bytes_moved,
+                "partial_enabled": self.partial_repair,
+                "partial_repairs": self.partial_repairs,
+                "partial_fallbacks": self.partial_fallbacks,
+                "last_repair_network_bytes_per_mb":
+                    self.last_repair_network_bytes_per_mb,
                 "last_lag_s": round(self.last_lag_s, 3),
                 "scrub_reports": self.scrub_reports,
                 "recent_needle_reports":

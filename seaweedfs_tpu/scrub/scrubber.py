@@ -234,16 +234,25 @@ class Scrubber:
         rep = {"volume_id": vid, "collection": ev.collection, "ec": True,
                "bytes": 0, "corruptions": [], "size": shard_size * total}
         present = sorted(ev.shards)
-        if any(i not in ev.shards for i in range(k)):
+        missing_data = [i for i in range(k) if i not in ev.shards]
+        remote_reader = getattr(self.store, "remote_partial_reader", None)
+        if missing_data and remote_reader is None:
             # a spread deployment holds only some shards per node; local
-            # parity recompute needs all k data columns (remote-assisted
-            # scrub is a roadmap item)
+            # parity recompute needs all k data columns, and this store
+            # has no partial-read chain to pull the rest
             rep["skipped"] = f"data shards not all local: {present}"
             return rep
         parity_present = [i for i in range(k, total) if i in ev.shards]
         if not parity_present:
             rep["skipped"] = "no parity shard local"
             return rep
+        local_data = [i for i in range(k) if i in ev.shards]
+        if missing_data:
+            # remote-assisted: peers ship ONE pre-reduced column for the
+            # absent data shards (partial-read chain), costing ~1
+            # column of ingress per group instead of the k-local_data
+            # raw columns a full fetch would
+            rep["remote_assisted"] = True
         offset = int(cursors["ec_volumes"].get(str(vid), 0))
         if offset >= shard_size:
             offset = 0
@@ -262,7 +271,10 @@ class Scrubber:
                 group += 1
                 continue
             self._set_current(vid, "ec", offset, shard_size)
-            read_n = length * (k + len(parity_present))
+            read_n = length * (len(local_data) + len(parity_present))
+            if missing_data:
+                # the pre-reduced remote column arrives over the wire
+                read_n += length * len(parity_present)
             self._apply_pressure()
             if not self.bucket.consume(read_n, self._stop):
                 break
@@ -282,7 +294,20 @@ class Scrubber:
                                     "offset": offset,
                                     "detail": "short read (truncated)"})
             else:
-                bad = self._check_group(rows, coder, k, parity_present)
+                if missing_data:
+                    try:
+                        bad = self._check_group_remote(
+                            rows, coder, k, local_data, missing_data,
+                            parity_present, vid, offset, length,
+                            remote_reader)
+                    except RuntimeError:
+                        rep["skipped"] = "remote partial unavailable"
+                        break
+                    detail = "parity mismatch (remote-assisted)"
+                else:
+                    bad = self._check_group(rows, coder, k,
+                                            parity_present)
+                    detail = "parity mismatch"
                 if bad is not None:
                     self._corrupt(rep, {
                         "type": "ec_shard", "volume_id": vid,
@@ -290,7 +315,7 @@ class Scrubber:
                         "shard_ids": bad if bad else
                         list(parity_present),
                         "offset": offset,
-                        "detail": "parity mismatch"})
+                        "detail": detail})
             offset += length
             group += 1
             rep["bytes"] += read_n
@@ -342,6 +367,40 @@ class Scrubber:
                    for j in parity_present):
                 return [i]
         return []
+
+    def _check_group_remote(self, rows: list, coder, k: int,
+                            local_data: list, missing_data: list,
+                            parity_present: list, vid: int, offset: int,
+                            length: int, remote_reader) -> Optional[list]:
+        """Parity check when only SOME data columns are local: fold the
+        local columns' partial parity, pull the absent columns'
+        contribution as one pre-reduced column through the partial-read
+        chain, XOR, and compare against the local parity shards.
+        Returns None (clean) or [] (mismatch — unidentified, since
+        leave-one-out needs the full columns). Raises RuntimeError when
+        no remote contribution is obtainable (caller records skipped)."""
+        from seaweedfs_tpu.ops.rs_cpu import CpuCoder, gf_partial_product
+        pmat = getattr(coder, "_parity", None)
+        if pmat is None:
+            pmat = CpuCoder(coder.scheme)._parity
+        n_rows = len(parity_present)
+        expected = np.zeros((n_rows, length), dtype=np.uint8)
+        if local_data:
+            mat_local = np.array(
+                [[pmat[j - k][i] for i in local_data]
+                 for j in parity_present], dtype=np.uint8)
+            data = np.stack([np.frombuffer(rows[i], dtype=np.uint8)
+                             for i in local_data])
+            gf_partial_product(mat_local, data, out=expected)
+        coeff_by_sid = {i: [int(pmat[j - k][i]) for j in parity_present]
+                        for i in missing_data}
+        remote = remote_reader(vid, coeff_by_sid, offset, length, n_rows)
+        if remote is None:
+            raise RuntimeError("remote partial unavailable")
+        expected ^= remote
+        mism = [j for idx, j in enumerate(parity_present)
+                if expected[idx].tobytes() != rows[j]]
+        return None if not mism else []
 
     # ---- bookkeeping ----
     def _apply_pressure(self) -> None:

@@ -22,7 +22,8 @@ from typing import Optional
 
 from seaweedfs_tpu.cluster.sequence import MemorySequencer
 from seaweedfs_tpu.cluster.topology import Topology
-from seaweedfs_tpu.qos import BACKGROUND, WRITE, class_scope
+from seaweedfs_tpu.qos import (BACKGROUND, INTERACTIVE, WRITE, QosGovernor,
+                               class_scope, classify, from_headers)
 from seaweedfs_tpu.cluster.volume_growth import (NoFreeSpaceError,
                                                  grow_by_type)
 from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
@@ -41,7 +42,9 @@ class MasterServer:
                  jwt_signing_key: str = "",
                  whitelist: Optional[list] = None,
                  meta_dir: str = "", grpc_port: Optional[int] = None,
-                 repair_rate_mbps: float = 0.0):
+                 repair_rate_mbps: float = 0.0,
+                 partial_repair: bool = True,
+                 qos: bool = True):
         self.topo = Topology(volume_size_limit=volume_size_limit_mb * 1024 * 1024)
         self.jwt_signing_key = jwt_signing_key
         from seaweedfs_tpu.utils.metrics import Registry
@@ -77,7 +80,15 @@ class MasterServer:
         self._admin_lock_ts = 0.0
         from seaweedfs_tpu.scrub import RepairQueue
         self.repair_queue = RepairQueue(
-            self, repair_rate_mbps=repair_rate_mbps)
+            self, repair_rate_mbps=repair_rate_mbps,
+            partial_repair=partial_repair)
+        # the master's serving edge (lookups/assigns) gets the same
+        # adaptive-concurrency governor as the volume servers' data
+        # edges; cluster-control traffic is exempt (see QOS_EXEMPT)
+        self.qos = QosGovernor(metrics=self.metrics, enabled=qos)
+        self._m_qos_shed = self.metrics.counter(
+            "master", "qos_shed_total", "requests shed at the master edge")
+        self.http.admission_gate = self._admission_gate
         self._register_routes()
         self._stop = threading.Event()
         self._pruner: Optional[threading.Thread] = None
@@ -345,11 +356,60 @@ class MasterServer:
         r("POST", "/scrub/report", self._handle_scrub_report)
         r("GET", "/ec/repair/status", self._handle_repair_status)
         r("POST", "/ec/repair/kick", self._handle_repair_kick)
+        r("GET", "/admin/qos", self._admin_qos)
+        r("POST", "/admin/qos", self._admin_qos_configure)
         r("POST", "/raft/vote", self._handle_raft("on_request_vote"))
         r("POST", "/raft/append", self._handle_raft("on_append_entries"))
         r("POST", "/raft/snapshot", self._handle_raft("on_install_snapshot"))
         from seaweedfs_tpu.utils.debug import install_debug_routes
         install_debug_routes(self.http)
+
+    # Shedding cluster-control traffic would destabilize the cluster
+    # the governor is trying to protect: heartbeats/raft keep liveness,
+    # scrub reports and repair control keep integrity moving, and the
+    # observability/registration endpoints must answer while degraded.
+    # The governed edge is the SERVING one: lookups, assigns, growth,
+    # directory status.
+    QOS_EXEMPT = ("/heartbeat", "/raft/", "/cluster/", "/metrics", "/ui",
+                  "/debug", "/scrub/report", "/ec/repair/", "/admin/lock",
+                  "/admin/unlock", "/admin/qos", "/dir/leave", "/col/")
+
+    def _admission_gate(self, method: str, path: str, headers, client):
+        """HttpServer admission hook for the master's serving edge —
+        same contract as the volume server's: classify (propagated
+        header wins), ask the governor, shed with 503 + Retry-After."""
+        if not self.qos.enabled or path == "/":
+            return None
+        for p in self.QOS_EXEMPT:
+            if path.startswith(p):
+                return None
+        cls = from_headers(headers) or self._classify_master(method, path)
+        grant = self.qos.admit(cls)
+        if not grant.ok:
+            self._m_qos_shed.inc()
+            return Response(
+                {"error": "overloaded", "class": cls}, status=503,
+                headers={"Retry-After": f"{grant.retry_after:.2f}"})
+        return grant.release
+
+    @staticmethod
+    def _classify_master(method: str, path: str) -> str:
+        # assigns and growth consume topology capacity like writes;
+        # lookups sit on every read path and stay interactive
+        if path.startswith(("/dir/assign", "/vol/")):
+            return WRITE
+        if path.startswith("/dir/"):
+            return INTERACTIVE
+        return classify(method, path)
+
+    def _admin_qos(self, req: Request) -> Response:
+        return Response({"url": self.url, **self.qos.snapshot()})
+
+    def _admin_qos_configure(self, req: Request) -> Response:
+        if not self.is_leader():
+            return self._not_leader()
+        return Response({"url": self.url,
+                         **self.qos.configure(**(req.json() or {}))})
 
     def _refresh_gauges(self) -> None:
         # runs before every exposition (scrape AND push-gateway loop)
@@ -767,6 +827,7 @@ class MasterServer:
             "is_leader": self.is_leader(),
             "cluster_pressure": max(
                 (n["qos_pressure"] for n in nodes), default=0.0),
+            "master_edge": self.qos.snapshot(),
             "nodes": nodes,
             "repair": {
                 "base_rate_bytes_per_sec":

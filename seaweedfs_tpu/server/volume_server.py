@@ -21,13 +21,17 @@ import threading
 import time
 from typing import Optional
 
+import numpy as np
+
 from seaweedfs_tpu.models.coder import ErasureCoder
-from seaweedfs_tpu.qos import (WRITE, QosGovernor, class_scope, classify,
-                               current_class, from_headers)
+from seaweedfs_tpu.ops.rs_cpu import gf_partial_product
+from seaweedfs_tpu.qos import (BACKGROUND, WRITE, QosGovernor, class_scope,
+                               classify, current_class, from_headers)
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.erasure_coding import decoder as ecdec
 from seaweedfs_tpu.storage.erasure_coding import encoder as ecenc
 from seaweedfs_tpu.storage.erasure_coding import layout
+from seaweedfs_tpu.storage.erasure_coding import partial as ecpart
 from seaweedfs_tpu.storage.file_id import parse_needle_id_cookie
 from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.store import Store
@@ -212,6 +216,7 @@ class VolumeServer:
         self.store.peer_health = self.peer_health
         self.store.shard_locations = self._shard_locations
         self.store.resilient_reads = self.resilient_reads
+        self.store.remote_partial_reader = self._remote_partial_reader
         if self._tcp_port >= 0:
             from seaweedfs_tpu.server.volume_tcp import TcpDataServer
             self.tcp_server = TcpDataServer(self.store, self.http.host,
@@ -460,6 +465,11 @@ class VolumeServer:
         r("POST", "/admin/ec/blob_delete", self._ec_blob_delete)
         r("GET", "/admin/ec/shard_read", self._ec_shard_read)
         r("GET", "/admin/ec/shard_file", self._ec_shard_file)
+        r("GET", "/admin/ec/shard_stat", self._ec_shard_stat)
+        # partial-column repair (network-frugal rebuild; see
+        # storage/erasure_coding/partial.py for the chain protocol)
+        r("POST", "/admin/ec/partial_read", self._ec_partial_read)
+        r("POST", "/admin/ec/rebuild_partial", self._ec_rebuild_partial)
         # integrity scrub
         r("POST", "/admin/scrub", self._admin_scrub)
         r("GET", "/admin/scrub/status", self._admin_scrub_status)
@@ -786,6 +796,15 @@ class VolumeServer:
             return denied
         self._m_req.inc("read")
         vid, key, cookie = self._parse_fid(req)
+        if req.headers.get("Range") and \
+                self.store.find_volume(vid) is None and \
+                self.store.has_ec_volume(vid) and \
+                not (req.query.get("width") or req.query.get("height")):
+            resp = self._ec_ranged_read(req, vid, key, cookie)
+            if resp is not None:
+                return resp
+            # else: metadata says we can't serve the subrange (v1,
+            # compressed, malformed range) — fall through to full read
         try:
             if self.store.find_volume(vid) is not None:
                 n = self.store.read_volume_needle(vid, key, cookie)
@@ -848,6 +867,60 @@ class VolumeServer:
         if req.headers.get("If-None-Match") == f'"{n.checksum:x}"':
             return Response(b"", status=304, content_type=mime)
         return Response(n.data, content_type=mime, headers=headers)
+
+    def _ec_ranged_read(self, req: Request, vid: int, key: int,
+                        cookie) -> Optional[Response]:
+        """Subrange degraded read: satisfy an EC Range request by
+        reconstructing ONLY the needle's requested byte range, not the
+        whole record — when a shard is missing, recovery cost scales
+        with the range, not the needle (or large-block) size. Returns
+        None to fall back to the whole-needle path (v1 volume,
+        compressed data, no parsable range)."""
+        from seaweedfs_tpu.utils.httpd import (RangeNotSatisfiable,
+                                               parse_byte_range)
+        try:
+            n, data_size = self.store.ec_needle_meta(vid, key, cookie)
+        except (NotFoundError, DeletedError, CookieMismatchError):
+            return Response(b"", status=404, content_type="text/plain")
+        except ValueError:
+            return None  # v1 layout: data offset isn't knowable cheaply
+        if n.is_compressed or data_size == 0:
+            return None  # must inflate (or 404) via the full path
+        headers = {}
+        if n.last_modified:
+            headers["X-Last-Modified"] = str(n.last_modified)
+        if n.name:
+            headers["X-File-Name"] = n.name.decode(errors="replace")
+        if n.has_ttl and n.ttl and n.last_modified:
+            from seaweedfs_tpu.storage.super_block import TTL
+            ttl = TTL.from_bytes(n.ttl)
+            if ttl.minutes and \
+                    time.time() > n.last_modified + ttl.minutes * 60:
+                return Response(b"", status=404, content_type="text/plain")
+        mime = (n.mime.decode(errors="replace")
+                if n.mime else "application/octet-stream")
+        try:
+            rng = parse_byte_range(req.headers["Range"], data_size)
+        except RangeNotSatisfiable:
+            headers["Content-Range"] = f"bytes */{data_size}"
+            return Response(b"", status=416, content_type=mime,
+                            headers=headers)
+        if rng is None:
+            return None  # malformed spec -> full body per RFC
+        lo, hi = rng
+        try:
+            piece = self.store.read_ec_needle_data_range(
+                vid, key, lo, hi - lo + 1)
+        except (NotFoundError, DeletedError):
+            return Response(b"", status=404, content_type="text/plain")
+        except Exception as e:
+            glog.warning("ec subrange read v%d,%x failed (%s); "
+                         "falling back to full read", vid, key, e)
+            return None
+        self._m_req.inc("ec_subrange")
+        headers["Content-Range"] = f"bytes {lo}-{hi}/{data_size}"
+        return Response(piece, status=206, content_type=mime,
+                        headers=headers)
 
     def _handle_delete(self, req: Request) -> Response:
         denied = self._check_jwt(req)
@@ -1410,6 +1483,381 @@ class VolumeServer:
             return Response({"error": "shard not found"}, status=404)
         return Response(ev.shards[sid].read_at(offset, size),
                         content_type="application/octet-stream")
+
+    def _ec_shard_stat(self, req: Request) -> Response:
+        """Shard inventory + size for one EC volume — lets a partial
+        rebuilder learn the shard width without streaming a shard."""
+        vid = int(req.query["volumeId"])
+        base = self._ec_base_name(vid, req.query.get("collection", ""))
+        sizes = {}
+        for i in range(layout.TOTAL_SHARDS_COUNT):
+            p = base + layout.shard_ext(i)
+            if os.path.exists(p):
+                sizes[i] = os.path.getsize(p)
+        if not sizes:
+            return Response({"error": "no shards"}, status=404)
+        return Response({"volume_id": vid, "shards": sorted(sizes),
+                         "shard_size": max(sizes.values())})
+
+    # ---- partial-column repair (storage/erasure_coding/partial.py) ----
+    def _ec_partial_read(self, req: Request) -> Response:
+        """One hop of a partial-column reduction chain: fold the local
+        members' GF partial products, XOR in the accumulated column
+        recursively requested from the rest of the chain, return ONE
+        pre-reduced column upstream. A 409 means the plan is stale for
+        this node (shard moved) — the caller falls back."""
+        b = req.json()
+        vid = int(b["volume_id"])
+        offset = int(b["offset"])
+        size = int(b["size"])
+        n_rows = int(b.get("n_rows", 1))
+        chain = b.get("chain") or []
+        if not chain or size <= 0 or n_rows <= 0:
+            return Response({"error": "bad partial plan"}, status=400)
+        ev = self.store.find_ec_volume(vid)
+        hop, rest = chain[0], chain[1:]
+        rows, cols = [], []
+        for sid, coeffs in hop["members"]:
+            if len(coeffs) != n_rows:
+                return Response({"error": "coeffs/n_rows mismatch"},
+                                status=400)
+            shard = ev.shards.get(int(sid)) if ev is not None else None
+            if shard is None:
+                return Response({"error": f"shard {sid} not local"},
+                                status=409)
+            data = shard.read_at(offset, size)
+            if len(data) != size:
+                return Response({"error": f"shard {sid} short read"},
+                                status=409)
+            rows.append(np.frombuffer(data, dtype=np.uint8))
+            cols.append(np.asarray(coeffs, dtype=np.uint8))
+        acc = np.zeros((n_rows, size), dtype=np.uint8)
+        if rows:
+            gf_partial_product(np.stack(cols, axis=1), np.stack(rows),
+                               out=acc)
+        shards_folded = len(rows)
+        reasons: list[str] = []
+        if rest:
+            try:
+                arr, dshards, _nbytes, dreasons = self._chain_partial(
+                    vid, b.get("collection", ""), offset, size, n_rows,
+                    rest)
+            except RuntimeError as e:
+                return Response({"error": str(e)}, status=502)
+            acc ^= arr
+            shards_folded += dshards
+            reasons.extend(dreasons)
+        headers = {ecpart.SHARDS_HEADER: str(shards_folded)}
+        if reasons:
+            headers[ecpart.FALLBACK_HEADER] = ",".join(reasons)
+        self._m_req.inc("ec_partial_read")
+        return Response(acc.tobytes(),
+                        content_type="application/octet-stream",
+                        headers=headers)
+
+    def _chain_partial(self, vid: int, collection: str, offset: int,
+                       size: int, n_rows: int, chain: list
+                       ) -> tuple[np.ndarray, int, int, list]:
+        """Request the accumulated partial column from a reduction
+        chain. Breaker-screened; on any failure of the next hop, fall
+        back to raw-streaming every remaining member's shard range and
+        reducing HERE (ladder rung 1/2 in partial.py). Returns
+        (array (n_rows, size), shards_folded, net_bytes_received,
+        fallback_reasons); raises RuntimeError when some member shard
+        is unobtainable by any means."""
+        url = chain[0]["url"]
+        expect = len(ecpart.chain_shard_ids(chain))
+        if self.peer_health.allow(url):
+            t0 = time.monotonic()
+            try:
+                status, body, hdrs = http_call(
+                    "POST", f"http://{url}{ecpart.PARTIAL_READ_PATH}",
+                    json_body={"volume_id": vid, "collection": collection,
+                               "offset": offset, "size": size,
+                               "n_rows": n_rows, "chain": chain},
+                    timeout=120)
+                self.peer_health.record(url, True, time.monotonic() - t0)
+                if status == 200 and len(body) == n_rows * size:
+                    arr = np.frombuffer(body, dtype=np.uint8) \
+                        .reshape(n_rows, size).copy()
+                    shards = int(hdrs.get(ecpart.SHARDS_HEADER, expect))
+                    reasons = [r for r in
+                               hdrs.get(ecpart.FALLBACK_HEADER,
+                                        "").split(",") if r]
+                    return arr, shards, len(body), reasons
+            except (ConnectionError, OSError):
+                self.peer_health.record(url, False)
+        arr, shards, nbytes = self._raw_partial_fold(
+            vid, offset, size, n_rows, chain)
+        return arr, shards, nbytes, [f"chain:{url}"]
+
+    def _raw_partial_fold(self, vid: int, offset: int, size: int,
+                          n_rows: int, chain: list
+                          ) -> tuple[np.ndarray, int, int]:
+        """Full-shard-streaming fallback: fetch each remaining member's
+        raw range (local file, planned holder, then any other holder)
+        and fold the partial products locally."""
+        acc = np.zeros((n_rows, size), dtype=np.uint8)
+        shards = 0
+        nbytes = 0
+        ev = self.store.find_ec_volume(vid)
+        for hop in chain:
+            for sid, coeffs in hop["members"]:
+                sid = int(sid)
+                data = None
+                local = ev.shards.get(sid) if ev is not None else None
+                if local is not None:
+                    data = local.read_at(offset, size)
+                    if len(data) != size:
+                        data = None
+                if data is None:
+                    data = self._fetch_shard_range(
+                        vid, sid, offset, size, prefer=hop["url"])
+                    if data is not None:
+                        nbytes += len(data)
+                if data is None:
+                    raise RuntimeError(
+                        f"shard {sid}: no reachable holder for "
+                        "partial fold")
+                gf_partial_product(
+                    np.asarray(coeffs, dtype=np.uint8)[:, None],
+                    np.frombuffer(data, dtype=np.uint8)[None, :],
+                    out=acc)
+                shards += 1
+        return acc, shards, nbytes
+
+    def _fetch_shard_range(self, vid: int, sid: int, offset: int,
+                           size: int, prefer: str = "") -> Optional[bytes]:
+        urls = [prefer] if prefer else []
+        try:
+            locs = self._shard_locations(vid)
+        except (ConnectionError, HttpError):
+            locs = {}
+        urls += [u for u in locs.get(sid, []) if u not in urls]
+        for u in urls:
+            if not self.peer_health.allow(u) and len(urls) > 1:
+                continue
+            t0 = time.monotonic()
+            try:
+                status, body, _ = http_call(
+                    "GET",
+                    f"http://{u}/admin/ec/shard_read"
+                    f"?volumeId={vid}&shardId={sid}"
+                    f"&offset={offset}&size={size}", timeout=60)
+            except (ConnectionError, OSError):
+                self.peer_health.record(u, False)
+                continue
+            self.peer_health.record(u, True, time.monotonic() - t0)
+            if status == 200 and len(body) == size:
+                return body
+        return None
+
+    def _remote_partial_reader(self, vid: int, coeff_by_sid: dict,
+                               offset: int, size: int,
+                               n_rows: int) -> Optional[np.ndarray]:
+        """Store hook for the scrubber: pull the XOR of remote shards'
+        partial products as one pre-reduced column (remote-assisted
+        parity recompute on spread deployments)."""
+        try:
+            locs = self._shard_locations(vid)
+        except (ConnectionError, HttpError):
+            return None
+        chain = ecpart.plan_chain(locs, coeff_by_sid,
+                                  health=self.peer_health)
+        if not chain:
+            return None
+        try:
+            with class_scope(BACKGROUND), \
+                    deadline_scope(Deadline.after(60.0)):
+                arr, shards, _n, _r = self._chain_partial(
+                    vid, "", offset, size, n_rows, chain)
+        except RuntimeError:
+            return None
+        if shards != len(coeff_by_sid):
+            return None
+        return arr
+
+    def _ensure_ec_aux_files(self, vid: int, collection: str, base: str,
+                             sources: dict) -> int:
+        """Fetch .ecx (mandatory) and .ecj/.vif (best-effort) from any
+        source holder when absent locally. Returns bytes copied."""
+        urls: list[str] = []
+        for us in sources.values():
+            for u in us:
+                if u not in urls:
+                    urls.append(u)
+        urls = self.peer_health.rank(urls)
+        copied = 0
+        for ext in (".ecx", ".ecj", ".vif"):
+            if os.path.exists(base + ext):
+                continue
+            for u in urls:
+                try:
+                    status, body, _ = http_call(
+                        "GET",
+                        f"http://{u}/admin/ec/shard_file?volumeId={vid}"
+                        f"&ext={ext}&collection={collection}", timeout=60)
+                except (ConnectionError, OSError):
+                    self.peer_health.record(u, False)
+                    continue
+                if status >= 400:
+                    continue
+                with open(base + ext, "wb") as f:
+                    f.write(body)
+                copied += len(body)
+                break
+        if not os.path.exists(base + ".ecx"):
+            raise RuntimeError("no source holder could supply .ecx")
+        return copied
+
+    def _ec_rebuild_partial(self, req: Request) -> Response:
+        """Network-frugal rebuild: reconstruct the missing shards from
+        pre-reduced partial columns pulled through a reduction chain —
+        ~1 shard-width received per lost shard instead of the k full
+        shards the copy+rebuild choreography stages. Bit-identical to
+        the serial rebuild (XOR folding is associative). The caller
+        (master repair queue) falls back to /admin/ec/copy +
+        /admin/ec/rebuild on any error here (ladder rung 3)."""
+        b = req.json()
+        vid = int(b["volume_id"])
+        collection = b.get("collection", "")
+        missing = sorted(int(s) for s in b.get("missing", []))
+        sources = {int(s): [u for u in urls if not self._is_self(u)]
+                   for s, urls in (b.get("sources") or {}).items()}
+        sources = {s: u for s, u in sources.items() if u}
+        batch = int(b.get("batch_size", 0)) or ecenc.DEFAULT_BATCH_SIZE
+        if not missing:
+            return Response({"error": "nothing to rebuild"}, status=400)
+        coder = self.store.coder
+        k = coder.scheme.data_shards
+        total = coder.scheme.total_shards
+        base = self._ec_base_name(vid, collection)
+        local = [i for i in range(total)
+                 if os.path.exists(base + layout.shard_ext(i))]
+        present = sorted((set(local) | set(sources)) - set(missing))
+        if len(present) < k:
+            return Response(
+                {"error": f"only {len(present)} shards known, need {k}"},
+                status=409)
+        src_sids = present[:k]
+        received = 0
+        shard_size = 0
+        for s in src_sids:
+            if s in local:
+                shard_size = os.path.getsize(base + layout.shard_ext(s))
+                break
+        if not shard_size:
+            shard_size = self._remote_shard_stat(vid, collection, sources)
+        if not shard_size:
+            return Response({"error": "cannot determine shard size"},
+                            status=409)
+        try:
+            received += self._ensure_ec_aux_files(
+                vid, collection, base, sources)
+        except RuntimeError as e:
+            return Response({"error": str(e)}, status=502)
+        if not hasattr(coder, "rebuild_matrix"):
+            from seaweedfs_tpu.ops.rs_cpu import CpuCoder
+            coder = CpuCoder(coder.scheme)
+        mat = coder.rebuild_matrix(present, missing)
+        workers = int(getattr(self.store.coder, "workers", 1) or 1)
+        miss_n = len(missing)
+        fallbacks: list[str] = []
+        local_fhs = {s: open(base + layout.shard_ext(s), "rb")
+                     for s in src_sids if s in local}
+        remote_src = [s for s in src_sids if s not in local_fhs]
+        outs = {m: open(base + layout.shard_ext(m) + ".tmp", "wb")
+                for m in missing}
+        try:
+            for off in range(0, shard_size, batch):
+                sz = min(batch, shard_size - off)
+                acc = np.zeros((miss_n, sz), dtype=np.uint8)
+                if local_fhs:
+                    rows, cols = [], []
+                    for j, s in enumerate(src_sids):
+                        fh = local_fhs.get(s)
+                        if fh is None:
+                            continue
+                        fh.seek(off)
+                        buf = fh.read(sz)
+                        if len(buf) != sz:
+                            raise RuntimeError(
+                                f"short local read shard {s}")
+                        rows.append(np.frombuffer(buf, dtype=np.uint8))
+                        cols.append(mat[:, j])
+                    gf_partial_product(np.stack(cols, axis=1),
+                                       np.stack(rows), out=acc,
+                                       workers=workers)
+                if remote_src:
+                    coeff_by_sid = {
+                        s: mat[:, src_sids.index(s)].tolist()
+                        for s in remote_src}
+                    chain = ecpart.plan_chain(
+                        sources, coeff_by_sid, health=self.peer_health)
+                    if chain is None:
+                        raise RuntimeError(
+                            "no holder for some source shard")
+                    arr, shards, nbytes, reasons = self._chain_partial(
+                        vid, collection, off, sz, miss_n, chain)
+                    if shards != len(remote_src):
+                        raise RuntimeError(
+                            f"chain folded {shards} shards, "
+                            f"expected {len(remote_src)}")
+                    received += nbytes
+                    fallbacks.extend(reasons)
+                    acc ^= arr
+                for r, m in enumerate(missing):
+                    outs[m].write(acc[r].tobytes())
+        except Exception as e:
+            for fh in outs.values():
+                fh.close()
+            for m in missing:
+                p = base + layout.shard_ext(m) + ".tmp"
+                if os.path.exists(p):
+                    os.remove(p)
+            return Response({"error": f"partial rebuild: {e}"},
+                            status=502)
+        finally:
+            for fh in local_fhs.values():
+                fh.close()
+            for fh in outs.values():
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+        for m in missing:
+            os.replace(base + layout.shard_ext(m) + ".tmp",
+                       base + layout.shard_ext(m))
+        ecenc.rebuild_ecx_file(base)
+        self._m_req.inc("ec_rebuild_partial")
+        mb = shard_size * miss_n / (1024.0 * 1024.0)
+        return Response({
+            "rebuilt_shard_ids": missing, "shard_size": shard_size,
+            "network_bytes": received,
+            "repair_network_bytes_per_mb":
+                round(received / mb, 1) if mb else 0.0,
+            "fallbacks": fallbacks,
+            "mode": "partial+fallback" if fallbacks else "partial"})
+
+    def _remote_shard_stat(self, vid: int, collection: str,
+                           sources: dict) -> int:
+        urls: list[str] = []
+        for us in sources.values():
+            for u in us:
+                if u not in urls:
+                    urls.append(u)
+        for u in self.peer_health.rank(urls):
+            try:
+                resp = http_json(
+                    "GET",
+                    f"http://{u}/admin/ec/shard_stat?volumeId={vid}"
+                    f"&collection={collection}", timeout=10)
+            except (ConnectionError, HttpError, OSError):
+                continue
+            ss = int(resp.get("shard_size", 0))
+            if ss > 0:
+                return ss
+        return 0
 
     # ---- EC client-side helpers ----
     SHARD_LOC_TTL = 5.0  # matches the replica-lookup cache tier

@@ -177,39 +177,45 @@ class Needle:
         return n
 
     def _parse_body_v2(self, body: bytes) -> None:
+        if not body:
+            return
+        data_size, = struct.unpack_from(">I", body, 0)
+        if data_size + 4 > len(body):
+            raise ValueError("index out of range")
+        self.data = bytes(body[4:4 + data_size])
+        self.parse_body_tail(body[4 + data_size:])
+
+    def parse_body_tail(self, tail: bytes) -> None:
+        """Parse flags + optional metadata from the bytes that FOLLOW
+        the data payload in a v2/3 body. Subrange reads fetch the head
+        and tail of a record without the (possibly large) data between,
+        so this must be callable on the tail slice alone."""
         idx = 0
-        if idx < len(body):
-            data_size, = struct.unpack_from(">I", body, idx)
-            idx += 4
-            if data_size + idx > len(body):
-                raise ValueError("index out of range")
-            self.data = bytes(body[idx:idx + data_size])
-            idx += data_size
-            self.flags = body[idx]
+        self.flags = tail[idx]
+        idx += 1
+        if self.has_name:
+            ln = tail[idx]
             idx += 1
-            if self.has_name:
-                ln = body[idx]
-                idx += 1
-                self.name = bytes(body[idx:idx + ln])
-                idx += ln
-            if self.has_mime:
-                ln = body[idx]
-                idx += 1
-                self.mime = bytes(body[idx:idx + ln])
-                idx += ln
-            if self.has_last_modified:
-                raw = b"\x00" * (8 - t.LAST_MODIFIED_BYTES_LENGTH) + \
-                    body[idx:idx + t.LAST_MODIFIED_BYTES_LENGTH]
-                self.last_modified, = struct.unpack(">Q", raw)
-                idx += t.LAST_MODIFIED_BYTES_LENGTH
-            if self.has_ttl:
-                self.ttl = bytes(body[idx:idx + 2])
-                idx += 2
-            if self.has_pairs:
-                ln, = struct.unpack_from(">H", body, idx)
-                idx += 2
-                self.pairs = bytes(body[idx:idx + ln])
-                idx += ln
+            self.name = bytes(tail[idx:idx + ln])
+            idx += ln
+        if self.has_mime:
+            ln = tail[idx]
+            idx += 1
+            self.mime = bytes(tail[idx:idx + ln])
+            idx += ln
+        if self.has_last_modified:
+            raw = b"\x00" * (8 - t.LAST_MODIFIED_BYTES_LENGTH) + \
+                tail[idx:idx + t.LAST_MODIFIED_BYTES_LENGTH]
+            self.last_modified, = struct.unpack(">Q", raw)
+            idx += t.LAST_MODIFIED_BYTES_LENGTH
+        if self.has_ttl:
+            self.ttl = bytes(tail[idx:idx + 2])
+            idx += 2
+        if self.has_pairs:
+            ln, = struct.unpack_from(">H", tail, idx)
+            idx += 2
+            self.pairs = bytes(tail[idx:idx + ln])
+            idx += ln
 
     def disk_size(self, version: int = CURRENT_VERSION) -> int:
         return t.get_actual_size(self.size, version)

@@ -65,6 +65,12 @@ class Store:
         self.peer_health = None
         self.shard_locations: Optional[Callable[[int], dict]] = None
         self.resilient_reads = True
+        # remote_partial_reader(vid, {sid: [coeffs]}, offset, size,
+        # n_rows) -> (n_rows, size) uint8 array | None. Injected by the
+        # volume server; lets the scrubber check parity on volumes whose
+        # data shards are spread across peers by pulling pre-reduced
+        # partial columns instead of k raw shard streams.
+        self.remote_partial_reader = None
         self._lock = threading.RLock()
         # delta channels to master (drained by the heartbeat loop)
         self.new_volumes: list[dict] = []
@@ -327,6 +333,83 @@ class Store:
         if cookie is not None and n.cookie != cookie:
             raise NotFoundError(f"cookie mismatch for needle {needle_id:x}")
         return n
+
+    def _read_record_range(self, ev: EcVolume, rec_offset: int,
+                           rel_off: int, length: int) -> bytes:
+        """Read `length` bytes starting `rel_off` into the record at
+        `rec_offset`, touching only the intervals that cover the range.
+        Each interval rides the full local -> remote -> degraded ladder,
+        so a missing shard costs one reconstruction of THIS range, not
+        of the whole record (let alone the whole large-block)."""
+        if length <= 0:
+            return b""
+        intervals = layout.locate_data(
+            layout.LARGE_BLOCK_SIZE, layout.SMALL_BLOCK_SIZE,
+            layout.DATA_SHARDS_COUNT * ev.shard_size(),
+            rec_offset + rel_off, length)
+        return b"".join(
+            self._read_one_interval(ev, iv) for iv in intervals)
+
+    def ec_needle_meta(self, vid: int, needle_id: int,
+                       cookie: Optional[int] = None
+                       ) -> tuple[Needle, int]:
+        """(needle-with-empty-data, data_size) by reading only the
+        record's head (header + data_size field) and tail (flags +
+        optional name/mime/lm/ttl/pairs) — the payload between is never
+        touched. Serves subrange degraded reads: the caller learns the
+        payload length and metadata for the price of a few dozen bytes,
+        then fetches just the requested slice. v2/3 only (a v1 record
+        has no data_size prefix); CRC is not checkable without the full
+        payload, so `checksum` stays 0."""
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise NotFoundError(f"ec volume {vid} not found")
+        if ev.version == 1:
+            raise ValueError("v1 records have no subrange layout")
+        offset, size = ev.find_needle_from_ecx(needle_id)
+        if t.size_is_deleted(size):
+            raise DeletedError(f"needle {needle_id:x} deleted")
+        head_len = t.NEEDLE_HEADER_SIZE + 4
+        head = self._read_record_range(ev, offset, 0, head_len)
+        n = Needle.parse_header(head)
+        if n.size != size:
+            raise NotFoundError(
+                f"needle {needle_id:x}: header size {n.size} != ecx {size}")
+        if cookie is not None and n.cookie != cookie:
+            raise NotFoundError(f"cookie mismatch for needle {needle_id:x}")
+        if size == 0:
+            return n, 0
+        data_size = int.from_bytes(head[t.NEEDLE_HEADER_SIZE:head_len],
+                                   "big")
+        # tail: [flags ... optional fields] up to the end of the body,
+        # plus crc (+ v3 timestamp) for completeness of append_at_ns
+        tail_off = head_len + data_size
+        tail_len = t.NEEDLE_HEADER_SIZE + size - tail_off \
+            + t.NEEDLE_CHECKSUM_SIZE \
+            + (t.TIMESTAMP_SIZE if ev.version == 3 else 0)
+        tail = self._read_record_range(ev, offset, tail_off, tail_len)
+        body_tail_len = t.NEEDLE_HEADER_SIZE + size - tail_off
+        if body_tail_len > 0:
+            n.parse_body_tail(tail[:body_tail_len])
+        if ev.version == 3 and len(tail) >= body_tail_len + 12:
+            n.append_at_ns = int.from_bytes(
+                tail[body_tail_len + 4:body_tail_len + 12], "big")
+        return n, data_size
+
+    def read_ec_needle_data_range(self, vid: int, needle_id: int,
+                                  lo: int, length: int) -> bytes:
+        """data[lo:lo+length] of an EC needle, reading (and on degraded
+        paths reconstructing) only the covering byte ranges."""
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise NotFoundError(f"ec volume {vid} not found")
+        if ev.version == 1:
+            raise ValueError("v1 records have no subrange layout")
+        offset, size = ev.find_needle_from_ecx(needle_id)
+        if t.size_is_deleted(size):
+            raise DeletedError(f"needle {needle_id:x} deleted")
+        return self._read_record_range(
+            ev, offset, t.NEEDLE_HEADER_SIZE + 4 + lo, length)
 
     def _read_one_interval(self, ev: EcVolume, iv: layout.Interval) -> bytes:
         data, shard_id = ev.read_interval(iv)

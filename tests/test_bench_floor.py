@@ -204,3 +204,23 @@ def test_replicated_write_floor(monkeypatch):
     # concurrent fan-out must beat the serial sum of the two slow legs
     assert out["replicated_write_p99_ms"] < \
         2 * out["replicated_write_slow_ms"], out
+
+
+def test_repair_network_floor():
+    """Network-frugal repair acceptance: a full-shard rebuild must land
+    <= 1.5 shard-widths of ingress at the rebuilder (one pre-reduced
+    column via the partial chain + aux slack) — not the ~len(need)
+    full widths the legacy copy+rebuild staging pays (k = 10 on a
+    fully spread layout) — and stay bit-identical to the original
+    shard. Asserting against the in-run legacy comparator keeps CI
+    variance out of the verdict."""
+    import bench
+
+    out = bench.bench_repair_network()
+    mb = 1024 * 1024
+    per_mb = out["repair_network_bytes_per_mb"]
+    assert 0 < per_mb <= 1.5 * mb, out
+    assert out["repair_partial_bit_identical"] is True, out
+    # the legacy comparator on the SAME layout pays several widths;
+    # if the chain stops pre-reducing, this gap collapses
+    assert out["repair_network_bytes_per_mb_legacy"] >= 2 * per_mb, out

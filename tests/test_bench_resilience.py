@@ -69,6 +69,7 @@ def test_main_emits_cpu_fallback_json_when_tpu_unavailable(monkeypatch,
     assert out["vs_baseline"] == 1.0
     assert out["backend"] == "cpu-fallback"
     assert "UNAVAILABLE" in out["error"]
+    assert out["tpu_fallback_reason"] == "probe_error"
 
 
 def test_main_emits_tpu_json_on_success(monkeypatch, capsys):
@@ -120,3 +121,52 @@ def test_probe_outcome_cached_for_process(tmp_path):
     second = bench.tpu_probe_with_retries(**args)
     assert first == second == (55.0, 1, None)
     assert marker.read_text() == "1"
+
+
+def test_probe_skips_fast_on_device_put_regression():
+    # A device_put failure is deterministic for the process AND the
+    # machine state — the child reports a skip (rc 0, tpu_mbps null)
+    # and the parent must not burn the rest of the retry schedule.
+    mbps, attempts, err = bench.tpu_probe_with_retries(
+        delays=(0, 0, 0, 0),
+        argv_prefix=[
+            sys.executable, "-c",
+            "import json; print(json.dumps({'tpu_mbps': None,"
+            " 'tpu_fallback_reason': 'device_put',"
+            " 'error': 'RuntimeError(device_put to TPU failed)'}))"],
+        sleep=lambda s: None)
+    assert mbps is None
+    assert attempts == 1
+    assert "device_put" in err
+
+
+def test_tpu_probe_child_skips_on_device_put(monkeypatch, capsys):
+    import pytest
+
+    def boom():
+        raise RuntimeError("device_put: transfer to TPU failed")
+
+    monkeypatch.setattr(bench, "bench_tpu", boom)
+    assert bench.main(["--tpu-probe"]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["tpu_mbps"] is None
+    assert out["tpu_fallback_reason"] == "device_put"
+
+    # any OTHER crash still crashes loudly (rc != 0 in the real child:
+    # the parent's retry schedule exists exactly for those)
+    def other():
+        raise ValueError("relay handshake garbled")
+
+    monkeypatch.setattr(bench, "bench_tpu", other)
+    with pytest.raises(ValueError):
+        bench.main(["--tpu-probe"])
+
+
+def test_classify_tpu_failure_reasons():
+    assert bench.classify_tpu_failure(None) is None
+    assert bench.classify_tpu_failure(
+        "attempt 1: device_put: RuntimeError(...)") == "device_put"
+    assert bench.classify_tpu_failure(
+        "attempt 1: timeout after 300s") == "relay_timeout"
+    assert bench.classify_tpu_failure(
+        "rc=1: backend init UNAVAILABLE") == "probe_error"

@@ -479,3 +479,75 @@ def test_metrics_registry_idempotent_registration():
         r.gauge("t", "hits", "h", ("k",))  # same name, different type
     with pytest.raises(ValueError):
         r.counter("t", "hits", "h", ("other",))  # different labels
+
+
+def test_per_class_tenant_rates():
+    """A class-scoped tenant bucket (e.g. throttle BACKGROUND per
+    tenant without touching interactive traffic) overrides the global
+    tenant bucket for that class only."""
+    g = QosGovernor(enabled=True)  # global tenant bucket unlimited
+    g.configure(tenant_class_rates={BACKGROUND: 1.0},
+                tenant_class_bursts={BACKGROUND: 2.0})
+    bg = [g.admit(BACKGROUND, tenant="carol") for _ in range(4)]
+    oks = [x.ok for x in bg]
+    assert oks[:2] == [True, True] and not all(oks)
+    shed = next(x for x in bg if not x.ok)
+    assert shed.reason == "tenant" and shed.retry_after > 0
+    for x in bg:
+        if x.ok:
+            x.release()
+    # same tenant, different class: global (unlimited) bucket applies
+    a = g.admit(INTERACTIVE, tenant="carol")
+    assert a.ok
+    a.release()
+    snap = g.snapshot()
+    assert BACKGROUND in snap["tenant_class_buckets"]
+    assert snap["tenant_class_buckets"][BACKGROUND]["rate"] == 1.0
+    # rate <= 0 drops the override; class falls back to the global
+    g.configure(tenant_class_rates={BACKGROUND: 0})
+    assert BACKGROUND not in g.snapshot()["tenant_class_buckets"]
+    assert all(g.admit(BACKGROUND, tenant="carol").ok for _ in range(5))
+
+
+def test_master_serving_edge_sheds_and_stays_observable(vs_cluster):
+    """The master's QoS governor gates its serving edge (/dir/*), while
+    control-plane paths stay exempt and /cluster/qos shows the edge."""
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+    master, vs, mc = vs_cluster
+    res = operation.upload_data(mc, b"q" * 256)
+    vid = res.fid.split(",")[0]
+
+    snap = http_json("GET", f"http://{master.url}/admin/qos")
+    assert snap["enabled"] is True
+    out = http_json("POST", f"http://{master.url}/admin/qos",
+                    {"min_limit": 8, "max_limit": 8, "limit": 8})
+    assert out["limit"] == 8
+
+    held = [master.qos.admit(INTERACTIVE) for _ in range(7)]
+    assert all(h.ok for h in held)
+    try:
+        status, _, hdrs = http_call(
+            "GET", f"http://{master.url}/dir/lookup?volumeId={vid}")
+        assert status == 503
+        ra = {k.lower(): v for k, v in hdrs.items()}.get("retry-after")
+        assert ra is not None and float(ra) > 0
+        # exempt control plane keeps serving while the edge sheds
+        cq = http_json("GET", f"http://{master.url}/cluster/qos")
+        assert "master_edge" in cq and cq["master_edge"]["limit"] == 8
+        status, _, _ = http_call("GET", f"http://{master.url}/metrics")
+        assert status == 200
+        # background still fits in its reserved slot
+        status, _, _ = http_call(
+            "GET", f"http://{master.url}/dir/lookup?volumeId={vid}",
+            headers={"X-Weed-Class": "background"})
+        assert status == 200
+    finally:
+        for h in held:
+            h.release()
+    status, _, _ = http_call(
+        "GET", f"http://{master.url}/dir/lookup?volumeId={vid}")
+    assert status == 200
+    snap = master.qos.snapshot()
+    assert sum(c["shed"] for c in snap["classes"].values()) >= 1

@@ -1,0 +1,19 @@
+"""Slow-marked wrapper around tools/repair_drill.py: a shard rebuild
+over a bandwidth-capped link (netchaos ChaosProxy pacing + the repair
+queue's own repair_rate_mbps TokenBucket) must finish inside the
+budget ~2 charged shard-widths buy — the whole point of shipping
+pre-reduced columns instead of staging len(need) full shards."""
+
+import pytest
+
+
+@pytest.mark.slow
+def test_repair_completes_within_capped_budget():
+    from tools.repair_drill import run_drill
+
+    out = run_drill(cap_mbps=2.0, n_files=6, overhead_s=10.0)
+    assert out["ok"]
+    assert out["elapsed_s"] <= out["budget_s"], out
+    # the capped link saw ~one pre-reduced column, not the shard spread
+    assert out["proxy_bytes_down"] <= 1.5 * out["shard_size"], out
+    assert 0 < out["repair_network_bytes_per_mb"] <= 1.5 * 1024 * 1024

@@ -181,6 +181,65 @@ def test_scrub_identifies_corrupt_ec_shard(tmp_path):
     store.close()
 
 
+def test_scrub_remote_assisted_ec(tmp_path):
+    """A node holding only SOME data columns (spread deployment) scrubs
+    anyway: absent columns' parity contribution arrives as one
+    pre-reduced remote partial. Clean groups pass, a corrupt local
+    parity is flagged, and an unreachable remote skips (never a false
+    positive)."""
+    import shutil
+
+    from seaweedfs_tpu.ops.rs_cpu import gf_partial_product
+
+    store = Store([str(tmp_path / "a")])
+    v = _fill_volume(store, 1, 4, 256 * KB)
+    base = v.file_name()
+    ecenc.write_ec_files(base, store.coder)
+    b_dir = tmp_path / "b"
+    b_dir.mkdir()
+    for sid in range(5, 10):  # data columns 5..9 live elsewhere
+        shutil.move(base + layout.shard_ext(sid),
+                    str(b_dir / f"1{layout.shard_ext(sid)}"))
+    b = Store([str(b_dir)])
+    b.mount_ec_shards("", 1, list(range(5, 10)))
+    store.mount_ec_shards("", 1, [0, 1, 2, 3, 4, 10, 11, 12, 13])
+
+    def remote_partial(vid, coeff_by_sid, offset, size, n_rows):
+        ev = b.find_ec_volume(vid)
+        acc = np.zeros((n_rows, size), dtype=np.uint8)
+        for sid, coeffs in coeff_by_sid.items():
+            data = ev.shards[sid].read_at(offset, size)
+            gf_partial_product(
+                np.asarray(coeffs, dtype=np.uint8)[:, None],
+                np.frombuffer(data, dtype=np.uint8)[None, :], out=acc)
+        return acc
+
+    store.remote_partial_reader = remote_partial
+    s = Scrubber(store, rate_bytes_per_sec=0)
+    out = s.run_once(volume_id=1)
+    reps = [r for r in out["volumes"] if r.get("ec")]
+    assert reps and reps[0].get("remote_assisted"), reps
+    assert reps[0].get("complete") and not out["corruptions"], out
+
+    # corrupt a LOCAL parity shard: the remote-assisted check catches
+    # the mismatch (unidentified -> reported as the parity set)
+    corrupt.flip_bits(base + layout.shard_ext(12), seed=4)
+    out = s.run_once(volume_id=1)
+    evs = [c for c in out["corruptions"] if c["type"] == "ec_shard"]
+    assert evs and 12 in evs[0]["shard_ids"], out
+    assert "remote-assisted" in evs[0]["detail"]
+    corrupt.flip_bits(base + layout.shard_ext(12), seed=4)  # undo
+
+    # remote contribution unobtainable -> skip the volume, no report
+    store.remote_partial_reader = lambda *a: None
+    out = s.run_once(volume_id=1)
+    reps = [r for r in out["volumes"] if r.get("ec")]
+    assert reps[0].get("skipped") == "remote partial unavailable", reps
+    assert not out["corruptions"]
+    store.close()
+    b.close()
+
+
 # ---------------- repair queue ----------------
 
 

@@ -1,0 +1,187 @@
+"""Bandwidth-capped EC repair drill: prove a shard rebuild FITS the
+cluster's repair budget when the link is the bottleneck.
+
+Topology (all in-process): vs1 encodes an EC volume and keeps shards
+0-2/11-13, shards 3-6 live on vs2 (direct), shards 7-10 on vs3 —
+reached only through a tools/netchaos.py ChaosProxy whose
+bandwidth_bps pacing caps the rebuilder's ingress link. One shard on
+vs2 is deleted and the master's repair queue drives the
+partial-column rebuild on vs1 while its own TokenBucket (the
+`repair_rate_mbps` cluster budget, which starts EMPTY, so every byte
+is paid for at the configured rate) throttles the choreography.
+
+The drill asserts the rebuild completes inside a wall-clock budget
+derived from that token bucket: ~2 shard-widths of charged bytes
+(1 width of pre-reduced column ingress + 1 width of rebuilt shard)
+plus fixed orchestration overhead. The legacy copy+rebuild staging
+charges (len(need) + 1) widths over the same capped link — the
+reported `legacy_estimate_s` shows how far outside the budget the
+old choreography lands as the spread grows.
+
+Usage:
+  PYTHONPATH=. python tools/repair_drill.py [--cap-mbps 2.0]
+      [--files 6] [--overhead-s 10]
+
+Also runnable as a slow-marked test: tests/test_repair_drill.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+MB = 1024 * 1024
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_drill(cap_mbps: float = 2.0, n_files: int = 6,
+              overhead_s: float = 10.0) -> dict:
+    """Returns the drill report; raises AssertionError if the rebuild
+    misses the token-bucket budget or the rebuilt shard differs."""
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.shell.commands import ShellContext
+    from seaweedfs_tpu.storage.erasure_coding import layout
+    from seaweedfs_tpu.utils.httpd import http_json
+    from tools.netchaos import ChaosProxy
+
+    rate = cap_mbps * MB
+    rng = np.random.default_rng(31)
+    with tempfile.TemporaryDirectory() as d:
+        master = MasterServer(volume_size_limit_mb=64,
+                              repair_rate_mbps=cap_mbps)
+        master.start()
+        vs1 = VolumeServer([os.path.join(d, "v1")], master.url)
+        vs1.start()
+        mc = MasterClient(master.url, cache_ttl=0.0)
+        res = operation.upload_data(mc, b"seed")
+        vid = int(res.fid.split(",")[0])
+        for _ in range(n_files):
+            a = mc.assign()
+            data = rng.integers(0, 256, int(rng.integers(100, 200)) *
+                                1024, dtype=np.uint8).tobytes()
+            operation.upload_to(a["fid"], a["url"], data)
+
+        sh = ShellContext(master.url, use_grpc=False)
+        sh.ec_encode(vid=vid)
+
+        vs2 = VolumeServer([os.path.join(d, "v2")], master.url)
+        vs2.start()
+        vs3_port = _free_port()
+        proxy = ChaosProxy("127.0.0.1", vs3_port,
+                           bandwidth_bps=rate).start()
+        vs3 = VolumeServer([os.path.join(d, "v3")], master.url,
+                           port=vs3_port, advertise=proxy.url)
+        vs3.start()
+
+        moves = {vs2: [3, 4, 5, 6], vs3: [7, 8, 9, 10]}
+        for vs, sids in moves.items():
+            direct = f"{vs.http.host}:{vs.http.port}"
+            http_json("POST", f"http://{direct}/admin/ec/copy",
+                      {"volume_id": vid, "shard_ids": sids,
+                       "source_data_node": f"{vs1.http.host}:"
+                                           f"{vs1.http.port}",
+                       "copy_ecx_file": True})
+            http_json("POST", f"http://{direct}/admin/ec/mount",
+                      {"volume_id": vid, "shard_ids": sids})
+        moved = [s for sids in moves.values() for s in sids]
+        http_json("POST", f"http://{vs1.url}/admin/ec/unmount",
+                  {"volume_id": vid, "shard_ids": moved})
+        http_json("POST", f"http://{vs1.url}/admin/ec/delete_shards",
+                  {"volume_id": vid, "shard_ids": moved})
+        time.sleep(0.3)
+
+        victim = 4
+        shard_path = os.path.join(
+            d, "v2", f"{vid}{layout.shard_ext(victim)}")
+        with open(shard_path, "rb") as f:
+            golden = f.read()
+        shard_size = len(golden)
+        direct2 = f"{vs2.http.host}:{vs2.http.port}"
+        http_json("POST", f"http://{direct2}/admin/ec/unmount",
+                  {"volume_id": vid, "shard_ids": [victim]})
+        http_json("POST", f"http://{direct2}/admin/ec/delete_shards",
+                  {"volume_id": vid, "shard_ids": [victim]})
+
+        # budget: the queue's token bucket charges ingress + rebuilt
+        # bytes (~2 widths for the partial chain, starting from an
+        # empty bucket) and the capped link adds ~1 width of transfer;
+        # 3 widths + fixed orchestration overhead is the ceiling.
+        budget_s = 3.0 * shard_size / rate + overhead_s
+        q = master.repair_queue
+        assert q.partial_repair, "drill needs the partial path enabled"
+        t0 = time.perf_counter()
+        q.submit(vid, "", reason="drill:capped-link")
+        deadline = time.time() + budget_s + 30
+        try:
+            while time.time() < deadline:
+                st = q.status()
+                if st["repaired_total"] >= 1 and not st["in_flight"]:
+                    break
+                q._dispatch()
+                time.sleep(0.05)
+            elapsed = time.perf_counter() - t0
+            st = q.status()
+            rebuilt_path = os.path.join(
+                d, "v1", f"{vid}{layout.shard_ext(victim)}")
+            assert st["repaired_total"] >= 1, f"repair stalled: {st}"
+            assert st["partial_repairs"] >= 1, \
+                f"partial path did not run: {st}"
+            with open(rebuilt_path, "rb") as f:
+                assert f.read() == golden, "rebuilt shard differs"
+            per_mb = st["last_repair_network_bytes_per_mb"]
+            assert 0 < per_mb <= 1.5 * MB, per_mb
+            assert elapsed <= budget_s, (
+                f"rebuild took {elapsed:.1f}s, budget {budget_s:.1f}s "
+                f"at {cap_mbps} MB/s")
+        finally:
+            mc.stop()
+            for vs in (vs3, vs2, vs1):
+                vs.stop()
+            proxy.stop()
+            master.stop()
+        # what the copy+rebuild staging would charge on this layout:
+        # len(need)=6 source widths + 1 rebuilt width through the bucket
+        legacy_estimate_s = 7.0 * shard_size / rate + overhead_s / 2
+        return {
+            "cap_mbps": cap_mbps,
+            "shard_size": shard_size,
+            "elapsed_s": round(elapsed, 2),
+            "budget_s": round(budget_s, 2),
+            "legacy_estimate_s": round(legacy_estimate_s, 2),
+            "repair_network_bytes_per_mb": per_mb,
+            "proxy_bytes_down": proxy.stats.get("bytes_down", 0),
+            "ok": True,
+        }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--cap-mbps", type=float, default=2.0,
+                   help="link + token-bucket rate (MB/s)")
+    p.add_argument("--files", type=int, default=6)
+    p.add_argument("--overhead-s", type=float, default=10.0,
+                   help="fixed orchestration allowance in the budget")
+    args = p.parse_args(argv)
+    out = run_drill(cap_mbps=args.cap_mbps, n_files=args.files,
+                    overhead_s=args.overhead_s)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
