@@ -224,6 +224,31 @@ def _p99_ms(samples_s: list) -> float:
     return round(xs[min(len(xs) - 1, int(len(xs) * 0.99))] * 1000, 1)
 
 
+def _stage_breakdown(tracers, t_mark: float) -> dict:
+    """Per-stage latency from the in-process flight recorders: spans
+    started after `t_mark` (one fully-sampled untimed op run after the
+    timed loop, so instrumentation cost never taints the headline
+    numbers), aggregated by span name across every node's recorder.
+    Per-request identifiers (fid, host:port) are collapsed so the 16
+    chunk POSTs of one PUT land in a single stage row."""
+    import re
+    fid = re.compile(r"/\d+,[0-9a-f]+")
+    host = re.compile(r"http://[^/ ]+")
+    stages: dict = {}
+    for tr in tracers:
+        for s in tr.snapshot(limit=4096)["spans"]:
+            if s["start"] < t_mark:
+                continue
+            name = host.sub("http://<node>", fid.sub("/<fid>", s["name"]))
+            st = stages.setdefault(name,
+                                   {"count": 0, "total_ms": 0.0})
+            st["count"] += 1
+            st["total_ms"] += s["duration_ms"]
+    return {name: {"count": st["count"],
+                   "total_ms": round(st["total_ms"], 2)}
+            for name, st in sorted(stages.items())}
+
+
 def bench_degraded_read(n_reads: int = 30,
                         straggler_ms: float = 200.0) -> dict:
     """EC degraded-read tail latency under one injected straggler.
@@ -328,6 +353,14 @@ def bench_degraded_read(n_reads: int = 30,
             vs1.resilient_reads = True
             vs1.store.resilient_reads = True
             hedged = measure()
+            # where the degraded-read time goes: one fully-sampled
+            # extra read, broken down by span across all three nodes
+            for node in (vs1, vs2, vs3):
+                node.tracer.sample_rate = 1.0
+            t_mark = time.time()
+            http_call("GET", f"http://{vs1.url}/{fid}", timeout=30)
+            breakdown = _stage_breakdown(
+                (vs1.tracer, vs2.tracer, vs3.tracer), t_mark)
         finally:
             mc.stop()
             for vs in (vs3, vs2, vs1):
@@ -342,6 +375,7 @@ def bench_degraded_read(n_reads: int = 30,
                                        2),
         "degraded_read_straggler_ms": straggler_ms,
         "degraded_read_n": n_reads,
+        "degraded_read_stage_breakdown_ms": breakdown,
     }
 
 
@@ -533,6 +567,15 @@ def bench_filer_put(size_mb: int = 4, chunk_kb: int = 256,
             par_s = put_and_verify("parallel.bin")
             fs.parallel_uploads = False
             ser_s = put_and_verify("serial.bin")
+            # where the PUT time goes: one fully-sampled extra upload
+            # (parallel mode), broken down by span across the stack
+            fs.parallel_uploads = True
+            for node in (fs, vs, master):
+                node.tracer.sample_rate = 1.0
+            t_mark = time.time()
+            put_and_verify("breakdown.bin")
+            breakdown = _stage_breakdown(
+                (fs.tracer, vs.tracer, master.tracer), t_mark)
         finally:
             fs.stop()
             vs.stop()
@@ -546,6 +589,7 @@ def bench_filer_put(size_mb: int = 4, chunk_kb: int = 256,
         "filer_put_chunks": (size + chunk_kb * 1024 - 1)
         // (chunk_kb * 1024),
         "filer_put_rtt_ms": rtt_ms,
+        "filer_put_stage_breakdown_ms": breakdown,
     }
 
 

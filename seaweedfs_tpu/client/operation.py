@@ -12,6 +12,7 @@ import urllib.parse
 from typing import Optional
 
 from seaweedfs_tpu.client.wdclient import MasterClient
+from seaweedfs_tpu.utils import tracing
 from seaweedfs_tpu.utils.httpd import HttpError, http_call
 from seaweedfs_tpu.utils.resilience import hedged
 
@@ -34,13 +35,14 @@ def upload_data(mc: MasterClient, data: bytes, name: str = "",
     # batched assigns: one master round trip mints a pool of keys, so
     # the hot path is a single volume-server POST per file (reference
     # clients amortize the assign plane the same way via gRPC)
-    a = mc.assign_batched(collection=collection, replication=replication,
-                          ttl=ttl)
-    if "error" in a and a["error"]:
-        raise RuntimeError(a["error"])
-    fid, url = a["fid"], a["url"]
-    return upload_to(fid, url, data, name=name, mime=mime, compress=compress,
-                     auth=a.get("auth", ""))
+    with tracing.child_scope("client.upload_data"):
+        a = mc.assign_batched(collection=collection,
+                              replication=replication, ttl=ttl)
+        if "error" in a and a["error"]:
+            raise RuntimeError(a["error"])
+        fid, url = a["fid"], a["url"]
+        return upload_to(fid, url, data, name=name, mime=mime,
+                         compress=compress, auth=a.get("auth", ""))
 
 
 def upload_to(fid: str, server_url: str, data: bytes, name: str = "",
@@ -97,6 +99,7 @@ def read_data(mc: MasterClient, fid: str,
         return None
 
     health = mc.peer_health
+    tracing.annotate("read.replicas", len(urls))
     out = hedged(fetch, health.rank(urls), health=health)
     if out is not None:
         return out

@@ -35,7 +35,7 @@ from typing import Optional
 from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
 from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.qos import INTERACTIVE, WRITE, QosGovernor
-from seaweedfs_tpu.utils import glog
+from seaweedfs_tpu.utils import glog, tracing
 from seaweedfs_tpu.utils.httpd import HttpServer, Request, Response
 
 BUCKETS_PATH = "/buckets"
@@ -100,7 +100,9 @@ class S3Server:
     def __init__(self, filer_server, host: str = "127.0.0.1", port: int = 0,
                  access_key: str = "", secret_key: str = "",
                  circuit_breaker: Optional[CircuitBreaker] = None,
-                 qos: bool = True):
+                 qos: bool = True,
+                 tracing_enabled: bool = True,
+                 trace_sample: float = 0.01):
         # filer_server: in-process FilerServer (gateway composes chunk
         # lists directly; the data path still flows through volume servers)
         self.fs = filer_server
@@ -136,11 +138,22 @@ class S3Server:
         self.metrics_http.add("GET", "/admin/qos", self._handle_qos)
         self.metrics_http.add("POST", "/admin/qos",
                               self._handle_qos_configure)
+        # tracing: spans mint on the public port's dispatch; the flight
+        # recorder rides the private listener like /metrics (the public
+        # port is all bucket namespace and must not leak trace data)
+        self.tracer = tracing.Tracer(
+            node=f"s3@{host}:{port}", enabled=tracing_enabled,
+            sample_rate=trace_sample)
+        self.http.tracer = self.tracer
+        self.metrics_http.tracer = self.tracer
+        from seaweedfs_tpu.utils.debug import install_debug_routes
+        install_debug_routes(self.metrics_http)
         self._register_routes()
 
     def start(self) -> None:
         self.http.start()
         self.metrics_http.start()
+        self.tracer.node = f"s3@{self.http.host}:{self.http.port}"
         glog.info("s3 gateway up at %s (metrics=%s)", self.url,
                   self.metrics_url)
 
@@ -716,7 +729,8 @@ class S3Server:
         if len(data) <= 2048:
             entry.content = data
         else:
-            entry.chunks = self.fs._upload_chunks(data, bucket, "")
+            entry.chunks = self.fs._upload_chunks(
+                data, bucket, self.fs.default_replication)
         self.filer.create_entry(entry)
         return None, md5.hex()
 
@@ -795,7 +809,8 @@ class S3Server:
             if not entry.attr.md5:
                 # multipart-composed sources carry no plain md5
                 entry.attr.md5 = hashlib.md5(data).digest()
-            entry.chunks = self.fs._upload_chunks(data, bucket, "")
+            entry.chunks = self.fs._upload_chunks(
+                data, bucket, self.fs.default_replication)
         self.filer.create_entry(entry)
         root = ET.Element("CopyObjectResult")
         ET.SubElement(root, "ETag").text = f'"{entry.attr.md5.hex()}"'
@@ -867,7 +882,8 @@ class S3Server:
         if len(data) <= 2048:
             entry.content = data
         else:
-            entry.chunks = self.fs._upload_chunks(data, bucket, "")
+            entry.chunks = self.fs._upload_chunks(
+                data, bucket, self.fs.default_replication)
         self.filer.create_entry(entry)
         return Response(b"", headers={"ETag": f'"{md5.hex()}"'})
 
@@ -888,7 +904,8 @@ class S3Server:
         for p in parts:
             if p.content:
                 # inline content gets re-uploaded as a chunk
-                up = self.fs._upload_chunks(p.content, bucket, "")
+                up = self.fs._upload_chunks(
+                    p.content, bucket, self.fs.default_replication)
                 for c in up:
                     c.offset += offset
                     chunks.append(c)

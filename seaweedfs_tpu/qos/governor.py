@@ -28,6 +28,7 @@ from typing import Optional
 
 from seaweedfs_tpu.qos.classes import BACKGROUND, CLASSES, INTERACTIVE, WRITE
 from seaweedfs_tpu.qos.limiter import AdaptiveLimiter
+from seaweedfs_tpu.utils import tracing
 
 # pressure decays with this half-life after the last shed event
 _SHED_HALF_LIFE_S = 5.0
@@ -189,6 +190,7 @@ class QosGovernor:
                     self._shed_tenant += 1
                 if self._m_shed:
                     self._m_shed.inc(cls, "tenant")
+                tracing.annotate("qos.verdict", "shed:tenant")
                 return Grant(False, retry_after=max(0.05, ra),
                              reason="tenant")
         with self._lock:
@@ -198,6 +200,13 @@ class QosGovernor:
                 if self._m_admitted:
                     self._m_admitted.inc(cls)
                 t0 = time.monotonic()
+                # the admission verdict lands on the ambient server
+                # span (annotate is a ContextVar read when no trace)
+                tracing.annotate("qos.verdict", "admitted")
+                tracing.annotate("qos.class", cls)
+                tracing.annotate(
+                    "qos.queue_delay_ms",
+                    round(self.limiter.queue_delay() * 1000.0, 3))
                 return Grant(True,
                              release_fn=lambda: self._release(cls, t0))
             self._shed[cls] += 1
@@ -207,6 +216,8 @@ class QosGovernor:
         # polite hint: roughly the time for the queue estimate to
         # drain, bounded so clients neither hammer nor stall
         ra = min(5.0, max(0.2, 2.0 * self.limiter.queue_delay()))
+        tracing.annotate("qos.verdict", "shed:limit")
+        tracing.annotate("qos.class", cls)
         return Grant(False, retry_after=ra, reason="limit")
 
     def _release(self, cls: str, t0: float) -> None:

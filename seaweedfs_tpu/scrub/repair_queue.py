@@ -23,7 +23,7 @@ import time
 
 from seaweedfs_tpu.qos import BACKGROUND, class_scope
 from seaweedfs_tpu.storage.erasure_coding import layout
-from seaweedfs_tpu.utils import glog
+from seaweedfs_tpu.utils import glog, tracing
 from seaweedfs_tpu.utils.httpd import http_json
 from seaweedfs_tpu.utils.limiter import TokenBucket
 from seaweedfs_tpu.utils.resilience import Deadline
@@ -257,6 +257,26 @@ class RepairQueue:
                              daemon=True).start()
 
     def _run(self, task: RepairTask) -> None:
+        # each repair job is its own (always-sampled) trace root:
+        # repairs are rare, expensive, and exactly what the flight
+        # recorder exists to explain — every /admin/ec/* hop and the
+        # reduction-chain fan-out downstream stitch under this id
+        tracer = getattr(self.master, "tracer", None)
+        span = tracer.root_span(f"repair.rebuild vid={task.vid}",
+                                sampled=True) \
+            if tracer is not None else tracing.NOOP
+        status, err = 200, ""
+        tok = tracing.attach(span)
+        try:
+            self._run_traced(task, span)
+        except BaseException as e:  # pragma: no cover - _run_traced
+            status, err = 500, f"{type(e).__name__}: {e}"  # swallows
+            raise
+        finally:
+            tracing.detach(tok)
+            span.finish(status=status, error=err)
+
+    def _run_traced(self, task: RepairTask, span) -> None:
         try:
             moved = self._repair(task)
         except Exception as e:
@@ -270,11 +290,14 @@ class RepairQueue:
                 self._tasks[task.vid] = task
                 self.failed_total += 1
             self._c_repairs.inc("failed")
+            span.annotate("repair.error", str(e))
             glog.warning("ec repair vol %d attempt %d failed "
                          "(backoff %.1fs): %s",
                          task.vid, task.attempts, backoff, e)
             return
         lag = time.time() - task.enqueued_at
+        span.annotate("repair.bytes_moved", moved)
+        span.annotate("repair.lag_s", round(lag, 3))
         with self._lock:
             del self._in_flight[task.vid]
             self.repaired_total += 1

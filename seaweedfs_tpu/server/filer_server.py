@@ -36,7 +36,7 @@ from seaweedfs_tpu.filer.filer_conf import FilerConf, PathConf
 from seaweedfs_tpu.filer.filerstore import make_store
 from seaweedfs_tpu.qos import (BACKGROUND, QosGovernor, class_scope,
                                classify, current_class, from_headers)
-from seaweedfs_tpu.utils import glog
+from seaweedfs_tpu.utils import glog, tracing
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call)
 from seaweedfs_tpu.utils.resilience import (Deadline, PeerHealth,
@@ -76,7 +76,9 @@ class FilerServer:
                  store_dir: Optional[str] = None,
                  default_replication: str = "", cipher: bool = False,
                  announce: bool = True, grpc_port: Optional[int] = None,
-                 qos: bool = True):
+                 qos: bool = True,
+                 tracing_enabled: bool = True,
+                 trace_sample: float = 0.01):
         # qos=False disables admission control entirely (the
         # bit-for-bit comparator, same convention as parallel_uploads)
         # cipher=True encrypts every chunk (AES-256-GCM, per-chunk key in
@@ -156,11 +158,23 @@ class FilerServer:
         # route there would shadow a stored file of that name
         self.metrics_http = HttpServer(host, 0)
         self.metrics_http.add("GET", "/metrics", self._handle_metrics)
+        # tracing: spans are minted on the MAIN port's dispatch, but the
+        # flight recorder is served from the metrics listener (the main
+        # port is user namespace — /debug/traces there would shadow a
+        # stored file of that name, same reason as /metrics above)
+        self.tracer = tracing.Tracer(
+            node=f"filer@{host}:{port}", enabled=tracing_enabled,
+            sample_rate=trace_sample)
+        self.http.tracer = self.tracer
+        self.metrics_http.tracer = self.tracer
+        from seaweedfs_tpu.utils.debug import install_debug_routes
+        install_debug_routes(self.metrics_http)
         self._register_routes()
 
     def start(self) -> None:
         self.http.start()
         self.metrics_http.start()
+        self.tracer.node = f"filer@{self.http.host}:{self.http.port}"
         glog.info("filer server up at %s (store=%s, metrics=%s)",
                   self.url, self.filer.store.name, self.metrics_url)
         if self._grpc_port_arg is not None:
@@ -433,13 +447,17 @@ class FilerServer:
         pool = self._get_upload_pool()
         chunks: list[Optional[FileChunk]] = [None] * len(offsets)
         # contextvars don't cross the pool: capture the request's QoS
-        # class here and re-enter it in each worker so the chunk PUTs
-        # carry the same X-Weed-Class as their parent (the deadline
-        # header rides the same pattern via Deadline propagation)
+        # class AND trace span here and re-enter both in each worker so
+        # the chunk PUTs carry the same X-Weed-Class / X-Weed-Trace as
+        # their parent (the deadline header rides the same pattern via
+        # Deadline propagation)
         upload_cls = current_class()
+        upload_span = tracing.current_span()
+        if upload_span is not None:
+            upload_span.annotate("chunks.fanout", len(offsets))
 
         def upload_in_class(a, piece, off):
-            with class_scope(upload_cls):
+            with class_scope(upload_cls), tracing.span_scope(upload_span):
                 return self._upload_one_chunk(a, piece, off)
 
         futures = {

@@ -27,7 +27,7 @@ from seaweedfs_tpu.qos import (BACKGROUND, INTERACTIVE, WRITE, QosGovernor,
 from seaweedfs_tpu.cluster.volume_growth import (NoFreeSpaceError,
                                                  grow_by_type)
 from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
-from seaweedfs_tpu.utils import glog
+from seaweedfs_tpu.utils import glog, tracing
 from seaweedfs_tpu.utils.httpd import (HttpServer, Request, Response,
                                        http_json)
 from seaweedfs_tpu.utils.resilience import Deadline, PeerHealth
@@ -44,7 +44,9 @@ class MasterServer:
                  meta_dir: str = "", grpc_port: Optional[int] = None,
                  repair_rate_mbps: float = 0.0,
                  partial_repair: bool = True,
-                 qos: bool = True):
+                 qos: bool = True,
+                 tracing_enabled: bool = True,
+                 trace_sample: float = 0.01):
         self.topo = Topology(volume_size_limit=volume_size_limit_mb * 1024 * 1024)
         self.jwt_signing_key = jwt_signing_key
         from seaweedfs_tpu.utils.metrics import Registry
@@ -89,6 +91,11 @@ class MasterServer:
         self._m_qos_shed = self.metrics.counter(
             "master", "qos_shed_total", "requests shed at the master edge")
         self.http.admission_gate = self._admission_gate
+        # distributed-tracing flight recorder; served at /debug/traces
+        self.tracer = tracing.Tracer(
+            node=f"master@{host}:{port}", enabled=tracing_enabled,
+            sample_rate=trace_sample)
+        self.http.tracer = self.tracer
         self._register_routes()
         self._stop = threading.Event()
         self._pruner: Optional[threading.Thread] = None
@@ -113,6 +120,7 @@ class MasterServer:
     # ---- lifecycle ----
     def start(self) -> None:
         self.http.start()
+        self.tracer.node = f"master@{self.http.host}:{self.http.port}"
         if self._grpc_port is not None:
             from seaweedfs_tpu.server.master_grpc import start_master_grpc
             self._grpc_server, self.grpc_port = start_master_grpc(
@@ -727,12 +735,18 @@ class MasterServer:
         shards = self.topo.lookup_ec_shards(vid)
         if shards is None:
             return Response({"error": "ec volume not found"}, status=404)
+        # each location carries its holder's heartbeat-reported QoS
+        # pressure so chain planners can tie-break away from loaded
+        # holders without extra round trips
         return Response({
             "volumeId": vid,
             "shards": [
                 {"shard_id": sid,
-                 "locations": [{"url": n.url, "publicUrl": n.public_url}
-                               for n in nodes]}
+                 "locations": [
+                     {"url": n.url, "publicUrl": n.public_url,
+                      "qos_pressure": round(
+                          getattr(n, "qos_pressure", 0.0), 4)}
+                     for n in nodes]}
                 for sid, nodes in enumerate(shards)],
         })
 

@@ -37,7 +37,7 @@ from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.volume import (CookieMismatchError, DeletedError,
                                           NotFoundError)
-from seaweedfs_tpu.utils import glog
+from seaweedfs_tpu.utils import glog, tracing
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call, http_json)
 from seaweedfs_tpu.utils.resilience import (Deadline, PeerHealth,
@@ -81,7 +81,9 @@ class VolumeServer:
                  resilient_reads: bool = True,
                  parallel_replication: bool = True,
                  fsync: bool = False,
-                 qos: bool = True):
+                 qos: bool = True,
+                 tracing_enabled: bool = True,
+                 trace_sample: float = 0.01):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
         the volume_server_pb gRPC admin plane (0 = ephemeral).
@@ -113,7 +115,11 @@ class VolumeServer:
         qos toggles the admission-control governor (adaptive
         concurrency limit + class-weighted shedding, see
         seaweedfs_tpu/qos/); off = today's queue-everything behavior,
-        kept as the overload-bench comparator."""
+        kept as the overload-bench comparator.
+        tracing_enabled/trace_sample control the distributed-tracing
+        flight recorder (utils/tracing.py): head-sample rate for
+        guaranteed retention; slow/error spans are kept regardless.
+        Off = the shared NOOP span, zero allocation per request."""
         urls = (master_url.split(",") if isinstance(master_url, str)
                 else list(master_url))
         self.master_urls = [u.strip() for u in urls if u.strip()]
@@ -165,7 +171,8 @@ class VolumeServer:
         # outbound call (masters and peer volume servers alike)
         self.retry = RetryPolicy()
         # vid -> (expires_monotonic, {shard_id: [peer urls]})
-        self._shard_loc_cache: dict[int, tuple[float, dict]] = {}
+        # vid -> (expires_monotonic, {shard_id: [urls]}, {url: pressure})
+        self._shard_loc_cache: dict[int, tuple] = {}
         self._scrub_rate = scrub_rate_mbps * 1024 * 1024
         self._scrub_interval = scrub_interval_s
         self.scrubber = None
@@ -193,10 +200,16 @@ class VolumeServer:
         # socket edge, before their body is buffered
         self.qos = QosGovernor(metrics=self.metrics, enabled=qos)
         self.http.admission_gate = self._admission_gate
+        # distributed-tracing flight recorder; served at /debug/traces
+        self.tracer = tracing.Tracer(
+            node=f"volume@{host}:{port}", enabled=tracing_enabled,
+            sample_rate=trace_sample)
+        self.http.tracer = self.tracer
 
     # ---- lifecycle ----
     def start(self) -> None:
         self.http.start()
+        self.tracer.node = f"volume@{self.http.host}:{self.http.port}"
         # register the ADVERTISED address with the master when one is
         # set, so peers route to us through it (chaos-proxy interpose)
         if self.advertise:
@@ -1002,8 +1015,13 @@ class VolumeServer:
         sep = "&" if qs else ""
         dl = current_deadline() or Deadline.after(self.REPLICATE_DEADLINE_S)
         # pool legs don't inherit contextvars: capture the ambient
-        # class (a replica leg of a client PUT stays write class)
+        # class (a replica leg of a client PUT stays write class) and
+        # the ambient trace span, so each replica leg's http_call nests
+        # as a child span of the PUT that fanned out
         cls = current_class() or WRITE
+        span = tracing.current_span()
+        if span is not None:
+            span.annotate("replica.fanout", len(others))
 
         def send(url: str) -> Optional[str]:
             if not self.peer_health.allow(url):
@@ -1011,7 +1029,7 @@ class VolumeServer:
             target = (f"http://{url}{req.path}?{qs}{sep}type=replicate")
             t0 = time.monotonic()
             try:
-                with class_scope(cls):
+                with class_scope(cls), tracing.span_scope(span):
                     if op == "write":
                         status, _body, _ = http_call("POST", target,
                                                      body=req.body,
@@ -1584,11 +1602,15 @@ class VolumeServer:
                     reasons = [r for r in
                                hdrs.get(ecpart.FALLBACK_HEADER,
                                         "").split(",") if r]
+                    tracing.annotate("partial_read.net_bytes", len(body))
+                    tracing.annotate("partial_read.shards", shards)
                     return arr, shards, len(body), reasons
             except (ConnectionError, OSError):
                 self.peer_health.record(url, False)
         arr, shards, nbytes = self._raw_partial_fold(
             vid, offset, size, n_rows, chain)
+        tracing.annotate("partial_read.net_bytes", nbytes)
+        tracing.annotate("partial_read.fallback", f"chain:{url}")
         return arr, shards, nbytes, [f"chain:{url}"]
 
     def _raw_partial_fold(self, vid: int, offset: int, size: int,
@@ -1663,7 +1685,8 @@ class VolumeServer:
         except (ConnectionError, HttpError):
             return None
         chain = ecpart.plan_chain(locs, coeff_by_sid,
-                                  health=self.peer_health)
+                                  health=self.peer_health,
+                                  pressure=self._shard_pressure(vid))
         if not chain:
             return None
         try:
@@ -1763,6 +1786,14 @@ class VolumeServer:
         workers = int(getattr(self.store.coder, "workers", 1) or 1)
         miss_n = len(missing)
         fallbacks: list[str] = []
+        # warm the holder-pressure map once (best-effort: a dead master
+        # must not fail a rebuild whose sources came with the request) —
+        # chain planning below tie-breaks equally-healthy holders by it
+        try:
+            self._shard_locations(vid)
+        except (ConnectionError, HttpError):
+            pass
+        pressure = self._shard_pressure(vid)
         local_fhs = {s: open(base + layout.shard_ext(s), "rb")
                      for s in src_sids if s in local}
         remote_src = [s for s in src_sids if s not in local_fhs]
@@ -1793,7 +1824,8 @@ class VolumeServer:
                         s: mat[:, src_sids.index(s)].tolist()
                         for s in remote_src}
                     chain = ecpart.plan_chain(
-                        sources, coeff_by_sid, health=self.peer_health)
+                        sources, coeff_by_sid, health=self.peer_health,
+                        pressure=pressure)
                     if chain is None:
                         raise RuntimeError(
                             "no holder for some source shard")
@@ -1866,7 +1898,10 @@ class VolumeServer:
         """{shard_id: [peer urls]} for an EC volume via the master's
         /dir/lookup_ec, self excluded, behind a short-TTL cache — a
         degraded read touches up to k+ shards and must not pay one
-        master round-trip per column."""
+        master round-trip per column. The same lookup carries each
+        holder's heartbeat-reported qos_pressure; _shard_pressure()
+        serves it from the same cache entry so chain planning can
+        tie-break away from loaded holders for free."""
         now = time.monotonic()
         cached = self._shard_loc_cache.get(vid)
         if cached is not None and cached[0] > now:
@@ -1874,13 +1909,27 @@ class VolumeServer:
         info = self._master_json("GET", f"/dir/lookup_ec?volumeId={vid}",
                                  deadline=Deadline.after(5.0))
         locs: dict[int, list[str]] = {}
+        pressure: dict[str, float] = {}
         for entry in info.get("shards", []):
-            urls = [l["url"] for l in entry["locations"]
-                    if not self._is_self(l["url"])]
+            urls = []
+            for l in entry["locations"]:
+                if self._is_self(l["url"]):
+                    continue
+                urls.append(l["url"])
+                pressure[l["url"]] = float(l.get("qos_pressure", 0.0))
             if urls:
                 locs[entry["shard_id"]] = urls
-        self._shard_loc_cache[vid] = (now + self.SHARD_LOC_TTL, locs)
+        self._shard_loc_cache[vid] = (now + self.SHARD_LOC_TTL, locs,
+                                      pressure)
         return locs
+
+    def _shard_pressure(self, vid: int) -> dict:
+        """{url: qos_pressure} from the cached lookup (empty when the
+        cache is cold — callers treat missing as unloaded)."""
+        cached = self._shard_loc_cache.get(vid)
+        if cached is not None and len(cached) > 2:
+            return cached[2]
+        return {}
 
     def _remote_shard_reader(self, vid: int, shard_id: int, offset: int,
                              size: int) -> Optional[bytes]:

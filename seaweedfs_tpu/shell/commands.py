@@ -775,6 +775,64 @@ class ShellContext:
                 nd["qos"] = {"error": type(e).__name__}
         return out
 
+    def cluster_trace(self, trace_id: str = "", min_ms: float = 0.0,
+                      limit: int = 64) -> dict:
+        """Trace view of the cluster: pull the master's and every
+        volume server's /debug/traces flight recorder and group the
+        spans by trace id, slowest trace first — the cross-node answer
+        to "which request was slow, and where did the time go". With
+        `trace_id`, returns just that trace's spans (sorted by start)
+        for stitching. Filers and S3 gateways expose the same endpoint
+        on their metrics port, which the master's topology doesn't
+        know; use tools/trace_collect.py --node to include them.
+        Unreachable nodes are reported, not fatal — same contract as
+        cluster.health."""
+        qs = f"?trace={trace_id}&min_ms={min_ms}&limit={limit}"
+        targets = [self.master_url]
+        try:
+            out = http_json("GET",
+                            f"http://{self.master_url}/cluster/qos")
+            targets += [n["url"] for n in out.get("nodes", [])
+                        if n.get("url") and n["url"] not in targets]
+        except Exception:
+            pass
+        spans: list[dict] = []
+        unreachable = []
+        for url in targets:
+            try:
+                snap = http_json(
+                    "GET", f"http://{url}/debug/traces{qs}")
+            except Exception as e:
+                unreachable.append({"node": url,
+                                    "error": type(e).__name__})
+                continue
+            spans.extend(snap.get("spans", []))
+        if trace_id:
+            spans.sort(key=lambda s: s["start"])
+            return {"trace_id": trace_id, "spans": spans,
+                    "unreachable": unreachable}
+        by_trace: dict[str, list[dict]] = defaultdict(list)
+        for s in spans:
+            by_trace[s["trace_id"]].append(s)
+        traces = []
+        for tid, group in by_trace.items():
+            roots = [s for s in group if not s.get("parent_id")]
+            root = roots[0] if roots else \
+                max(group, key=lambda s: s["duration_ms"])
+            t0 = min(s["start"] for s in group)
+            t1 = max(s["start"] + s["duration_ms"] / 1000.0
+                     for s in group)
+            traces.append({
+                "trace_id": tid, "root": root["name"],
+                "duration_ms": round((t1 - t0) * 1000.0, 3),
+                "spans": len(group),
+                "nodes": sorted({s["node"] for s in group}),
+                "errors": sum(1 for s in group
+                              if s.get("error") or s["status"] >= 500),
+            })
+        traces.sort(key=lambda t: -t["duration_ms"])
+        return {"traces": traces, "unreachable": unreachable}
+
     # ---- ec.balance (reference command_ec_balance.go) ----
     def ec_balance(self, apply: bool = True) -> list[ec_plan.ShardMove]:
         topo = self.topology()

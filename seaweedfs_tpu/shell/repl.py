@@ -61,6 +61,9 @@ HELP = """commands:
               [-tenantRate R] [-tenantBurst B] [-enable|-disable]
                                     per-node admission-control view; with
                                     flags, reconfigures the governors
+  cluster.trace [-trace ID] [-minMs MS] [-limit N]
+                                    recent slow traces cluster-wide; with
+                                    -trace, that trace's stitched spans
   volume.scrub [-node HOST:PORT] [-volumeId N]   synchronous integrity pass
   lock / unlock
   help / exit
@@ -621,6 +624,11 @@ def run_command(sh: ShellContext, line: str):
             conf["enabled"] = False
         return sh.cluster_qos(configure=conf or None,
                               node=flags.get("node", ""))
+    if cmd == "cluster.trace":
+        return sh.cluster_trace(
+            trace_id=flags.get("trace", ""),
+            min_ms=float(flags.get("minMs", 0) or 0),
+            limit=int(flags.get("limit", 64) or 64))
     if cmd == "ec.repair.kick":
         return sh.ec_repair_kick()
     if cmd == "volume.scrub":

@@ -43,7 +43,8 @@ FALLBACK_HEADER = "X-Weed-Partial-Fallback"
 def plan_chain(sources: dict[int, Sequence[str]],
                coeff_by_sid: dict[int, Sequence[int]],
                health=None,
-               exclude_urls: Sequence[str] = ()) -> Optional[list[dict]]:
+               exclude_urls: Sequence[str] = (),
+               pressure: Optional[dict] = None) -> Optional[list[dict]]:
     """Group the remote shards of one reduction by holder and order the
     holders into a chain. Returns [{"url": u, "members": [[sid,
     [coeffs...]], ...]}, ...] or None when some shard has no usable
@@ -51,8 +52,12 @@ def plan_chain(sources: dict[int, Sequence[str]],
 
     Placement: each shard goes to one holder; holders already carrying
     another member are preferred (fewer hops = fewer serial RTTs), then
-    breaker-ranked health. Hops are ordered most-members-first so the
-    longest local compute overlaps the deepest downstream wait."""
+    breaker-ranked health with heartbeat-reported `pressure` ({url:
+    qos_pressure}) breaking ties among similarly-healthy holders — a
+    repair chain routed through a holder that is actively shedding
+    client traffic makes the overload worse for no repair speedup.
+    Hops are ordered most-members-first so the longest local compute
+    overlaps the deepest downstream wait."""
     excluded = set(exclude_urls)
     members: dict[str, list] = {}
     for sid, coeffs in coeff_by_sid.items():
@@ -61,9 +66,12 @@ def plan_chain(sources: dict[int, Sequence[str]],
             return None
         if health is not None:
             try:
-                urls = health.rank(urls)
+                urls = health.rank(urls, pressure=pressure) \
+                    if pressure else health.rank(urls)
             except Exception:
                 pass
+        elif pressure:
+            urls = sorted(urls, key=lambda u: pressure.get(u, 0.0))
         chosen = next((u for u in urls if u in members), urls[0])
         members.setdefault(chosen, []).append(
             [int(sid), [int(c) for c in coeffs]])

@@ -18,6 +18,22 @@ def install_debug_routes(http: HttpServer) -> None:
     http.add("GET", "/debug/stacks", _handle_stacks)
     http.add("GET", "/debug/profile", _handle_profile)
     http.add("GET", "/debug/vars", _handle_vars)
+    # flight recorder: reads whatever tracer is wired onto this server
+    # at request time (servers set http.tracer after construction)
+    http.add("GET", "/debug/traces", lambda req: _handle_traces(req, http))
+
+
+def _handle_traces(req: Request, http: HttpServer) -> Response:
+    """Dump the node's span flight recorder. Filters: ?trace=<id>,
+    ?min_ms=<float>, ?limit=<n>. tools/trace_collect.py and the
+    cluster.trace shell command stitch these across nodes."""
+    tracer = http.tracer
+    if tracer is None:
+        return Response({"enabled": False, "spans": []})
+    return Response(tracer.snapshot(
+        trace_id=req.query.get("trace", ""),
+        min_ms=float(req.query.get("min_ms", 0) or 0),
+        limit=int(req.query.get("limit", 512) or 512)))
 
 
 def _handle_stacks(req: Request) -> Response:

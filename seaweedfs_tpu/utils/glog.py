@@ -34,6 +34,7 @@ _verbosity = 0
 _vmodule: dict[str, int] = {}
 _log_file: Optional["_RotatingFile"] = None
 _also_stderr = True
+_context_provider = None  # e.g. tracing's "[t=abcd1234] " prefix hook
 MAX_SIZE = 64 << 20  # rotation threshold, reference glog.MaxSize
 
 
@@ -96,6 +97,16 @@ def set_log_file(path: str, max_bytes: int = MAX_SIZE,
         _also_stderr = also_stderr
 
 
+def set_context_provider(fn) -> None:
+    """Register a callable returning a per-line prefix (e.g. the active
+    trace id) inserted between the glog head and the message. Must be
+    cheap and return "" when it has nothing to add; any exception it
+    raises is swallowed. Survives reset(): the provider is ambient
+    wiring (tracing installs it at import), not test-local state."""
+    global _context_provider
+    _context_provider = fn
+
+
 def reset() -> None:
     """Back to defaults (tests)."""
     global _log_file, _verbosity, _vmodule, _also_stderr
@@ -133,6 +144,11 @@ def _emit(sev: int, depth: int, msg: str, args: tuple) -> None:
             f"{time.strftime('%m%d %H:%M:%S', time.localtime(now))}"
             f".{frac:06d} {threading.get_native_id():>6d} "
             f"{fname}:{lineno}] ")
+    if _context_provider is not None:
+        try:
+            head += _context_provider()
+        except Exception:
+            pass
     line = head + msg + "\n"
     with _lock:
         if _log_file is not None:

@@ -20,7 +20,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
 
 from seaweedfs_tpu.qos import classes as qos_classes
-from seaweedfs_tpu.utils import glog, resilience
+from seaweedfs_tpu.utils import glog, resilience, tracing
 
 
 class Request:
@@ -150,6 +150,11 @@ class HttpServer:
         # the admission slot once the response is fully sent, None
         # passes. See seaweedfs_tpu/qos/governor.py.
         self.admission_gate = None
+        # tracing.Tracer wired by the owning server: _dispatch mints a
+        # server span per request (continuing an inbound X-Weed-Trace)
+        # and records it into the node's flight recorder. None -> the
+        # shared NOOP span, zero allocation.
+        self.tracer = None
 
     def route(self, method: str, pattern: str):
         compiled = re.compile("^" + pattern + "$")
@@ -255,6 +260,23 @@ class HttpServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 path = urllib.parse.unquote(
                     urllib.parse.urlparse(self.path).path)
+                # server span: continue an inbound X-Weed-Trace or mint
+                # a fresh trace at this edge. Ambient BEFORE the gates
+                # so QoS verdicts annotate it, and around the handler so
+                # nested http_calls inject the header downstream. With
+                # no tracer (or disabled) this is one attribute check
+                # plus the shared NOOP span — no allocation.
+                tracer = server.tracer
+                span = (tracer.server_span(f"{self.command} {path}",
+                                           self.headers)
+                        if tracer is not None else tracing.NOOP)
+                tok = tracing.attach(span)
+                try:
+                    self._dispatch_inner(path, length, span)
+                finally:
+                    tracing.detach(tok)
+
+            def _dispatch_inner(self, path, length, span):
                 release = None
                 agate = server.admission_gate
                 if agate is not None:
@@ -262,10 +284,12 @@ class HttpServer:
                                     self.client_address[0])
                     if isinstance(verdict, Response):
                         self._reject(verdict, length)
+                        span.finish(status=verdict.status)
                         return
                     release = verdict
                 on_sent = None
                 resp = None
+                out_status = 500
                 t0 = time.perf_counter()
                 try:
                     gate = server.body_gate
@@ -273,6 +297,7 @@ class HttpServer:
                             self.command in ("POST", "PUT"):
                         verdict = gate(path, length)
                         if isinstance(verdict, Response):
+                            out_status = verdict.status
                             self._reject(verdict, length)
                             return
                         on_sent = verdict
@@ -299,6 +324,7 @@ class HttpServer:
                             break
                     else:
                         resp = Response({"error": "not found"}, status=404)
+                    out_status = resp.status
                     self._send(resp)
                     glog.vlog(2, "%s %s %d %dB %.1fms",
                               self.command, self.path, resp.status,
@@ -312,6 +338,7 @@ class HttpServer:
                         cb()
                     if release is not None:
                         release()
+                    span.finish(status=out_status)
 
             def _send(self, resp):
                 try:
@@ -635,6 +662,34 @@ def http_call(method: str, url: str, body: Optional[bytes] = None,
               json_body: Any = None, timeout: float = 30.0,
               headers: Optional[dict] = None,
               deadline=None) -> tuple[int, bytes, dict]:
+    # Trace propagation: when a trace is ambient, this outbound RPC
+    # becomes a client child span and its ids ride X-Weed-Trace so the
+    # callee's server span nests under it. No ambient trace (or tracing
+    # disabled) costs one ContextVar read — no span allocation.
+    amb = tracing.current_span()
+    if amb is None:
+        return _http_call_impl(method, url, body, json_body, timeout,
+                               headers, deadline)
+    span = amb.child(f"{method.upper()} {url.split('?', 1)[0]}")
+    headers = dict(headers or {})
+    headers.setdefault(tracing.TRACE_HEADER, span.header_value())
+    status, err = 0, ""
+    try:
+        out = _http_call_impl(method, url, body, json_body, timeout,
+                              headers, deadline)
+        status = out[0]
+        return out
+    except BaseException as e:
+        status, err = 599, f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        span.finish(status=status, error=err)
+
+
+def _http_call_impl(method: str, url: str, body: Optional[bytes] = None,
+                    json_body: Any = None, timeout: float = 30.0,
+                    headers: Optional[dict] = None,
+                    deadline=None) -> tuple[int, bytes, dict]:
     # Deadline propagation: `timeout` becomes a CAP under the caller's
     # remaining budget (explicit `deadline` arg, else the ambient
     # request-scope one), and the remaining seconds ride along in the

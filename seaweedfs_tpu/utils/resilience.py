@@ -29,8 +29,10 @@ distributed-systems playbook):
   p95), fire the next-healthiest; first success wins, losers are
   abandoned. Open circuits are skipped unless no other holder exists.
 
-Pure stdlib; imports nothing from the HTTP plane so httpd.py can use
-``DeadlineExceeded`` without a cycle.
+Pure stdlib plus utils.tracing (itself stdlib-only, below us in the
+import DAG); imports nothing from the HTTP plane so httpd.py can use
+``DeadlineExceeded`` without a cycle. Retries and hedge outcomes
+annotate the ambient trace span when one is active.
 """
 
 from __future__ import annotations
@@ -42,6 +44,8 @@ import time
 from collections import deque
 from contextlib import contextmanager
 from typing import Callable, Iterable, Optional, Sequence
+
+from seaweedfs_tpu.utils import tracing
 
 DEADLINE_HEADER = "X-Weed-Deadline"  # remaining seconds, decimal string
 
@@ -190,6 +194,12 @@ class RetryPolicy:
                 return fn()
             except retry_on as e:
                 last = e
+                # cross-reference the retry storm in the trace: the
+                # ambient span (if any) ends up carrying the highest
+                # attempt number reached and the destination
+                tracing.annotate("retry.failed_attempt", attempt + 1)
+                if dest:
+                    tracing.annotate("retry.dest", dest)
                 if isinstance(e, DeadlineExceeded):
                     raise
                 if attempt + 1 >= self.attempts \
@@ -406,17 +416,28 @@ class PeerHealth:
         if self._c_hedges is not None:
             self._c_hedges.inc(outcome)
 
-    def rank(self, urls: Iterable[str]) -> list[str]:
+    def rank(self, urls: Iterable[str],
+             pressure: Optional[dict] = None) -> list[str]:
         """Healthiest first: closed before half-open before open (open
         circuits sort last — 'skipped unless no other holder exists'),
         ties broken by the EWMA-latency score. Passive: no probe slots
-        are consumed here; allow() happens at dial time."""
+        are consumed here; allow() happens at dial time.
+
+        `pressure` ({url: qos_pressure [0,1]} from heartbeats) breaks
+        ties among SIMILARLY healthy peers: latency is quantized into
+        20ms buckets so a few ms of EWMA noise can't override a holder
+        that is visibly shedding load, while a genuinely slower peer
+        still loses to a fast loaded one."""
         def key(u: str):
             br = self.breaker(u)
             state_rank = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}[br.state]
             if br.state == OPEN and br.probe_ripe():
                 state_rank = 1  # due a probe: better than hard-open
-            return (state_rank, br.score())
+            if pressure is None:
+                return (state_rank, br.score())
+            s = br.score()
+            return (state_rank, round(s / 0.020),
+                    pressure.get(u, 0.0), s)
         return sorted(urls, key=key)
 
     def hedge_delay(self, primary: Optional[str] = None) -> float:
@@ -486,11 +507,15 @@ def hedged(fn: Callable[[str], object], candidates: Sequence[str],
     dl = deadline or current_deadline()
     pool = _get_hedge_pool()
     ctx_dl = dl  # propagate into workers
+    # ContextVars don't cross the pool: capture the ambient span here
+    # and re-enter it in each worker, so every leg's http_call becomes
+    # a child span of the request that hedged
+    ctx_sp = tracing.current_span()
 
     def run_one(c: str):
         t0 = time.monotonic()
         try:
-            with deadline_scope(ctx_dl):
+            with deadline_scope(ctx_dl), tracing.span_scope(ctx_sp):
                 out = fn(c)
         except Exception:
             out = None
@@ -522,8 +547,11 @@ def hedged(fn: Callable[[str], object], candidates: Sequence[str],
                        return_when=FIRST_COMPLETED)
         for f in done:
             result = f.result()
-            pending.pop(f)
+            won = pending.pop(f)
             if result is not None:
+                if ctx_sp is not None:
+                    ctx_sp.annotate("hedge.winner", won)
+                    ctx_sp.annotate("hedge.legs_fired", nxt)
                 for g in pending:
                     g.cancel()
                 return result

@@ -1,0 +1,414 @@
+"""Distributed tracing (round 10): X-Weed-Trace propagation across the
+serving edges, per-node flight recorders at /debug/traces, the
+zero-cost-when-disabled contract, glog trace stamping, pressure-aware
+repair-chain planning, and the cross-node trace collector."""
+
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.utils import glog, tracing
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+@pytest.fixture(autouse=True)
+def _reset_glog():
+    yield
+    glog.reset()
+
+
+# ---- span / tracer unit semantics ----
+
+def test_header_roundtrip_and_parse():
+    tr = tracing.Tracer(node="n", sample_rate=1.0)
+    sp = tr.root_span("op", sampled=True)
+    parsed = tracing.parse_header(sp.header_value())
+    assert parsed == (sp.trace_id, sp.span_id, True)
+    assert tracing.parse_header("garbage") is None
+    assert tracing.parse_header("a:b") is None
+    assert tracing.parse_header("xyz:12ab:1") is None  # non-hex trace
+    assert tracing.parse_header("12ab34cd:9f:notanint") is None
+
+
+def test_child_span_links_parent():
+    tr = tracing.Tracer(node="n", sample_rate=1.0)
+    root = tr.root_span("root", sampled=True)
+    ch = root.child("hop")
+    assert ch.trace_id == root.trace_id
+    assert ch.parent_id == root.span_id
+    assert ch.span_id != root.span_id
+    assert ch.sampled is True
+
+
+def test_noop_span_is_shared_and_inert():
+    tr = tracing.Tracer(node="n", enabled=False)
+    sp = tr.server_span("GET /x", {})
+    assert sp is tracing.NOOP
+    assert not sp
+    assert sp.child("c") is tracing.NOOP
+    sp.annotate("k", 1)
+    sp.finish(status=500, error="boom")
+    snap = tr.snapshot()
+    assert snap["enabled"] is False
+    assert snap["spans"] == [] and snap["started"] == 0
+    # root spans honor the same contract
+    assert tr.root_span("job", sampled=True) is tracing.NOOP
+
+
+def test_recorder_tail_keep_policy():
+    tr = tracing.Tracer(node="n", sample_rate=0.0, slow_ms=50.0)
+    fast = tr.server_span("GET /fast", {})
+    assert fast.sampled is False
+    fast.finish(status=200)  # unsampled, fast, OK -> dropped
+    err = tr.server_span("GET /err", {})
+    err.finish(status=503)  # 5xx -> always kept
+    slow = tr.server_span("GET /slow", {})
+    slow.start -= 1.0  # fake a 1s request
+    slow.finish(status=200)  # past slow_ms -> always kept
+    snap = tr.snapshot()
+    assert [s["name"] for s in snap["spans"]] == ["GET /err", "GET /slow"]
+    assert snap["started"] == 3 and snap["kept"] == 2
+    # snapshot filters: trace id and min duration
+    assert tr.snapshot(trace_id=err.trace_id)["spans"][0]["name"] \
+        == "GET /err"
+    assert [s["name"] for s in tr.snapshot(min_ms=500.0)["spans"]] \
+        == ["GET /slow"]
+
+
+def test_scope_helpers_and_annotations():
+    tr = tracing.Tracer(node="n", sample_rate=1.0)
+    root = tr.root_span("root", sampled=True)
+    assert tracing.current_span() is None
+    assert tracing.current_trace_id() == ""
+    tracing.annotate("dropped", 1)  # no ambient span: free no-op
+    with tracing.span_scope(root):
+        assert tracing.current_span() is root
+        assert tracing.current_trace_id() == root.trace_id
+        with tracing.child_scope("stage") as ch:
+            assert ch.parent_id == root.span_id
+            tracing.annotate("k", "v")
+    assert tracing.current_span() is None
+    stage = [s for s in tr.snapshot()["spans"] if s["name"] == "stage"]
+    assert stage and stage[0]["annotations"] == {"k": "v"}
+    # child_scope outside any trace is a NOOP passthrough
+    with tracing.child_scope("orphan") as ch:
+        assert ch is tracing.NOOP
+
+
+def test_server_span_continues_inbound_header():
+    tr = tracing.Tracer(node="n", sample_rate=0.0)
+    inbound = {tracing.TRACE_HEADER: "12ab34cd12ab34cd:9f9f9f9f:1"}
+    sp = tr.server_span("GET /x", inbound)
+    assert sp.trace_id == "12ab34cd12ab34cd"
+    assert sp.parent_id == "9f9f9f9f"
+    assert sp.sampled is True  # inherited, beats the 0% head rate
+    # malformed header: mint fresh instead of failing the request
+    sp2 = tr.server_span("GET /x", {tracing.TRACE_HEADER: "zz:yy"})
+    assert len(sp2.trace_id) == 16 and sp2.parent_id == ""
+
+
+# ---- glog cross-referencing (satellite: [t=...] stamps) ----
+
+def test_glog_lines_carry_trace_id(tmp_path):
+    log = tmp_path / "weed.log"
+    glog.set_log_file(str(log), also_stderr=False)
+    tr = tracing.Tracer(node="n", sample_rate=1.0)
+    sp = tr.root_span("op", sampled=True)
+    glog.info("plain line")
+    with tracing.span_scope(sp):
+        glog.info("traced line")
+    unsampled = tr.root_span("quiet", sampled=False)
+    with tracing.span_scope(unsampled):
+        glog.info("unsampled line")
+    lines = log.read_text().splitlines()
+    assert "[t=" not in lines[0]
+    assert f"[t={sp.trace_id[:8]}] traced line" in lines[1]
+    # unsampled spans keep the historical line format byte-identical
+    assert "[t=" not in lines[2]
+
+
+# ---- pressure-aware repair-chain planning (satellite) ----
+
+def test_rank_pressure_tiebreak():
+    from seaweedfs_tpu.utils.resilience import PeerHealth
+    h = PeerHealth()
+    urls = ["peer-a:80", "peer-b:80"]
+    # fresh, equally-healthy peers: heartbeat pressure breaks the tie
+    assert h.rank(urls, pressure={"peer-a:80": 0.9,
+                                  "peer-b:80": 0.1})[0] == "peer-b:80"
+    assert h.rank(urls, pressure={"peer-a:80": 0.1,
+                                  "peer-b:80": 0.9})[0] == "peer-a:80"
+    # a genuinely slower peer still loses, whatever its pressure says
+    for _ in range(20):
+        h.record("peer-a:80", True, latency_s=0.005)
+        h.record("peer-b:80", True, latency_s=0.200)
+    assert h.rank(urls, pressure={"peer-a:80": 1.0,
+                                  "peer-b:80": 0.0})[0] == "peer-a:80"
+
+
+def test_plan_chain_prefers_calm_holders():
+    from seaweedfs_tpu.storage.erasure_coding.partial import plan_chain
+    sources = {3: ["busy:1", "calm:1"], 7: ["busy:1", "calm:1"]}
+    coeffs = {3: [1, 2], 7: [3, 4]}
+    # without pressure, master-lookup order wins
+    hops = plan_chain(sources, coeffs)
+    assert [h["url"] for h in hops] == ["busy:1"]
+    # with pressure, the whole chain routes around the loaded holder
+    hops = plan_chain(sources, coeffs,
+                      pressure={"busy:1": 0.8, "calm:1": 0.0})
+    assert [h["url"] for h in hops] == ["calm:1"]
+    assert len(hops[0]["members"]) == 2
+
+
+# ---- metrics thread-safety (satellite) ----
+
+def test_metrics_expose_races_writers():
+    """Counter.inc / Histogram.observe hammered from threads while
+    expose_text scrapes concurrently: every exposition parses, counter
+    totals only go up, and the final totals are exact."""
+    from seaweedfs_tpu.utils.metrics import Registry
+    reg = Registry(namespace="TST")
+    ctr = reg.counter("race", "ops_total", "ops", labels=("kind",))
+    hist = reg.histogram("race", "lat_seconds", "lat", labels=("kind",))
+    n_writers, per = 4, 2000
+    errors = []
+
+    def writer(i):
+        try:
+            for j in range(per):
+                ctr.inc(f"k{i % 2}")
+                hist.observe(j * 1e-4, f"k{i % 2}")
+        except Exception as e:  # pragma: no cover - the failure mode
+            errors.append(e)
+
+    def total_of(text):
+        return sum(float(line.rsplit(" ", 1)[1])
+                   for line in text.splitlines()
+                   if line.startswith("TST_race_ops_total{"))
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_writers)]
+    for t in threads:
+        t.start()
+    last = 0.0
+    while any(t.is_alive() for t in threads):
+        text = reg.expose_text()
+        for line in text.splitlines():
+            if line.startswith("#") or not line.strip():
+                continue
+            float(line.rsplit(" ", 1)[1])  # every sample parses
+        now = total_of(text)
+        assert now >= last, "counter went backwards under race"
+        last = now
+    for t in threads:
+        t.join()
+    assert not errors
+    final = reg.expose_text()
+    assert total_of(final) == n_writers * per
+    hist_counts = sum(float(line.rsplit(" ", 1)[1])
+                      for line in final.splitlines()
+                      if line.startswith("TST_race_lat_seconds_count"))
+    assert hist_counts == n_writers * per
+
+
+# ---- end-to-end: one S3 PUT, one stitched trace ----
+
+@pytest.fixture
+def traced_stack(tmp_path):
+    from seaweedfs_tpu.gateway.s3_server import S3Server
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    ms = MasterServer(volume_size_limit_mb=64, trace_sample=1.0)
+    ms.start()
+    vs1 = VolumeServer([str(tmp_path / "v1")], ms.url, trace_sample=1.0)
+    vs1.start()
+    vs2 = VolumeServer([str(tmp_path / "v2")], ms.url, trace_sample=1.0)
+    vs2.start()
+    time.sleep(0.3)  # both heartbeats registered before assigns
+    fs = FilerServer(ms.url, default_replication="001", trace_sample=1.0)
+    fs.start()
+    s3 = S3Server(fs, trace_sample=1.0)
+    s3.start()
+    yield ms, vs1, vs2, fs, s3
+    s3.stop()
+    fs.stop()
+    vs2.stop()
+    vs1.stop()
+    ms.stop()
+
+
+def test_s3_put_produces_single_stitched_trace(traced_stack):
+    ms, vs1, vs2, fs, s3 = traced_stack
+    status, _, _ = http_call("PUT", f"http://{s3.url}/tracebkt")
+    assert status < 300
+    body = b"\xab" * 256 * 1024
+    status, _, _ = http_call("PUT", f"http://{s3.url}/tracebkt/obj",
+                             body=body)
+    assert status < 300
+
+    # the gateway edge minted the root; find its trace id
+    roots = [s for s in s3.tracer.snapshot()["spans"]
+             if s["name"] == "PUT /tracebkt/obj"]
+    assert roots, "gateway recorded no span for the object PUT"
+    tid = roots[0]["trace_id"]
+    assert roots[0]["parent_id"] == ""  # edge-minted, not continued
+
+    # collect the same trace over HTTP from every node's recorder —
+    # gateway/filer serve /debug/traces on their metrics listener
+    spans = []
+    nodes_answering = 0
+    for url in (s3.metrics_url, fs.metrics_url, ms.url,
+                vs1.url, vs2.url):
+        snap = http_json("GET",
+                         f"http://{url}/debug/traces?trace={tid}")
+        if snap["spans"]:
+            nodes_answering += 1
+        spans.extend(snap["spans"])
+
+    assert nodes_answering >= 3, \
+        f"trace only visible on {nodes_answering} nodes"
+    assert all(s["trace_id"] == tid for s in spans)
+    assert len(spans) >= 6, \
+        f"expected >=6 spans, got {[s['name'] for s in spans]}"
+
+    # replica fan-out shows up as an annotated parent + client child
+    fanout = [s for s in spans
+              if (s.get("annotations") or {}).get("replica.fanout")]
+    assert fanout, "no replica fan-out annotation in the trace"
+    kids = [s for s in spans
+            if s["parent_id"] == fanout[0]["span_id"]
+            and s["kind"] == "client"]
+    assert kids, "replica fan-out produced no client child span"
+
+    # QoS admission decisions ride the same spans
+    verdicts = {(s.get("annotations") or {}).get("qos.verdict")
+                for s in spans}
+    assert "admitted" in verdicts
+
+
+def test_tracing_disabled_is_invisible(tmp_path):
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    ms = MasterServer(tracing_enabled=False)
+    ms.start()
+    vs = VolumeServer([str(tmp_path / "v")], ms.url,
+                      tracing_enabled=False)
+    vs.start()
+    time.sleep(0.2)
+    fs = FilerServer(ms.url, tracing_enabled=False)
+    fs.start()
+    try:
+        status, _, _ = http_call("POST", f"http://{fs.url}/z/a.bin",
+                                 body=b"q" * 100_000)
+        assert status < 300
+        status, got, _ = http_call("GET", f"http://{fs.url}/z/a.bin")
+        assert status == 200 and got == b"q" * 100_000
+        # the write crossed every node; no span was ever allocated
+        for tr in (ms.tracer, vs.tracer, fs.tracer):
+            snap = tr.snapshot()
+            assert snap["spans"] == [] and snap["started"] == 0
+        assert vs.tracer.server_span("GET /x", {}) is tracing.NOOP
+        out = http_json("GET", f"http://{vs.url}/debug/traces")
+        assert out["enabled"] is False and out["spans"] == []
+    finally:
+        fs.stop()
+        vs.stop()
+        ms.stop()
+
+
+# ---- tools/trace_collect.py (tier-1 smoke, fixture servers) ----
+
+def test_trace_collect_stitches_across_nodes(tmp_path, capsys):
+    import tools.trace_collect as tc
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    ms = MasterServer(trace_sample=1.0)
+    ms.start()
+    vs = VolumeServer([str(tmp_path / "v")], ms.url, trace_sample=1.0)
+    vs.start()
+    time.sleep(0.2)
+    mc = MasterClient(ms.url, cache_ttl=0.0)
+    client_tr = tracing.Tracer(node="client", sample_rate=1.0)
+    root = client_tr.root_span("client.put", sampled=True)
+    try:
+        with tracing.span_scope(root):
+            operation.upload_data(mc, b"t" * 50_000)
+        root.finish()
+
+        # list mode: the client's trace shows up cluster-wide
+        rc = tc.main(["--node", ms.url, "--node", vs.url, "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        rows = {t["trace_id"]: t for t in out["traces"]}
+        assert root.trace_id in rows
+        assert rows[root.trace_id]["spans"] >= 2
+
+        # stitch mode: Chrome trace-event JSON with per-node processes
+        outfile = tmp_path / "trace.json"
+        rc = tc.main(["--node", ms.url, "--node", vs.url,
+                      "--trace", root.trace_id, "--out", str(outfile)])
+        assert rc == 0
+        doc = json.loads(outfile.read_text())
+        events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert events
+        for e in events:
+            assert e["ts"] >= 0 and e["dur"] >= 0 and e["pid"] >= 1
+        procs = [e for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"]
+        assert len(procs) >= 2  # master + volume lanes
+
+        # asking for an unknown trace fails loudly
+        rc = tc.main(["--node", ms.url, "--trace", "f" * 16,
+                      "--out", str(tmp_path / "none.json")])
+        assert rc == 1
+    finally:
+        mc.stop()
+        vs.stop()
+        ms.stop()
+
+
+# ---- sampling overhead (acceptance: <=5% at the 1% head rate) ----
+
+@pytest.mark.slow
+def test_put_overhead_at_one_percent_sampling(tmp_path):
+    """Measured PUT cost with tracing at the default 1% head rate vs
+    disabled. The 5%-overhead acceptance bar is checked with slack
+    (CI timer noise dwarfs the real delta on loopback fixtures)."""
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    def run(enabled: bool) -> float:
+        d = tmp_path / ("on" if enabled else "off")
+        ms = MasterServer(tracing_enabled=enabled, trace_sample=0.01)
+        ms.start()
+        vs = VolumeServer([str(d)], ms.url, tracing_enabled=enabled,
+                          trace_sample=0.01)
+        vs.start()
+        time.sleep(0.2)
+        mc = MasterClient(ms.url, cache_ttl=0.0)
+        body = b"p" * 65536
+        try:
+            for _ in range(10):  # warmup
+                operation.upload_data(mc, body)
+            t0 = time.perf_counter()
+            for _ in range(150):
+                operation.upload_data(mc, body)
+            return time.perf_counter() - t0
+        finally:
+            mc.stop()
+            vs.stop()
+            ms.stop()
+
+    off = run(False)
+    on = run(True)
+    assert on <= off * 1.5, \
+        f"tracing overhead too high: {off:.3f}s off vs {on:.3f}s on"

@@ -224,6 +224,12 @@ class ChaosProxy:
                 return
             # pass-through with degradation
             upstream = socket.create_connection(self.target, timeout=5.0)
+            # create_connection leaves its timeout on the socket for
+            # life, so an idle keepalive conn would die after 5s of
+            # response silence — with _pump's finally then shutting
+            # down BOTH directions, possibly mid-request. The 5s is
+            # for connect only; relaying must tolerate idle peers.
+            upstream.settimeout(None)
             self._track(upstream)
             up = threading.Thread(
                 target=self._pump, args=(client, upstream, True),

@@ -1,0 +1,199 @@
+"""Cross-node trace stitcher: collect /debug/traces flight recorders
+and assemble one trace id into Chrome trace-event JSON.
+
+Every node keeps its own bounded span ring (utils/tracing.py); a trace
+that crossed four processes is four partial views. This tool pulls them
+all, groups spans by trace id, and either:
+
+- lists recent traces cluster-wide (default): one row per trace with
+  its root span, total span count, nodes touched, and critical-path
+  duration — slowest first, so the trace worth staring at is row one;
+- stitches one trace (`--trace ID`) into Chrome trace-event format
+  (`--out trace.json`), loadable in Perfetto / chrome://tracing: each
+  node becomes a "process", each span a complete ("ph":"X") event with
+  its annotations under args.
+
+Targets come from `--node HOST:PORT` (repeatable — volume servers and
+the master serve /debug/traces on their main port; filers and S3
+gateways on their metrics port) or are discovered from a master via
+`--master HOST:PORT` (the master itself + every volume node; filer /
+gateway metrics ports are not in the topology, add them with --node).
+
+Usage:
+  PYTHONPATH=. python tools/trace_collect.py --master 127.0.0.1:9333
+  PYTHONPATH=. python tools/trace_collect.py --node 127.0.0.1:8080 \
+      --trace 5e0c0ffee5e0c0ff --out /tmp/trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from seaweedfs_tpu.utils.httpd import http_json  # noqa: E402
+
+
+def discover_nodes(master: str) -> list:
+    """Master + every volume node (GET /cluster/qos lists them)."""
+    nodes = [master]
+    try:
+        out = http_json("GET", f"http://{master}/cluster/qos",
+                        timeout=5.0)
+        for n in out.get("nodes", []):
+            url = n.get("url", "")
+            if url and url not in nodes:
+                nodes.append(url)
+    except Exception:
+        pass
+    return nodes
+
+
+def collect(nodes: list, trace_id: str = "", min_ms: float = 0.0,
+            limit: int = 512) -> tuple[list, list]:
+    """Fetch every node's recorder. Returns (spans, unreachable)."""
+    spans: list = []
+    unreachable: list = []
+    qs = f"?trace={trace_id}&min_ms={min_ms}&limit={limit}"
+    for node in nodes:
+        try:
+            snap = http_json("GET", f"http://{node}/debug/traces{qs}",
+                             timeout=5.0)
+        except Exception as e:  # noqa: BLE001 — report, keep collecting
+            unreachable.append({"node": node, "error": str(e)})
+            continue
+        spans.extend(snap.get("spans", []))
+    return spans, unreachable
+
+
+def group_traces(spans: list) -> dict:
+    by_trace: dict[str, list] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    return by_trace
+
+
+def summarize(by_trace: dict) -> list:
+    """One row per trace, slowest critical path first."""
+    rows = []
+    for tid, spans in by_trace.items():
+        roots = [s for s in spans if not s.get("parent_id")]
+        root = roots[0] if roots else max(spans,
+                                          key=lambda s: s["duration_ms"])
+        t0 = min(s["start"] for s in spans)
+        t1 = max(s["start"] + s["duration_ms"] / 1000.0 for s in spans)
+        rows.append({
+            "trace_id": tid,
+            "root": root["name"],
+            "root_node": root["node"],
+            "duration_ms": round((t1 - t0) * 1000.0, 3),
+            "spans": len(spans),
+            "nodes": sorted({s["node"] for s in spans}),
+            "errors": sum(1 for s in spans
+                          if s.get("error") or s["status"] >= 500),
+            "start": t0,
+        })
+    rows.sort(key=lambda r: -r["duration_ms"])
+    return rows
+
+
+def to_chrome_trace(spans: list) -> dict:
+    """Chrome trace-event JSON (Perfetto-loadable): node -> pid,
+    span -> one complete event; ts/dur in microseconds."""
+    nodes = sorted({s["node"] for s in spans})
+    pid_of = {n: i + 1 for i, n in enumerate(nodes)}
+    events = []
+    for n, pid in pid_of.items():
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": n}})
+    t0 = min(s["start"] for s in spans) if spans else 0.0
+    for i, s in enumerate(sorted(spans, key=lambda x: x["start"])):
+        args = {"span_id": s["span_id"],
+                "parent_id": s.get("parent_id", ""),
+                "kind": s["kind"], "status": s["status"]}
+        args.update(s.get("annotations") or {})
+        if s.get("error"):
+            args["error"] = s["error"]
+        events.append({
+            "name": s["name"], "ph": "X", "cat": s["kind"],
+            "ts": round((s["start"] - t0) * 1e6, 1),
+            "dur": round(s["duration_ms"] * 1e3, 1),
+            "pid": pid_of[s["node"]],
+            # one lane per span keeps overlapping children visible
+            "tid": i + 1,
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="collect /debug/traces and stitch traces")
+    ap.add_argument("--master", default="",
+                    help="discover nodes from this master")
+    ap.add_argument("--node", action="append", default=[],
+                    help="explicit HOST:PORT (repeatable)")
+    ap.add_argument("--trace", default="",
+                    help="stitch this trace id (else: list recent)")
+    ap.add_argument("--min-ms", type=float, default=0.0,
+                    help="only spans at least this slow")
+    ap.add_argument("--limit", type=int, default=512,
+                    help="max spans per node")
+    ap.add_argument("--out", default="",
+                    help="write Chrome trace JSON here (with --trace)")
+    ap.add_argument("--json", action="store_true",
+                    help="print machine-readable output")
+    args = ap.parse_args(argv)
+
+    nodes = list(args.node)
+    if args.master:
+        nodes += [n for n in discover_nodes(args.master)
+                  if n not in nodes]
+    if not nodes:
+        ap.error("no targets: pass --master and/or --node")
+
+    spans, unreachable = collect(nodes, trace_id=args.trace,
+                                 min_ms=args.min_ms, limit=args.limit)
+    for u in unreachable:
+        print(f"# unreachable {u['node']}: {u['error']}",
+              file=sys.stderr)
+
+    if args.trace:
+        if not spans:
+            print(f"no spans for trace {args.trace} on {len(nodes)} "
+                  "node(s)", file=sys.stderr)
+            return 1
+        doc = to_chrome_trace(spans)
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(doc, fh)
+            print(f"wrote {len(doc['traceEvents'])} events "
+                  f"({len(spans)} spans, "
+                  f"{len({s['node'] for s in spans})} nodes) "
+                  f"to {args.out}")
+        else:
+            json.dump(doc, sys.stdout)
+            print()
+        return 0
+
+    rows = summarize(group_traces(spans))
+    if args.json:
+        print(json.dumps({"traces": rows, "unreachable": unreachable}))
+        return 0
+    if not rows:
+        print(f"no traces recorded on {len(nodes)} node(s)")
+        return 0
+    print(f"{'TRACE':<18} {'MS':>9} {'SPANS':>5} {'NODES':>5} "
+          f"{'ERR':>3}  ROOT")
+    for r in rows:
+        print(f"{r['trace_id']:<18} {r['duration_ms']:>9.1f} "
+              f"{r['spans']:>5} {len(r['nodes']):>5} "
+              f"{r['errors']:>3}  {r['root']} @ {r['root_node']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
