@@ -80,7 +80,7 @@ def cmd_master(args):
     if args.peers:
         extra += f", raft peers {ms.peers}"
     print(f"master listening on {ms.url}{extra}")
-    _wait_forever()
+    _serve_until_signal(ms)
 
 
 def cmd_volume(args):
@@ -108,7 +108,7 @@ def cmd_volume(args):
     g = f", grpc {vs.grpc_port}" if vs.grpc_port else ""
     print(f"volume server listening on {vs.url}{tcp}{g}, "
           f"master {args.mserver}")
-    _wait_forever()
+    _serve_until_signal(vs)
 
 
 def cmd_server(args):
@@ -156,7 +156,9 @@ def cmd_server(args):
             extra.append(s3)
             push_targets.append(("s3", s3))
     _start_push(args, *push_targets)
-    _wait_forever()
+    # volume drains first (its draining heartbeat needs the master
+    # still up), gateways/filer next, master last
+    _serve_until_signal(vs, *reversed(extra), ms)
 
 
 def cmd_filer(args):
@@ -187,7 +189,7 @@ def cmd_filer(args):
                                        port=args.mqPort)
         extra += f", mq grpc {args.ip}:{mq_port}"
     print(f"filer {fs.url} (store={args.store}){extra}")
-    _wait_forever()
+    _serve_until_signal(fs)
 
 
 def cmd_gateway(args):
@@ -801,6 +803,33 @@ def _wait_forever():
             time.sleep(3600)
     except KeyboardInterrupt:
         pass
+
+
+def _serve_until_signal(*servers):
+    """Block until SIGTERM/SIGINT, then stop the given servers in
+    order. Volume servers drain gracefully (their stop() finishes
+    in-flight requests, flushes the group commit, and sends a final
+    draining heartbeat) — list them BEFORE their master so the
+    announcement still has someone to hear it."""
+    import signal
+    import threading
+    stop_ev = threading.Event()
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda signum, frame: stop_ev.set())
+    except ValueError:
+        # not the main thread (embedded/test use): no signal hooks
+        pass
+    try:
+        while not stop_ev.wait(3600):
+            pass
+    except KeyboardInterrupt:
+        pass
+    for srv in servers:
+        try:
+            srv.stop()
+        except Exception as e:
+            print(f"stop {type(srv).__name__}: {e}", file=sys.stderr)
 
 
 def main(argv=None):

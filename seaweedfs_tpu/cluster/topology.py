@@ -45,6 +45,10 @@ class DataNode:
         # repair scheduler backs its bandwidth budget off when serving
         # nodes are shedding interactive load
         self.qos_pressure = 0.0
+        # graceful-drain announcement (rides heartbeats): a draining
+        # node takes no new assignments or volume growth, and its
+        # departure must not trigger rebuilds (repair drain grace)
+        self.draining = False
 
     @property
     def id(self) -> str:
@@ -169,7 +173,13 @@ class VolumeLayout:
     def pick_for_write(self) -> tuple[int, list[DataNode]]:
         if not self.writable:
             raise LookupError("no writable volumes")
-        vid = random.choice(sorted(self.writable))
+        # a write lands on EVERY replica, so a volume with any draining
+        # holder is not assignable (the drained node 503s new work);
+        # when every writable volume touches a draining node, fall back
+        # to the full set — a maybe-slow write beats no write at all
+        fresh = [vid for vid in sorted(self.writable)
+                 if not any(n.draining for n in self.locations.get(vid, []))]
+        vid = random.choice(fresh or sorted(self.writable))
         return vid, self.locations[vid]
 
     def set_volume_unavailable(self, vid: int) -> None:
@@ -177,6 +187,15 @@ class VolumeLayout:
 
     def active_volume_count(self) -> int:
         return len(self.writable)
+
+    def clean_volume_count(self) -> int:
+        """Writable volumes with no draining holder — the set
+        pick_for_write prefers. Zero while volumes exist means every
+        assignment would land on a node that is shutting down, which
+        the master treats as a grow trigger."""
+        return sum(1 for vid in self.writable
+                   if not any(n.draining
+                              for n in self.locations.get(vid, [])))
 
 
 class Topology:
@@ -256,6 +275,7 @@ class Topology:
             node.last_seen = time.time()
             node.scrubbing = bool(hb.get("scrubbing", False))
             node.qos_pressure = float(hb.get("qos_pressure", 0.0))
+            node.draining = bool(hb.get("draining", False))
             node.grpc_port = hb.get("grpc_port", 0)
             node.max_volume_count = hb.get("max_volume_count",
                                            node.max_volume_count)
@@ -304,6 +324,8 @@ class Topology:
                 node.scrubbing = bool(deltas["scrubbing"])
             if "qos_pressure" in deltas:
                 node.qos_pressure = float(deltas["qos_pressure"])
+            if "draining" in deltas:
+                node.draining = bool(deltas["draining"])
             new_vids, deleted_vids = set(), set()
             new_ec_vids, deleted_ec_vids = set(), set()
             # deletes BEFORE adds: a disk-tier move reports the same

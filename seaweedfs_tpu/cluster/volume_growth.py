@@ -42,6 +42,11 @@ def find_empty_slots(topo: Topology, rp: ReplicaPlacement,
     tier (reference types.DiskType), so untyped growth never lands on
     a node that only has ssd slots."""
     def fs(obj) -> float:
+        # a draining node takes no new volumes (graceful-drain
+        # contract); dc/rack aggregates still count it, but the
+        # node-level weighted pick zeroes it out
+        if getattr(obj, "draining", False):
+            return 0.0
         return obj.free_space(disk or "")
 
     dcs = [dc for dc in topo.data_centers.values() if fs(dc) >= 1]

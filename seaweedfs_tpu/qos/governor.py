@@ -23,12 +23,11 @@ the bit-for-bit comparator switch, same convention as
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional
 
 from seaweedfs_tpu.qos.classes import BACKGROUND, CLASSES, INTERACTIVE, WRITE
 from seaweedfs_tpu.qos.limiter import AdaptiveLimiter
-from seaweedfs_tpu.utils import tracing
+from seaweedfs_tpu.utils import clockctl, tracing
 
 # pressure decays with this half-life after the last shed event
 _SHED_HALF_LIFE_S = 5.0
@@ -87,7 +86,7 @@ class TenantBuckets:
         the table grows past 4096 so an IP sweep can't balloon it."""
         if self.rate <= 0:
             return True, 0.0
-        now = time.monotonic()
+        now = clockctl.monotonic()
         with self._lock:
             b = self._buckets.get(key)
             if b is None:
@@ -199,7 +198,7 @@ class QosGovernor:
                 self._admitted[cls] += 1
                 if self._m_admitted:
                     self._m_admitted.inc(cls)
-                t0 = time.monotonic()
+                t0 = clockctl.monotonic()
                 # the admission verdict lands on the ambient server
                 # span (annotate is a ContextVar read when no trace)
                 tracing.annotate("qos.verdict", "admitted")
@@ -210,7 +209,7 @@ class QosGovernor:
                 return Grant(True,
                              release_fn=lambda: self._release(cls, t0))
             self._shed[cls] += 1
-            self._last_shed = time.monotonic()
+            self._last_shed = clockctl.monotonic()
         if self._m_shed:
             self._m_shed.inc(cls, "limit")
         # polite hint: roughly the time for the queue estimate to
@@ -221,7 +220,7 @@ class QosGovernor:
         return Grant(False, retry_after=ra, reason="limit")
 
     def _release(self, cls: str, t0: float) -> None:
-        dt = time.monotonic() - t0
+        dt = clockctl.monotonic() - t0
         with self._lock:
             self._inflight[cls] -= 1
             prev = self._lat_ms[cls]
@@ -244,7 +243,7 @@ class QosGovernor:
         util = max(0.0, min(1.0, (total / limit - 0.5) / 0.5))
         shed = 0.0
         if last_shed > 0:
-            age = time.monotonic() - last_shed
+            age = clockctl.monotonic() - last_shed
             shed = 0.5 ** (age / _SHED_HALF_LIFE_S)
         return max(util, shed)
 

@@ -64,7 +64,8 @@ class RepairQueue:
                  backoff_base: float = 2.0, backoff_max: float = 300.0,
                  scan_grace_s: float = 60.0,
                  repair_rate_mbps: float = 0.0,
-                 partial_repair: bool = True):
+                 partial_repair: bool = True,
+                 drain_grace_s: float = 120.0):
         """scan_grace_s: how long a volume must stay CONTINUOUSLY
         degraded in the heartbeat shard map before the scanner enqueues
         it — transient states (a node mid-restart, an operator running
@@ -77,6 +78,12 @@ class RepairQueue:
         rebuild traffic, so N parallel repairs split the budget instead
         of each taking the full rate (<= 0 = unlimited).
 
+        drain_grace_s: how long after a node announces a graceful
+        drain its volumes stay exempt from the degraded scan — a
+        rolling restart (drain, stop, start, re-register) must look
+        like nothing happened, not like a repair storm. Scrub
+        corruption reports still skip every grace.
+
         partial_repair: try the network-frugal partial-column rebuild
         (/admin/ec/rebuild_partial — the rebuilder pulls pre-reduced
         columns through a reduction chain, ~1 shard-width received per
@@ -88,6 +95,10 @@ class RepairQueue:
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
         self.scan_grace_s = scan_grace_s
+        self.drain_grace_s = drain_grace_s
+        # vid -> wall-clock deadline: exempt from the degraded scan
+        # while its (graceful-drain-departed) holder is expected back
+        self._drain_grace: dict[int, float] = {}
         self._base_rate = repair_rate_mbps * 1024 * 1024
         self.bandwidth = TokenBucket(self._base_rate)
         # max qos_pressure over live nodes, refreshed each tick(): the
@@ -152,6 +163,19 @@ class RepairQueue:
             self.recent_needle_reports.append(body)
             del self.recent_needle_reports[:-MAX_RECENT_NEEDLE_REPORTS]
         return {"queued": False, "recorded": True}
+
+    def note_drain(self, vids, grace_s: "float | None" = None) -> float:
+        """A node carrying `vids` announced a graceful drain: exempt
+        those volumes from the degraded scan until the grace expires
+        (refreshes on every draining heartbeat). Returns the
+        deadline."""
+        until = time.time() + (self.drain_grace_s
+                               if grace_s is None else grace_s)
+        with self._lock:
+            for vid in vids:
+                self._drain_grace[vid] = max(
+                    self._drain_grace.get(vid, 0.0), until)
+        return until
 
     def submit(self, vid: int, collection: str = "",
                corrupt_shards: set = frozenset(),
@@ -228,8 +252,19 @@ class RepairQueue:
         for vid in list(self._degraded_since):
             if vid not in degraded:
                 del self._degraded_since[vid]
+        with self._lock:
+            for vid in list(self._drain_grace):
+                if self._drain_grace[vid] <= now:
+                    del self._drain_grace[vid]
+            in_grace = set(self._drain_grace)
         for vid, missing in degraded.items():
             if missing <= 0:
+                continue
+            if vid in in_grace:
+                # the holder left via graceful drain and is expected
+                # back; restart the continuous-degraded clock so the
+                # normal scan grace only starts once drain grace ends
+                self._degraded_since[vid] = now
                 continue
             since = self._degraded_since.setdefault(vid, now)
             if now - since < self.scan_grace_s:
@@ -523,6 +558,7 @@ class RepairQueue:
                 "repair_rate_bytes_per_sec": self.bandwidth.rate,
                 "base_rate_bytes_per_sec": self._base_rate,
                 "cluster_qos_pressure": round(self.cluster_pressure, 4),
+                "drain_grace_vids": sorted(self._drain_grace),
                 "budget_remaining_bytes":
                     (round(self.bandwidth.peek())
                      if self.bandwidth.rate > 0 else None),

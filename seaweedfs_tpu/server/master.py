@@ -551,7 +551,14 @@ class MasterServer:
                                 status=409)
             self.topo.incremental_sync(node, hb)
         else:
-            self.topo.sync_data_node_registration(hb)
+            node = self.topo.sync_data_node_registration(hb)
+        if node is not None and node.draining:
+            # graceful drain announced: exempt the node's volumes from
+            # the degraded repair scan so a rolling restart never looks
+            # like a failure (refreshed on every draining heartbeat)
+            vids = set(node.volumes) | set(node.ec_shards)
+            if vids:
+                self.repair_queue.note_drain(vids)
         # mirror reference reply: volume size limit + leader
         return Response({
             "volume_size_limit": self.topo.volume_size_limit,
@@ -583,13 +590,20 @@ class MasterServer:
         layout = self.topo.get_layout(collection, replication, ttl,
                                       disk_type)
         with self._grow_lock:
-            if layout.active_volume_count() == 0:
+            # grow when there is nothing writable, and ALSO when every
+            # writable volume touches a draining node: a rolling
+            # restart must not funnel new writes onto the server that
+            # is about to close its listener
+            if layout.clean_volume_count() == 0:
                 try:
                     grow_by_type(self.topo, collection, replication, ttl,
                                  self._allocate_rpc, count=1,
                                  preferred_dc=data_center, disk=disk_type)
                 except NoFreeSpaceError as e:
-                    return {"error": str(e)}
+                    if layout.active_volume_count() == 0:
+                        return {"error": str(e)}
+                    # no room to grow but draining copies still serve:
+                    # pick_for_write's fallback takes the slow path
                 # replicate the new MaxVolumeId so a failed-over leader
                 # never re-issues a vid (cluster_commands.go)
                 if not self._raft_propose({"type": "max_volume_id",

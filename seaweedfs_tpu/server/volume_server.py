@@ -140,6 +140,9 @@ class VolumeServer:
         self._public_url = public_url
         self.store: Optional[Store] = None
         self._stop = threading.Event()
+        # graceful-drain announcement: rides every heartbeat so the
+        # master stops assigning here and grants repair drain grace
+        self.draining = False
         self._hb_thread: Optional[threading.Thread] = None
         self.volume_size_limit = 0
         self.jwt_signing_key = jwt_signing_key
@@ -256,12 +259,39 @@ class VolumeServer:
         glog.info("volume server up at %s (dirs=%s, master=%s)",
                   self.url, ",".join(self._store_dirs), self.master_url)
 
-    def stop(self) -> None:
+    def stop(self, graceful: bool = True,
+             drain_timeout: float = 5.0) -> None:
+        """Stop serving. graceful=True (the default) drains first:
+        announce draining to the master (no new assigns, repair drain
+        grace for our volumes), let in-flight requests finish, flush
+        the group commit, then send a final draining heartbeat so the
+        grace clock restarts from the actual departure."""
         self._stop.set()
         if self.scrubber is not None:
             self.scrubber.stop()
+        graceful = graceful and self.store is not None
+        if graceful:
+            self.draining = True
+            try:
+                self.heartbeat_once()
+            except Exception:
+                pass  # master gone: hard teardown still proceeds
+            self.http.drain(drain_timeout)
         if self._replicate_pool is not None:
-            self._replicate_pool.shutdown(wait=False)
+            # graceful: wait out queued replica fan-out legs so every
+            # acked write reaches its peers before we disappear
+            self._replicate_pool.shutdown(wait=graceful)
+        if graceful:
+            for loc in self.store.locations:
+                for v in list(loc.volumes.values()):
+                    try:
+                        v.sync()
+                    except Exception:
+                        pass
+            try:
+                self.heartbeat_once()
+            except Exception:
+                pass
         self.metrics.stop_push()
         if self.tcp_server is not None:
             self.tcp_server.stop()
@@ -317,6 +347,7 @@ class VolumeServer:
     def heartbeat_once(self) -> None:
         hb = self.store.collect_heartbeat()
         hb["scrubbing"] = self._is_scrubbing()
+        hb["draining"] = self.draining
         # local overload pressure rides every heartbeat so the master's
         # repair scheduler can back off nodes that are shedding load
         hb["qos_pressure"] = round(self.qos.pressure(), 4)
@@ -379,6 +410,7 @@ class VolumeServer:
         body = {"ip": self.store.ip, "port": self.store.port,
                 "is_delta": True, "scrubbing": self._is_scrubbing(),
                 "qos_pressure": round(self.qos.pressure(), 4),
+                "draining": self.draining,
                 **deltas}
         try:
             self._master_json("POST", "/heartbeat", body,
@@ -412,6 +444,7 @@ class VolumeServer:
                             "is_delta": True,
                             "scrubbing": self._is_scrubbing(),
                             "qos_pressure": round(self.qos.pressure(), 4),
+                            "draining": self.draining,
                             **deltas}
                     reply = self._master_json(
                         "POST", "/heartbeat", body,

@@ -1,0 +1,275 @@
+"""Scripted incident library + machine-checked invariants.
+
+Four incidents, each a pure function of (seed, n_actors):
+
+  az_loss          grey-failure prelude (scripted latency band on every
+                   link), then correlated crash of one whole AZ; the
+                   fleet must lose zero acked writes, re-replicate
+                   within the pacing budget, keep interactive p99
+                   bounded and no tenant starved.
+  rolling_restart  drain -> restart every node, one AZ at a time in
+                   small groups; the drain path must make this
+                   invisible: ZERO failed client requests and ZERO
+                   repair enqueues for drained nodes, breakers all
+                   re-closed at the end.
+  herd_repair      simultaneous crash of a spread of nodes (repair
+                   storm) plus a scripted 5xx flap on one survivor;
+                   pacing must hold (active streams never exceed the
+                   budget), convergence within budget, p99 bounded,
+                   breakers recovered.
+  tenant_flood     one tenant floods background scans at ~4x the total
+                   polite load; the governor must keep interactive p99
+                   bounded, shed the flood (not the polite tenants),
+                   and still leave the flooder its background slot.
+
+``run_incident`` returns a JSON-able report: per-invariant verdicts,
+client/repair metrics, the event-log hash (bit-reproducibility), and
+the sizing actually used.  Used by tests/test_macro_sim.py (16-actor
+tier-1 smoke, 100-actor slow matrix) and tools/macro_sim.py.
+"""
+
+from __future__ import annotations
+
+from seaweedfs_tpu.qos.classes import INTERACTIVE
+from seaweedfs_tpu.sim.faults import parse_schedule
+from seaweedfs_tpu.sim.harness import SimCluster, percentile
+from seaweedfs_tpu.sim.workload import TenantSpec, ZipfWorkload, \
+    default_tenants
+
+# interactive p99 ceiling (virtual seconds) for every incident: service
+# time is ~4ms, so 250ms allows one failover + backoff but not collapse
+P99_BOUND_S = 0.25
+TENANT_MIN_OK_RATIO = 0.85
+
+
+def _check(name: str, ok: bool, detail: str) -> dict:
+    return {"name": name, "ok": bool(ok), "detail": detail}
+
+
+def _common_invariants(cluster: SimCluster, checks: list) -> None:
+    lost = cluster.lost_acked_writes()
+    checks.append(_check(
+        "zero_acked_write_loss", not lost,
+        f"{len(lost)} acked writes unreadable" if lost
+        else f"{len(cluster.metrics.acked)} acked writes all readable"))
+    p99 = percentile(cluster.metrics.lat[INTERACTIVE], 0.99)
+    checks.append(_check(
+        "interactive_p99_bounded", p99 <= P99_BOUND_S,
+        f"p99={p99 * 1000:.1f}ms bound={P99_BOUND_S * 1000:.0f}ms"))
+
+
+def _tenant_invariant(cluster: SimCluster, checks: list,
+                      exclude=()) -> None:
+    worst_name, worst = "", 1.0
+    for t, (ok, fail) in sorted(cluster.metrics.tenants.items()):
+        if t in exclude or ok + fail == 0:
+            continue
+        ratio = ok / (ok + fail)
+        if ratio < worst:
+            worst_name, worst = t, ratio
+    checks.append(_check(
+        "no_tenant_starvation", worst >= TENANT_MIN_OK_RATIO,
+        f"worst tenant {worst_name or 'n/a'} ok-ratio {worst:.3f} "
+        f"(floor {TENANT_MIN_OK_RATIO})"))
+
+
+def _breaker_invariant(cluster: SimCluster, checks: list) -> None:
+    bad = cluster.open_breakers()
+    checks.append(_check(
+        "breakers_reclosed", not bad,
+        f"open against live nodes: {bad[:4]}" if bad
+        else "all filer breakers closed against live nodes"))
+
+
+def _convergence_invariant(cluster: SimCluster, checks: list,
+                           t_fault: float, n_repairs_expected: int) -> None:
+    m = cluster.master
+    copy_s = (cluster.volumes[0].base_volume_bytes
+              / m.repair_stream_bw + 0.1)
+    # detection (5 pulses + tick quantization) + continuous scan grace
+    # + paced copy waves with 3.5x slack for backoff/stagger + settle
+    budget = (12.0 + m.repair_grace_s
+              + 3.5 * n_repairs_expected * copy_s / m.max_repair_streams
+              + 15.0)
+    took = (m.converged_at - t_fault) if m.converged_at else None
+    checks.append(_check(
+        "repair_converged_in_budget",
+        took is not None and took <= budget,
+        f"converged in {took:.1f}s (budget {budget:.1f}s, "
+        f"{m.repairs_done} repairs)" if took is not None
+        else f"NOT converged (queue={len(m._queue)} "
+             f"active={len(m._active)} degraded={len(cluster.degraded_vids())})"))
+    checks.append(_check(
+        "repair_pacing_held",
+        m.repair_active_max <= m.max_repair_streams,
+        f"max active streams {m.repair_active_max} "
+        f"<= budget {m.max_repair_streams}"))
+
+
+def _settle(cluster: SimCluster, workload: ZipfWorkload, t0: float,
+            duration: float) -> None:
+    """Light post-incident traffic so half-open probes have something
+    to ride on (breakers only transition on real calls)."""
+    rate = max(8.0, 0.5 * len(cluster.volumes))
+    ops = ZipfWorkload([TenantSpec("settle", rate)],
+                       seed=cluster.kernel.seed + 7,
+                       keyspace=workload.keyspace).generate(duration)
+    for op in ops:
+        op.t += t0
+    cluster.load(ops)
+
+
+# ---------------------------------------------------------------- incidents
+
+def _az_loss(cluster: SimCluster, n_actors: int, rate: float) -> list:
+    duration, t_fault = 40.0, 12.0
+    schedule = [{"link": "*->*", "fault": "latency", "start": 8.0,
+                 "duration": 4.0, "latency_ms": 60}]
+    cluster.faults.events[:] = parse_schedule(schedule)
+    wl = ZipfWorkload(default_tenants(4, rate), seed=cluster.kernel.seed)
+    cluster.load(wl.generate(duration))
+    cluster.at(t_fault, cluster.crash_az, 0)
+    cluster.run(duration)
+    n_lost = len(cluster.az_nodes(0))
+    degraded = sum(1 for vid, holders in cluster.master.layout.items()
+                   if any(cluster.actor(h).crashed for h in holders))
+    _settle(cluster, wl, duration, 30.0)
+    cluster.run_until_converged(duration + 90.0)
+    cluster.run(cluster.kernel.now + 8.0)  # let probes settle
+    checks: list = []
+    _common_invariants(cluster, checks)
+    _tenant_invariant(cluster, checks)
+    _convergence_invariant(cluster, checks, t_fault, degraded)
+    checks.append(_check(
+        "az_dead_detected", len(cluster.master.dead) == n_lost,
+        f"{len(cluster.master.dead)}/{n_lost} lost nodes declared dead"))
+    return checks
+
+
+def _rolling_restart(cluster: SimCluster, n_actors: int,
+                     rate: float) -> list:
+    wl = ZipfWorkload(default_tenants(4, rate), seed=cluster.kernel.seed)
+
+    def roll():
+        yield 6.0  # warmup traffic first
+        for az in range(cluster.n_az):
+            nodes = cluster.az_nodes(az)
+            group = max(1, len(nodes) // 4)
+            for i in range(0, len(nodes), group):
+                batch = nodes[i:i + group]
+                drains = [cluster.kernel.spawn(
+                    cluster.actor(n).drain()) for n in batch]
+                yield drains
+                yield 3.0  # process down: restart delay
+                for n in batch:
+                    cluster.restore(n)
+                yield 2.0  # re-register + settle before next batch
+
+    driver = cluster.kernel.spawn(roll())
+    # traffic must cover the whole wave: 4 az * 4 groups * ~6s
+    duration = 6.0 + cluster.n_az * 4 * 6.5 + 10.0
+    cluster.load(wl.generate(duration))
+    cluster.run(duration)
+    if not driver.done:  # pragma: no cover - sizing guard
+        raise RuntimeError("rolling restart did not finish in window")
+    _settle(cluster, wl, duration, 10.0)
+    cluster.run(duration + 12.0)  # 2x breaker open_for + probe traffic
+    checks: list = []
+    _common_invariants(cluster, checks)
+    checks.append(_check(
+        "zero_failed_client_requests", cluster.metrics.fail_total == 0,
+        f"{cluster.metrics.fail_total} failed ops "
+        f"(samples: {cluster.metrics.fail_samples[:3]})"
+        if cluster.metrics.fail_total else
+        f"all {cluster.metrics.ops_total()} ops succeeded"))
+    enq = cluster.master.repair_enqueued_for
+    checks.append(_check(
+        "zero_repairs_for_drained_nodes", not enq,
+        f"repairs enqueued for {dict(enq)}" if enq
+        else "repair queue never saw a drained node"))
+    _breaker_invariant(cluster, checks)
+    _tenant_invariant(cluster, checks)
+    return checks
+
+
+def _herd_repair(cluster: SimCluster, n_actors: int, rate: float) -> list:
+    duration, t_fault = 40.0, 10.0
+    victims = [f"vol-{i}" for i in range(0, n_actors, 7)]
+    flapper = f"vol-{3 % n_actors}"
+    schedule = [{"link": f"*->{flapper}", "fault": "http_error",
+                 "start": 14.0, "duration": 5.0, "status": 503}]
+    cluster.faults.events[:] = parse_schedule(schedule)
+    wl = ZipfWorkload(default_tenants(4, rate), seed=cluster.kernel.seed)
+    cluster.load(wl.generate(duration))
+
+    def herd():
+        yield t_fault
+        cluster.kernel.note("incident", "herd_crash", str(len(victims)))
+        for v in victims:
+            cluster.crash(v)
+
+    cluster.kernel.spawn(herd())
+    cluster.run(duration)
+    degraded = sum(1 for vid, holders in cluster.master.layout.items()
+                   if any(cluster.actor(h).crashed for h in holders))
+    _settle(cluster, wl, duration, 30.0)
+    cluster.run_until_converged(duration + 120.0)
+    cluster.run(cluster.kernel.now + 8.0)
+    checks: list = []
+    _common_invariants(cluster, checks)
+    _convergence_invariant(cluster, checks, t_fault, degraded)
+    _breaker_invariant(cluster, checks)
+    _tenant_invariant(cluster, checks)
+    return checks
+
+
+def _tenant_flood(cluster: SimCluster, n_actors: int, rate: float) -> list:
+    duration = 40.0
+    tenants = default_tenants(4, rate, flood_tenant="flooder",
+                              flood_rate=20.0 * rate)
+    wl = ZipfWorkload(tenants, seed=cluster.kernel.seed)
+    cluster.load(wl.generate(duration))
+    cluster.run(duration + 5.0)
+    checks: list = []
+    _common_invariants(cluster, checks)
+    _tenant_invariant(cluster, checks, exclude=("flooder",))
+    fl_ok, _fl_fail = cluster.metrics.tenants.get("flooder", (0, 0))
+    fl_shed = cluster.metrics.sheds.get("flooder", 0)
+    polite_shed = sum(n for t, n in cluster.metrics.sheds.items()
+                      if t != "flooder")
+    checks.append(_check(
+        "flood_was_shed", fl_shed > 0 and fl_shed >= 10 * max(1, polite_shed),
+        f"flooder shed {fl_shed}x vs polite tenants {polite_shed}x"))
+    checks.append(_check(
+        "flood_not_fully_starved", fl_ok > 0,
+        f"flooder still completed {fl_ok} background ops"))
+    return checks
+
+
+INCIDENTS = {
+    "az_loss": _az_loss,
+    "rolling_restart": _rolling_restart,
+    "herd_repair": _herd_repair,
+    "tenant_flood": _tenant_flood,
+}
+
+
+def run_incident(name: str, seed: int = 0, n_actors: int = 100,
+                 n_filers: int = 4, rate: float = 0.0) -> dict:
+    """Run one scripted incident; returns the JSON-able report.
+    ``rate`` 0 auto-sizes offered load to ~2.4 ops/s per actor."""
+    if name not in INCIDENTS:
+        raise KeyError(f"unknown incident {name!r} "
+                       f"(have {sorted(INCIDENTS)})")
+    if rate <= 0:
+        rate = 2.4 * n_actors
+    cluster = SimCluster(n_volume_actors=n_actors, n_filers=n_filers,
+                         seed=seed)
+    checks = INCIDENTS[name](cluster, n_actors, rate)
+    report = cluster.report()
+    report.update({
+        "incident": name, "seed": seed, "actors": n_actors,
+        "invariants": checks,
+        "passed": all(c["ok"] for c in checks),
+    })
+    return report

@@ -1,0 +1,68 @@
+"""Process-wide monotonic-clock indirection for virtual-time testing.
+
+Every timer in the resilience and QoS layers (breaker open windows,
+deadline budgets, retry sleeps, token-bucket refills, pressure decay)
+reads the clock through this module instead of ``time`` directly.  In
+production nothing changes: the default hooks ARE ``time.monotonic`` /
+``time.sleep`` and the indirection costs one module-attribute load.
+
+The macro-scale simulation harness (``seaweedfs_tpu/sim``) installs a
+VirtualClock here so O(100) in-process actors share one deterministic
+compressed timeline: a breaker's 5s open window elapses when the sim
+kernel advances 5 virtual seconds, not 5 wall seconds.  ``install()``
+returns a restore handle and is also usable as a context manager, so a
+test can never leak a virtual clock into the rest of the suite.
+
+Deliberately NOT thread-aware: the simulator is single-threaded by
+construction (that is what makes it bit-reproducible), and production
+never installs anything.  Code that needs wall time for *measurement*
+(bench drivers, tracing timestamps) keeps using ``time`` directly —
+only *behavioral* timers route through here.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+_monotonic: Callable[[], float] = time.monotonic
+_sleep: Callable[[float], None] = time.sleep
+
+
+def monotonic() -> float:
+    """The behavioral clock: wall monotonic unless a virtual clock is
+    installed."""
+    return _monotonic()
+
+
+def sleep(seconds: float) -> None:
+    """Behavioral sleep (retry backoff etc.); virtual clocks make this
+    raise — simulated actors must yield to the kernel instead of
+    blocking the one real thread."""
+    _sleep(seconds)
+
+
+def is_virtual() -> bool:
+    return _monotonic is not time.monotonic
+
+
+def _no_real_sleep(seconds: float) -> None:
+    raise RuntimeError(
+        "blocking sleep under a virtual clock — simulated code must "
+        "yield to the sim kernel instead")
+
+
+@contextmanager
+def install(monotonic_fn: Callable[[], float],
+            sleep_fn: Optional[Callable[[float], None]] = None):
+    """Install a clock override for the duration of a with-block.
+    Nested installs restore correctly (LIFO)."""
+    global _monotonic, _sleep
+    prev = (_monotonic, _sleep)
+    _monotonic = monotonic_fn
+    _sleep = sleep_fn if sleep_fn is not None else _no_real_sleep
+    try:
+        yield
+    finally:
+        _monotonic, _sleep = prev

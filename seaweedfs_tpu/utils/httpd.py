@@ -155,6 +155,13 @@ class HttpServer:
         # and records it into the node's flight recorder. None -> the
         # shared NOOP span, zero allocation.
         self.tracer = None
+        # graceful-drain state: once draining, new requests (including
+        # ones arriving on kept-alive connections) are answered 503 +
+        # Connection: close while in-flight requests run to completion;
+        # drain() waits on the in-flight counter.
+        self.draining = False
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
 
     def route(self, method: str, pattern: str):
         compiled = re.compile("^" + pattern + "$")
@@ -258,6 +265,23 @@ class HttpServer:
 
             def _dispatch(self):
                 length = int(self.headers.get("Content-Length") or 0)
+                if server.draining:
+                    # a draining server takes no NEW work; kept-alive
+                    # clients get a clean 503 + close so their retry
+                    # lands on another replica immediately
+                    self._reject(Response(
+                        {"error": "draining"}, status=503,
+                        headers={"Retry-After": "1"}), length)
+                    return
+                with server._inflight_lock:
+                    server._inflight += 1
+                try:
+                    self._dispatch_traced(length)
+                finally:
+                    with server._inflight_lock:
+                        server._inflight -= 1
+
+            def _dispatch_traced(self, length):
                 path = urllib.parse.unquote(
                     urllib.parse.urlparse(self.path).path)
                 # server span: continue an inbound X-Weed-Trace or mint
@@ -411,6 +435,24 @@ class HttpServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Graceful-stop phase one: refuse new requests (503 + close),
+        stop accepting connections, and wait for in-flight requests to
+        finish.  Returns True when the server went idle within
+        ``timeout``; the caller then runs stop() for the hard close.
+        Idempotent, and safe before start()."""
+        self.draining = True
+        if self._httpd:
+            self._httpd.shutdown()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                if self._inflight == 0:
+                    return True
+            time.sleep(0.02)
+        with self._inflight_lock:
+            return self._inflight == 0
 
     def stop(self) -> None:
         if self._httpd:

@@ -45,9 +45,16 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Callable, Iterable, Optional, Sequence
 
-from seaweedfs_tpu.utils import tracing
+from seaweedfs_tpu.utils import clockctl, tracing
 
 DEADLINE_HEADER = "X-Weed-Deadline"  # remaining seconds, decimal string
+
+
+def _now() -> float:
+    """Behavioral clock: wall monotonic in production, the sim kernel's
+    virtual clock when one is installed (utils/clockctl.py) — breaker
+    open windows, deadlines and retry sleeps all elapse in sim time."""
+    return clockctl.monotonic()
 
 CLOSED = "closed"
 OPEN = "open"
@@ -72,13 +79,13 @@ class Deadline:
 
     @classmethod
     def after(cls, seconds: float) -> "Deadline":
-        return cls(time.monotonic() + max(0.0, float(seconds)))
+        return cls(_now() + max(0.0, float(seconds)))
 
     def remaining(self) -> float:
-        return max(0.0, self._at - time.monotonic())
+        return max(0.0, self._at - _now())
 
     def expired(self) -> bool:
-        return time.monotonic() >= self._at
+        return _now() >= self._at
 
     def timeout(self, cap: Optional[float] = None) -> float:
         """Socket timeout for one nested call: min(remaining, cap).
@@ -93,7 +100,7 @@ class Deadline:
         """A child deadline capped at `seconds` from now — for a step
         that must leave budget for the caller's fallback (e.g. a direct
         remote fetch must not starve degraded reconstruction)."""
-        return Deadline(min(self._at, time.monotonic() + float(seconds)))
+        return Deadline(min(self._at, _now() + float(seconds)))
 
     def header_value(self) -> str:
         return f"{self.remaining():.3f}"
@@ -217,7 +224,7 @@ class RetryPolicy:
                     # never sleep into (or retry inside) a budget that
                     # cannot fit the server-requested wait
                     raise
-                time.sleep(delay)
+                clockctl.sleep(delay)
         raise last  # pragma: no cover - loop always returns/raises
 
 
@@ -261,7 +268,7 @@ class CircuitBreaker:
             if self.state == CLOSED:
                 return True
             if self.state == OPEN:
-                if time.monotonic() - self._opened_at < self.open_for:
+                if _now() - self._opened_at < self.open_for:
                     return False
                 self.state = HALF_OPEN
                 self._probes = 0
@@ -278,14 +285,14 @@ class CircuitBreaker:
             if self.state == HALF_OPEN:
                 return self._probes < self.half_open_max
             return (self.state == OPEN
-                    and time.monotonic() - self._opened_at >= self.open_for)
+                    and _now() - self._opened_at >= self.open_for)
 
     # -- outcomes --
     def record(self, ok: bool, latency_s: Optional[float] = None) -> None:
         with self._lock:
             if ok:
                 self.success_total += 1
-                self.last_ok_at = time.monotonic()
+                self.last_ok_at = _now()
                 self._consec_failures = 0
                 if self.state != CLOSED:
                     self.state = CLOSED
@@ -298,20 +305,20 @@ class CircuitBreaker:
                          + (1.0 - self.ewma_alpha) * self.ewma_s)
                 return
             self.failure_total += 1
-            self.last_fail_at = time.monotonic()
+            self.last_fail_at = _now()
             self._consec_failures += 1
             if self.state == HALF_OPEN \
                     or (self.state == CLOSED
                         and self._consec_failures >= self.failure_threshold):
                 self.state = OPEN
-                self._opened_at = time.monotonic()
+                self._opened_at = _now()
                 self.opened_total += 1
                 self._probes = 0
             elif self.state == OPEN:
                 # a failed ripe probe (or a forced dial on a sole
                 # holder) re-arms the open window — the peer proved it
                 # is still down, so back off for another `open_for`
-                self._opened_at = time.monotonic()
+                self._opened_at = _now()
 
     # -- health --
     def p95_s(self) -> Optional[float]:
@@ -337,7 +344,7 @@ class CircuitBreaker:
 
     def snapshot(self) -> dict:
         with self._lock:
-            now = time.monotonic()
+            now = _now()
             return {
                 "state": self.state,
                 "ewma_ms": (round(self.ewma_s * 1000, 2)
@@ -513,13 +520,13 @@ def hedged(fn: Callable[[str], object], candidates: Sequence[str],
     ctx_sp = tracing.current_span()
 
     def run_one(c: str):
-        t0 = time.monotonic()
+        t0 = _now()
         try:
             with deadline_scope(ctx_dl), tracing.span_scope(ctx_sp):
                 out = fn(c)
         except Exception:
             out = None
-        lat = time.monotonic() - t0
+        lat = _now() - t0
         if health is not None:
             health.record(c, out is not None, lat if out is not None
                           else None)

@@ -1,0 +1,262 @@
+"""Macro-scale incident simulation: schema, determinism, invariants.
+
+Tier-1 (unmarked) tests keep the fleet small (16 actors) so the whole
+file runs in a few seconds; the 100-actor acceptance matrix — every
+incident in the library at the paper-scale actor count — is
+slow-marked. Alongside the sim proper this file pins down the control
+policies the sim exercises with the REAL implementations on a virtual
+clock: the circuit breaker's open -> half-open -> closed walk and the
+adaptive limiter's dual-EWMA gradient on a scripted latency trace.
+"""
+
+import json
+
+import pytest
+
+from seaweedfs_tpu.qos.limiter import AdaptiveLimiter
+from seaweedfs_tpu.sim.faults import FaultScheduler, parse_schedule
+from seaweedfs_tpu.sim.harness import SimCluster
+from seaweedfs_tpu.sim.incidents import INCIDENTS, run_incident
+from seaweedfs_tpu.sim.workload import ZipfWorkload, default_tenants
+from seaweedfs_tpu.utils import clockctl
+from seaweedfs_tpu.utils.resilience import (CLOSED, HALF_OPEN, OPEN,
+                                            CircuitBreaker)
+
+
+# ---------------------------------------------------- fault schedule schema
+
+def test_schedule_parses_json_and_dict_and_list():
+    events = [{"link": "filer-0->vol-3", "fault": "latency",
+               "start": 5.0, "duration": 10.0, "latency_ms": 250},
+              {"link": "*->vol-7", "fault": "blackhole",
+               "start": 8, "duration": 6}]
+    for doc in (events, {"events": events},
+                json.dumps({"events": events})):
+        parsed = parse_schedule(doc)
+        assert [e.fault for e in parsed] == ["latency", "blackhole"]
+    # round-trips through to_dict
+    again = parse_schedule([e.to_dict() for e in parse_schedule(events)])
+    assert again[0].latency_ms == 250
+    assert again[1].dst == "vol-7" and again[1].src == "*"
+
+
+def test_schedule_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_schedule([{"link": "no-arrow", "fault": "latency",
+                         "start": 0, "duration": 1}])
+    with pytest.raises(ValueError):
+        parse_schedule([{"link": "a->b", "fault": "meteor",
+                         "start": 0, "duration": 1}])
+
+
+def test_schedule_decide_stacks_latency_and_later_mode_wins():
+    now = [0.0]
+    sched = FaultScheduler(parse_schedule([
+        {"link": "*->*", "fault": "latency", "start": 0, "duration": 10,
+         "latency_ms": 100},
+        {"link": "*->vol-1", "fault": "latency", "start": 0,
+         "duration": 10, "latency_ms": 50},
+        {"link": "*->vol-1", "fault": "http_error", "start": 5,
+         "duration": 2, "status": 429},
+    ]), lambda: now[0])
+    mode, extra, _ = sched.decide("filer-0", "vol-1")
+    assert mode is None and extra == pytest.approx(0.150)
+    mode, extra, _ = sched.decide("filer-0", "vol-2")
+    assert mode is None and extra == pytest.approx(0.100)
+    now[0] = 6.0
+    mode, extra, status = sched.decide("filer-0", "vol-1")
+    assert mode == "http_error" and status == 429
+    assert extra == pytest.approx(0.150)  # latency bands still stack
+    now[0] = 12.0
+    assert sched.decide("filer-0", "vol-1") == (None, 0.0, 503)
+    assert sched.horizon() == 10.0
+
+
+# ------------------------------------------------------------ determinism
+
+def test_same_seed_same_event_log():
+    a = run_incident("az_loss", seed=5, n_actors=16)
+    b = run_incident("az_loss", seed=5, n_actors=16)
+    assert a["log_hash"] == b["log_hash"]
+    assert a["client"]["ops"] == b["client"]["ops"]
+    c = run_incident("az_loss", seed=6, n_actors=16)
+    assert c["log_hash"] != a["log_hash"]
+
+
+def test_workload_is_a_pure_function_of_seed():
+    spec = default_tenants(3, 60.0)
+    ops1 = ZipfWorkload(spec, seed=11).generate(20.0)
+    ops2 = ZipfWorkload(default_tenants(3, 60.0), seed=11).generate(20.0)
+    assert [(o.t, o.tenant, o.kind, o.key) for o in ops1] == \
+        [(o.t, o.tenant, o.kind, o.key) for o in ops2]
+    # zipf skew: the most popular 1% of drawn keys covers a large
+    # share of the draws (hot-spot traffic, not uniform)
+    from collections import Counter
+    counts = Counter(o.key for o in ops1)
+    top = sum(n for _, n in counts.most_common(max(1, len(counts) // 100)))
+    assert top / len(ops1) > 0.05
+
+
+# ------------------------------------------------------ incident smokes
+
+def test_rolling_restart_invisible_at_16_actors():
+    r = run_incident("rolling_restart", seed=0, n_actors=16)
+    assert r["passed"], [c for c in r["invariants"] if not c["ok"]]
+    assert r["client"]["failed"] == 0
+    assert not r["repair"]["enqueued_for"]
+
+
+def test_az_loss_converges_at_16_actors():
+    r = run_incident("az_loss", seed=0, n_actors=16)
+    assert r["passed"], [c for c in r["invariants"] if not c["ok"]]
+    assert r["repair"]["done"] > 0
+    assert r["repair"]["converged_at"] is not None
+
+
+def test_unknown_incident_raises():
+    with pytest.raises(KeyError):
+        run_incident("kraken", n_actors=16)
+
+
+def test_sim_drain_excludes_node_and_finishes_inflight():
+    cluster = SimCluster(n_volume_actors=8, n_az=4, seed=1)
+    wl = ZipfWorkload(default_tenants(2, 40.0), seed=1)
+    cluster.load(wl.generate(8.0))
+    cluster.at(2.0, cluster.drain, "vol-0")
+    cluster.run(12.0)
+    actor = cluster.actor("vol-0")
+    assert actor.draining and actor.crashed  # drain ran to completion
+    assert actor.active == 0                 # nothing left in flight
+    st = cluster.master.nodes["vol-0"]
+    assert st["draining"]
+    # the master granted drain grace instead of queueing repairs
+    assert cluster.master.drain_grace_until.get("vol-0", 0) > 0
+    assert not cluster.master.repair_enqueued_for
+
+
+def test_az_disjoint_placement_requires_enough_zones():
+    with pytest.raises(ValueError):
+        SimCluster(n_volume_actors=8, n_az=2, replication=3)
+    c = SimCluster(n_volume_actors=8, n_az=4, replication=3)
+    for vid, holders in c.master.layout.items():
+        azs = {c.actor(h).az for h in holders}
+        assert len(azs) == 3  # one replica per zone
+
+
+# ------------------------------------- real policies on the virtual clock
+
+def test_breaker_walks_open_half_open_closed_on_virtual_time():
+    t = [0.0]
+    with clockctl.install(lambda: t[0]):
+        br = CircuitBreaker(failure_threshold=3, open_for=2.0)
+        for _ in range(3):
+            br.record(False)
+        assert br.state == OPEN and not br.allow()
+        t[0] += 1.0
+        assert not br.probe_ripe() and not br.allow()
+        t[0] += 1.1  # open_for elapsed: one probe slot opens
+        assert br.probe_ripe()
+        assert br.allow()
+        assert br.state == HALF_OPEN
+        assert not br.allow()  # probe slots metered (half_open_max=1)
+        br.record(True, 0.004)
+        assert br.state == CLOSED and br.allow()
+
+
+def test_breaker_failed_probe_rearms_full_window():
+    t = [0.0]
+    with clockctl.install(lambda: t[0]):
+        br = CircuitBreaker(failure_threshold=1, open_for=2.0)
+        br.record(False)
+        t[0] += 2.5
+        assert br.allow()      # half-open probe
+        br.record(False)       # probe fails: re-open, fresh clock
+        assert br.state == OPEN
+        t[0] += 1.0            # only half the window
+        assert not br.allow()
+        t[0] += 1.5
+        assert br.allow()
+
+
+def test_adaptive_limiter_gradient_on_scripted_trace():
+    def make():
+        return AdaptiveLimiter(initial=32, min_limit=8, max_limit=256)
+
+    lim = make()
+    # scripted trace, phase 1: steady 4ms service -> headroom, the
+    # limit climbs (gradient clamps at 1.1 plus the sqrt explore term)
+    for _ in range(200):
+        lim.observe(0.004)
+    grown = lim.limit
+    assert grown > 32
+    assert lim.queue_delay() == pytest.approx(0.0, abs=1e-9)
+    # phase 2: latency steps to 40ms — the short EWMA races ahead of
+    # the long baseline, the gradient drops below 1, the limit backs off
+    for _ in range(50):
+        lim.observe(0.040)
+    assert lim.queue_delay() > 0.010
+    assert lim.limit < grown
+    # the whole walk is deterministic: an identical twin fed the same
+    # trace lands on the identical limit
+    twin = make()
+    for _ in range(200):
+        twin.observe(0.004)
+    for _ in range(50):
+        twin.observe(0.040)
+    assert twin.snapshot() == lim.snapshot()
+
+
+# ------------------------- same schedule schema against real processes
+
+def test_netchaos_replays_sim_schedule_against_real_proxy():
+    import time as _time
+
+    from tools.netchaos import ChaosProxy, ScheduleDriver
+    from seaweedfs_tpu.utils.httpd import HttpServer, Response, http_call
+
+    srv = HttpServer()
+    srv.add("GET", "/ping", lambda req: Response({"ok": True}))
+    srv.start()
+    proxy = ChaosProxy("127.0.0.1", srv.port).start()
+    # the exact JSON the sim transport consumes, replayed on wall time
+    driver = ScheduleDriver(proxy, {"events": [
+        {"link": "*->*", "fault": "http_error", "start": 0.1,
+         "duration": 0.4, "status": 418}]}).start()
+    try:
+        deadline = _time.time() + 2.0
+        saw_fault = False
+        while _time.time() < deadline and not saw_fault:
+            status, _, _ = http_call("GET", f"http://{proxy.url}/ping",
+                                     timeout=2.0)
+            saw_fault = status == 418
+            _time.sleep(0.05)
+        assert saw_fault
+        deadline = _time.time() + 3.0
+        while _time.time() < deadline and not driver.done():
+            _time.sleep(0.05)
+        assert driver.done()  # schedule exhausted, proxy healed
+        status, _, _ = http_call("GET", f"http://{proxy.url}/ping",
+                                 timeout=2.0)
+        assert status == 200
+        modes = [a["mode"] for a in driver.applied]
+        assert "http_error" in modes and modes[-1] == "pass"
+    finally:
+        driver.stop()
+        proxy.stop()
+        srv.stop()
+
+
+# ------------------------------------------------- 100-actor acceptance
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(INCIDENTS))
+def test_incident_matrix_100_actors(name):
+    r = run_incident(name, seed=0, n_actors=100)
+    assert r["passed"], [c for c in r["invariants"] if not c["ok"]]
+
+
+@pytest.mark.slow
+def test_bit_reproducible_at_100_actors():
+    a = run_incident("rolling_restart", seed=42, n_actors=100)
+    b = run_incident("rolling_restart", seed=42, n_actors=100)
+    assert a["log_hash"] == b["log_hash"]

@@ -18,7 +18,16 @@ the network conditions repair actually runs in.
 Usage (also importable: `with ChaosProxy(host, port, latency_s=0.2) as p:`):
   PYTHONPATH=. python tools/netchaos.py <target_host> <target_port> \
       [--listen-port N] [--latency MS] [--jitter MS] [--bandwidth BPS] \
-      [--mode pass|blackhole|reset|http_error] [--http-status 503] [--seed S]
+      [--mode pass|blackhole|reset|http_error] [--http-status 503] [--seed S] \
+      [--schedule faults.json] [--link "client->vol-3"]
+
+--schedule replays a time-scripted fault schedule — the SAME JSON
+schema the macro simulation consumes (seaweedfs_tpu/sim/faults.py), so
+an incident rehearsed against the 100-actor sim drives real processes
+unchanged. Times are seconds since proxy start; --link names the one
+link this proxy embodies so wildcard entries match correctly. The
+proxy starts in --mode and flips as schedule windows open and close,
+returning to plain pass-through after the horizon.
 
 Prints one JSON line with the listen address and the active fault, then
 serves until SIGINT.
@@ -270,6 +279,63 @@ class ChaosProxy:
                     pass
 
 
+class ScheduleDriver:
+    """Replay a sim/faults.py fault schedule onto one ChaosProxy.
+
+    The proxy embodies a single link; ``link`` ("src->dst") names it so
+    schedules shared with the macro sim — where wildcards span a whole
+    fleet — select the right windows. A background thread samples the
+    schedule every ``tick_s`` seconds of wall time since start() and
+    calls set_fault() whenever the collapsed decision changes; after
+    the last window closes the proxy is restored to clean pass-through
+    and the thread exits."""
+
+    def __init__(self, proxy: ChaosProxy, schedule,
+                 link: str = "client->server", tick_s: float = 0.05):
+        from seaweedfs_tpu.sim.faults import FaultScheduler, parse_schedule
+        self.proxy = proxy
+        src, _, dst = link.partition("->")
+        self.src, self.dst = (src.strip() or "*"), (dst.strip() or "*")
+        self._t0 = 0.0
+        self.sched = FaultScheduler(parse_schedule(schedule),
+                                    lambda: time.monotonic() - self._t0)
+        self.tick_s = tick_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="netchaos-schedule")
+        self.applied: list[dict] = []  # [{t, mode, latency_ms, status}]
+
+    def start(self) -> "ScheduleDriver":
+        self._t0 = time.monotonic()
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def _loop(self) -> None:
+        horizon = self.sched.horizon()
+        last = None
+        while not self._stop.is_set():
+            now = time.monotonic() - self._t0
+            mode, extra, status = self.sched.decide(self.src, self.dst)
+            state = (mode or "pass", round(extra, 6), status)
+            if state != last:
+                self.proxy.set_fault(mode=state[0], latency_s=extra,
+                                     http_status=status)
+                self.applied.append({"t": round(now, 3), "mode": state[0],
+                                     "latency_ms": extra * 1000.0,
+                                     "status": status})
+                last = state
+            if now > horizon and state[0] == "pass" and extra == 0.0:
+                return  # schedule exhausted, proxy left clean
+            self._stop.wait(self.tick_s)
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("target_host")
@@ -286,6 +352,12 @@ def main() -> None:
                    choices=("pass", "blackhole", "reset", "http_error"))
     p.add_argument("--http-status", type=int, default=503)
     p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--schedule", default="",
+                   help="JSON fault schedule file ('-' = stdin), same "
+                        "schema as seaweedfs_tpu/sim/faults.py")
+    p.add_argument("--link", default="*->*",
+                   help="'src->dst' identity of this proxy's link for "
+                        "schedule wildcard matching")
     args = p.parse_args()
 
     proxy = ChaosProxy(
@@ -294,15 +366,23 @@ def main() -> None:
         latency_s=args.latency / 1000.0, jitter_s=args.jitter / 1000.0,
         bandwidth_bps=args.bandwidth, mode=args.mode,
         http_status=args.http_status, seed=args.seed).start()
+    driver = None
+    if args.schedule:
+        doc = (sys.stdin.read() if args.schedule == "-"
+               else open(args.schedule).read())
+        driver = ScheduleDriver(proxy, doc, link=args.link).start()
     print(json.dumps({
         "listen": proxy.url, "target": f"{args.target_host}:{args.target_port}",
         "mode": args.mode, "latency_ms": args.latency,
-        "jitter_ms": args.jitter, "bandwidth_bps": args.bandwidth}),
+        "jitter_ms": args.jitter, "bandwidth_bps": args.bandwidth,
+        "schedule": bool(args.schedule), "link": args.link}),
         flush=True)
     try:
         while True:
             time.sleep(1.0)
     except KeyboardInterrupt:
+        if driver is not None:
+            driver.stop()
         proxy.stop()
 
 
