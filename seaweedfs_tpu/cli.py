@@ -698,13 +698,18 @@ def cmd_benchmark(args):
         key = (url, _th.get_ident())
         with tcp_lock:
             c = tcp_clients.get(key)
-            if c is None:
-                st = http_json("GET", f"http://{url}/status")
-                if "TcpPort" not in st:
-                    raise SystemExit(
-                        f"{url} has no TCP port; start volume with -tcp")
-                host = url.rsplit(":", 1)[0]
-                c = TcpClient(host, st["TcpPort"])
+        if c is None:
+            # status probe outside the lock: the key is per-thread, so
+            # no other thread can race this entry, and holding the lock
+            # across the HTTP round-trip would serialize every bench
+            # thread behind one slow volume server
+            st = http_json("GET", f"http://{url}/status")
+            if "TcpPort" not in st:
+                raise SystemExit(
+                    f"{url} has no TCP port; start volume with -tcp")
+            host = url.rsplit(":", 1)[0]
+            c = TcpClient(host, st["TcpPort"])
+            with tcp_lock:
                 tcp_clients[key] = c
         return c
 

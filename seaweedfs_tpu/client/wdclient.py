@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Optional
 
+from seaweedfs_tpu.utils import clockctl
 from seaweedfs_tpu.utils.httpd import HttpError, http_json
 from seaweedfs_tpu.utils.resilience import RetryPolicy
 
@@ -106,7 +107,7 @@ class MasterClient:
                 # the old fixed 0.2*2^n doubling resynchronized every
                 # disconnected client onto the same retry instants
                 # after a master restart (thundering herd)
-                time.sleep(self.retry.backoff(failures))
+                clockctl.sleep(self.retry.backoff(failures))
 
     def _apply_volume_location(self, vl) -> None:
         loc = {"url": vl.url, "publicUrl": vl.public_url or vl.url}
@@ -182,7 +183,7 @@ class MasterClient:
                 # per-destination tokens and stops the retry storm early
                 if not self.retry.allow_retry(self._leader):
                     break
-                time.sleep(self.retry.backoff(attempt))
+                clockctl.sleep(self.retry.backoff(attempt))
         raise last_err
 
     @property
@@ -204,13 +205,13 @@ class MasterClient:
             if locs:
                 return list(locs)
             hit = self._cache.get(vid)
-            if hit and time.time() - hit[0] < self.cache_ttl:
+            if hit and clockctl.now() - hit[0] < self.cache_ttl:
                 return hit[1]
         out = self._call(
             "GET", f"/dir/lookup?volumeId={vid}&collection={collection}")
         locs = out.get("locations", [])
         with self._lock:
-            self._cache[vid] = (time.time(), locs)
+            self._cache[vid] = (clockctl.now(), locs)
         return locs
 
     def lookup_file_id(self, fid: str) -> list[str]:
@@ -220,12 +221,12 @@ class MasterClient:
     def lookup_ec_volume(self, vid: int) -> list[dict]:
         with self._lock:
             hit = self._ec_cache.get(vid)
-            if hit and time.time() - hit[0] < self.cache_ttl:
+            if hit and clockctl.now() - hit[0] < self.cache_ttl:
                 return hit[1]
         out = self._call("GET", f"/dir/lookup_ec?volumeId={vid}")
         shards = out.get("shards", [])
         with self._lock:
-            self._ec_cache[vid] = (time.time(), shards)
+            self._ec_cache[vid] = (clockctl.now(), shards)
         return shards
 
     def invalidate(self, vid: int) -> None:
@@ -257,7 +258,7 @@ class MasterClient:
         from seaweedfs_tpu.storage.file_id import (
             format_needle_id_cookie, parse_needle_id_cookie)
         key = (collection, replication, ttl, disk)
-        now = time.monotonic()
+        now = clockctl.monotonic()
         with self._lock:
             pool = self._assign_pools.get(key)
             if pool and pool[0] > now and pool[1]:

@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from seaweedfs_tpu.utils import clockctl
 from seaweedfs_tpu.utils.httpd import http_json
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
@@ -76,7 +77,7 @@ class RaftNode:
 
         self.lock = threading.RLock()
         self._commit_cond = threading.Condition(self.lock)
-        self._last_heartbeat = time.monotonic()
+        self._last_heartbeat = clockctl.monotonic()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         # check-quorum state: last successful round-trip per peer, and
@@ -167,7 +168,7 @@ class RaftNode:
         while not self._stop.wait(0.05):
             with self.lock:
                 state = self.state
-                elapsed = time.monotonic() - self._last_heartbeat
+                elapsed = clockctl.monotonic() - self._last_heartbeat
                 timeout = self._current_timeout
             if state == LEADER:
                 self._check_quorum()
@@ -184,7 +185,7 @@ class RaftNode:
             if self.state != LEADER or not self.peers:
                 return
             lease = self.election_timeout[1]
-            now = time.monotonic()
+            now = clockctl.monotonic()
             fresh = sum(1 for p in self.peers
                         if now - self._peer_acked.get(p, 0) < lease)
             # self counts toward the majority
@@ -202,7 +203,7 @@ class RaftNode:
         return self._timeout_roll
 
     def _reset_election_timer(self) -> None:
-        self._last_heartbeat = time.monotonic()
+        self._last_heartbeat = clockctl.monotonic()
         self._timeout_roll = random.uniform(*self.election_timeout)
 
     # ---- election ----
@@ -260,7 +261,7 @@ class RaftNode:
         nxt = self._last_index() + 1
         self.next_index = {p: nxt for p in self.peers}
         self.match_index = {p: 0 for p in self.peers}
-        now = time.monotonic()
+        now = clockctl.monotonic()
         self._peer_acked = {p: now for p in self.peers}  # quorum grace
         # no-op barrier: committing it commits every inherited
         # prior-term entry (raft §8); is_ready() gates on it
@@ -277,13 +278,13 @@ class RaftNode:
                     and self.commit_index >= self._noop_index)
 
     def wait_ready(self, timeout: float = 5.0) -> bool:
-        deadline = time.monotonic() + timeout
+        deadline = clockctl.monotonic() + timeout
         with self._commit_cond:
             while not (self.state == LEADER
                        and self.commit_index >= self._noop_index):
                 if self.state != LEADER:
                     return False
-                remaining = deadline - time.monotonic()
+                remaining = deadline - clockctl.monotonic()
                 if remaining <= 0 or self._stop.is_set():
                     return False
                 self._commit_cond.wait(min(remaining, 0.1))
@@ -349,7 +350,7 @@ class RaftNode:
                 return
             if self.state != LEADER or self.current_term != term:
                 return
-            self._peer_acked[peer] = time.monotonic()
+            self._peer_acked[peer] = clockctl.monotonic()
             if resp.get("success"):
                 # max(): a stale response must never regress the indices
                 m = max(self.match_index.get(peer, 0),
@@ -379,7 +380,7 @@ class RaftNode:
             if resp.get("term", 0) > self.current_term:
                 self._step_down(resp["term"])
                 return
-            self._peer_acked[peer] = time.monotonic()
+            self._peer_acked[peer] = clockctl.monotonic()
             self.match_index[peer] = max(self.match_index.get(peer, 0),
                                          snap_index)
             self.next_index[peer] = max(self.next_index.get(peer, 1),
@@ -438,7 +439,7 @@ class RaftNode:
             if self.state == LEADER:
                 self.next_index[peer] = self._last_index() + 1
                 self.match_index[peer] = 0
-                self._peer_acked[peer] = time.monotonic()
+                self._peer_acked[peer] = clockctl.monotonic()
             self._persist()
 
     def remove_peer(self, peer: str) -> None:
@@ -467,10 +468,10 @@ class RaftNode:
             index = self._last_index()
             self._persist()
         self._broadcast_append()
-        deadline = time.monotonic() + timeout
+        deadline = clockctl.monotonic() + timeout
         with self._commit_cond:
             while self.commit_index < index:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - clockctl.monotonic()
                 if remaining <= 0 or self._stop.is_set():
                     return False
                 if self.state != LEADER:

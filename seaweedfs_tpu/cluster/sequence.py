@@ -41,12 +41,12 @@ class SnowflakeSequencer:
 
     def next_file_id(self, count: int = 1) -> int:
         with self._lock:
-            now = int(time.time() * 1000) - self.EPOCH_MS
+            now = int(time.time() * 1000) - self.EPOCH_MS  # weedlint: disable=raw-clock — IDs embed the absolute epoch
             if now == self._last_ms:
                 self._seq += count
                 if self._seq >= 4096:
                     while now <= self._last_ms:
-                        now = int(time.time() * 1000) - self.EPOCH_MS
+                        now = int(time.time() * 1000) - self.EPOCH_MS  # weedlint: disable=raw-clock — IDs embed the absolute epoch
                     self._seq = 0
             else:
                 self._seq = 0

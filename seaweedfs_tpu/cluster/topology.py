@@ -14,6 +14,7 @@ import threading
 import time
 from typing import Optional
 
+from seaweedfs_tpu.utils import clockctl
 from seaweedfs_tpu.storage.erasure_coding import layout as ec_layout
 from seaweedfs_tpu.storage.super_block import ReplicaPlacement, TTL
 
@@ -37,7 +38,7 @@ class DataNode:
         self.volumes: dict[int, dict] = {}
         self.ec_shards: dict[int, int] = {}  # vid -> shard bits
         self.rack: Optional["Rack"] = None
-        self.last_seen = time.time()
+        self.last_seen = clockctl.now()
         # mid-scrub-pass right now (rides heartbeats): repair dispatch
         # avoids piling rebuild I/O onto a disk being swept
         self.scrubbing = False
@@ -272,7 +273,7 @@ class Topology:
             node = rk.get_or_create_node(
                 hb["ip"], hb["port"], hb.get("public_url", ""),
                 hb.get("max_volume_count", 8))
-            node.last_seen = time.time()
+            node.last_seen = clockctl.now()
             node.scrubbing = bool(hb.get("scrubbing", False))
             node.qos_pressure = float(hb.get("qos_pressure", 0.0))
             node.draining = bool(hb.get("draining", False))
@@ -319,7 +320,7 @@ class Topology:
 
     def incremental_sync(self, node: DataNode, deltas: dict) -> None:
         with self.lock:
-            node.last_seen = time.time()
+            node.last_seen = clockctl.now()
             if "scrubbing" in deltas:
                 node.scrubbing = bool(deltas["scrubbing"])
             if "qos_pressure" in deltas:
@@ -434,7 +435,7 @@ class Topology:
     def prune_dead_nodes(self, timeout: Optional[float] = None) -> list[DataNode]:
         timeout = timeout or self.pulse_seconds * 5
         dead = [n for n in self.all_nodes()
-                if time.time() - n.last_seen > timeout]
+                if clockctl.now() - n.last_seen > timeout]
         for n in dead:
             self.unregister_data_node(n)
         return dead

@@ -113,6 +113,10 @@ class MongoClient:
     def __init__(self, host: str, port: int, timeout: float = 10.0):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # the connect timeout would otherwise persist as the I/O
+        # timeout; make the per-op deadline explicit so an idle
+        # keepalive connection isn't killed by the connect budget
+        self.sock.settimeout(timeout)
         self._rfile = self.sock.makefile("rb")
         self._lock = threading.Lock()
         self._req = 0

@@ -15,11 +15,16 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Optional
 
+from seaweedfs_tpu.utils import clockctl, tracing
 from seaweedfs_tpu.filer.entry import Attr, Entry
 from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.utils.httpd import HttpServer, Request, Response
+from seaweedfs_tpu.utils.resilience import Deadline, deadline_scope
 
 IDENTITY_PATH = "/etc/iam/identity.json"
+
+# edge budget when the client didn't propagate one
+IAM_DEADLINE_S = 10.0
 
 
 class IdentityStore:
@@ -36,7 +41,7 @@ class IdentityStore:
 
     def save(self, conf: dict) -> None:
         data = json.dumps(conf, indent=2).encode()
-        now = time.time()
+        now = clockctl.now()
         self.filer.create_entry(Entry(
             full_path=IDENTITY_PATH,
             attr=Attr(mtime=now, crtime=now, mime="application/json",
@@ -52,9 +57,17 @@ class IdentityStore:
 
 
 class IamServer:
-    def __init__(self, filer_server, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, filer_server, host: str = "127.0.0.1", port: int = 0,
+                 tracing_enabled: bool = True,
+                 trace_sample: float = 0.01):
         self.store = IdentityStore(filer_server.filer)
         self.http = HttpServer(host, port)
+        # continue inbound X-Weed-Trace at this edge so identity writes
+        # that reach the filer/volume tier stay on the caller's trace
+        self.tracer = tracing.Tracer(
+            node=f"iam@{host}:{port}", enabled=tracing_enabled,
+            sample_rate=trace_sample)
+        self.http.tracer = self.tracer
         self.http.add("POST", "/", self._handle)
         self.http.add("GET", "/", self._handle)
 
@@ -78,7 +91,11 @@ class IamServer:
         fn = getattr(self, f"_do_{action}", None)
         if fn is None:
             return _iam_err("InvalidAction", action, 400)
-        return fn(params)
+        # edge deadline: identity reads/writes that reach the filer (and
+        # its volume-ward calls) inherit the caller's remaining budget
+        with deadline_scope(Deadline.from_headers(req.headers,
+                                                  default=IAM_DEADLINE_S)):
+            return fn(params)
 
     # ---- actions ----
     def _do_CreateUser(self, p) -> Response:

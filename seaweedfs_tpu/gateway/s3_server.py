@@ -32,6 +32,7 @@ import uuid
 import xml.etree.ElementTree as ET
 from typing import Optional
 
+from seaweedfs_tpu.utils import clockctl
 from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
 from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.qos import INTERACTIVE, WRITE, QosGovernor
@@ -262,7 +263,7 @@ class S3Server:
             amz_date = req.query.get("X-Amz-Date", "")
             expires = int(req.query.get("X-Amz-Expires", "900"))
             t = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
-            if time.time() - t > expires:
+            if time.time() - t > expires:  # weedlint: disable=raw-clock — X-Amz-Date is an absolute epoch
                 return _err("AccessDenied", "request has expired", 403)
             signed_headers = req.query["X-Amz-SignedHeaders"].split(";")
             query = {k: v for k, v in req.query.items()
@@ -443,7 +444,7 @@ class S3Server:
                     stamp = exp.rstrip("Z").split(".")[0]
                     t = calendar.timegm(time.strptime(
                         stamp, "%Y-%m-%dT%H:%M:%S"))
-                    if time.time() > t:
+                    if time.time() > t:  # weedlint: disable=raw-clock — policy expiry is an absolute epoch
                         return _err("AccessDenied", "policy expired", 403)
             except (ValueError, KeyError):
                 return _err("MalformedPOSTRequest", "bad policy", 400)
@@ -570,7 +571,7 @@ class S3Server:
         weedtpu_s3_pb.S3CircuitBreakerConfig — reference
         s3api_circuit_breaker.go loads the same message from the
         filer) at most every CB_TTL seconds, mtime-gated."""
-        now = time.time()
+        now = clockctl.now()
         next_at, seen_mtime = getattr(self, "_cb_state", (0.0, -1.0))
         if now < next_at:
             return
@@ -719,7 +720,7 @@ class S3Server:
         if denied is not None:
             return denied, ""
         md5 = hashlib.md5(data).digest()
-        now = time.time()
+        now = clockctl.now()
         entry = Entry(
             full_path=f"{BUCKETS_PATH}/{bucket}/{key}",
             attr=Attr(mtime=now, crtime=now, mime=mime,
@@ -752,7 +753,7 @@ class S3Server:
         quota = int(raw)
         if not hasattr(self, "_usage_cache"):
             self._usage_cache = {}
-        now = time.time()
+        now = clockctl.now()
         hit = self._usage_cache.get(bucket)
         if hit is None or hit[0] < now:
             used = self._subtree_size(f"{BUCKETS_PATH}/{bucket}")
@@ -789,7 +790,7 @@ class S3Server:
             return _err("NoSuchKey", src, 404)
         if self.filer.find_entry(f"{BUCKETS_PATH}/{bucket}") is None:
             return _err("NoSuchBucket", bucket, 404)
-        now = time.time()
+        now = clockctl.now()
         entry = Entry(
             full_path=f"{BUCKETS_PATH}/{bucket}/{key}",
             attr=Attr(mtime=now, crtime=now, mime=src_entry.attr.mime,
@@ -859,7 +860,7 @@ class S3Server:
         upload_id = uuid.uuid4().hex
         self.filer.mkdirs(f"{UPLOADS_PATH}/{upload_id}")
         marker = Entry(f"{UPLOADS_PATH}/{upload_id}/.meta",
-                       attr=Attr(mtime=time.time()))
+                       attr=Attr(mtime=clockctl.now()))
         marker.extended = {"bucket": bucket, "key": key}
         self.filer.create_entry(marker)
         root = ET.Element("InitiateMultipartUploadResult")
@@ -875,7 +876,7 @@ class S3Server:
             return _err("NoSuchUpload", upload_id, 404)
         data = req.body
         md5 = hashlib.md5(data).digest()
-        now = time.time()
+        now = clockctl.now()
         entry = Entry(f"{UPLOADS_PATH}/{upload_id}/{part:05d}.part",
                       attr=Attr(mtime=now, crtime=now, md5=md5,
                                 file_size=len(data)))
@@ -917,7 +918,7 @@ class S3Server:
             offset += p.file_size()
             md5.update(p.attr.md5)
         etag = md5.hexdigest() + f"-{len(parts)}"
-        now = time.time()
+        now = clockctl.now()
         entry = Entry(f"{BUCKETS_PATH}/{bucket}/{key}",
                       attr=Attr(mtime=now, crtime=now, file_size=offset,
                                 collection=bucket))

@@ -10,20 +10,33 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Optional
 
+from seaweedfs_tpu.utils import clockctl, tracing
 from seaweedfs_tpu.filer.entry import Entry
 from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.utils.httpd import HttpServer, Request, Response
+from seaweedfs_tpu.utils.resilience import Deadline, deadline_scope
 
 DAV_NS = "DAV:"
+
+# edge budget when the client didn't propagate one
+DAV_DEADLINE_S = 30.0
 
 
 class WebDavServer:
     def __init__(self, filer_server, host: str = "127.0.0.1", port: int = 0,
-                 root: str = "/"):
+                 root: str = "/", tracing_enabled: bool = True,
+                 trace_sample: float = 0.01):
         self.fs = filer_server
         self.filer: Filer = filer_server.filer
         self.root = "/" + root.strip("/") if root.strip("/") else ""
         self.http = HttpServer(host, port)
+        # without a tracer this edge attaches the shared NOOP span and
+        # an inbound X-Weed-Trace dies here instead of riding the
+        # filer's chunk uploads to the volume tier
+        self.tracer = tracing.Tracer(
+            node=f"webdav@{host}:{port}", enabled=tracing_enabled,
+            sample_rate=trace_sample)
+        self.http.tracer = self.tracer
         for m in ("OPTIONS", "PROPFIND", "GET", "HEAD", "PUT", "DELETE",
                   "MKCOL", "MOVE", "COPY", "LOCK", "UNLOCK", "PROPPATCH"):
             self.http.add(m, "/.*", self._dispatch)
@@ -44,6 +57,14 @@ class WebDavServer:
         return (self.root + p).rstrip("/") or "/"
 
     def _dispatch(self, req: Request) -> Response:
+        # edge deadline: honor an inbound X-Weed-Deadline (or mint the
+        # default) so the filer's chunk reads/uploads below inherit the
+        # remaining budget and re-inject the header volume-ward
+        with deadline_scope(Deadline.from_headers(req.headers,
+                                                  default=DAV_DEADLINE_S)):
+            return self._route(req)
+
+    def _route(self, req: Request) -> Response:
         m = req.method
         if m == "OPTIONS":
             return Response(b"", headers={
@@ -128,7 +149,7 @@ class WebDavServer:
     def _put(self, req: Request) -> Response:
         path = self._fpath(req.path)
         from seaweedfs_tpu.filer.entry import Attr
-        now = time.time()
+        now = clockctl.now()
         entry = Entry(full_path=path,
                       attr=Attr(mtime=now, crtime=now,
                                 mime=req.headers.get("Content-Type", ""),
@@ -164,7 +185,7 @@ class WebDavServer:
                 return Response(b"", status=501)
             data = self.fs._read_entry_bytes(entry)
             from seaweedfs_tpu.filer.entry import Attr
-            now = time.time()
+            now = clockctl.now()
             new = Entry(full_path=dest_path,
                         attr=Attr(mtime=now, crtime=now,
                                   mime=entry.attr.mime,

@@ -72,6 +72,10 @@ class KafkaProducer:
         self.sock = socket.create_connection((self.host, self.port),
                                              timeout=self.timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # explicit per-op deadline: produce() reconnects on a timed-out
+        # socket, so a finite I/O timeout is the retry trigger, but it
+        # must be a deliberate choice, not the connect budget leaking
+        self.sock.settimeout(self.timeout)
 
     def produce(self, topic: str, key: bytes, value: bytes) -> int:
         """Send one message; returns the broker-assigned base offset.

@@ -13,9 +13,9 @@ from __future__ import annotations
 
 import base64
 import json
-import urllib.request
 
 from seaweedfs_tpu.notification.queue import MessageQueue
+from seaweedfs_tpu.utils.httpd import http_call
 
 
 class PubSubQueue(MessageQueue):
@@ -40,11 +40,13 @@ class PubSubQueue(MessageQueue):
         headers = {"Content-Type": "application/json"}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
-        req = urllib.request.Request(url, data=payload, method="POST",
-                                     headers=headers)
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            if resp.status >= 300:
-                raise ConnectionError(f"Pub/Sub publish: {resp.status}")
+        # http_call (not raw urllib) so the ambient Deadline/QoS-class/
+        # Trace of the write that triggered this notification propagate
+        # into the broker hop
+        status, _, _ = http_call("POST", url, body=payload,
+                                 timeout=self.timeout, headers=headers)
+        if status >= 300:
+            raise ConnectionError(f"Pub/Sub publish: {status}")
 
 
 class MiniPubSubServer:

@@ -13,10 +13,10 @@ import hashlib
 import json
 import time
 import urllib.parse
-import urllib.request
 
 from seaweedfs_tpu.notification.queue import MessageQueue
 from seaweedfs_tpu.utils import sigv4
+from seaweedfs_tpu.utils.httpd import http_call
 
 API_VERSION = "2012-11-05"
 
@@ -62,11 +62,13 @@ class SqsQueue(MessageQueue):
         headers["Authorization"] = (
             f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
             f"SignedHeaders={';'.join(signed)}, Signature={sig}")
-        req = urllib.request.Request(self.queue_url, data=body,
-                                     method="POST", headers=headers)
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            if resp.status >= 300:
-                raise ConnectionError(f"SQS SendMessage: {resp.status}")
+        # SigV4 signs only the headers in `signed`; the extra
+        # X-Weed-* headers http_call injects ride unsigned, so the
+        # signature stays valid while deadline/class/trace propagate
+        status, _, _ = http_call("POST", self.queue_url, body=body,
+                                 timeout=self.timeout, headers=headers)
+        if status >= 300:
+            raise ConnectionError(f"SQS SendMessage: {status}")
 
 
 class MiniSqsServer:

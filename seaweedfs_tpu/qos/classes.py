@@ -26,7 +26,8 @@ import contextlib
 import contextvars
 from typing import Optional
 
-CLASS_HEADER = "X-Weed-Class"
+from seaweedfs_tpu.utils import headers
+CLASS_HEADER = headers.CLASS
 
 INTERACTIVE = "interactive"
 WRITE = "write"

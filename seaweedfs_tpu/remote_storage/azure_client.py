@@ -14,12 +14,11 @@ import base64
 import hashlib
 import hmac
 import time
-import urllib.error
 import urllib.parse
-import urllib.request
 import xml.etree.ElementTree as ET
 from typing import Iterator, Optional
 
+from seaweedfs_tpu.utils.httpd import http_call
 from seaweedfs_tpu.remote_storage.remote_storage import (RemoteFile,
                                                          RemoteStorageClient)
 
@@ -90,20 +89,15 @@ class AzureRemote(RemoteStorageClient):
                                    path, query, lower)
         hdrs["Authorization"] = f"SharedKey {self.account}:{sig}"
         qs = ("?" + urllib.parse.urlencode(query)) if query else ""
-        req = urllib.request.Request(
-            f"{self.endpoint}{path}{qs}", data=body or None,
-            method=method, headers=hdrs)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                data = r.read()
-                if r.status not in ok:
-                    raise ConnectionError(f"azure {method} {path}: "
-                                          f"{r.status}")
-                return r.status, data, dict(r.headers)
-        except urllib.error.HTTPError as e:
-            if e.code in ok:
-                return e.code, e.read(), dict(e.headers)
-            raise
+        # http_call: deadline/class/trace headers propagate to the
+        # remote tier; SharedKey only canonicalizes x-ms-* headers, so
+        # the extra X-Weed-* headers don't disturb the signature
+        status, data, resp_headers = http_call(
+            method, f"{self.endpoint}{path}{qs}", body=body or None,
+            timeout=self.timeout, headers=hdrs)
+        if status not in ok:
+            raise ConnectionError(f"azure {method} {path}: {status}")
+        return status, data, resp_headers
 
     # ---- RemoteStorageClient ----
     def traverse(self, prefix: str = "") -> Iterator[RemoteFile]:
@@ -149,12 +143,10 @@ class AzureRemote(RemoteStorageClient):
         self._call("DELETE", path.lstrip("/"), ok=(200, 202, 404))
 
     def stat(self, path: str) -> Optional[RemoteFile]:
-        try:
-            _, _, h = self._call("HEAD", path.lstrip("/"))
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+        status, _, h = self._call("HEAD", path.lstrip("/"),
+                                  ok=(200, 404))
+        if status == 404:
+            return None
         return RemoteFile(path=path.lstrip("/"),
                           size=int(h.get("Content-Length", 0)),
                           mtime=0, etag=h.get("Etag", ""))

@@ -16,6 +16,8 @@ import os
 import urllib.parse
 from typing import Optional
 
+from seaweedfs_tpu.utils import headers as weed_headers
+
 
 class ReplicationSink(abc.ABC):
     name = "abstract"
@@ -52,7 +54,7 @@ class FilerSink(ReplicationSink):
     def _headers(self) -> Optional[dict]:
         if not self.signature:
             return None
-        return {"X-Weed-Sync-Signature": str(self.signature)}
+        return {weed_headers.SYNC_SIGNATURE: str(self.signature)}
 
     def create_entry(self, path: str, entry: dict,
                      data: Optional[bytes]) -> None:

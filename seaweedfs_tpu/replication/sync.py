@@ -58,7 +58,10 @@ def _grpc_event_stream(client, since_ns: int, path_prefix: str,
 
     call = client.subscribe_metadata(since_ns=since_ns,
                                      path_prefix=path_prefix)
-    q: "_queue.Queue" = _queue.Queue()
+    # bounded: a slow consumer backpressures the pump (put blocks),
+    # which stops reading the gRPC stream instead of buffering the
+    # whole event backlog in memory (weedlint unbounded-pool)
+    q: "_queue.Queue" = _queue.Queue(maxsize=256)
 
     def pump():
         try:

@@ -19,11 +19,10 @@ so repair traffic is observable against the cluster's bandwidth budget
 from __future__ import annotations
 
 import threading
-import time
 
 from seaweedfs_tpu.qos import BACKGROUND, class_scope
 from seaweedfs_tpu.storage.erasure_coding import layout
-from seaweedfs_tpu.utils import glog, tracing
+from seaweedfs_tpu.utils import clockctl, glog, tracing
 from seaweedfs_tpu.utils.httpd import http_json
 from seaweedfs_tpu.utils.limiter import TokenBucket
 from seaweedfs_tpu.utils.resilience import Deadline
@@ -43,7 +42,7 @@ class RepairTask:
         self.priority = priority
         self.corrupt_shards = set(corrupt_shards)
         self.reason = reason
-        self.enqueued_at = time.time()
+        self.enqueued_at = clockctl.now()
         self.attempts = 0
         self.next_attempt = 0.0
         self.last_error = ""
@@ -169,7 +168,7 @@ class RepairQueue:
         those volumes from the degraded scan until the grace expires
         (refreshes on every draining heartbeat). Returns the
         deadline."""
-        until = time.time() + (self.drain_grace_s
+        until = clockctl.now() + (self.drain_grace_s
                                if grace_s is None else grace_s)
         with self._lock:
             for vid in vids:
@@ -248,7 +247,7 @@ class RepairQueue:
                 for vid, owners in topo.ec_shard_map.items()
                 if 0 < sum(1 for nodes in owners if nodes)
                 < layout.TOTAL_SHARDS_COUNT}
-        now = time.time()
+        now = clockctl.now()
         for vid in list(self._degraded_since):
             if vid not in degraded:
                 del self._degraded_since[vid]
@@ -275,7 +274,7 @@ class RepairQueue:
             self.submit(vid, "", reason="heartbeat:degraded")
 
     def _dispatch(self) -> None:
-        now = time.time()
+        now = clockctl.now()
         to_run = []
         with self._lock:
             ready = sorted(
@@ -321,7 +320,7 @@ class RepairQueue:
                 task.last_error = str(e)
                 backoff = min(self.backoff_max,
                               self.backoff_base * 2 ** (task.attempts - 1))
-                task.next_attempt = time.time() + backoff
+                task.next_attempt = clockctl.now() + backoff
                 self._tasks[task.vid] = task
                 self.failed_total += 1
             self._c_repairs.inc("failed")
@@ -330,7 +329,7 @@ class RepairQueue:
                          "(backoff %.1fs): %s",
                          task.vid, task.attempts, backoff, e)
             return
-        lag = time.time() - task.enqueued_at
+        lag = clockctl.now() - task.enqueued_at
         span.annotate("repair.bytes_moved", moved)
         span.annotate("repair.lag_s", round(lag, 3))
         with self._lock:

@@ -21,11 +21,11 @@ from __future__ import annotations
 import json
 import os
 import threading
-import time
 from typing import Callable, Optional
 
 import numpy as np
 
+from seaweedfs_tpu.utils import clockctl
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.needle import CrcError, Needle
 from seaweedfs_tpu.storage.super_block import SuperBlock
@@ -113,7 +113,7 @@ class Scrubber:
         """Scrub every mounted volume and EC volume (or just volume_id).
         Returns {"volumes": [per-volume reports], "bytes": n,
         "corruptions": [...]}. Rate-limited unless the bucket rate<=0."""
-        t0 = time.monotonic()
+        t0 = clockctl.monotonic()
         out = {"volumes": [], "bytes": 0, "corruptions": []}
         for loc in self.store.locations:
             cursors = self._load_cursors(loc.directory) if use_cursor \
@@ -146,8 +146,8 @@ class Scrubber:
         with self._lock:
             self.passes_completed += 1
             self._pass_index += 1
-            self.last_pass_s = time.monotonic() - t0
-            self.last_pass_at = time.time()
+            self.last_pass_s = clockctl.monotonic() - t0
+            self.last_pass_at = clockctl.now()
             self.current = None
         if self._m_passes is not None:
             self._m_passes.inc()
@@ -409,7 +409,7 @@ class Scrubber:
         must stay off the hot path's critical cost)."""
         if self.pressure_fn is None or self._base_rate <= 0:
             return
-        now = time.monotonic()
+        now = clockctl.monotonic()
         if now - self._pressure_checked < 0.5:
             return
         self._pressure_checked = now

@@ -16,6 +16,7 @@ from typing import Iterator, Optional
 
 import grpc
 
+from seaweedfs_tpu.utils import clockctl
 from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
 from seaweedfs_tpu.pb import filer_pb2 as pb
 
@@ -255,8 +256,8 @@ class FilerGrpc:
             entry = self.fs.filer.find_entry(path)
             if entry is None:
                 entry = Entry(full_path=path,
-                              attr=Attr(mtime=_time.time(),
-                                        crtime=_time.time(), mode=0o644))
+                              attr=Attr(mtime=clockctl.now(),
+                                        crtime=clockctl.now(), mode=0o644))
             elif entry.content:
                 # inline content can't coexist with chunks (the read
                 # path prefers content): spill it to a chunk first

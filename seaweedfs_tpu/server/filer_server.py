@@ -36,7 +36,8 @@ from seaweedfs_tpu.filer.filer_conf import FilerConf, PathConf
 from seaweedfs_tpu.filer.filerstore import make_store
 from seaweedfs_tpu.qos import (BACKGROUND, QosGovernor, class_scope,
                                classify, current_class, from_headers)
-from seaweedfs_tpu.utils import glog, tracing
+from seaweedfs_tpu.utils import headers as weed_headers
+from seaweedfs_tpu.utils import clockctl, glog, tracing
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call)
 from seaweedfs_tpu.utils.resilience import (Deadline, PeerHealth,
@@ -121,7 +122,7 @@ class FilerServer:
                            delete_chunks_fn=self._delete_chunks,
                            read_chunk_fn=self._read_chunk)
         self.filer_conf = FilerConf.load(self.filer.store)
-        self._filer_conf_loaded = time.time()
+        self._filer_conf_loaded = clockctl.now()
         self._filer_conf_write_lock = threading.Lock()
         from seaweedfs_tpu.filer.remote_mount import RemoteMounts
         self.remote_mounts = RemoteMounts(self.filer)
@@ -345,7 +346,7 @@ class FilerServer:
         X-Weed-Sync-Signature so the reverse sync direction can exclude
         them from the event stream (reference filer.sync signatures)."""
         def wrapped(req: Request) -> Response:
-            sig = req.headers.get("X-Weed-Sync-Signature")
+            sig = req.headers.get(weed_headers.SYNC_SIGNATURE)
             if not sig:
                 return handler(req)
             try:
@@ -376,7 +377,7 @@ class FilerServer:
         ttl = req.query.get("ttl", "") or rule.ttl
         mime = (req.headers.get("Content-Type")
                 or "application/octet-stream")
-        now = time.time()
+        now = clockctl.now()
         entry = Entry(full_path=path,
                       attr=Attr(mtime=now, crtime=now, mime=mime,
                                 file_size=len(data),
@@ -634,7 +635,7 @@ class FilerServer:
         """Rules are shared multi-process state (KV in the store, which
         may itself be remote); re-read on a short TTL so gateways and
         peers observe fs.configure changes."""
-        now = time.time()
+        now = clockctl.now()
         if now - self._filer_conf_loaded > self.FILER_CONF_TTL:
             try:
                 self.filer_conf = FilerConf.load(self.filer.store)
@@ -792,7 +793,7 @@ class FilerServer:
                 conf.set_rule(PathConf.from_dict(b))
             conf.save(self.filer.store)
             self.filer_conf = conf
-            self._filer_conf_loaded = time.time()
+            self._filer_conf_loaded = clockctl.now()
         return Response({"locations": [r.to_dict()
                                        for r in self.filer_conf.rules]})
 

@@ -27,7 +27,8 @@ from seaweedfs_tpu.qos import (BACKGROUND, INTERACTIVE, WRITE, QosGovernor,
 from seaweedfs_tpu.cluster.volume_growth import (NoFreeSpaceError,
                                                  grow_by_type)
 from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
-from seaweedfs_tpu.utils import glog, tracing
+from seaweedfs_tpu.utils import headers as weed_headers
+from seaweedfs_tpu.utils import clockctl, glog, tracing
 from seaweedfs_tpu.utils.httpd import (HttpServer, Request, Response,
                                        http_json)
 from seaweedfs_tpu.utils.resilience import Deadline, PeerHealth
@@ -467,14 +468,12 @@ class MasterServer:
         weed/cluster/cluster.go + master ListClusterNodes)."""
         b = req.json()
         ntype, url = b.get("type", "filer"), b["url"]
-        import time as _time
-        self._cluster_nodes[(ntype, url)] = _time.time()
+        self._cluster_nodes[(ntype, url)] = clockctl.now()
         return Response({})
 
     def _handle_cluster_nodes(self, req: Request) -> Response:
-        import time as _time
         ntype = req.query.get("type", "")
-        now = _time.time()
+        now = clockctl.now()
         nodes = [{"type": t, "url": u}
                  for (t, u), seen in self._cluster_nodes.items()
                  if now - seen < 60 and (not ntype or t == ntype)]
@@ -699,7 +698,7 @@ class MasterServer:
         X-Weed-Proxied guard stops loops during elections."""
         if self.is_leader():
             return None
-        if req.headers.get("X-Weed-Proxied"):
+        if req.headers.get(weed_headers.PROXIED):
             return None  # second hop: answer locally rather than loop
         leader = self.leader
         if not leader or leader == self.url:
@@ -712,7 +711,7 @@ class MasterServer:
         try:
             status, body, _ = http_call(
                 "GET", f"http://{leader}{req.path}?{qs}",
-                headers={"X-Weed-Proxied": "1"},
+                headers={weed_headers.PROXIED: "1"},
                 deadline=Deadline.after(10.0))
             parsed = json.loads(body) if body else {}
         except (ConnectionError, ValueError):
@@ -811,7 +810,7 @@ class MasterServer:
         """Resilience rollup for the cluster.health shell command: per
         registered node (liveness, scrub state, load), this master's
         breaker/health table, and the repair bandwidth budget."""
-        now = time.time()
+        now = clockctl.now()
         with self.topo.lock:
             nodes = [{
                 "url": n.url,
@@ -842,7 +841,7 @@ class MasterServer:
         """Cluster QoS rollup for the cluster.qos shell command:
         per-node overload pressure (from heartbeats) and how far the
         repair budget has backed off in response."""
-        now = time.time()
+        now = clockctl.now()
         with self.topo.lock:
             nodes = [{
                 "url": n.url,
@@ -870,7 +869,7 @@ class MasterServer:
     def _handle_lock(self, req: Request) -> Response:
         body = req.json() or {}
         client = body.get("client", "unknown")
-        now = time.time()
+        now = clockctl.now()
         if (self._admin_lock_holder
                 and self._admin_lock_holder != client
                 and now - self._admin_lock_ts < 60):

@@ -37,7 +37,8 @@ from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.volume import (CookieMismatchError, DeletedError,
                                           NotFoundError)
-from seaweedfs_tpu.utils import glog, tracing
+from seaweedfs_tpu.utils import headers as weed_headers
+from seaweedfs_tpu.utils import clockctl, glog, tracing
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call, http_json)
 from seaweedfs_tpu.utils.resilience import (Deadline, PeerHealth,
@@ -316,19 +317,19 @@ class VolumeServer:
         """One master RPC with a deadline cap and breaker bookkeeping.
         An HttpError still counts as transport-healthy (the master
         answered); only ConnectionError marks the peer down."""
-        t0 = time.monotonic()
+        t0 = clockctl.monotonic()
         try:
             out = http_json(method, f"http://{self.master_url}{path}",
                             body, timeout=timeout, deadline=deadline)
         except HttpError:
             self.peer_health.record(self.master_url, True,
-                                    time.monotonic() - t0)
+                                    clockctl.monotonic() - t0)
             raise
         except ConnectionError:
             self.peer_health.record(self.master_url, False)
             raise
         self.peer_health.record(self.master_url, True,
-                                time.monotonic() - t0)
+                                clockctl.monotonic() - t0)
         return out
 
     def _is_scrubbing(self) -> bool:
@@ -763,8 +764,7 @@ class VolumeServer:
             n.ttl = TTL.parse(req.query["ttl"]).to_bytes()
             n.flags |= FLAG_HAS_TTL
             if not n.last_modified:
-                import time as _time
-                n.last_modified = int(_time.time())
+                n.last_modified = int(clockctl.now())
             from seaweedfs_tpu.storage.needle import \
                 FLAG_HAS_LAST_MODIFIED_DATE
             n.flags |= FLAG_HAS_LAST_MODIFIED_DATE
@@ -887,10 +887,9 @@ class VolumeServer:
             headers["X-File-Name"] = n.name.decode(errors="replace")
         if n.has_ttl and n.ttl and n.last_modified:
             from seaweedfs_tpu.storage.super_block import TTL
-            import time as _time
             ttl = TTL.from_bytes(n.ttl)
             if ttl.minutes and \
-                    _time.time() > n.last_modified + ttl.minutes * 60:
+                    clockctl.now() > n.last_modified + ttl.minutes * 60:
                 return Response(b"", status=404, content_type="text/plain")
         mime = (n.mime.decode(errors="replace")
                 if n.mime else "application/octet-stream")
@@ -941,7 +940,7 @@ class VolumeServer:
             from seaweedfs_tpu.storage.super_block import TTL
             ttl = TTL.from_bytes(n.ttl)
             if ttl.minutes and \
-                    time.time() > n.last_modified + ttl.minutes * 60:
+                    clockctl.now() > n.last_modified + ttl.minutes * 60:
                 return Response(b"", status=404, content_type="text/plain")
         mime = (n.mime.decode(errors="replace")
                 if n.mime else "application/octet-stream")
@@ -998,7 +997,7 @@ class VolumeServer:
         master /dir/lookup per write would cost more than the write
         itself (the reference's writers resolve replicas through the
         wdclient vidMap cache the same way)."""
-        now = time.monotonic()
+        now = clockctl.monotonic()
         cached = self._replica_cache.get(vid)
         if cached is not None and cached[0] > now:
             return cached[1]
@@ -1060,7 +1059,7 @@ class VolumeServer:
             if not self.peer_health.allow(url):
                 return f"replica {url}: circuit open"
             target = (f"http://{url}{req.path}?{qs}{sep}type=replicate")
-            t0 = time.monotonic()
+            t0 = clockctl.monotonic()
             try:
                 with class_scope(cls), tracing.span_scope(span):
                     if op == "write":
@@ -1075,7 +1074,7 @@ class VolumeServer:
                 return f"replica {url}: {e}"
             # an HTTP answer means the peer is up (same convention as
             # _master_json); the write itself may still have failed
-            self.peer_health.record(url, True, time.monotonic() - t0)
+            self.peer_health.record(url, True, clockctl.monotonic() - t0)
             if status >= 400 and status != 404:
                 return f"replica {url}: HTTP {status}"
             return None
@@ -1274,7 +1273,7 @@ class VolumeServer:
                 f.write(body)
             # preserve the source's mtime: a replica copy must NOT
             # restart a TTL volume's expiry clock
-            src_mtime = hdrs.get("X-Weed-File-Mtime")
+            src_mtime = hdrs.get(weed_headers.FILE_MTIME)
             if src_mtime:
                 os.utime(base + ext, (float(src_mtime),
                                       float(src_mtime)))
@@ -1383,7 +1382,7 @@ class VolumeServer:
         with open(path, "rb") as f:
             return Response(
                 f.read(), content_type="application/octet-stream",
-                headers={"X-Weed-File-Mtime":
+                headers={weed_headers.FILE_MTIME:
                          str(os.stat(path).st_mtime)})
 
     # ---- EC rpcs (reference volume_grpc_erasure_coding.go) ----
@@ -1619,7 +1618,7 @@ class VolumeServer:
         url = chain[0]["url"]
         expect = len(ecpart.chain_shard_ids(chain))
         if self.peer_health.allow(url):
-            t0 = time.monotonic()
+            t0 = clockctl.monotonic()
             try:
                 status, body, hdrs = http_call(
                     "POST", f"http://{url}{ecpart.PARTIAL_READ_PATH}",
@@ -1627,7 +1626,7 @@ class VolumeServer:
                                "offset": offset, "size": size,
                                "n_rows": n_rows, "chain": chain},
                     timeout=120)
-                self.peer_health.record(url, True, time.monotonic() - t0)
+                self.peer_health.record(url, True, clockctl.monotonic() - t0)
                 if status == 200 and len(body) == n_rows * size:
                     arr = np.frombuffer(body, dtype=np.uint8) \
                         .reshape(n_rows, size).copy()
@@ -1692,7 +1691,7 @@ class VolumeServer:
         for u in urls:
             if not self.peer_health.allow(u) and len(urls) > 1:
                 continue
-            t0 = time.monotonic()
+            t0 = clockctl.monotonic()
             try:
                 status, body, _ = http_call(
                     "GET",
@@ -1702,7 +1701,7 @@ class VolumeServer:
             except (ConnectionError, OSError):
                 self.peer_health.record(u, False)
                 continue
-            self.peer_health.record(u, True, time.monotonic() - t0)
+            self.peer_health.record(u, True, clockctl.monotonic() - t0)
             if status == 200 and len(body) == size:
                 return body
         return None
@@ -1935,7 +1934,7 @@ class VolumeServer:
         holder's heartbeat-reported qos_pressure; _shard_pressure()
         serves it from the same cache entry so chain planning can
         tie-break away from loaded holders for free."""
-        now = time.monotonic()
+        now = clockctl.monotonic()
         cached = self._shard_loc_cache.get(vid)
         if cached is not None and cached[0] > now:
             return cached[1]
@@ -2031,14 +2030,14 @@ class VolumeServer:
                 if loc["url"] in done or self._is_self(loc["url"]):
                     continue
                 done.add(loc["url"])
-                t0 = time.monotonic()
+                t0 = clockctl.monotonic()
                 try:
                     http_json("POST",
                               f"http://{loc['url']}/admin/ec/blob_delete",
                               {"volume_id": vid, "needle_id": key},
                               deadline=Deadline.after(10.0))
                     self.peer_health.record(loc["url"], True,
-                                            time.monotonic() - t0)
+                                            clockctl.monotonic() - t0)
                 except ConnectionError:
                     self.peer_health.record(loc["url"], False)
                 except HttpError:

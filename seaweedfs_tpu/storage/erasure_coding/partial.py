@@ -31,13 +31,15 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+from seaweedfs_tpu.utils import headers
+
 PARTIAL_READ_PATH = "/admin/ec/partial_read"
 REBUILD_PARTIAL_PATH = "/admin/ec/rebuild_partial"
 SHARD_STAT_PATH = "/admin/ec/shard_stat"
 
 # response headers the chain hops use to report downstream state
-SHARDS_HEADER = "X-Weed-Partial-Shards"
-FALLBACK_HEADER = "X-Weed-Partial-Fallback"
+SHARDS_HEADER = headers.PARTIAL_SHARDS
+FALLBACK_HEADER = headers.PARTIAL_FALLBACK
 
 
 def plan_chain(sources: dict[int, Sequence[str]],

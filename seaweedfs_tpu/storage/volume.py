@@ -16,6 +16,7 @@ import threading
 import time
 from typing import Optional
 
+from seaweedfs_tpu.utils import clockctl
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.needle import CURRENT_VERSION, Needle
 from seaweedfs_tpu.storage.needle_map import CompactMap
@@ -241,7 +242,7 @@ class Volume:
                 self._flush_cond.wait()
         covered = None
         try:
-            t0 = time.monotonic()
+            t0 = clockctl.monotonic()
             with self._lock:
                 high = self._appended_seq
                 self._dat.flush()
@@ -250,7 +251,7 @@ class Volume:
                     os.fsync(self._dat.fileno())
                     os.fsync(self._idx.fileno())
                 covered = high  # only on flush success
-            self.flush_s += time.monotonic() - t0
+            self.flush_s += clockctl.monotonic() - t0
         finally:
             with self._flush_cond:
                 self._flush_leader = False
@@ -554,12 +555,13 @@ class Volume:
                 return os.stat(self.file_name() + ext).st_mtime
             except OSError:
                 continue
-        return time.time()
+        return time.time()  # weedlint: disable=raw-clock — fallback for absolute st_mtime
 
     def is_expired(self) -> bool:
         ttl_sec = self.super_block.ttl.minutes * 60
         if ttl_sec == 0:
             return False
+        # weedlint: disable=raw-clock — st_mtime is an absolute epoch
         return time.time() > self._last_activity_sec() + ttl_sec
 
     def is_expired_long_enough(self) -> bool:
@@ -571,6 +573,7 @@ class Volume:
         if ttl_sec == 0:
             return False
         grace = min(ttl_sec // 10, self.MAX_TTL_REMOVAL_DELAY_SEC)
+        # weedlint: disable=raw-clock — st_mtime is an absolute epoch
         return time.time() > self._last_activity_sec() + ttl_sec + grace
 
     def check_integrity(self) -> bool:

@@ -28,12 +28,24 @@ from typing import Callable, Optional
 
 _monotonic: Callable[[], float] = time.monotonic
 _sleep: Callable[[float], None] = time.sleep
+_now: Callable[[], float] = time.time
 
 
 def monotonic() -> float:
     """The behavioral clock: wall monotonic unless a virtual clock is
     installed."""
     return _monotonic()
+
+
+def now() -> float:
+    """Behavioral wall clock (epoch seconds in production).  For
+    timestamps the code later compares against itself — backoff
+    next_attempt, drain-grace expiry, last-seen ages.  Under a virtual
+    clock this follows the sim timeline (so a 30s grace elapses in 30
+    virtual seconds); values that leave the process as ABSOLUTE epochs
+    (HTTP Date, SigV4 signing, TLS validity) must keep ``time.time``
+    with an inline suppression."""
+    return _now()
 
 
 def sleep(seconds: float) -> None:
@@ -55,14 +67,18 @@ def _no_real_sleep(seconds: float) -> None:
 
 @contextmanager
 def install(monotonic_fn: Callable[[], float],
-            sleep_fn: Optional[Callable[[float], None]] = None):
+            sleep_fn: Optional[Callable[[float], None]] = None,
+            now_fn: Optional[Callable[[], float]] = None):
     """Install a clock override for the duration of a with-block.
-    Nested installs restore correctly (LIFO)."""
-    global _monotonic, _sleep
-    prev = (_monotonic, _sleep)
+    Nested installs restore correctly (LIFO).  ``now_fn`` defaults to
+    ``monotonic_fn``: the virtual timeline serves both clocks, which
+    keeps now()-vs-now() comparisons coherent inside the sim."""
+    global _monotonic, _sleep, _now
+    prev = (_monotonic, _sleep, _now)
     _monotonic = monotonic_fn
     _sleep = sleep_fn if sleep_fn is not None else _no_real_sleep
+    _now = now_fn if now_fn is not None else monotonic_fn
     try:
         yield
     finally:
-        _monotonic, _sleep = prev
+        _monotonic, _sleep, _now = prev

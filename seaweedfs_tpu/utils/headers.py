@@ -1,0 +1,50 @@
+"""The X-Weed-* header namespace — every cross-node protocol header,
+in one place.
+
+These names ARE the wire protocol for the cluster's ambient request
+scope (deadline budget, QoS class, trace context) and its side-channel
+metadata (replica mtimes, sync signatures, partial-repair state).  A
+typo in an inline literal fails open — the header silently doesn't
+match and the contract quietly stops propagating at that hop, which is
+exactly how the S3 gateway lost replication for four call sites in
+PR 7.  weedlint's ``header-literal`` rule therefore bans inline
+``"X-Weed-*"`` strings everywhere but here; import the constant.
+
+Adding a header: define it here with a comment naming its
+producer/consumer pair, then use it via this module.  The domain
+modules (resilience/tracing/qos.classes) re-export their own header
+for their callers' convenience; both spellings are the same object.
+"""
+
+from __future__ import annotations
+
+# ---- ambient request scope (injected by http_call, re-entered by
+#      HttpServer._dispatch on the far side) ----
+
+# remaining deadline budget, decimal seconds (utils/resilience.py)
+DEADLINE = "X-Weed-Deadline"
+# traffic class: interactive | write | background (qos/classes.py)
+CLASS = "X-Weed-Class"
+# trace context: <trace_id>:<span_id>:<flags> (utils/tracing.py)
+TRACE = "X-Weed-Trace"
+
+# ---- replication & sync ----
+
+# replica-copy source mtime: a copy must not restart a TTL volume's
+# expiry clock (volume server /admin/copy)
+FILE_MTIME = "X-Weed-File-Mtime"
+# replicator signature so the reverse sync direction can exclude its
+# own writes from the event stream (replication/sink.py <-> filer)
+SYNC_SIGNATURE = "X-Weed-Sync-Signature"
+
+# ---- control plane ----
+
+# loop guard on follower->leader proxying during elections (master)
+PROXIED = "X-Weed-Proxied"
+
+# ---- partial-parallel EC repair (storage/erasure_coding/partial.py) ----
+
+# shard ids folded into a chain hop's pre-reduced column
+PARTIAL_SHARDS = "X-Weed-Partial-Shards"
+# set when a hop fell back to raw-streaming its members locally
+PARTIAL_FALLBACK = "X-Weed-Partial-Fallback"

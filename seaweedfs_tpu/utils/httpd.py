@@ -553,6 +553,8 @@ class RawHttpConnection:
         elif ":" in netloc:
             host, _, p = netloc.rpartition(":")
             port = int(p)
+        # weedlint: disable=persistent-socket-timeout — _pooled_conn
+        # re-arms settimeout() per request with the caller's deadline
         self.sock = socket.create_connection((host or "127.0.0.1", port),
                                              timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)

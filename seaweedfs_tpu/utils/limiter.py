@@ -10,7 +10,8 @@ which the caller sheds load (HTTP 429)."""
 from __future__ import annotations
 
 import threading
-import time
+
+from seaweedfs_tpu.utils import clockctl
 
 
 class TokenBucket:
@@ -30,7 +31,7 @@ class TokenBucket:
         self.capacity = float(capacity if capacity is not None
                               else max(self.rate, 1.0))
         self._tokens = float(initial)
-        self._ts = time.monotonic()
+        self._ts = clockctl.monotonic()
         self._lock = threading.Lock()
 
     def set_rate(self, rate_bytes_per_sec: float) -> None:
@@ -39,7 +40,7 @@ class TokenBucket:
             self.rate = float(rate_bytes_per_sec)
 
     def _refill(self) -> None:
-        now = time.monotonic()
+        now = clockctl.monotonic()
         if self.rate > 0:
             self._tokens = min(self.capacity,
                                self._tokens + (now - self._ts) * self.rate)
@@ -71,7 +72,7 @@ class TokenBucket:
                 if stop.wait(wait):
                     return False
             else:
-                time.sleep(wait)
+                clockctl.sleep(wait)
 
 
 class InFlightLimiter:
@@ -91,11 +92,11 @@ class InFlightLimiter:
             with self._cond:
                 self._used += max(n, 0)
             return True
-        deadline = time.monotonic() + (self.timeout if timeout is None
+        deadline = clockctl.monotonic() + (self.timeout if timeout is None
                                        else timeout)
         with self._cond:
             while self._used > 0 and self._used + n > self.limit:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - clockctl.monotonic()
                 if remaining <= 0:
                     return False
                 self._waiters += 1

@@ -183,9 +183,8 @@ class Registry:
                    interval_sec: float = 15.0) -> None:
         if not address:
             return
-        import urllib.request
-
         from seaweedfs_tpu.utils import glog
+        from seaweedfs_tpu.utils.httpd import http_call
         self._push_stop = threading.Event()
         url = (f"http://{address}/metrics/job/{job}"
                f"/instance/{urllib.parse.quote(instance, safe='')}")
@@ -193,11 +192,10 @@ class Registry:
         def loop():
             while not self._push_stop.wait(interval_sec):
                 try:
-                    req = urllib.request.Request(
-                        url, data=self.expose_text().encode(),
-                        method="PUT",
-                        headers={"Content-Type": "text/plain"})
-                    urllib.request.urlopen(req, timeout=10).read()
+                    http_call("PUT", url,
+                              body=self.expose_text().encode(),
+                              timeout=10,
+                              headers={"Content-Type": "text/plain"})
                 except Exception as e:
                     glog.vlog(1, "metrics push to %s failed: %s", url, e)
 

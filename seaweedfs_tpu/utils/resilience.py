@@ -45,9 +45,10 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Callable, Iterable, Optional, Sequence
 
+from seaweedfs_tpu.utils import headers
 from seaweedfs_tpu.utils import clockctl, tracing
 
-DEADLINE_HEADER = "X-Weed-Deadline"  # remaining seconds, decimal string
+DEADLINE_HEADER = headers.DEADLINE  # remaining seconds, decimal string
 
 
 def _now() -> float:

@@ -43,7 +43,8 @@ from typing import Optional
 
 from seaweedfs_tpu.utils import glog
 
-TRACE_HEADER = "X-Weed-Trace"
+from seaweedfs_tpu.utils import headers
+TRACE_HEADER = headers.TRACE
 
 # ring-buffer + keep-policy defaults; Tracer() callers can override
 DEFAULT_CAPACITY = 2048

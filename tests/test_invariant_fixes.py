@@ -1,0 +1,149 @@
+"""Regression tests for the concrete defects weedlint surfaced when it
+first ran over the tree (see ARCHITECTURE.md "Static analysis &
+invariants"): the master's KeepConnected broadcast wedging on one slow
+subscriber, the gRPC sync pump buffering an unbounded event backlog,
+and long-lived protocol sockets silently inheriting their connect
+timeout as the per-op I/O timeout.  The lint gate itself
+(test_weedlint.py) keeps the *patterns* from coming back; these pin
+the repaired *behavior*."""
+
+import queue
+import socket
+import threading
+import time
+
+import pytest
+
+
+# ---- master KeepConnected: bounded per-subscriber queues --------------
+
+class _StubTopo:
+    def __init__(self):
+        self.listeners = []
+
+
+class _StubMaster:
+    def __init__(self):
+        self.topo = _StubTopo()
+
+
+def test_master_broadcast_sheds_oldest_for_slow_subscriber():
+    """_broadcast must never block while holding the subscriber lock:
+    a full (stalled) subscriber queue loses its OLDEST delta to make
+    room for the newest, and healthy subscribers still get every
+    delta."""
+    from seaweedfs_tpu.server.master_grpc import (MasterGrpc,
+                                                  SUB_QUEUE_DEPTH)
+
+    mg = MasterGrpc(_StubMaster())
+    slow: queue.Queue = queue.Queue(maxsize=SUB_QUEUE_DEPTH)
+    healthy: queue.Queue = queue.Queue(maxsize=SUB_QUEUE_DEPTH)
+    for i in range(SUB_QUEUE_DEPTH):
+        slow.put_nowait(f"old-{i}")
+    with mg._subs_lock:
+        mg._subs[1] = slow
+        mg._subs[2] = healthy
+
+    done = threading.Event()
+
+    def bcast():
+        mg._broadcast("new-delta")
+        done.set()
+
+    threading.Thread(target=bcast, daemon=True).start()
+    assert done.wait(2.0), "_broadcast blocked on a full subscriber"
+    assert healthy.get_nowait() == "new-delta"
+    drained = []
+    while True:
+        try:
+            drained.append(slow.get_nowait())
+        except queue.Empty:
+            break
+    assert drained[0] == "old-1", "oldest delta should have been shed"
+    assert drained[-1] == "new-delta"
+    assert len(drained) == SUB_QUEUE_DEPTH
+
+
+# ---- gRPC sync pump: bounded queue backpressures the stream -----------
+
+class _StubCall:
+    """Iterable standing in for a grpc SubscribeMetadata stream that
+    never ends; counts how far the pump has read it."""
+
+    def __init__(self):
+        self.pulled = 0
+        self.cancelled = False
+
+    def __iter__(self):
+        while not self.cancelled:
+            self.pulled += 1
+            yield ("ev", self.pulled)
+
+    def cancel(self):
+        self.cancelled = True
+
+
+class _StubClient:
+    def __init__(self, call):
+        self._call = call
+
+    def subscribe_metadata(self, since_ns, path_prefix):
+        return self._call
+
+
+def test_sync_pump_backpressures_instead_of_buffering(monkeypatch):
+    """With the consumer stalled, the pump thread must stop reading the
+    stream once the queue fills — bounded memory — instead of slurping
+    the whole backlog."""
+    from seaweedfs_tpu.replication import sync as sync_mod
+
+    monkeypatch.setattr(sync_mod, "_pb_event_to_dict", lambda resp: resp)
+    call = _StubCall()
+    gen = sync_mod._grpc_event_stream(_StubClient(call), 0, "/")
+    assert next(gen) is not None  # starts the pump
+    deadline = time.monotonic() + 2.0
+    while call.pulled < 100 and time.monotonic() < deadline:
+        time.sleep(0.01)  # let the pump fill the queue
+    high = call.pulled
+    time.sleep(0.3)       # consumer stalled: pump must be parked
+    # one extra item can be in flight inside the blocked put()
+    assert call.pulled <= high + 1 <= 260, \
+        f"pump read {call.pulled} events with a stalled consumer"
+    gen.close()           # cancels the stream via the finally branch
+    assert call.cancelled
+
+
+# ---- long-lived sockets: explicit I/O timeout after connect -----------
+
+@pytest.fixture
+def listener():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    yield srv.getsockname()
+    srv.close()
+
+
+def test_store_clients_set_explicit_io_timeout(listener):
+    """The filer-store wire clients must not let the connect timeout
+    silently persist as the per-op I/O timeout — the socket deadline
+    after __init__ is the explicit one the client chose."""
+    from seaweedfs_tpu.filer.redis_store import RespClient
+
+    host, port = listener
+    c = RespClient(host, port, timeout=3.5)
+    try:
+        assert c.sock.gettimeout() == 3.5
+    finally:
+        c.sock.close()
+
+
+def test_kafka_producer_sets_explicit_io_timeout(listener):
+    from seaweedfs_tpu.notification.kafka_queue import KafkaProducer
+
+    host, port = listener
+    p = KafkaProducer(host, port)
+    try:
+        assert p.sock.gettimeout() == p.timeout
+    finally:
+        p.sock.close()

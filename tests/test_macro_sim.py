@@ -206,6 +206,41 @@ def test_adaptive_limiter_gradient_on_scripted_trace():
     assert twin.snapshot() == lim.snapshot()
 
 
+def test_scrub_token_bucket_elapses_on_virtual_time():
+    """The scrubber's byte throttle (TokenBucket) rides clockctl: under
+    a virtual clock its refills and waits follow the sim timeline, so
+    consuming 400 bytes at 100 B/s costs 4 virtual seconds and ~zero
+    wall seconds — the property that lets the macro-sim model scrub
+    pacing without wall-clock sleeps."""
+    import time as _time
+
+    from seaweedfs_tpu.utils.limiter import TokenBucket
+
+    t = [0.0]
+    with clockctl.install(lambda: t[0],
+                          sleep_fn=lambda s: t.__setitem__(0, t[0] + s)):
+        tb = TokenBucket(rate_bytes_per_sec=100.0)
+        wall0 = _time.perf_counter()
+        for _ in range(4):
+            assert tb.consume(100)
+        wall = _time.perf_counter() - wall0
+    # the bucket starts empty, so 4x100 bytes is exactly 4s of refill
+    assert t[0] == pytest.approx(4.0)
+    assert wall < 0.5
+
+
+def test_token_bucket_refuses_to_block_inside_the_sim():
+    """install() without a sleep hook (how the sim kernel runs) makes a
+    limiter that would block raise instead of stalling the one real
+    thread the whole fleet shares."""
+    from seaweedfs_tpu.utils.limiter import TokenBucket
+
+    with clockctl.install(lambda: 0.0):
+        tb = TokenBucket(rate_bytes_per_sec=10.0)
+        with pytest.raises(RuntimeError, match="virtual clock"):
+            tb.consume(100)
+
+
 # ------------------------- same schedule schema against real processes
 
 def test_netchaos_replays_sim_schedule_against_real_proxy():

@@ -290,6 +290,94 @@ def test_s3_put_produces_single_stitched_trace(traced_stack):
     assert "admitted" in verdicts
 
 
+def test_webdav_edge_propagates_trace_and_deadline(tmp_path):
+    """A traced request through the WebDAV edge carries X-Weed-Trace to
+    the volume tier (the chunk upload is a real wire hop) and honors an
+    inbound X-Weed-Deadline — an exhausted budget fails the write fast
+    instead of uploading chunks."""
+    from seaweedfs_tpu.gateway.webdav_server import WebDavServer
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.utils import headers as weed_headers
+
+    ms = MasterServer(volume_size_limit_mb=64, trace_sample=1.0)
+    ms.start()
+    vs = VolumeServer([str(tmp_path / "v")], ms.url, trace_sample=1.0)
+    vs.start()
+    time.sleep(0.2)
+    fs = FilerServer(ms.url, trace_sample=1.0)
+    fs.start()
+    dav = WebDavServer(fs, trace_sample=1.0)
+    dav.start()
+    try:
+        tid = "00deadbeef001234"
+        # > 2048 bytes so the filer uploads real chunks volume-ward
+        status, _, _ = http_call(
+            "PUT", f"http://{dav.url}/traced.bin", body=b"x" * 8192,
+            headers={weed_headers.TRACE: f"{tid}:1234abcd:1",
+                     weed_headers.DEADLINE: "30"})
+        assert status == 201
+        vol_spans = [s for s in vs.tracer.snapshot()["spans"]
+                     if s["trace_id"] == tid]
+        assert vol_spans, \
+            "X-Weed-Trace died at the WebDAV edge instead of riding " \
+            "the chunk upload to the volume server"
+
+        # deadline honored downstream: an exhausted budget makes the
+        # chunk upload raise DeadlineExceeded before any bytes move
+        status, _, _ = http_call(
+            "PUT", f"http://{dav.url}/late.bin", body=b"y" * 8192,
+            headers={weed_headers.DEADLINE: "0.000001"})
+        assert status >= 500
+        assert fs.filer.find_entry("/late.bin") is None
+    finally:
+        dav.stop()
+        fs.stop()
+        vs.stop()
+        ms.stop()
+
+
+def test_iam_edge_continues_inbound_trace(tmp_path):
+    """The IAM edge continues an inbound X-Weed-Trace (server span on
+    the caller's trace, parented to the caller's span) rather than
+    dropping it, so its filer-ward writes stay on the same trace."""
+    from seaweedfs_tpu.gateway.iam_server import IamServer
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.utils import headers as weed_headers
+
+    ms = MasterServer(volume_size_limit_mb=64)
+    ms.start()
+    vs = VolumeServer([str(tmp_path / "v")], ms.url)
+    vs.start()
+    time.sleep(0.2)
+    fs = FilerServer(ms.url)
+    fs.start()
+    iam = IamServer(fs, trace_sample=1.0)
+    iam.start()
+    try:
+        tid, caller_span = "00cafe0000005678", "0badf00d"
+        status, body, _ = http_call(
+            "POST", f"http://{iam.url}/",
+            body=b"Action=CreateUser&UserName=alice",
+            headers={"Content-Type": "application/x-www-form-urlencoded",
+                     weed_headers.TRACE: f"{tid}:{caller_span}:1",
+                     weed_headers.DEADLINE: "10"})
+        assert status == 200, body
+        edge = [s for s in iam.tracer.snapshot()["spans"]
+                if s["trace_id"] == tid]
+        assert edge, "IAM edge minted a fresh trace instead of " \
+                     "continuing the inbound one"
+        assert any(s["parent_id"] == caller_span for s in edge)
+    finally:
+        iam.stop()
+        fs.stop()
+        vs.stop()
+        ms.stop()
+
+
 def test_tracing_disabled_is_invisible(tmp_path):
     from seaweedfs_tpu.server.filer_server import FilerServer
     from seaweedfs_tpu.server.master import MasterServer

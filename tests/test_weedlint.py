@@ -1,0 +1,311 @@
+"""weedlint: the AST invariant checker (tools/weedlint).
+
+Three layers of coverage:
+
+1. per-rule fixtures — for each rule a violating snippet, a clean
+   counterpart, and a suppressed variant, run through check_source;
+2. the engine — baseline capture/round-trip, the consuming-multiset
+   new-violation filter, --diff against a synthetic two-commit git
+   repo, CLI exit codes;
+3. the tree gate — the real repository lints clean against the
+   checked-in baseline (THE tier-1 invariant this PR adds), inside the
+   <5s budget, and the baseline has burned down >=60 entries from the
+   initial capture frozen at tools/weedlint/baseline_initial.json.
+"""
+
+import json
+import subprocess
+import sys
+import time
+from collections import Counter
+from pathlib import Path
+
+import pytest
+
+from tools.weedlint import engine
+from tools.weedlint.rules import RULES, check_source
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def rules_of(src: str, path: str = "seaweedfs_tpu/x.py") -> list:
+    return [v.rule for v in check_source(path, src)]
+
+
+# ------------------------------------------------- per-rule fixtures
+
+CASES = {
+    "raw-clock": {
+        "bad": "import time\n\ndef f():\n    return time.monotonic()\n",
+        "clean": ("from seaweedfs_tpu.utils import clockctl\n\n"
+                  "def f():\n    return clockctl.monotonic()\n"),
+    },
+    "raw-http": {
+        "bad": ("import urllib.request\n\ndef f(url):\n"
+                "    return urllib.request.urlopen(url).read()\n"),
+        "clean": ("from seaweedfs_tpu.utils.httpd import http_call\n\n"
+                  "def f(url):\n"
+                  "    return http_call('GET', url)[1]\n"),
+    },
+    "lock-across-blocking": {
+        "bad": ("import time\nfrom seaweedfs_tpu.utils.httpd import "
+                "http_call\nlock = object()\n\ndef f():\n"
+                "    with lock:\n"
+                "        http_call('GET', 'http://x/')\n"),
+        "clean": ("from seaweedfs_tpu.utils.httpd import http_call\n"
+                  "lock = object()\n\ndef f():\n"
+                  "    with lock:\n        x = 1\n"
+                  "    http_call('GET', 'http://x/')\n"),
+    },
+    "swallowed-exit": {
+        "bad": ("def gen():\n    try:\n        yield 1\n"
+                "    except BaseException:\n        pass\n"),
+        "clean": ("def gen():\n    try:\n        yield 1\n"
+                  "    except Exception:\n        pass\n"),
+    },
+    "header-literal": {
+        "bad": "HEADERS = {'X-Weed-Deadline': '5'}\n",
+        "clean": ("from seaweedfs_tpu.utils import headers\n"
+                  "HEADERS = {headers.DEADLINE: '5'}\n"),
+    },
+    "persistent-socket-timeout": {
+        "bad": ("import socket\n\ndef connect(h, p):\n"
+                "    return socket.create_connection((h, p), timeout=5)\n"),
+        "clean": ("import socket\n\ndef connect(h, p):\n"
+                  "    s = socket.create_connection((h, p), timeout=5)\n"
+                  "    s.settimeout(None)\n    return s\n"),
+    },
+    "unbounded-pool": {
+        "bad": "import queue\n\nq = queue.Queue()\n",
+        "clean": "import queue\n\nq = queue.Queue(maxsize=64)\n",
+    },
+    "ambient-scope-loss": {
+        "bad": ("from seaweedfs_tpu.utils.tracing import current_span\n\n"
+                "def f(pool):\n"
+                "    def work():\n        return current_span()\n"
+                "    pool.submit(work)\n"),
+        "clean": ("from seaweedfs_tpu.utils.tracing import (current_span,"
+                  " span_scope)\n\n"
+                  "def f(pool):\n"
+                  "    span = current_span()\n"
+                  "    def work():\n"
+                  "        with span_scope(span):\n"
+                  "            return span\n"
+                  "    pool.submit(work)\n"),
+    },
+}
+
+
+@pytest.mark.parametrize("rule", sorted(CASES))
+def test_rule_flags_violation(rule):
+    assert rule in rules_of(CASES[rule]["bad"]), \
+        f"{rule}: violating fixture not flagged"
+
+
+@pytest.mark.parametrize("rule", sorted(CASES))
+def test_rule_passes_clean_counterpart(rule):
+    assert rule not in rules_of(CASES[rule]["clean"]), \
+        f"{rule}: clean fixture wrongly flagged"
+
+
+@pytest.mark.parametrize("rule", sorted(CASES))
+def test_rule_suppressible_inline(rule):
+    bad = CASES[rule]["bad"]
+    flagged = check_source("seaweedfs_tpu/x.py", bad)
+    line_no = next(v.line for v in flagged if v.rule == rule)
+    lines = bad.splitlines(keepends=True)
+    lines[line_no - 1] = (lines[line_no - 1].rstrip("\n")
+                          + f"  # weedlint: disable={rule}\n")
+    assert rule not in rules_of("".join(lines)), \
+        f"{rule}: inline suppression ignored"
+
+
+def test_every_rule_has_a_fixture():
+    assert set(CASES) == set(RULES)
+
+
+# ------------------------------------ rule subtleties worth pinning
+
+def test_suppression_comment_block_above():
+    """The directive may sit anywhere in the contiguous comment block
+    above a multi-line statement (the httpd.py idiom)."""
+    src = ("import socket\n\ndef connect(h, p):\n"
+           "    # weedlint: disable=persistent-socket-timeout — managed\n"
+           "    # per-request by the caller\n"
+           "    return socket.create_connection((h, p),\n"
+           "                                    timeout=5)\n")
+    assert "persistent-socket-timeout" not in rules_of(src)
+
+
+def test_swallowed_exit_shielded_by_prior_generatorexit_handler():
+    """A broad handler AFTER `except GeneratorExit: raise` can never
+    see GeneratorExit and must not be flagged (the sim _reply_chain
+    shape)."""
+    src = ("def gen():\n    try:\n        yield 1\n"
+           "    except GeneratorExit:\n        raise\n"
+           "    except BaseException as e:\n        err = e\n")
+    assert "swallowed-exit" not in rules_of(src)
+
+
+def test_swallowed_exit_flags_yield_in_finally():
+    src = ("def gen():\n    try:\n        yield 1\n"
+           "    finally:\n        yield 2\n")
+    assert "swallowed-exit" in rules_of(src)
+
+
+def test_raw_clock_catches_aliased_imports():
+    assert "raw-clock" in rules_of(
+        "from time import sleep as snooze\n\ndef f():\n    snooze(1)\n")
+    assert "raw-clock" in rules_of(
+        "import time as t\n\ndef f():\n    return t.time()\n")
+
+
+def test_rule_home_files_are_exempt():
+    assert "raw-clock" not in rules_of(
+        "import time\nx = time.time()\n",
+        path="seaweedfs_tpu/utils/clockctl.py")
+    assert "header-literal" not in rules_of(
+        "D = 'X-Weed-Deadline'\n",
+        path="seaweedfs_tpu/utils/headers.py")
+
+
+def test_syntax_error_reported_not_crashed():
+    vs = check_source("seaweedfs_tpu/x.py", "def broken(:\n")
+    assert [v.rule for v in vs] == ["syntax-error"]
+
+
+# ------------------------------------------------------- the engine
+
+def _write(root: Path, rel: str, src: str) -> Path:
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(src)
+    return p
+
+
+def test_baseline_roundtrip(tmp_path):
+    """--update-baseline then a plain run exits 0; a NEW violation on
+    top of the grandfathered ones exits 1 and names only itself."""
+    from tools.weedlint.__main__ import main
+
+    _write(tmp_path, "seaweedfs_tpu/old.py",
+           "import time\nx = time.time()\n")
+    args = ["--root", str(tmp_path)]
+    assert main(args + ["--update-baseline"]) == 0
+    assert main(args) == 0  # grandfathered
+
+    _write(tmp_path, "seaweedfs_tpu/new.py",
+           "import time\ny = time.monotonic()\n")
+    assert main(args) == 1
+
+    baseline = engine.load_baseline(tmp_path / engine.BASELINE_NAME)
+    fresh = engine.filter_new(
+        engine.lint_tree(tmp_path), baseline)
+    assert [v.file for v in fresh] == ["seaweedfs_tpu/new.py"]
+
+
+def test_baseline_keys_survive_line_drift(tmp_path):
+    """Baseline entries match on (file, rule, snippet), not line
+    numbers — inserting unrelated lines above must not re-flag."""
+    p = _write(tmp_path, "seaweedfs_tpu/drift.py",
+               "import time\nx = time.time()\n")
+    base = Counter(v.key() for v in engine.lint_tree(tmp_path))
+    p.write_text("import time\n\n# padding\nA = 1\nx = time.time()\n")
+    assert engine.filter_new(engine.lint_tree(tmp_path), base) == []
+
+
+def test_filter_new_is_a_consuming_multiset(tmp_path):
+    """One grandfathered entry covers ONE occurrence: duplicating the
+    identical violating line is a new violation."""
+    p = _write(tmp_path, "seaweedfs_tpu/dup.py",
+               "import time\nx = time.time()\n")
+    base = Counter(v.key() for v in engine.lint_tree(tmp_path))
+    p.write_text("import time\nx = time.time()\nx = time.time()\n")
+    fresh = engine.filter_new(engine.lint_tree(tmp_path), base)
+    assert len(fresh) == 1
+
+
+def test_diff_mode_lints_only_changed_files(tmp_path):
+    """Synthetic two-commit repo: commit 1 carries an old violation,
+    commit 2 adds a second file; --diff REV sees only the new file
+    (plus untracked)."""
+    def git(*a):
+        subprocess.run(["git", *a], cwd=tmp_path, check=True,
+                       capture_output=True,
+                       env={"GIT_AUTHOR_NAME": "t",
+                            "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t",
+                            "HOME": str(tmp_path),
+                            "PATH": "/usr/bin:/bin:/usr/local/bin"})
+
+    git("init", "-q")
+    _write(tmp_path, "seaweedfs_tpu/legacy.py",
+           "import time\nx = time.time()\n")
+    git("add", "-A")
+    git("commit", "-qm", "one")
+    first = subprocess.run(
+        ["git", "rev-parse", "HEAD"], cwd=tmp_path, check=True,
+        capture_output=True, text=True).stdout.strip()
+    _write(tmp_path, "seaweedfs_tpu/fresh.py",
+           "import time\ny = time.monotonic()\n")
+    git("add", "-A")
+    git("commit", "-qm", "two")
+    _write(tmp_path, "seaweedfs_tpu/untracked.py",
+           "import time\ntime.sleep(0)\n")
+
+    changed = engine.changed_files(tmp_path, first)
+    rels = sorted(p.relative_to(tmp_path).as_posix() for p in changed)
+    assert rels == ["seaweedfs_tpu/fresh.py",
+                    "seaweedfs_tpu/untracked.py"]
+    vs = engine.lint_tree(tmp_path, files=changed)
+    assert sorted({v.file for v in vs}) == rels
+
+
+def test_cli_runs_as_module(tmp_path):
+    """`python -m tools.weedlint` is the documented entry point."""
+    _write(tmp_path, "seaweedfs_tpu/v.py", "import time\nt = time.time()\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.weedlint", "--root", str(tmp_path),
+         "--no-baseline"],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 1
+    assert "seaweedfs_tpu/v.py:2:raw-clock" in out.stdout
+
+
+# ------------------------------------------------------ the tree gate
+
+def test_repo_tree_lints_clean_within_budget():
+    """THE gate: the real tree has zero non-baselined violations, and
+    the whole-tree walk fits the 5s budget the tier-1 suite pays."""
+    t0 = time.perf_counter()
+    violations = engine.lint_tree(REPO)
+    elapsed = time.perf_counter() - t0
+    baseline = engine.load_baseline(REPO / engine.BASELINE_NAME)
+    fresh = engine.filter_new(violations, baseline)
+    assert fresh == [], "new weedlint violations:\n" + "\n".join(
+        v.format() for v in fresh)
+    assert elapsed < 5.0, f"tree lint took {elapsed:.2f}s"
+
+
+def test_baseline_burned_down_at_least_60_entries():
+    """The PR's burn-down contract: the checked-in baseline is >=60
+    entries smaller than the initial capture (frozen when the linter
+    first ran over the tree)."""
+    initial = json.loads(
+        (REPO / "tools/weedlint/baseline_initial.json").read_text())
+    current = json.loads(
+        (REPO / engine.BASELINE_NAME).read_text())
+    shrink = len(initial["entries"]) - len(current["entries"])
+    assert shrink >= 60, \
+        f"baseline shrank by only {shrink} entries"
+
+
+def test_baseline_matches_tree_exactly():
+    """No phantom grandfathering: every baseline entry corresponds to a
+    live violation, so the ratchet can only tighten."""
+    live = Counter(v.key() for v in engine.lint_tree(REPO))
+    base = engine.load_baseline(REPO / engine.BASELINE_NAME)
+    stale = base - live
+    assert not stale, f"baseline entries with no live violation: " \
+                      f"{sorted(stale)[:5]}"

@@ -1,0 +1,34 @@
+"""weedlint — project-specific AST invariant checker.
+
+The cluster rests on cross-cutting contracts that no unit test can
+enforce per-call-site: every RPC must ride ``http_call`` so
+Deadline/Class/Trace headers propagate, every behavioral timer must
+read ``utils/clockctl.py`` so the macro-sim can elapse real code in
+virtual time, locks must not be held across blocking I/O, generators
+must not swallow ``GeneratorExit``.  Each rule here encodes an
+invariant a past PR learned the hard way; the linter turns those
+review-time lessons into machine-checked gates.
+
+Usage::
+
+    python -m tools.weedlint                  # whole tree vs baseline
+    python -m tools.weedlint --diff HEAD~1    # only changed files
+    python -m tools.weedlint --update-baseline
+    python -m tools.weedlint --list-rules
+
+Suppression: append ``# weedlint: disable=<rule>[,<rule>...]`` to the
+offending line (or a pure-comment line directly above it).  Sites that
+predate a rule live in ``weedlint_baseline.json``; the gate only fails
+on violations NOT in the baseline, so new code is held to the full
+contract while the grandfathered debt is burned down incrementally.
+"""
+
+from tools.weedlint.engine import (  # noqa: F401
+    filter_new,
+    iter_py_files,
+    lint_file,
+    lint_tree,
+    load_baseline,
+    save_baseline,
+)
+from tools.weedlint.rules import RULES, Violation  # noqa: F401

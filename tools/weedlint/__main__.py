@@ -1,0 +1,112 @@
+"""CLI: ``python -m tools.weedlint [options] [files...]``.
+
+Exit codes: 0 clean, 1 non-baselined violations found, 2 usage or
+internal error (same convention as flake8).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from collections import Counter
+from pathlib import Path
+
+from tools.weedlint import engine
+from tools.weedlint.rules import RULES
+
+
+def _find_root(start: Path) -> Path:
+    cur = start.resolve()
+    for cand in (cur, *cur.parents):
+        if (cand / ".git").exists() or \
+                (cand / engine.BASELINE_NAME).exists():
+            return cand
+    return cur
+
+
+def main(argv: list[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="weedlint",
+        description="AST invariant checker for the seaweedfs-tpu tree")
+    ap.add_argument("files", nargs="*",
+                    help="specific files to lint (default: whole tree)")
+    ap.add_argument("--root", type=Path, default=None,
+                    help="repo root (default: auto-detect from cwd)")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help=f"baseline file (default: <root>/"
+                         f"{engine.BASELINE_NAME})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every violation, grandfathered or not")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current tree")
+    ap.add_argument("--diff", nargs="?", const="HEAD", default=None,
+                    metavar="REV",
+                    help="lint only files changed vs REV (default HEAD) "
+                         "plus untracked files")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--stats", action="store_true",
+                    help="per-rule violation counts instead of lines")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        width = max(len(r) for r in RULES)
+        for rule, desc in RULES.items():
+            print(f"{rule:<{width}}  {desc}")
+        return 0
+
+    root = (args.root or _find_root(Path.cwd())).resolve()
+    baseline_path = args.baseline or (root / engine.BASELINE_NAME)
+
+    t0 = time.perf_counter()
+    if args.files:
+        files = []
+        for f in args.files:
+            p = Path(f) if Path(f).is_absolute() else root / f
+            if p.is_dir():
+                files.extend(q for q in sorted(p.rglob("*.py"))
+                             if not engine._excluded(
+                                 q.relative_to(root).as_posix()))
+            else:
+                files.append(p)
+        violations = engine.lint_tree(root, files=files)
+    elif args.diff is not None:
+        try:
+            files = engine.changed_files(root, args.diff)
+        except Exception as e:
+            print(f"weedlint: --diff failed: {e}", file=sys.stderr)
+            return 2
+        violations = engine.lint_tree(root, files=files)
+    else:
+        violations = engine.lint_tree(root)
+    elapsed = time.perf_counter() - t0
+
+    if args.update_baseline:
+        n = engine.save_baseline(baseline_path, violations)
+        print(f"weedlint: baseline captured: {n} entries -> "
+              f"{baseline_path}")
+        return 0
+
+    baseline = Counter() if args.no_baseline \
+        else engine.load_baseline(baseline_path)
+    fresh = engine.filter_new(violations, baseline)
+
+    if args.stats:
+        counts = Counter(v.rule for v in fresh)
+        for rule in sorted(counts):
+            print(f"{counts[rule]:5d}  {rule}")
+        print(f"{len(fresh)} new / {len(violations)} total "
+              f"({len(violations) - len(fresh)} baselined) "
+              f"in {elapsed:.2f}s")
+    else:
+        for v in fresh:
+            print(v.format())
+        if fresh:
+            print(f"weedlint: {len(fresh)} new violation(s) "
+                  f"({len(violations) - len(fresh)} baselined) "
+                  f"in {elapsed:.2f}s", file=sys.stderr)
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

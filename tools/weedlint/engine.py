@@ -1,0 +1,144 @@
+"""weedlint engine: file discovery, baseline ratchet, diff mode.
+
+The baseline (``weedlint_baseline.json``) is the grandfather list: a
+multiset of (file, rule, stripped-source-line) keys captured when a
+rule was introduced.  A current violation whose key matches an unused
+baseline entry is old debt and doesn't fail the gate; anything else is
+NEW and does.  Keys use the stripped source line rather than the line
+number so unrelated edits above a grandfathered site don't resurrect
+it.  ``--update-baseline`` rewrites the file from the current tree —
+run it only to capture a new rule or record a burn-down, never to
+bury a fresh violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+from collections import Counter
+from pathlib import Path
+from typing import Iterable, Optional
+
+from tools.weedlint.rules import Violation, check_source
+
+# scanned package roots, repo-relative
+DEFAULT_ROOTS = ("seaweedfs_tpu", "tools")
+# generated protos and the linter itself (its rule table names the
+# patterns it hunts, which would self-flag)
+EXCLUDE_PARTS = ("__pycache__",)
+EXCLUDE_PREFIXES = ("seaweedfs_tpu/pb/", "tools/weedlint/")
+BASELINE_NAME = "weedlint_baseline.json"
+
+
+def _rel(path: Path, root: Path) -> str:
+    return path.relative_to(root).as_posix()
+
+
+def _excluded(rel: str) -> bool:
+    if any(part in rel.split("/") for part in EXCLUDE_PARTS):
+        return True
+    return any(rel.startswith(p) for p in EXCLUDE_PREFIXES)
+
+
+def iter_py_files(root: Path,
+                  roots: Iterable[str] = DEFAULT_ROOTS) -> list[Path]:
+    out: list[Path] = []
+    for top in roots:
+        base = root / top
+        if base.is_file():
+            out.append(base)
+            continue
+        for p in sorted(base.rglob("*.py")):
+            if not _excluded(_rel(p, root)):
+                out.append(p)
+    return out
+
+
+def lint_file(path: Path, root: Path) -> list[Violation]:
+    rel = _rel(path, root) if path.is_absolute() else path.as_posix()
+    try:
+        source = path.read_text(encoding="utf-8")
+    except (OSError, UnicodeDecodeError) as e:
+        return [Violation(file=rel, line=1, col=0, rule="io-error",
+                          message=str(e), snippet="")]
+    return check_source(rel, source)
+
+
+def lint_tree(root: Path,
+              roots: Iterable[str] = DEFAULT_ROOTS,
+              files: Optional[Iterable[Path]] = None) -> list[Violation]:
+    targets = list(files) if files is not None \
+        else iter_py_files(root, roots)
+    out: list[Violation] = []
+    for path in targets:
+        out.extend(lint_file(path, root))
+    out.sort(key=lambda v: (v.file, v.line, v.rule))
+    return out
+
+
+# ---- baseline ----
+
+def load_baseline(path: Path) -> Counter:
+    """Multiset of grandfathered (file, rule, snippet) keys; an absent
+    file is an empty baseline (everything is new)."""
+    if not path.exists():
+        return Counter()
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return Counter((e["file"], e["rule"], e["snippet"])
+                   for e in data.get("entries", []))
+
+
+def save_baseline(path: Path, violations: Iterable[Violation]) -> int:
+    entries = sorted(
+        ({"file": v.file, "rule": v.rule, "snippet": v.snippet}
+         for v in violations),
+        key=lambda e: (e["file"], e["rule"], e["snippet"]))
+    path.write_text(json.dumps({"version": 1, "entries": entries},
+                               indent=1) + "\n", encoding="utf-8")
+    return len(entries)
+
+
+def filter_new(violations: Iterable[Violation],
+               baseline: Counter) -> list[Violation]:
+    """Violations not covered by the baseline multiset.  Matching
+    consumes entries, so two identical new copies of one grandfathered
+    line still fail (the debt doesn't license duplication)."""
+    budget = Counter(baseline)
+    fresh: list[Violation] = []
+    for v in violations:
+        key = v.key()
+        if budget[key] > 0:
+            budget[key] -= 1
+        else:
+            fresh.append(v)
+    return fresh
+
+
+# ---- diff mode ----
+
+def changed_files(root: Path, rev: str = "HEAD",
+                  roots: Iterable[str] = DEFAULT_ROOTS) -> list[Path]:
+    """Tracked .py files changed vs `rev` plus untracked ones, limited
+    to the scanned roots; the unit of reporting stays the whole file
+    (a diff hunk can break an invariant established elsewhere in it)."""
+    def _git(*args: str) -> list[str]:
+        res = subprocess.run(
+            ["git", *args], cwd=root, text=True,
+            capture_output=True, check=True)
+        return [ln for ln in res.stdout.splitlines() if ln.strip()]
+
+    names = set(_git("diff", "--name-only", rev, "--", "*.py"))
+    names.update(_git("ls-files", "--others", "--exclude-standard",
+                      "--", "*.py"))
+    out: list[Path] = []
+    for name in sorted(names):
+        rel = name.replace(os.sep, "/")
+        if not any(rel == r or rel.startswith(r + "/") for r in roots):
+            continue
+        if _excluded(rel):
+            continue
+        p = root / rel
+        if p.exists():  # deleted files have no violations
+            out.append(p)
+    return out

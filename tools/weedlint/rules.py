@@ -1,0 +1,489 @@
+"""The weedlint rule set: one AST pass, eight invariants.
+
+Every rule encodes a contract the cluster depends on ambiently — the
+kind that breaks silently at a single call site and only surfaces as a
+sim-fidelity gap or a dropped header three hops downstream.  The rule
+id in parentheses is what ``# weedlint: disable=<id>`` takes.
+
+raw-clock
+    ``time.time()/monotonic()/sleep()`` outside ``utils/clockctl.py``.
+    Behavioral timers must read the clockctl indirection so the
+    macro-sim's virtual clock reaches them; a raw site is invisible to
+    the sim and elapses in wall time mid-simulation.  Measurement-only
+    wall-clock reads (bench timing, log timestamps) are legitimate —
+    suppress them inline with a justification.
+
+raw-http
+    ``urllib.request.urlopen/Request`` or ``http.client.HTTP(S)
+    Connection`` outside ``utils/httpd.py``.  Raw clients drop the
+    X-Weed-Deadline/Class/Trace headers that ``http_call`` injects, so
+    deadlines, QoS class and traces silently stop at that edge.
+
+lock-across-blocking
+    a ``with <lock>:`` body that calls ``http_call/http_json/urlopen``,
+    ``sleep`` or a no-arg ``.join()``.  Holding a lock across blocking
+    I/O turns one slow peer into a pile-up of every thread that
+    touches the lock.
+
+swallowed-exit
+    a handler in a generator that can eat ``GeneratorExit``: bare
+    ``except:`` / ``except BaseException:`` around a ``yield`` without
+    a bare re-``raise`` (a preceding ``except GeneratorExit: raise``
+    shields later broad handlers), an ``except GeneratorExit`` that
+    doesn't re-raise, or a ``yield`` inside ``finally``.  The sim kernel
+    closes actor coroutines via GeneratorExit; a swallowing handler
+    turns actor teardown into an infinite loop (the PR 8
+    ``_reply_chain`` bug).
+
+header-literal
+    an inline ``"X-Weed-*"`` string outside ``utils/headers.py``.
+    Header names are protocol constants; a typo in a literal fails
+    open (header silently not propagated), so all sites must import
+    the shared constant.
+
+persistent-socket-timeout
+    ``create_connection(..., timeout=)`` in a function that never
+    calls ``settimeout``.  The connect timeout persists as the
+    socket's I/O timeout and kills long-lived keepalive connections
+    after the first idle period (the netchaos proxy-teardown bug);
+    long-lived sockets must ``settimeout(None)`` (or an explicit
+    per-op value) after connecting.
+
+unbounded-pool
+    ``ThreadPoolExecutor()`` without ``max_workers`` or ``Queue()``
+    without ``maxsize``.  Unbounded pools/queues convert overload into
+    memory growth instead of backpressure; every pool in the data path
+    must state its bound.
+
+ambient-scope-loss
+    ``executor.submit`` of a closure that reads ambient context
+    (``current_span/current_deadline/current_class``) or issues
+    ``http_call`` without re-entering a scope.  ContextVars don't
+    cross pool threads: the closure must capture the ambient value in
+    the submitting thread and re-enter it via ``span_scope/
+    deadline_scope/class_scope/attach`` (the filer ``_upload_chunks``
+    idiom), otherwise the worker runs traceless and deadline-less.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+RULES: dict[str, str] = {
+    "raw-clock": "time.time/monotonic/sleep outside utils/clockctl.py",
+    "raw-http": "urllib/http.client request outside utils/httpd.py",
+    "lock-across-blocking": "with <lock>: body calls blocking I/O",
+    "swallowed-exit": "generator handler can swallow GeneratorExit",
+    "header-literal": "inline X-Weed-* literal instead of utils/headers.py",
+    "persistent-socket-timeout":
+        "create_connection(timeout=) without settimeout",
+    "unbounded-pool": "ThreadPoolExecutor/Queue without an explicit bound",
+    "ambient-scope-loss":
+        "submit of closure using ambient scope without re-entry",
+}
+
+# files that ARE the sanctioned implementation of a contract
+_RULE_HOME = {
+    "raw-clock": "utils/clockctl.py",
+    "raw-http": "utils/httpd.py",
+    "header-literal": "utils/headers.py",
+}
+
+_HEADER_PREFIX = "X-Weed-"
+_LOCKISH = re.compile(r"(?:^|_)(?:lock|mutex)$", re.IGNORECASE)
+_CLOCK_CALLS = {"time.time", "time.monotonic", "time.sleep"}
+_HTTP_CALLS = {
+    "urllib.request.urlopen", "urllib.request.Request",
+    "http.client.HTTPConnection", "http.client.HTTPSConnection",
+}
+# modules whose aliases we track for canonical-name resolution
+_TRACKED_MODULES = ("time", "urllib.request", "urllib", "http.client",
+                    "http", "socket", "queue", "concurrent.futures",
+                    "concurrent")
+_BLOCKING_TERMINALS = {"http_call", "http_json", "urlopen"}
+_AMBIENT_READERS = {"current_span", "current_deadline", "current_class"}
+_SCOPE_ENTRIES = {"span_scope", "deadline_scope", "class_scope",
+                  "attach", "child_scope"}
+
+
+@dataclass(frozen=True)
+class Violation:
+    file: str          # repo-relative, forward slashes
+    line: int
+    col: int
+    rule: str
+    message: str
+    snippet: str       # stripped source line: baseline key, drift-proof
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.file, self.rule, self.snippet)
+
+    def format(self) -> str:
+        return f"{self.file}:{self.line}:{self.rule}: {self.message}"
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    """Rightmost name of the call target: 'c' for a.b.c, 'f' for f."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _walk_same_scope(node: ast.AST, *, skip_root_check: bool = True):
+    """Yield nodes inside `node` without descending into nested
+    function/class scopes (their bodies run elsewhere/later).  The
+    nested scope's own def node IS yielded — callers like _Scope need
+    to see `def work(): ...` to resolve a later `pool.submit(work)` —
+    it's only the body that stays opaque."""
+    stack = [node]
+    first = True
+    while stack:
+        cur = stack.pop()
+        if not first and isinstance(cur, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.Lambda, ast.ClassDef)):
+            yield cur
+            continue
+        first = False
+        yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def _contains_yield(node: ast.AST) -> bool:
+    return any(isinstance(n, (ast.Yield, ast.YieldFrom))
+               for n in _walk_same_scope(node))
+
+
+def _has_bare_raise(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        for n in _walk_same_scope(ast.Module(body=[stmt],
+                                             type_ignores=[])):
+            if isinstance(n, ast.Raise) and n.exc is None:
+                return True
+    return False
+
+
+def _handler_catches(handler: ast.ExceptHandler, names: set[str]) -> bool:
+    t = handler.type
+    if t is None:
+        return "BARE" in names
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(_terminal(x) in names for x in types)
+
+
+class _Scope:
+    """Per-function bookkeeping for rules that need whole-function
+    context (persistent-socket-timeout, ambient-scope-loss,
+    swallowed-exit generator detection)."""
+
+    def __init__(self, node):
+        self.node = node
+        self.is_generator = (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and _contains_yield(node))
+        self.create_conn: list[ast.Call] = []
+        self.has_settimeout = False
+        # locally-defined closures by name, for submit() resolution
+        self.local_defs: dict[str, ast.AST] = {}
+        if not isinstance(node, ast.Module):
+            for n in _walk_same_scope(node):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n is not node:
+                    self.local_defs[n.name] = n
+                elif isinstance(n, ast.Assign) \
+                        and isinstance(n.value, ast.Lambda):
+                    for tgt in n.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.local_defs[tgt.id] = n.value
+
+
+class Checker(ast.NodeVisitor):
+    def __init__(self, rel_path: str, source: str):
+        self.rel = rel_path.replace("\\", "/")
+        self.lines = source.splitlines()
+        self.violations: list[Violation] = []
+        self.aliases: dict[str, str] = {}      # local name -> module
+        self.from_imports: dict[str, str] = {}  # local name -> mod.attr
+        self.scopes: list[_Scope] = []
+
+    # ---- reporting ----
+
+    def _snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def report(self, node: ast.AST, rule: str, message: str) -> None:
+        if self.rel.endswith(_RULE_HOME.get(rule, "\0")):
+            return
+        line = getattr(node, "lineno", 1)
+        self.violations.append(Violation(
+            file=self.rel, line=line, col=getattr(node, "col_offset", 0),
+            rule=rule, message=message, snippet=self._snippet(line)))
+
+    # ---- name resolution ----
+
+    def visit_Import(self, node: ast.Import) -> None:
+        # plain `import x.y` binds `x` and attribute access already
+        # spells the canonical dotted path; only `as` needs mapping
+        for a in node.names:
+            if a.asname and a.name in _TRACKED_MODULES:
+                self.aliases[a.asname] = a.name
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module in _TRACKED_MODULES:
+            for a in node.names:
+                self.from_imports[a.asname or a.name] = \
+                    f"{node.module}.{a.name}"
+        self.generic_visit(node)
+
+    def _canonical(self, func: ast.AST) -> Optional[str]:
+        """Resolve a call target to its canonical dotted module path
+        through `import x as y` / `from x import y` indirection."""
+        dotted = _dotted(func)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        if head in self.from_imports:
+            base = self.from_imports[head]
+            return f"{base}.{rest}" if rest else base
+        if head in self.aliases:
+            base = self.aliases[head]
+            return f"{base}.{rest}" if rest else base
+        return dotted
+
+    # ---- scope management ----
+
+    def _function_scope(self, node) -> None:
+        scope = _Scope(node)
+        self.scopes.append(scope)
+        self.generic_visit(node)
+        self.scopes.pop()
+        if scope.create_conn and not scope.has_settimeout:
+            for call in scope.create_conn:
+                self.report(
+                    call, "persistent-socket-timeout",
+                    "create_connection timeout persists as the socket "
+                    "I/O timeout; call settimeout(None) (or a per-op "
+                    "value) after connect")
+
+    visit_FunctionDef = _function_scope
+    visit_AsyncFunctionDef = _function_scope
+
+    def visit_Module(self, node: ast.Module) -> None:
+        self._function_scope(node)
+
+    # ---- per-node rules ----
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if isinstance(node.value, str) and \
+                node.value.startswith(_HEADER_PREFIX):
+            self.report(node, "header-literal",
+                        f'inline header literal "{node.value}" — import '
+                        "the constant from seaweedfs_tpu.utils.headers")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        canonical = self._canonical(node.func)
+        terminal = _terminal(node.func)
+
+        if canonical in _CLOCK_CALLS:
+            what = canonical.split(".")[1]
+            self.report(node, "raw-clock",
+                        f"raw time.{what}() — use clockctl.{'monotonic' if what == 'monotonic' else ('sleep' if what == 'sleep' else 'now')}() so "
+                        "virtual-clock sims reach this timer")
+        if canonical in _HTTP_CALLS:
+            self.report(node, "raw-http",
+                        f"raw {canonical}() drops X-Weed-Deadline/Class/"
+                        "Trace propagation — route through "
+                        "utils.httpd.http_call")
+        if terminal == "create_connection":
+            if any(kw.arg == "timeout" for kw in node.keywords) \
+                    or len(node.args) >= 2:
+                if self.scopes:
+                    self.scopes[-1].create_conn.append(node)
+        if terminal == "settimeout" and self.scopes:
+            self.scopes[-1].has_settimeout = True
+
+        if terminal == "ThreadPoolExecutor":
+            if not node.args and not any(kw.arg == "max_workers"
+                                         for kw in node.keywords):
+                self.report(node, "unbounded-pool",
+                            "ThreadPoolExecutor without max_workers — "
+                            "state the bound explicitly")
+        elif terminal == "Queue":
+            if not node.args and not any(kw.arg == "maxsize"
+                                         for kw in node.keywords):
+                self.report(node, "unbounded-pool",
+                            "Queue() without maxsize — unbounded queues "
+                            "turn overload into memory growth")
+
+        if terminal == "submit" and isinstance(node.func, ast.Attribute) \
+                and node.args:
+            self._check_submit(node)
+
+        self.generic_visit(node)
+
+    def _check_submit(self, node: ast.Call) -> None:
+        target = node.args[0]
+        closure: Optional[ast.AST] = None
+        if isinstance(target, ast.Lambda):
+            closure = target
+        elif isinstance(target, ast.Name) and self.scopes:
+            closure = self.scopes[-1].local_defs.get(target.id)
+        if closure is None:
+            return
+        body = closure.body if isinstance(closure, ast.Lambda) \
+            else ast.Module(body=closure.body, type_ignores=[])
+        reads_ambient = False
+        does_http = False
+        enters_scope = False
+        for n in _walk_same_scope(body):
+            if isinstance(n, ast.Call):
+                t = _terminal(n.func)
+                if t in _AMBIENT_READERS:
+                    reads_ambient = True
+                elif t in ("http_call", "http_json"):
+                    does_http = True
+                elif t in _SCOPE_ENTRIES:
+                    enters_scope = True
+        if (reads_ambient or does_http) and not enters_scope:
+            why = ("reads ambient context" if reads_ambient
+                   else "issues http_call")
+            self.report(
+                node, "ambient-scope-loss",
+                f"submitted closure {why} but never re-enters a scope — "
+                "capture span/deadline/class in the submitting thread "
+                "and re-enter via span_scope/deadline_scope/class_scope")
+
+    def _visit_with(self, node) -> None:
+        lockish = None
+        for item in node.items:
+            term = _terminal(item.context_expr)
+            if term and _LOCKISH.search(term):
+                lockish = term
+                break
+        if lockish is not None:
+            for n in _walk_same_scope(node):
+                if not isinstance(n, ast.Call):
+                    continue
+                canonical = self._canonical(n.func)
+                terminal = _terminal(n.func)
+                blocking = None
+                if canonical in ("time.sleep", "clockctl.sleep") or \
+                        terminal == "sleep":
+                    blocking = "sleep"
+                elif terminal in _BLOCKING_TERMINALS:
+                    blocking = terminal
+                elif terminal == "join" and not n.args and \
+                        not n.keywords and \
+                        isinstance(n.func, ast.Attribute) and \
+                        not isinstance(n.func.value, ast.Constant):
+                    blocking = "join"
+                if blocking:
+                    self.report(
+                        n, "lock-across-blocking",
+                        f"{blocking}() while holding '{lockish}' — "
+                        "blocking under a lock serializes every thread "
+                        "that touches it; move the I/O outside the "
+                        "critical section")
+        self.generic_visit(node)
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def visit_Try(self, node: ast.Try) -> None:
+        in_generator = bool(self.scopes) and self.scopes[-1].is_generator
+        if in_generator:
+            body_yields = any(_contains_yield(s) for s in node.body)
+            shielded = False  # a prior `except GeneratorExit: raise`
+            for handler in node.handlers:
+                if _handler_catches(handler, {"GeneratorExit"}) and \
+                        not _has_bare_raise(handler.body):
+                    self.report(
+                        handler, "swallowed-exit",
+                        "except GeneratorExit without re-raise — actor "
+                        "teardown (gen.close()) becomes RuntimeError")
+                elif body_yields and not shielded and \
+                        _handler_catches(handler,
+                                         {"BARE", "BaseException"}) and \
+                        not _has_bare_raise(handler.body):
+                    self.report(
+                        handler, "swallowed-exit",
+                        "broad except around a yield can swallow "
+                        "GeneratorExit — catch Exception (or re-raise "
+                        "GeneratorExit) so gen.close() terminates")
+                if _handler_catches(handler,
+                                    {"GeneratorExit", "BARE",
+                                     "BaseException"}) and \
+                        _has_bare_raise(handler.body):
+                    # earlier handlers re-raise GeneratorExit, so later
+                    # broad handlers can never see it
+                    shielded = True
+            if any(_contains_yield(s) for s in node.finalbody):
+                self.report(
+                    node, "swallowed-exit",
+                    "yield inside finally — GeneratorExit delivered at "
+                    "this yield escapes the cleanup path")
+        self.generic_visit(node)
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*weedlint:\s*disable=([a-zA-Z0-9_,\s-]+)")
+
+
+def suppressed_rules(lines: list[str], line_no: int) -> set[str]:
+    """Rules disabled at `line_no` (1-based): an inline trailing
+    directive, or one anywhere in the contiguous block of pure-comment
+    lines directly above (so a multi-line justification comment still
+    carries its directive)."""
+    out: set[str] = set()
+
+    def collect(text: str) -> None:
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            out.update(r.strip() for r in m.group(1).split(",")
+                       if r.strip())
+
+    if 0 <= line_no - 1 < len(lines):
+        collect(lines[line_no - 1])
+    idx = line_no - 2
+    while 0 <= idx < len(lines) and lines[idx].lstrip().startswith("#"):
+        collect(lines[idx])
+        idx -= 1
+    return out
+
+
+def check_source(rel_path: str, source: str) -> list[Violation]:
+    """All non-suppressed violations in one file's source text."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Violation(file=rel_path.replace("\\", "/"),
+                          line=e.lineno or 1, col=e.offset or 0,
+                          rule="syntax-error",
+                          message=f"unparseable: {e.msg}",
+                          snippet="")]
+    checker = Checker(rel_path, source)
+    checker.visit(tree)
+    lines = checker.lines
+    return [v for v in checker.violations
+            if v.rule not in suppressed_rules(lines, v.line)]
