@@ -893,17 +893,12 @@ def tpu_probe_with_retries(delays=TPU_ATTEMPT_DELAYS,
 
 def classify_tpu_failure(err):
     """Map a probe failure string onto a stable fallback reason for
-    the BENCH json: 'device_put' (accelerator rejected the
-    host->device transfer, the BENCH_r04 signature), 'relay_timeout'
-    (hung relay, the BENCH_r05 signature), else 'probe_error'."""
-    if not err:
-        return None
-    low = err.lower()
-    if "device_put" in low:
-        return "device_put"
-    if "timeout" in low:
-        return "relay_timeout"
-    return "probe_error"
+    the BENCH json. Delegates to parallel/mesh.classify_failure so the
+    subprocess probe here, the in-process probe, and the batch
+    scheduler all speak the same vocabulary (device_put /
+    relay_timeout / probe_error)."""
+    from seaweedfs_tpu.parallel.mesh import classify_failure
+    return classify_failure(err)
 
 
 def main(argv=None):

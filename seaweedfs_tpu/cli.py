@@ -24,8 +24,17 @@ def _add_common_volume_args(p):
     p.add_argument("-mserver", default="127.0.0.1:9333")
     p.add_argument("-rack", default="")
     p.add_argument("-dataCenter", default="")
-    p.add_argument("-coder", default="cpu", choices=["cpu", "jax", "pallas"],
-                   help="erasure coder backend (jax/pallas = TPU)")
+    p.add_argument("-coder", default="cpu",
+                   choices=["cpu", "jax", "pallas", "mesh"],
+                   help="erasure coder backend (jax/pallas = TPU, "
+                        "mesh = multi-device batch)")
+    p.add_argument("-ecBatcher", action="store_true",
+                   help="coalesce concurrent EC encode/rebuild jobs into "
+                        "device-sized mesh batches (overrides -coder; "
+                        "CPU fallback on device loss; stats at "
+                        "/admin/ec/batcher)")
+    p.add_argument("-ecBatchWindowMs", type=float, default=5.0,
+                   help="batcher coalescing window in ms (with -ecBatcher)")
     p.add_argument("-index", default="memory", choices=["memory", "ldb"],
                    help="needle map kind (reference -index flag)")
     p.add_argument("-tcp", action="store_true",
@@ -89,7 +98,9 @@ def cmd_volume(args):
     dirs = args.dir.split(",")
     vs = VolumeServer(dirs, args.mserver, host=args.ip, port=args.port,
                       rack=args.rack, data_center=args.dataCenter,
-                      coder=make_coder(args.coder),
+                      coder=None if args.ecBatcher else make_coder(args.coder),
+                      ec_batcher=args.ecBatcher,
+                      ec_batch_window_s=args.ecBatchWindowMs / 1000.0,
                       max_volume_counts=[args.max] * len(dirs),
                       disk_types=[t.strip() for t in args.disk.split(",")
                                   if t.strip()] if args.disk.strip()
@@ -121,7 +132,9 @@ def cmd_server(args):
     ms.start()
     dirs = args.dir.split(",")
     vs = VolumeServer(dirs, ms.url, host=args.ip, port=args.port,
-                      coder=make_coder(args.coder),
+                      coder=None if args.ecBatcher else make_coder(args.coder),
+                      ec_batcher=args.ecBatcher,
+                      ec_batch_window_s=args.ecBatchWindowMs / 1000.0,
                       max_volume_counts=[args.max] * len(dirs),
                       disk_types=[t.strip() for t in args.disk.split(",")
                                   if t.strip()] if args.disk.strip()

@@ -118,7 +118,8 @@ def register_coder(name: str):
 
 def make_coder(name: str = "cpu", scheme: RSScheme = DEFAULT_SCHEME) -> ErasureCoder:
     """Factory: 'cpu' (default, like the reference), 'jax', 'pallas',
-    'mxu' (measurement kernel — see ops/rs_mxu.py)."""
+    'mxu' (measurement kernel — see ops/rs_mxu.py), 'mesh' (batched
+    multi-device dispatch — see ops/rs_mesh.py)."""
     # import for registration side effects
     from seaweedfs_tpu.ops import rs_cpu  # noqa: F401
     if name in ("jax", "tpu", "pallas", "mxu"):
@@ -127,6 +128,8 @@ def make_coder(name: str = "cpu", scheme: RSScheme = DEFAULT_SCHEME) -> ErasureC
         from seaweedfs_tpu.ops import rs_pallas  # noqa: F401
     if name == "mxu":
         from seaweedfs_tpu.ops import rs_mxu  # noqa: F401
+    if name == "mesh":
+        from seaweedfs_tpu.ops import rs_mesh  # noqa: F401
     if name not in _REGISTRY:
         raise KeyError(f"unknown coder {name!r}; known: {sorted(_REGISTRY)}")
     return _REGISTRY[name](scheme)

@@ -1,0 +1,222 @@
+"""Mesh-sharded Reed-Solomon coder: a BATCH of block-groups per dispatch.
+
+The single-volume coders (rs_cpu / rs_jax) encode one (k, n) block-group
+per call, so concurrent ``ec.encode`` pipelines and repair jobs serialize
+on the device.  MeshCoder lowers a batch of B independent block-groups —
+typically coalesced from several volumes by parallel/batcher.py — into
+ONE vmapped dispatch whose leading axis is sharded across a 1-D device
+mesh (parallel/mesh.batch_mesh): device d computes lanes
+[d*B/n .. (d+1)*B/n) with no collectives, so throughput scales with
+device count for batches that fill the mesh.
+
+Two compiled programs cover every operation:
+
+  - encode: the static RS(10,4) parity matrix unrolls at trace time into
+    the same Horner/XOR graph as rs_jax (bit-identical by construction);
+  - rebuild: the coefficient matrix arrives as a TRACED (B, m, k) operand
+    (zero rows disabled), so one program serves every survivor pattern in
+    the batch — jobs with different loss patterns ride one dispatch.
+
+Batches are zero-padded to a device-count multiple on the leading axis
+(NamedSharding needs even division); pad lanes are discarded on the host.
+Output is bit-identical to CpuCoder in all modes — GF(256) has no
+rounding to disagree about, and the tests hold it to that.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from seaweedfs_tpu.models.coder import (DEFAULT_SCHEME, ErasureCoder,
+                                        RSScheme, register_coder)
+from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops.rs_jax import _apply_matrix_words, _mat_to_tuple
+from seaweedfs_tpu.parallel import mesh as mesh_mod
+from seaweedfs_tpu.parallel.distributed import _gf_mul_dynamic
+
+
+@functools.lru_cache(maxsize=None)
+def batch_encode_fn(scheme: RSScheme, mesh: Mesh):
+    """jit over the mesh: (B, k, nw) uint32 sharded P('batch', None, None)
+    -> (B, m, nw) parity with matching sharding.  Static parity matrix,
+    no collectives."""
+    mat = _mat_to_tuple(gf256.parity_matrix(scheme.data_shards,
+                                            scheme.parity_shards))
+
+    def one(words):
+        return _apply_matrix_words(words, mat)
+
+    s3 = mesh_mod.batch_spec(mesh)
+    return jax.jit(jax.vmap(one), in_shardings=(s3,), out_shardings=s3)
+
+
+@functools.lru_cache(maxsize=None)
+def batch_apply_fn(mesh: Mesh, n_out: int):
+    """jit over the mesh: per-lane GF matrix application with TRACED
+    coefficients — (B, k, nw) words x (B, n_out, k) coeff -> (B, n_out,
+    nw).  Zero coefficient rows yield zero output rows, so one compiled
+    program serves every (survivor pattern, missing set) mix in a
+    batch."""
+
+    def one(words, coeff):
+        outs = []
+        for i in range(n_out):
+            acc = jnp.zeros_like(words[0])
+            for j in range(words.shape[0]):
+                acc = acc ^ _gf_mul_dynamic(coeff[i, j], words[j])
+            outs.append(acc)
+        return jnp.stack(outs)
+
+    s3 = mesh_mod.batch_spec(mesh)
+    return jax.jit(jax.vmap(one), in_shardings=(s3, s3), out_shardings=s3)
+
+
+@register_coder("mesh")
+class MeshCoder(ErasureCoder):
+    """ErasureCoder whose unit of dispatch is a batch of block-groups
+    sharded across a 1-D device mesh.  The scalar ErasureCoder API is a
+    batch of one (bit-identical, just not faster); the batch API is what
+    parallel/batcher.py feeds."""
+
+    def __init__(self, scheme: RSScheme = DEFAULT_SCHEME,
+                 n_devices: int | None = None, mesh: Optional[Mesh] = None):
+        super().__init__(scheme)
+        self.mesh = mesh if mesh is not None else mesh_mod.batch_mesh(n_devices)
+        # host-side helper for rebuild-matrix derivation (pure numpy)
+        from seaweedfs_tpu.ops.rs_cpu import CpuCoder
+        self._host = CpuCoder(scheme)
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    # ---- batch API (the batcher's entry points) ----
+
+    def _pad_batch(self, words: np.ndarray) -> np.ndarray:
+        b = words.shape[0]
+        pb = -(-b // self.n_devices) * self.n_devices
+        if pb == b:
+            return words
+        pad = np.zeros((pb - b,) + words.shape[1:], dtype=words.dtype)
+        return np.concatenate([words, pad], axis=0)
+
+    def encode_batch(self, batch: np.ndarray) -> np.ndarray:
+        """(B, k, n) uint8 -> (B, m, n) uint8 parity, one sharded
+        dispatch.  n must be a multiple of 4 (uint32 lanes)."""
+        B, k, n = batch.shape
+        assert k == self.scheme.data_shards, (k, self.scheme)
+        assert n % 4 == 0, n
+        words = self._pad_batch(np.ascontiguousarray(batch).view(np.uint32))
+        fn = batch_encode_fn(self.scheme, self.mesh)
+        out = np.asarray(jax.device_get(fn(words)))
+        return np.ascontiguousarray(out[:B]).view(np.uint8)
+
+    def rebuild_batch(self, srcdata: np.ndarray,
+                      mats: Sequence[np.ndarray]) -> list[np.ndarray]:
+        """srcdata: (B, k, n) uint8 — per job, rows of the first k
+        present shards.  mats[i]: (r_i, k) uint8 rebuild matrix (from
+        rebuild_matrix(); r_i <= parity_shards).  Returns a list of
+        (r_i, n) uint8 recovered rows, one per job, in one sharded
+        dispatch even when jobs lost different shards."""
+        B, k, n = srcdata.shape
+        assert k == self.scheme.data_shards and n % 4 == 0
+        assert len(mats) == B
+        m = self.scheme.parity_shards
+        coeff = np.zeros((B, m, k), dtype=np.uint32)
+        for i, mt in enumerate(mats):
+            mt = np.asarray(mt)
+            assert mt.shape == (mt.shape[0], k) and mt.shape[0] <= m, mt.shape
+            coeff[i, :mt.shape[0]] = mt.astype(np.uint32)
+        words = self._pad_batch(np.ascontiguousarray(srcdata).view(np.uint32))
+        coeff = self._pad_batch(coeff)
+        fn = batch_apply_fn(self.mesh, m)
+        out = np.asarray(jax.device_get(fn(words, coeff)))  # (pb, m, nw)
+        out8 = np.ascontiguousarray(out[:B]).view(np.uint8)  # (B, m, n)
+        return [np.ascontiguousarray(out8[i, :np.asarray(mats[i]).shape[0]])
+                for i in range(B)]
+
+    # ---- scalar ErasureCoder API (batch of one) ----
+
+    def encode_array(self, data: np.ndarray) -> np.ndarray:
+        assert data.shape[1] % 4 == 0
+        return self.encode_batch(
+            np.ascontiguousarray(data, dtype=np.uint8)[None])[0]
+
+    def encode_into(self, data: np.ndarray, out: np.ndarray) -> np.ndarray:
+        out[:] = self.encode_array(data)
+        return out
+
+    def encode(self, shards: Sequence[bytes]) -> list[bytes]:
+        k = self.scheme.data_shards
+        n = len(shards[0])
+        pad = (-n) % 4
+        data = np.zeros((k, n + pad), dtype=np.uint8)
+        for i in range(k):
+            data[i, :n] = np.frombuffer(bytes(shards[i]), dtype=np.uint8)
+        parity = self.encode_batch(data[None])[0]
+        return [bytes(shards[i]) for i in range(k)] + \
+            [parity[i, :n].tobytes() for i in range(self.scheme.parity_shards)]
+
+    def rebuild_matrix(self, present: Sequence[int],
+                       missing: Sequence[int]) -> np.ndarray:
+        return self._host.rebuild_matrix(present, missing)
+
+    def reconstruct_rows(self, srcdata: np.ndarray,
+                         rebuild_mat: np.ndarray,
+                         out: Optional[np.ndarray] = None) -> np.ndarray:
+        rec = self.rebuild_batch(
+            np.ascontiguousarray(srcdata, dtype=np.uint8)[None],
+            [rebuild_mat])[0]
+        if out is not None:
+            out[:] = rec
+            return out
+        return rec
+
+    def reconstruct(self, shards: Sequence[Optional[bytes]]) -> list[bytes]:
+        k, total = self.scheme.data_shards, self.scheme.total_shards
+        present = [i for i in range(total) if shards[i] is not None]
+        if len(present) < k:
+            raise ValueError(f"too few shards: {len(present)} < {k}")
+        missing = [i for i in range(total) if shards[i] is None]
+        if not missing:
+            return [bytes(s) for s in shards]
+        n = len(shards[present[0]])
+        pad = (-n) % 4
+        src = np.zeros((k, n + pad), dtype=np.uint8)
+        for r, i in enumerate(sorted(present)[:k]):
+            src[r, :n] = np.frombuffer(bytes(shards[i]), dtype=np.uint8)
+        # rebuild_matrix expresses data AND parity losses directly as
+        # combinations of the first k present shards — one dispatch
+        mat = self.rebuild_matrix(present, missing)
+        rec = self.rebuild_batch(src[None], [mat])[0]
+        out = [bytes(s) if s is not None else None for s in shards]
+        for r, i in enumerate(missing):
+            out[i] = rec[r, :n].tobytes()
+        return [bytes(s) for s in out]
+
+    def reconstruct_data(self, shards: Sequence[Optional[bytes]]
+                         ) -> list[Optional[bytes]]:
+        k, total = self.scheme.data_shards, self.scheme.total_shards
+        present = [i for i in range(total) if shards[i] is not None]
+        if len(present) < k:
+            raise ValueError(f"too few shards: {len(present)} < {k}")
+        missing_data = [i for i in range(k) if shards[i] is None]
+        out = [bytes(s) if s is not None else None for s in shards]
+        if not missing_data:
+            return out
+        n = len(shards[present[0]])
+        pad = (-n) % 4
+        src = np.zeros((k, n + pad), dtype=np.uint8)
+        for r, i in enumerate(sorted(present)[:k]):
+            src[r, :n] = np.frombuffer(bytes(shards[i]), dtype=np.uint8)
+        mat = self.rebuild_matrix(present, missing_data)
+        rec = self.rebuild_batch(src[None], [mat])[0]
+        for r, i in enumerate(missing_data):
+            out[i] = rec[r, :n].tobytes()
+        return out

@@ -1,0 +1,366 @@
+"""Cross-volume EC batch scheduler: coalesce, dispatch sharded, demux.
+
+One device-mesh dispatch amortizes across many block-groups (ops/
+rs_mesh.py), but the work arrives one block-group at a time from
+independent callers: concurrent ``ec.encode`` pipelines on different
+volumes, the repair queue's rebuild jobs, degraded reads.  This module
+is the funnel between them and the mesh:
+
+  submit (any thread) -> bounded queue -> dispatcher thread coalesces a
+  deadline-bounded batch -> one MeshCoder dispatch -> per-job futures.
+
+Scheduling contract:
+  - the submission queue is BOUNDED (overload becomes backpressure on
+    the submitting pipeline, not memory growth);
+  - every job carries a coalescing deadline (submit time + window); the
+    dispatcher never holds a job past the EARLIEST deadline in its
+    batch, so a lone job costs at most one window of latency and a
+    burst fills a device-sized batch;
+  - jobs are ordered by QoS class (interactive > write > background —
+    the ambient class is captured at submit, same as every other
+    fan-out edge) before dispatch, so a background rebuild flood cannot
+    starve a degraded-read reconstruction sharing the mesh;
+  - the CPU fallback is LOAD-BEARING: when the mesh dispatch raises
+    (BENCH_r05's relay vanished mid-run), the failed batch and
+    everything queued behind it drain through CpuCoderMT with
+    bit-identical results, ``coder_fallbacks`` increments, and the mesh
+    is benched for a cooldown before being retried.
+
+All behavioral timing routes through clockctl so the scheduler stays
+legible to the virtual-clock sim; blocking primitives (queue waits)
+stay real because the batcher never runs inside the sim kernel.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from seaweedfs_tpu.models.coder import (DEFAULT_SCHEME, ErasureCoder,
+                                        RSScheme)
+from seaweedfs_tpu.qos import CLASSES, current_class
+from seaweedfs_tpu.utils import clockctl, glog
+
+_STOP = object()
+_CLASS_RANK = {c: i for i, c in enumerate(CLASSES)}
+
+
+def _rank(cls: Optional[str]) -> int:
+    # unknown/absent class sorts after background: un-classed work is
+    # by definition not latency-sensitive
+    return _CLASS_RANK.get(cls, len(CLASSES))
+
+
+class _Job:
+    __slots__ = ("kind", "data", "mat", "n", "cls", "deadline", "future")
+
+    def __init__(self, kind: str, data: np.ndarray,
+                 mat: Optional[np.ndarray], n: int, cls: Optional[str],
+                 deadline: float):
+        self.kind = kind          # "encode" | "rebuild"
+        self.data = data          # (k, n4) uint8, column-padded to 4
+        self.mat = mat            # rebuild only: (r, k) uint8
+        self.n = n                # original column count pre-padding
+        self.cls = cls
+        self.deadline = deadline
+        self.future: Future = Future()
+
+
+class EcBatchScheduler:
+    """The funnel.  Construct one per process (the volume server owns
+    one); hand pipelines a BatchCoder facade over it."""
+
+    def __init__(self, scheme: RSScheme = DEFAULT_SCHEME, *,
+                 mesh_coder=None, cpu_coder: Optional[ErasureCoder] = None,
+                 window_s: float = 0.005, max_batch: int = 64,
+                 queue_depth: int = 256, cooldown_s: float = 30.0,
+                 on_fallback: Optional[Callable[[str], None]] = None):
+        self.scheme = scheme
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self.cooldown_s = cooldown_s
+        self._on_fallback = on_fallback
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
+        if cpu_coder is None:
+            from seaweedfs_tpu.ops.rs_cpu import CpuCoderMT
+            cpu_coder = CpuCoderMT(scheme)
+        self._cpu = cpu_coder
+        self.fallback_reason: Optional[str] = None
+        self._mesh = mesh_coder
+        if self._mesh is None:
+            try:
+                from seaweedfs_tpu.ops.rs_mesh import MeshCoder
+                self._mesh = MeshCoder(scheme)
+            except Exception as e:  # noqa: BLE001 — classified fallback
+                from seaweedfs_tpu.parallel import mesh as mesh_mod
+                self.fallback_reason = mesh_mod.classify_failure(repr(e))
+                glog.warning("EC batcher: no device mesh (%s); running "
+                             "on the CPU coder", e)
+        self._down_until = 0.0
+        # counters are only written by the dispatcher thread; readers
+        # (stats/metrics) tolerate a stale int
+        self.jobs_total = 0
+        self.batches_total = 0
+        self.mesh_batches = 0
+        self.cpu_batches = 0
+        self.coder_fallbacks = 0
+        self.max_coalesced = 0
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ec-batcher")
+        self._thread.start()
+
+    # ---- submission (any thread) ----
+
+    def _submit(self, kind: str, data: np.ndarray,
+                mat: Optional[np.ndarray], cls: Optional[str]) -> Future:
+        if self._stopped:
+            raise RuntimeError("EC batch scheduler is stopped")
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        n = data.shape[1]
+        pad = (-n) % 4
+        if pad:
+            data = np.concatenate(
+                [data, np.zeros((data.shape[0], pad), dtype=np.uint8)],
+                axis=1)
+        if cls is None:
+            cls = current_class()
+        job = _Job(kind, data, mat, n, cls,
+                   clockctl.monotonic() + self.window_s)
+        self._q.put(job)  # bounded: blocks -> backpressure
+        return job.future
+
+    def submit_encode(self, data: np.ndarray,
+                      cls: Optional[str] = None) -> Future:
+        """(k, n) uint8 -> Future of (m, n) uint8 parity."""
+        return self._submit("encode", data, None, cls)
+
+    def submit_rebuild(self, srcdata: np.ndarray, rebuild_mat: np.ndarray,
+                       cls: Optional[str] = None) -> Future:
+        """(k, n) rows of the first k present shards + (r, k) rebuild
+        matrix -> Future of (r, n) recovered rows."""
+        return self._submit("rebuild", srcdata,
+                            np.ascontiguousarray(rebuild_mat,
+                                                 dtype=np.uint8), cls)
+
+    def encode(self, data: np.ndarray, cls: Optional[str] = None
+               ) -> np.ndarray:
+        return self.submit_encode(data, cls).result()
+
+    def rebuild(self, srcdata: np.ndarray, rebuild_mat: np.ndarray,
+                cls: Optional[str] = None) -> np.ndarray:
+        return self.submit_rebuild(srcdata, rebuild_mat, cls).result()
+
+    # ---- dispatcher ----
+
+    def _loop(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is _STOP:
+                return
+            batch = [job]
+            stopping = False
+            while len(batch) < self.max_batch:
+                wait = min(j.deadline for j in batch) - clockctl.monotonic()
+                if wait <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=wait)
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stopping = True
+                    break
+                batch.append(nxt)
+            self._dispatch(batch)
+            if stopping:
+                return
+
+    def _mesh_healthy(self) -> bool:
+        return (self._mesh is not None
+                and clockctl.monotonic() >= self._down_until)
+
+    def _dispatch(self, batch: list) -> None:
+        self.jobs_total += len(batch)
+        self.batches_total += 1
+        self.max_coalesced = max(self.max_coalesced, len(batch))
+        # QoS ordering: a group containing an interactive job dispatches
+        # before an all-background group
+        batch.sort(key=lambda j: (_rank(j.cls), j.deadline))
+        groups: dict[tuple, list] = {}
+        for j in batch:
+            groups.setdefault((j.kind,) + j.data.shape, []).append(j)
+        for jobs in groups.values():
+            self._run_group(jobs)
+
+    def _run_group(self, jobs: list) -> None:
+        if self._mesh_healthy():
+            try:
+                self._run_mesh(jobs)
+                self.mesh_batches += 1
+                return
+            except Exception as e:  # noqa: BLE001 — the fallback ladder
+                from seaweedfs_tpu.parallel import mesh as mesh_mod
+                self.coder_fallbacks += 1
+                self.fallback_reason = mesh_mod.classify_failure(repr(e))
+                self._down_until = clockctl.monotonic() + self.cooldown_s
+                glog.warning(
+                    "EC batcher: mesh dispatch failed (%s: %s); draining "
+                    "through the CPU coder for %.0fs", type(e).__name__,
+                    e, self.cooldown_s)
+                if self._on_fallback is not None:
+                    try:
+                        self._on_fallback(self.fallback_reason or "error")
+                    except Exception:  # noqa: BLE001 — observer only
+                        pass
+        self._run_cpu(jobs)
+        self.cpu_batches += 1
+
+    def _run_mesh(self, jobs: list) -> None:
+        kind = jobs[0].kind
+        stacked = np.stack([j.data for j in jobs])
+        if kind == "encode":
+            out = self._mesh.encode_batch(stacked)
+            for i, j in enumerate(jobs):
+                j.future.set_result(
+                    np.ascontiguousarray(out[i][:, :j.n]))
+        else:
+            recs = self._mesh.rebuild_batch(stacked,
+                                            [j.mat for j in jobs])
+            for j, rec in zip(jobs, recs):
+                j.future.set_result(np.ascontiguousarray(rec[:, :j.n]))
+
+    def _run_cpu(self, jobs: list) -> None:
+        for j in jobs:
+            try:
+                if j.kind == "encode":
+                    out = np.asarray(self._cpu.encode_array(j.data))
+                else:
+                    out = np.asarray(
+                        self._cpu.reconstruct_rows(j.data, j.mat))
+                j.future.set_result(np.ascontiguousarray(out[:, :j.n]))
+            except BaseException as e:  # noqa: BLE001 — per-job demux
+                j.future.set_exception(e)
+
+    # ---- lifecycle / observability ----
+
+    def stop(self) -> None:
+        """Stop the dispatcher; anything still queued drains through
+        the CPU coder so no submitted future is ever abandoned."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._q.put(_STOP)
+        self._thread.join(timeout=10)
+        leftovers = []
+        while True:
+            try:
+                j = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if j is not _STOP:
+                leftovers.append(j)
+        if leftovers:
+            self._run_cpu(leftovers)
+            self.cpu_batches += 1
+
+    def stats(self) -> dict:
+        mesh_devices = self._mesh.n_devices if self._mesh is not None \
+            else 0
+        return {
+            "window_s": self.window_s,
+            "max_batch": self.max_batch,
+            "queue_depth": self._q.maxsize,
+            "queued": self._q.qsize(),
+            "mesh_devices": mesh_devices,
+            "mesh_healthy": self._mesh_healthy(),
+            "jobs_total": self.jobs_total,
+            "batches_total": self.batches_total,
+            "mesh_batches": self.mesh_batches,
+            "cpu_batches": self.cpu_batches,
+            "coder_fallbacks": self.coder_fallbacks,
+            "max_coalesced": self.max_coalesced,
+            "fallback_reason": self.fallback_reason,
+        }
+
+
+class BatchCoder(ErasureCoder):
+    """ErasureCoder facade over an EcBatchScheduler — a drop-in for the
+    Store/pipeline coder seam.  Each pipeline keeps calling
+    encode_into/reconstruct_rows per block-group exactly as before; the
+    facade turns those calls into scheduler submissions, so N concurrent
+    volume pipelines coalesce into device-sized mesh batches without
+    knowing about each other."""
+
+    def __init__(self, scheduler: EcBatchScheduler):
+        super().__init__(scheduler.scheme)
+        self.scheduler = scheduler
+        from seaweedfs_tpu.ops.rs_cpu import CpuCoder
+        self._host = CpuCoder(scheduler.scheme)  # matrix derivation only
+
+    def encode_array(self, data: np.ndarray) -> np.ndarray:
+        return self.scheduler.encode(data)
+
+    def encode_into(self, data: np.ndarray, out: np.ndarray) -> np.ndarray:
+        out[:] = self.scheduler.encode(data)
+        return out
+
+    def encode(self, shards: Sequence[bytes]) -> list[bytes]:
+        k = self.scheme.data_shards
+        data = np.stack([np.frombuffer(bytes(shards[i]), dtype=np.uint8)
+                         for i in range(k)])
+        parity = self.scheduler.encode(data)
+        return [bytes(shards[i]) for i in range(k)] + \
+            [parity[i].tobytes() for i in range(self.scheme.parity_shards)]
+
+    def rebuild_matrix(self, present: Sequence[int],
+                       missing: Sequence[int]) -> np.ndarray:
+        return self._host.rebuild_matrix(present, missing)
+
+    def reconstruct_rows(self, srcdata: np.ndarray,
+                         rebuild_mat: np.ndarray,
+                         out: Optional[np.ndarray] = None) -> np.ndarray:
+        rec = self.scheduler.rebuild(srcdata, rebuild_mat)
+        if out is not None:
+            out[:] = rec
+            return out
+        return rec
+
+    def reconstruct(self, shards: Sequence[Optional[bytes]]) -> list[bytes]:
+        k, total = self.scheme.data_shards, self.scheme.total_shards
+        present = [i for i in range(total) if shards[i] is not None]
+        if len(present) < k:
+            raise ValueError(f"too few shards: {len(present)} < {k}")
+        missing = [i for i in range(total) if shards[i] is None]
+        if not missing:
+            return [bytes(s) for s in shards]
+        src = np.stack([np.frombuffer(bytes(shards[i]), dtype=np.uint8)
+                        for i in sorted(present)[:k]])
+        rec = self.scheduler.rebuild(
+            src, self.rebuild_matrix(present, missing))
+        out = [bytes(s) if s is not None else None for s in shards]
+        for r, i in enumerate(missing):
+            out[i] = rec[r].tobytes()
+        return [bytes(s) for s in out]
+
+    def reconstruct_data(self, shards: Sequence[Optional[bytes]]
+                         ) -> list[Optional[bytes]]:
+        k, total = self.scheme.data_shards, self.scheme.total_shards
+        present = [i for i in range(total) if shards[i] is not None]
+        if len(present) < k:
+            raise ValueError(f"too few shards: {len(present)} < {k}")
+        missing_data = [i for i in range(k) if shards[i] is None]
+        out = [bytes(s) if s is not None else None for s in shards]
+        if not missing_data:
+            return out
+        src = np.stack([np.frombuffer(bytes(shards[i]), dtype=np.uint8)
+                        for i in sorted(present)[:k]])
+        rec = self.scheduler.rebuild(
+            src, self.rebuild_matrix(present, missing_data))
+        for r, i in enumerate(missing_data):
+            out[i] = rec[r].tobytes()
+        return out

@@ -64,7 +64,8 @@ class RepairQueue:
                  scan_grace_s: float = 60.0,
                  repair_rate_mbps: float = 0.0,
                  partial_repair: bool = True,
-                 drain_grace_s: float = 120.0):
+                 drain_grace_s: float = 120.0,
+                 coalesce_window_s: float = 0.0):
         """scan_grace_s: how long a volume must stay CONTINUOUSLY
         degraded in the heartbeat shard map before the scanner enqueues
         it — transient states (a node mid-restart, an operator running
@@ -87,7 +88,16 @@ class RepairQueue:
         (/admin/ec/rebuild_partial — the rebuilder pulls pre-reduced
         columns through a reduction chain, ~1 shard-width received per
         lost shard) before falling back to the legacy copy+rebuild
-        choreography (~k shard-widths staged on the rebuilder)."""
+        choreography (~k shard-widths staged on the rebuilder).
+
+        coalesce_window_s: hold a freshly-enqueued repair up to this
+        long waiting for siblings, so a burst (a node death degrades
+        many volumes at once) dispatches as one WAVE of concurrent
+        rebuilds whose EC work lands together on the volume servers'
+        batch scheduler (parallel/batcher.py) instead of trickling in
+        one coder dispatch at a time. A full wave (max_concurrent
+        tasks ready) dispatches immediately; 0 (the default) keeps
+        per-task immediate dispatch."""
         self.master = master
         self.partial_repair = partial_repair
         self.max_concurrent = max_concurrent
@@ -95,6 +105,9 @@ class RepairQueue:
         self.backoff_max = backoff_max
         self.scan_grace_s = scan_grace_s
         self.drain_grace_s = drain_grace_s
+        self.coalesce_window_s = coalesce_window_s
+        self.dispatch_waves = 0
+        self.last_wave_size = 0
         # vid -> wall-clock deadline: exempt from the degraded scan
         # while its (graceful-drain-departed) holder is expected back
         self._drain_grace: dict[int, float] = {}
@@ -281,11 +294,21 @@ class RepairQueue:
                 (t for t in self._tasks.values()
                  if t.next_attempt <= now),
                 key=lambda t: (-t.priority, t.enqueued_at))
-            room = self.max_concurrent - len(self._in_flight)
-            for task in ready[:max(0, room)]:
+            room = max(0, self.max_concurrent - len(self._in_flight))
+            if (self.coalesce_window_s > 0 and room > 0
+                    and len(ready) < room):
+                # partial wave: hold young tasks for siblings (a later
+                # submit() or tick() re-dispatches); a task that has
+                # waited out the window goes regardless
+                ready = [t for t in ready
+                         if now - t.enqueued_at >= self.coalesce_window_s]
+            for task in ready[:room]:
                 del self._tasks[task.vid]
                 self._in_flight[task.vid] = task
                 to_run.append(task)
+            if to_run:
+                self.dispatch_waves += 1
+                self.last_wave_size = len(to_run)
         for task in to_run:
             threading.Thread(target=self._run, args=(task,),
                              daemon=True).start()
@@ -564,6 +587,9 @@ class RepairQueue:
                 "repaired_total": self.repaired_total,
                 "failed_total": self.failed_total,
                 "bytes_moved": self.bytes_moved,
+                "coalesce_window_s": self.coalesce_window_s,
+                "dispatch_waves": self.dispatch_waves,
+                "last_wave_size": self.last_wave_size,
                 "partial_enabled": self.partial_repair,
                 "partial_repairs": self.partial_repairs,
                 "partial_fallbacks": self.partial_fallbacks,

@@ -45,6 +45,7 @@ class MasterServer:
                  meta_dir: str = "", grpc_port: Optional[int] = None,
                  repair_rate_mbps: float = 0.0,
                  partial_repair: bool = True,
+                 repair_coalesce_window_s: float = 0.0,
                  qos: bool = True,
                  tracing_enabled: bool = True,
                  trace_sample: float = 0.01):
@@ -84,7 +85,8 @@ class MasterServer:
         from seaweedfs_tpu.scrub import RepairQueue
         self.repair_queue = RepairQueue(
             self, repair_rate_mbps=repair_rate_mbps,
-            partial_repair=partial_repair)
+            partial_repair=partial_repair,
+            coalesce_window_s=repair_coalesce_window_s)
         # the master's serving edge (lookups/assigns) gets the same
         # adaptive-concurrency governor as the volume servers' data
         # edges; cluster-control traffic is exempt (see QOS_EXEMPT)

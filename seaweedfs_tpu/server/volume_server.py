@@ -84,7 +84,9 @@ class VolumeServer:
                  fsync: bool = False,
                  qos: bool = True,
                  tracing_enabled: bool = True,
-                 trace_sample: float = 0.01):
+                 trace_sample: float = 0.01,
+                 ec_batcher: bool = False,
+                 ec_batch_window_s: float = 0.005):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
         the volume_server_pb gRPC admin plane (0 = ephemeral).
@@ -120,7 +122,14 @@ class VolumeServer:
         tracing_enabled/trace_sample control the distributed-tracing
         flight recorder (utils/tracing.py): head-sample rate for
         guaranteed retention; slow/error spans are kept regardless.
-        Off = the shared NOOP span, zero allocation per request."""
+        Off = the shared NOOP span, zero allocation per request.
+
+        ec_batcher routes this node's EC encode/rebuild work through a
+        cross-volume batch scheduler (parallel/batcher.py): concurrent
+        volumes' block-groups coalesce for ec_batch_window_s into one
+        device-mesh dispatch, with a CPU drain when devices fail
+        mid-run. Off (the default) keeps the per-volume coder path.
+        Ignored when an explicit `coder` is passed."""
         urls = (master_url.split(",") if isinstance(master_url, str)
                 else list(master_url))
         self.master_urls = [u.strip() for u in urls if u.strip()]
@@ -132,6 +141,9 @@ class VolumeServer:
         self._rack = rack
         self._dc = data_center
         self._coder = coder
+        self._ec_batcher_enabled = ec_batcher and coder is None
+        self._ec_batch_window_s = ec_batch_window_s
+        self.ec_batcher = None  # EcBatchScheduler when enabled
         self._needle_map_kind = needle_map_kind
         self._tcp_port = tcp_port
         self.tcp_server = None
@@ -197,6 +209,12 @@ class VolumeServer:
         self._m_disk_free = self.metrics.gauge(
             "volumeServer", "disk_free_bytes", "statvfs free bytes",
             ("dir",))
+        # mesh->CPU drains in the EC batch scheduler, labeled by the
+        # classified reason (device_put / relay_timeout / probe_error)
+        self._m_ec_fallbacks = self.metrics.counter(
+            "volumeServer", "ec_coder_fallbacks",
+            "EC batcher mesh dispatch failures drained via CPU",
+            ("reason",))
         self.metrics.on_expose(self._refresh_gauges)
         self.peer_health = PeerHealth(metrics=self.metrics)
         # admission control: class-weighted slots under an adaptive
@@ -221,6 +239,13 @@ class VolumeServer:
             reg_host, reg_port = adv_host, int(adv_port)
         else:
             reg_host, reg_port = self.http.host, self.http.port
+        if self._ec_batcher_enabled and self._coder is None:
+            from seaweedfs_tpu.parallel.batcher import (BatchCoder,
+                                                        EcBatchScheduler)
+            self.ec_batcher = EcBatchScheduler(
+                window_s=self._ec_batch_window_s,
+                on_fallback=lambda reason: self._m_ec_fallbacks.inc(reason))
+            self._coder = BatchCoder(self.ec_batcher)
         self.store = Store(
             self._store_dirs, self._max_volume_counts,
             ip=reg_host, port=reg_port,
@@ -299,6 +324,8 @@ class VolumeServer:
         if self._grpc_server is not None:
             self._grpc_server.stop(0)
         self.http.stop()
+        if self.ec_batcher is not None:
+            self.ec_batcher.stop()
         if self.store:
             self.store.close()
 
@@ -517,6 +544,8 @@ class VolumeServer:
         # storage/erasure_coding/partial.py for the chain protocol)
         r("POST", "/admin/ec/partial_read", self._ec_partial_read)
         r("POST", "/admin/ec/rebuild_partial", self._ec_rebuild_partial)
+        # batch-scheduler snapshot (coalescing + fallback counters)
+        r("GET", "/admin/ec/batcher", self._admin_ec_batcher)
         # integrity scrub
         r("POST", "/admin/scrub", self._admin_scrub)
         r("GET", "/admin/scrub/status", self._admin_scrub_status)
@@ -525,6 +554,11 @@ class VolumeServer:
         # admission-control snapshot + runtime tuning (cluster.qos)
         r("GET", "/admin/qos", self._admin_qos)
         r("POST", "/admin/qos", self._admin_qos_configure)
+
+    def _admin_ec_batcher(self, req: Request) -> Response:
+        if self.ec_batcher is None:
+            return Response({"enabled": False})
+        return Response({"enabled": True, **self.ec_batcher.stats()})
 
     def _admin_health(self, req: Request) -> Response:
         return Response({"url": self.url,
@@ -535,7 +569,8 @@ class VolumeServer:
     # control endpoints an operator needs most exactly when the node is
     # overloaded (shedding /admin/qos would saw off the escape hatch)
     QOS_EXEMPT = ("/status", "/metrics", "/ui", "/debug",
-                  "/admin/qos", "/admin/health", "/admin/scrub/status")
+                  "/admin/qos", "/admin/health", "/admin/scrub/status",
+                  "/admin/ec/batcher")
 
     def _admission_gate(self, method: str, path: str, headers, client):
         """HttpServer admission hook: classify (propagated header wins

@@ -1,0 +1,138 @@
+"""Sim-rehearsed fault schedule replayed against a real 3-node cluster.
+
+The fault-schedule JSON (seaweedfs_tpu/sim/faults.py schema) is the
+contract both rehearsal surfaces consume: the macro sim's transport
+asks FaultScheduler.decide() per message, and tools/netchaos.py
+--schedule walks the same timeline against real sockets. The drill
+here closes the PR 8 follow-up: rehearse ONE schedule in the sim
+(fast, tier-1), then replay the identical document through a
+ChaosProxy interposed on a volume server of a real 3-node cluster
+(slow-marked) and assert the cluster degrades and heals on the
+schedule's clock — fault observed during the window, bit-identical
+reads and fresh writes after it.
+
+The slow test drives ScheduleDriver in-process — the exact object
+`python tools/netchaos.py --schedule faults.json` constructs — so the
+CLI path and the drill cannot drift apart.
+"""
+
+import json
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.client import operation
+from seaweedfs_tpu.client.wdclient import MasterClient
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.commands import ShellContext
+from seaweedfs_tpu.sim.faults import FaultScheduler, parse_schedule
+from seaweedfs_tpu.utils.httpd import http_call
+from tools.netchaos import ChaosProxy, ScheduleDriver
+
+# one document, two consumers: the sim rehearsal and the real replay
+SCHEDULE = {"events": [
+    {"link": "*->*", "fault": "latency", "start": 0.0, "duration": 1.2,
+     "latency_ms": 30},
+    {"link": "*->*", "fault": "http_error", "start": 0.3,
+     "duration": 0.5, "status": 503},
+]}
+
+
+def test_schedule_rehearses_in_sim():
+    """The drill schedule drives the sim transport the way the real
+    replay expects: latency band, error burst overriding it, full heal
+    at the horizon."""
+    events = parse_schedule(json.dumps(SCHEDULE))
+    t = [0.0]
+    sched = FaultScheduler(events, lambda: t[0])
+    t[0] = 0.1
+    mode, extra, _ = sched.decide("client", "vol-1")
+    assert mode is None and extra == pytest.approx(0.03)
+    t[0] = 0.5
+    mode, extra, status = sched.decide("client", "vol-1")
+    assert mode == "http_error" and status == 503
+    assert extra == pytest.approx(0.03)  # latency band still stacks
+    t[0] = 1.0
+    mode, _, _ = sched.decide("client", "vol-1")
+    assert mode is None  # error burst over, latency band remains
+    t[0] = 1.3
+    assert sched.decide("client", "vol-1") == (None, 0.0, 503)
+    assert sched.horizon() == pytest.approx(1.2)
+
+
+def _wait_nodes(master, n: int, timeout: float = 5.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        topo = ShellContext(master.url).topology()
+        if sum(len(r["nodes"]) for dc in topo["data_centers"]
+               for r in dc["racks"]) >= n:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"{n} nodes never registered")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_drill_replays_schedule_against_real_3node_cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs_port = _free_port()
+    proxy = ChaosProxy("127.0.0.1", vs_port).start()
+    chaotic = VolumeServer([str(tmp_path / "v0")], master.url,
+                           port=vs_port, advertise=proxy.url,
+                           scrub_interval_s=0)
+    others = [VolumeServer([str(tmp_path / f"v{i}")], master.url,
+                           scrub_interval_s=0) for i in (1, 2)]
+    driver = None
+    try:
+        # start the chaotic node alone so the first assign grows its
+        # volumes there — the drill needle must live behind the proxy
+        chaotic.start()
+        _wait_nodes(master, 1)
+        mc = MasterClient(master.url, cache_ttl=0.0)
+        payload = b"drill-payload"
+        a = mc.assign()
+        assert a["url"] == proxy.url, a
+        operation.upload_to(a["fid"], a["url"], payload)
+        fid = a["fid"]
+        for vs in others:
+            vs.start()
+        _wait_nodes(master, 3)
+
+        driver = ScheduleDriver(proxy, SCHEDULE).start()
+        saw_fault = False
+        deadline = time.time() + 6
+        while time.time() < deadline and not driver.done():
+            status, _, _ = http_call(
+                "GET", f"http://{proxy.url}/{fid}", timeout=2.0)
+            saw_fault = saw_fault or status >= 500
+            time.sleep(0.05)
+        assert driver.done(), "schedule never exhausted"
+        assert saw_fault, "error burst never observed through the proxy"
+
+        # healed on schedule: the same needle reads back bit-identical
+        # through the proxied path, and the cluster takes fresh writes
+        status, body, _ = http_call(
+            "GET", f"http://{proxy.url}/{fid}", timeout=2.0)
+        assert status == 200 and body == payload
+        a = mc.assign()
+        operation.upload_to(a["fid"], a["url"], b"post-storm")
+        modes = [ap["mode"] for ap in driver.applied]
+        assert "http_error" in modes and modes[-1] == "pass"
+    finally:
+        if driver is not None:
+            driver.stop()
+        for vs in others:
+            vs.stop()
+        chaotic.stop()
+        proxy.stop()
+        master.stop()

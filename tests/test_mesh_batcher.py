@@ -1,0 +1,426 @@
+"""Mesh-sharded EC coder + cross-volume batch scheduler.
+
+Four layers:
+
+1. MeshCoder (ops/rs_mesh.py) — batched encode/rebuild bit-identical to
+   CpuCoder, heterogeneous loss patterns in one dispatch, odd batch
+   sizes zero-padded to the device-count multiple, the scalar
+   ErasureCoder API, and registry wiring;
+2. EcBatchScheduler (parallel/batcher.py) — coalescing, per-job demux,
+   QoS-class ordering, the LOAD-BEARING CPU fallback: a mesh that
+   raises mid-run drains every queued job through CpuCoder
+   bit-identically, increments coder_fallbacks, classifies the reason
+   and benches the mesh for the cooldown;
+3. the volume-server seam — ec_batcher=True routes a real ec.encode
+   through the scheduler (jobs counted at /admin/ec/batcher) and the
+   encoded volume still reads back;
+4. the device-scaling measurement — well-formed + bit-identical under
+   tier-1's virtual devices; the >=1.6x 1->2 floor binds (slow-marked)
+   only on real multi-device hardware, because virtual host-platform
+   devices time-slice one CPU and cannot scale wall-clock.
+
+conftest.py forces 8 virtual CPU devices, so every mesh path here runs
+genuinely sharded.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.models.coder import DEFAULT_SCHEME, make_coder
+from seaweedfs_tpu.ops.rs_cpu import CpuCoder
+from seaweedfs_tpu.ops.rs_mesh import MeshCoder
+from seaweedfs_tpu.parallel import mesh as mesh_mod
+from seaweedfs_tpu.parallel.batcher import BatchCoder, EcBatchScheduler
+from seaweedfs_tpu.qos import BACKGROUND, INTERACTIVE, class_scope
+
+CPU = CpuCoder(DEFAULT_SCHEME)
+K = DEFAULT_SCHEME.data_shards
+M = DEFAULT_SCHEME.parity_shards
+TOTAL = DEFAULT_SCHEME.total_shards
+
+
+def _batch(b, n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(b, K, n), dtype=np.uint8)
+
+
+# --------------------------------------------------------- MeshCoder
+
+def test_mesh_discovery_and_probe_cached():
+    assert mesh_mod.device_count() >= 2  # conftest forces 8 virtual
+    p1 = mesh_mod.probe()
+    assert p1["ok"] and p1["n_devices"] >= 2
+    assert p1["fallback_reason"] is None
+    assert mesh_mod.probe() == p1  # cached
+
+
+def test_classify_failure_vocabulary():
+    assert mesh_mod.classify_failure(None) is None
+    assert mesh_mod.classify_failure("jax device_put rejected") == \
+        "device_put"
+    assert mesh_mod.classify_failure("DeadlineExceeded: timeout") == \
+        "relay_timeout"
+    assert mesh_mod.classify_failure("boom") == "probe_error"
+
+
+def test_mesh_coder_registered():
+    assert isinstance(make_coder("mesh"), MeshCoder)
+
+
+def test_encode_batch_bit_identical_odd_batch():
+    """B=5 on 8 devices exercises the zero-pad lanes."""
+    mc = MeshCoder(DEFAULT_SCHEME)
+    data = _batch(5, 4096)
+    out = mc.encode_batch(data)
+    assert out.shape == (5, M, 4096)
+    for i in range(5):
+        assert np.array_equal(out[i], CPU.encode_array(data[i]))
+
+
+def test_rebuild_batch_heterogeneous_loss_one_dispatch():
+    """Jobs with DIFFERENT survivor patterns (data-only, parity-only,
+    mixed, single-shard) ride one traced-coefficient dispatch."""
+    mc = MeshCoder(DEFAULT_SCHEME)
+    losses = [(0, 3, 7, 9), (10, 11, 12, 13), (0, 5, 11, 13), (2,), (12,)]
+    data = _batch(len(losses), 2048, seed=1)
+    srcs, mats, want = [], [], []
+    for i, drop in enumerate(losses):
+        shards = CPU.encode([data[i, j].tobytes() for j in range(K)])
+        full = [np.frombuffer(s, dtype=np.uint8) for s in shards]
+        present = [j for j in range(TOTAL) if j not in drop]
+        srcs.append(np.stack([full[j] for j in sorted(present)[:K]]))
+        mats.append(CPU.rebuild_matrix(present, list(drop)))
+        want.append(np.stack([full[j] for j in drop]))
+    recs = mc.rebuild_batch(np.stack(srcs), mats)
+    for rec, expect in zip(recs, want):
+        assert np.array_equal(rec, expect)
+
+
+def test_mesh_coder_scalar_bytes_api():
+    rng = np.random.default_rng(2)
+    mc = MeshCoder(DEFAULT_SCHEME)
+    shards = [rng.integers(0, 256, 997, dtype=np.uint8).tobytes()
+              for _ in range(K)]
+    full = mc.encode(shards)
+    assert [bytes(s) for s in full] == \
+        [bytes(s) for s in CPU.encode(shards)]
+    holes = [s if i not in (0, 5, 12) else None
+             for i, s in enumerate(full)]
+    assert [bytes(s) for s in mc.reconstruct(holes)] == \
+        [bytes(s) for s in full]
+    dr = mc.reconstruct_data(
+        [s if i != 3 else None for i, s in enumerate(full)])
+    assert bytes(dr[3]) == bytes(full[3])
+
+
+# -------------------------------------------------- EcBatchScheduler
+
+def test_scheduler_coalesces_and_demuxes():
+    sched = EcBatchScheduler(window_s=0.05)
+    try:
+        datas = [_batch(1, 1000, seed=i)[0] for i in range(7)]
+        futs = [sched.submit_encode(d) for d in datas]
+        for d, f in zip(datas, futs):
+            assert np.array_equal(f.result(timeout=30),
+                                  CPU.encode_array(d))
+        st = sched.stats()
+        assert st["jobs_total"] == 7
+        assert st["mesh_batches"] >= 1 and st["cpu_batches"] == 0
+        assert st["coder_fallbacks"] == 0
+        assert st["max_coalesced"] >= 2  # the window actually coalesced
+    finally:
+        sched.stop()
+
+
+def test_scheduler_pads_odd_columns():
+    sched = EcBatchScheduler(window_s=0.005)
+    try:
+        d = _batch(1, 997, seed=3)[0]
+        assert np.array_equal(sched.encode(d), CPU.encode_array(d))
+    finally:
+        sched.stop()
+
+
+class _Recorder:
+    """Mesh stand-in that records dispatch order and answers via CPU."""
+    n_devices = 1
+
+    def __init__(self):
+        self.shapes = []
+
+    def encode_batch(self, b):
+        self.shapes.append(b.shape)
+        return np.stack([CPU.encode_array(x) for x in b])
+
+    def rebuild_batch(self, s, mats):
+        return [CPU.reconstruct_rows(s[i], mats[i])
+                for i in range(s.shape[0])]
+
+
+def test_scheduler_orders_by_qos_class():
+    """An interactive job submitted AFTER a background job dispatches
+    first (distinct shapes -> distinct dispatch groups, so group order
+    is observable)."""
+    rec = _Recorder()
+    sched = EcBatchScheduler(mesh_coder=rec, window_s=0.4)
+    try:
+        with class_scope(BACKGROUND):
+            f_bg = sched.submit_encode(_batch(1, 16, seed=4)[0])
+        with class_scope(INTERACTIVE):
+            f_int = sched.submit_encode(_batch(1, 8, seed=5)[0])
+        f_bg.result(timeout=30)
+        f_int.result(timeout=30)
+        assert rec.shapes[0][2] == 8, rec.shapes  # interactive first
+    finally:
+        sched.stop()
+
+
+class _Boom:
+    n_devices = 8
+
+    def encode_batch(self, b):
+        raise RuntimeError("device_put failed: relay vanished")
+
+    def rebuild_batch(self, s, m):
+        raise RuntimeError("device_put failed: relay vanished")
+
+
+def test_mid_run_device_loss_drains_through_cpu():
+    """THE satellite: backend raises on dispatch -> every queued job
+    drains through the CPU fallback bit-identically, coder_fallbacks
+    increments, the reason is classified, the on_fallback observer
+    fires, and the mesh is benched for the cooldown."""
+    reasons = []
+    sched = EcBatchScheduler(mesh_coder=_Boom(), window_s=0.02,
+                             cooldown_s=60.0,
+                             on_fallback=reasons.append)
+    try:
+        datas = [_batch(1, 1000, seed=10 + i)[0] for i in range(6)]
+        futs = [sched.submit_encode(d) for d in datas]
+        for d, f in zip(datas, futs):
+            assert np.array_equal(f.result(timeout=30),
+                                  CPU.encode_array(d))
+        assert sched.coder_fallbacks >= 1
+        assert sched.fallback_reason == "device_put"
+        assert reasons and reasons[0] == "device_put"
+        # benched: later work routes straight to CPU without re-raising
+        d = _batch(1, 512, seed=20)[0]
+        assert np.array_equal(sched.encode(d), CPU.encode_array(d))
+        st = sched.stats()
+        assert st["mesh_healthy"] is False
+        assert st["cpu_batches"] >= 2
+        # rebuild drains too
+        shards = CPU.encode([d[i].tobytes() for i in range(K)])
+        full = [np.frombuffer(s, dtype=np.uint8) for s in shards]
+        present = [j for j in range(TOTAL) if j != 0]
+        mat = CPU.rebuild_matrix(present, [0])
+        rec = sched.rebuild(np.stack([full[j]
+                                      for j in sorted(present)[:K]]), mat)
+        assert np.array_equal(rec[0], full[0])
+    finally:
+        sched.stop()
+
+
+def test_stop_drains_queued_jobs_through_cpu():
+    """No submitted future is ever abandoned: jobs still queued at
+    stop() complete via the CPU path."""
+    gate = threading.Event()
+
+    class _Slow(_Recorder):
+        def encode_batch(self, b):
+            gate.wait(5)
+            return super().encode_batch(b)
+
+    sched = EcBatchScheduler(mesh_coder=_Slow(), window_s=0.0)
+    d1, d2 = _batch(2, 256, seed=6)
+    f1 = sched.submit_encode(d1)
+    time.sleep(0.05)  # dispatcher now blocked inside _Slow on f1
+    f2 = sched.submit_encode(d2)
+    gate.set()
+    sched.stop()
+    assert np.array_equal(f1.result(timeout=10), CPU.encode_array(d1))
+    assert np.array_equal(f2.result(timeout=10), CPU.encode_array(d2))
+
+
+def test_batch_coder_facade_is_a_drop_in_coder():
+    sched = EcBatchScheduler(window_s=0.005)
+    try:
+        bc = BatchCoder(sched)
+        rng = np.random.default_rng(8)
+        shards = [rng.integers(0, 256, 500, dtype=np.uint8).tobytes()
+                  for _ in range(K)]
+        full = bc.encode(shards)
+        assert [bytes(s) for s in full] == \
+            [bytes(s) for s in CPU.encode(shards)]
+        holes = [s if i not in (1, 11) else None
+                 for i, s in enumerate(full)]
+        assert [bytes(s) for s in bc.reconstruct(holes)] == \
+            [bytes(s) for s in full]
+        assert bc.verify(full)
+    finally:
+        sched.stop()
+
+
+# ------------------------------------- repair-queue wave coalescing
+
+def test_repair_queue_coalesces_dispatch_waves():
+    from seaweedfs_tpu.scrub.repair_queue import RepairQueue
+    from seaweedfs_tpu.utils.metrics import Registry
+
+    class _Topo:
+        lock = threading.Lock()
+
+        def all_nodes(self):
+            return []
+
+    class _Master:
+        metrics = Registry()
+        topo = _Topo()
+
+    ran = []
+    done = threading.Event()
+    rq = RepairQueue(_Master(), max_concurrent=2,
+                     coalesce_window_s=30.0)
+    rq._repair = lambda task: (ran.append(task.vid), done.set(),
+                               0)[-1]
+    rq.submit(1, reason="t")
+    time.sleep(0.1)
+    assert rq.status()["active"] == 0  # held for siblings
+    assert ran == []
+    rq.submit(2, reason="t")  # full wave -> immediate dispatch
+    assert done.wait(5)
+    deadline = time.time() + 5
+    while time.time() < deadline and len(ran) < 2:
+        time.sleep(0.02)
+    assert sorted(ran) == [1, 2]
+    assert rq.dispatch_waves == 1 and rq.last_wave_size == 2
+    assert rq.status()["coalesce_window_s"] == 30.0
+
+
+def test_repair_queue_window_zero_keeps_immediate_dispatch():
+    from seaweedfs_tpu.scrub.repair_queue import RepairQueue
+    from seaweedfs_tpu.utils.metrics import Registry
+
+    class _Topo:
+        lock = threading.Lock()
+
+        def all_nodes(self):
+            return []
+
+    class _Master:
+        metrics = Registry()
+        topo = _Topo()
+
+    done = threading.Event()
+    rq = RepairQueue(_Master(), max_concurrent=2)
+    rq._repair = lambda task: (done.set(), 0)[-1]
+    rq.submit(7, reason="t")
+    assert done.wait(5)
+    assert rq.dispatch_waves == 1 and rq.last_wave_size == 1
+
+
+def test_repair_queue_aged_task_escapes_partial_wave():
+    """A lone task must not wait forever for siblings: once it has
+    waited out the window, tick() dispatches it alone."""
+    from seaweedfs_tpu.scrub.repair_queue import RepairQueue
+    from seaweedfs_tpu.utils.metrics import Registry
+
+    class _Topo:
+        lock = threading.Lock()
+        ec_shard_map = {}
+
+        def all_nodes(self):
+            return []
+
+    class _Master:
+        metrics = Registry()
+        topo = _Topo()
+
+    done = threading.Event()
+    rq = RepairQueue(_Master(), max_concurrent=2,
+                     coalesce_window_s=0.15)
+    rq._repair = lambda task: (done.set(), 0)[-1]
+    rq.submit(9, reason="t")
+    assert not done.wait(0.05)  # young: held
+    time.sleep(0.15)
+    rq.tick()
+    assert done.wait(5)
+
+
+# ------------------------------------------ volume-server seam (e2e)
+
+def test_volume_server_ec_batcher_end_to_end(tmp_path):
+    import time as _time
+
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.shell.commands import ShellContext
+    from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url,
+                      scrub_interval_s=0, ec_batcher=True)
+    try:
+        vs.start()
+        assert vs.ec_batcher is not None
+        deadline = _time.time() + 5
+        while _time.time() < deadline:
+            topo = ShellContext(master.url).topology()
+            if sum(len(r["nodes"]) for dc in topo["data_centers"]
+                   for r in dc["racks"]) == 1:
+                break
+            _time.sleep(0.05)
+        mc = MasterClient(master.url, cache_ttl=0.0)
+        rng = np.random.default_rng(9)
+        payload = rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+        up = operation.upload_data(mc, payload)
+        sh = ShellContext(master.url)
+        sh.lock()
+        assert sh.ec_encode(), "no volumes encoded"
+        # the EC work went through the scheduler...
+        st = http_json("GET", f"http://{vs.url}/admin/ec/batcher")
+        assert st["enabled"] and st["jobs_total"] >= 1
+        assert st["coder_fallbacks"] == 0
+        # ...and the needle still reads back from the EC volume
+        status, body, _ = http_call("GET", f"http://{vs.url}/{up.fid}")
+        assert status == 200 and body == payload
+    finally:
+        vs.stop()
+        master.stop()
+
+
+# ------------------------------------------- device-scaling contract
+
+def test_scaling_measurement_well_formed_and_bit_identical():
+    from tools.mesh_profile import measure_scaling
+
+    sc = measure_scaling([1, 2], batch=4, n_cols=16 * 1024, iters=1)
+    assert sc["bit_identical"] is True
+    assert [r["devices"] for r in sc["rows"]] == [1, 2]
+    assert all(r["encode_mbps"] > 0 and r["rebuild_mbps"] > 0
+               for r in sc["rows"])
+    assert sc["encode_scaling_1_to_2"] is not None
+    assert sc["rebuild_scaling_1_to_2"] is not None
+
+
+@pytest.mark.slow
+def test_device_scaling_floor_1_to_2():
+    """The acceptance floor: >=1.6x encode/rebuild going 1->2 devices.
+    Only real accelerator devices can scale wall-clock (tier-1's
+    virtual CPU devices share one core), so the floor binds on TPU
+    backends with >=2 devices and records-but-skips elsewhere."""
+    from tools.mesh_profile import measure_scaling
+
+    if mesh_mod.default_backend() != "tpu" or mesh_mod.device_count() < 2:
+        pytest.skip("scaling floor binds only on real multi-device "
+                    "hardware (virtual devices share one core)")
+    sc = measure_scaling([1, 2], batch=16, n_cols=256 * 1024, iters=3)
+    assert sc["bit_identical"] is True
+    assert sc["encode_scaling_1_to_2"] >= 1.6, sc
+    assert sc["rebuild_scaling_1_to_2"] >= 1.6, sc

@@ -79,6 +79,11 @@ CASES = {
         "bad": "import queue\n\nq = queue.Queue()\n",
         "clean": "import queue\n\nq = queue.Queue(maxsize=64)\n",
     },
+    "raw-device-discovery": {
+        "bad": "import jax\n\ndef f():\n    return jax.devices()\n",
+        "clean": ("from seaweedfs_tpu.parallel import mesh\n\n"
+                  "def f():\n    return mesh.devices()\n"),
+    },
     "ambient-scope-loss": {
         "bad": ("from seaweedfs_tpu.utils.tracing import current_span\n\n"
                 "def f(pool):\n"
@@ -167,6 +172,16 @@ def test_rule_home_files_are_exempt():
     assert "header-literal" not in rules_of(
         "D = 'X-Weed-Deadline'\n",
         path="seaweedfs_tpu/utils/headers.py")
+    assert "raw-device-discovery" not in rules_of(
+        "import jax\nd = jax.devices()\n",
+        path="seaweedfs_tpu/parallel/mesh.py")
+
+
+def test_raw_device_discovery_catches_aliased_imports():
+    assert "raw-device-discovery" in rules_of(
+        "from jax import devices as dv\n\ndef f():\n    return dv()\n")
+    assert "raw-device-discovery" in rules_of(
+        "import jax as j\n\ndef f():\n    return j.local_devices()\n")
 
 
 def test_syntax_error_reported_not_crashed():

@@ -1,4 +1,4 @@
-"""The weedlint rule set: one AST pass, eight invariants.
+"""The weedlint rule set: one AST pass, nine invariants.
 
 Every rule encodes a contract the cluster depends on ambiently — the
 kind that breaks silently at a single call site and only surfaces as a
@@ -63,6 +63,14 @@ ambient-scope-loss
     the submitting thread and re-enter it via ``span_scope/
     deadline_scope/class_scope/attach`` (the filer ``_upload_chunks``
     idiom), otherwise the worker runs traceless and deadline-less.
+
+raw-device-discovery
+    ``jax.devices()/local_devices()/device_count()`` outside
+    ``parallel/mesh.py``.  Device discovery must route through
+    ``mesh.devices()`` so every layer shares one cached probe (and its
+    classified ``fallback_reason``) instead of re-hanging on a flaky
+    relay per call site, and so the driver's virtual-device request is
+    honored before any backend initializes.
 """
 
 from __future__ import annotations
@@ -83,6 +91,8 @@ RULES: dict[str, str] = {
     "unbounded-pool": "ThreadPoolExecutor/Queue without an explicit bound",
     "ambient-scope-loss":
         "submit of closure using ambient scope without re-entry",
+    "raw-device-discovery":
+        "jax.devices()/local_devices() outside parallel/mesh.py",
 }
 
 # files that ARE the sanctioned implementation of a contract
@@ -90,6 +100,7 @@ _RULE_HOME = {
     "raw-clock": "utils/clockctl.py",
     "raw-http": "utils/httpd.py",
     "header-literal": "utils/headers.py",
+    "raw-device-discovery": "parallel/mesh.py",
 }
 
 _HEADER_PREFIX = "X-Weed-"
@@ -102,7 +113,9 @@ _HTTP_CALLS = {
 # modules whose aliases we track for canonical-name resolution
 _TRACKED_MODULES = ("time", "urllib.request", "urllib", "http.client",
                     "http", "socket", "queue", "concurrent.futures",
-                    "concurrent")
+                    "concurrent", "jax")
+_DEVICE_CALLS = {"jax.devices", "jax.local_devices",
+                 "jax.device_count", "jax.local_device_count"}
 _BLOCKING_TERMINALS = {"http_call", "http_json", "urlopen"}
 _AMBIENT_READERS = {"current_span", "current_deadline", "current_class"}
 _SCOPE_ENTRIES = {"span_scope", "deadline_scope", "class_scope",
@@ -310,6 +323,12 @@ class Checker(ast.NodeVisitor):
             self.report(node, "raw-clock",
                         f"raw time.{what}() — use clockctl.{'monotonic' if what == 'monotonic' else ('sleep' if what == 'sleep' else 'now')}() so "
                         "virtual-clock sims reach this timer")
+        if canonical in _DEVICE_CALLS:
+            self.report(node, "raw-device-discovery",
+                        f"raw {canonical}() — route through "
+                        "seaweedfs_tpu.parallel.mesh.devices() so the "
+                        "cached probe and virtual-device config are "
+                        "shared")
         if canonical in _HTTP_CALLS:
             self.report(node, "raw-http",
                         f"raw {canonical}() drops X-Weed-Deadline/Class/"
