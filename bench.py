@@ -208,6 +208,74 @@ def bench_scrub(size_mb: int = 64) -> dict:
             "scrub_mb": size_mb}
 
 
+def bench_telemetry_overhead(n_reads: int = 600,
+                             concurrency: int = 8) -> dict:
+    """Round-13 telemetry-plane cost: the same single-volume read
+    sweep with the RED histogram + hot-key sketch recording live
+    (shipped default) vs surgically disabled (http.red = None and a
+    no-op sketch), interleaved ON/OFF/ON/OFF so CPU-frequency drift
+    hits both arms equally. The per-request work is one bisect + one
+    dict update under a lock (histogram) and one sketch offer — the
+    claim in PERF.md round 13 is "within noise", so the paired sweeps
+    are the evidence."""
+    import concurrent.futures
+    import tempfile
+
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    with tempfile.TemporaryDirectory() as d:
+        master = MasterServer(volume_size_limit_mb=64)
+        master.start()
+        vs = VolumeServer([d], master.url)
+        vs.start()
+        time.sleep(0.3)
+        mc = MasterClient(master.url)
+        try:
+            fids = [operation.upload_data(
+                mc, b"\xa5" * 4096, name=f"t{i}").fid
+                for i in range(32)]
+
+            def read_one(i):
+                operation.read_data(mc, fids[i % len(fids)])
+
+            def sweep() -> float:
+                t0 = time.perf_counter()
+                with concurrent.futures.ThreadPoolExecutor(
+                        concurrency) as ex:
+                    list(ex.map(read_one, range(n_reads)))
+                return n_reads / (time.perf_counter() - t0)
+
+            red_on, hot_on = vs.http.red, vs.hotkeys
+            hot_off = type(hot_on)(dims=())  # records nothing
+
+            def set_plane(on: bool) -> None:
+                vs.http.red = red_on if on else None
+                vs.hotkeys = hot_on if on else hot_off
+
+            sweep()  # warm connections + page cache
+            on_rps, off_rps = [], []
+            for _ in range(2):
+                set_plane(True)
+                on_rps.append(sweep())
+                set_plane(False)
+                off_rps.append(sweep())
+            set_plane(True)
+        finally:
+            mc.stop()
+            vs.stop()
+            master.stop()
+    on, off = max(on_rps), max(off_rps)
+    return {
+        "telemetry_on_rps": round(on, 1),
+        "telemetry_off_rps": round(off, 1),
+        "telemetry_overhead_pct": round((off - on) / off * 100, 2)
+        if off else 0.0,
+    }
+
+
 def _free_port() -> int:
     """Reserve a port number for a server created behind a proxy: the
     proxy must know the target port before HttpServer binds it."""
@@ -924,6 +992,7 @@ def main(argv=None):
     e2e.update(bench_filer_put())  # parallel chunk-upload write path
     e2e.update(bench_replicated_write())  # concurrent replica fan-out
     e2e.update(bench_overload())  # QoS admission under overload
+    e2e.update(bench_telemetry_overhead())  # RED+sketch plane cost
     e2e.update(bench_repair_network())  # partial-column repair ingress
     tpu, attempts, err = tpu_probe_with_retries()
     if tpu is not None:

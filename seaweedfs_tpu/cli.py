@@ -12,6 +12,8 @@ import json
 import sys
 import time
 
+from seaweedfs_tpu.utils import clockctl
+
 
 def _add_common_volume_args(p):
     p.add_argument("-dir", default="./data", help="data directory (comma-separated)")
@@ -763,42 +765,42 @@ def cmd_benchmark(args):
 
     dispenser = FidDispenser(mc, args.assignBatch)
     fids = []
-    t0 = time.perf_counter()
+    t0 = clockctl.monotonic()
     lat = []
 
     def write_one(i):
-        s = time.perf_counter()
+        s = clockctl.monotonic()
         fid, url, auth = dispenser.next()
         if args.useTcp:
             tcp_client_for(url).write(fid, payload)
         else:
             operation.upload_to(fid, url, payload, auth=auth)
-        lat.append(time.perf_counter() - s)
+        lat.append(clockctl.monotonic() - s)
         return fid
 
     with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
         fids = list(ex.map(write_one, range(args.n)))
-    dt = time.perf_counter() - t0
+    dt = clockctl.monotonic() - t0
     _report("write", args.n, args.size, dt, lat)
 
     lat = []
-    t0 = time.perf_counter()
+    t0 = clockctl.monotonic()
 
     def read_one(_):
         fid = random.choice(fids)
-        s = time.perf_counter()
+        s = clockctl.monotonic()
         if args.useTcp:
             vid = int(fid.split(",")[0])
             url = mc.lookup_volume(vid)[0]["url"]
             data = tcp_client_for(url).read(fid)
         else:
             data = operation.read_data(mc, fid)
-        lat.append(time.perf_counter() - s)
+        lat.append(clockctl.monotonic() - s)
         assert len(data) == args.size
 
     with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
         list(ex.map(read_one, range(args.n)))
-    dt = time.perf_counter() - t0
+    dt = clockctl.monotonic() - t0
     _report("read", args.n, args.size, dt, lat)
     for c in tcp_clients.values():
         c.close()

@@ -50,6 +50,10 @@ class DataNode:
         # node takes no new assignments or volume growth, and its
         # departure must not trigger rebuilds (repair drain grace)
         self.draining = False
+        # last telemetry snapshot (RED histogram + hot-key sketches,
+        # rides heartbeats next to qos_pressure); merged cluster-wide
+        # by the master's ClusterTelemetry
+        self.telemetry: Optional[dict] = None
 
     @property
     def id(self) -> str:
@@ -277,6 +281,8 @@ class Topology:
             node.scrubbing = bool(hb.get("scrubbing", False))
             node.qos_pressure = float(hb.get("qos_pressure", 0.0))
             node.draining = bool(hb.get("draining", False))
+            if hb.get("telemetry"):
+                node.telemetry = hb["telemetry"]
             node.grpc_port = hb.get("grpc_port", 0)
             node.max_volume_count = hb.get("max_volume_count",
                                            node.max_volume_count)
@@ -327,6 +333,8 @@ class Topology:
                 node.qos_pressure = float(deltas["qos_pressure"])
             if "draining" in deltas:
                 node.draining = bool(deltas["draining"])
+            if deltas.get("telemetry"):
+                node.telemetry = deltas["telemetry"]
             new_vids, deleted_vids = set(), set()
             new_ec_vids, deleted_ec_vids = set(), set()
             # deletes BEFORE adds: a disk-tier move reports the same

@@ -68,6 +68,17 @@ class IamServer:
             node=f"iam@{host}:{port}", enabled=tracing_enabled,
             sample_rate=trace_sample)
         self.http.tracer = self.tracer
+        # RED on the main port: the IAM API is action-parameter based
+        # (POST/GET "/"), so reserved GET paths can't shadow anything
+        from seaweedfs_tpu.utils.metrics import Registry, RedRecorder
+        self.metrics = Registry()
+        self.red = RedRecorder(self.metrics, "iam")
+        self.http.red = self.red
+        self.http.add(
+            "GET", "/metrics",
+            lambda req: Response(self.metrics.expose_text(),
+                                 content_type="text/plain; version=0.0.4"))
+        self.http.add("GET", "/admin/telemetry", self._handle_telemetry)
         self.http.add("POST", "/", self._handle)
         self.http.add("GET", "/", self._handle)
 
@@ -76,10 +87,18 @@ class IamServer:
 
     def stop(self) -> None:
         self.http.stop()
+        self.metrics.stop_push()
 
     @property
     def url(self) -> str:
         return f"{self.http.host}:{self.http.port}"
+
+    def telemetry_snapshot(self) -> dict:
+        return {"node": self.url, "server": "iam",
+                "red": self.red.snapshot()}
+
+    def _handle_telemetry(self, req: Request) -> Response:
+        return Response(self.telemetry_snapshot())
 
     def _handle(self, req: Request) -> Response:
         params = dict(req.query)

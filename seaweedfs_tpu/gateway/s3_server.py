@@ -147,6 +147,18 @@ class S3Server:
             sample_rate=trace_sample)
         self.http.tracer = self.tracer
         self.metrics_http.tracer = self.tracer
+        # cluster telemetry plane: RED histogram on the public port's
+        # dispatch + hot path/tenant sketches on the private listener
+        # (same reasoning as /metrics: bucket names must not leak)
+        from seaweedfs_tpu.stats.hotkeys import HotKeys
+        from seaweedfs_tpu.utils.metrics import RedRecorder
+        self.red = RedRecorder(self.metrics, "s3")
+        self.http.red = self.red
+        self.hotkeys = HotKeys(dims=("path", "tenant"))
+        self.metrics_http.add("GET", "/admin/hotkeys",
+                              self.hotkeys.handler(self.url))
+        self.metrics_http.add("GET", "/admin/telemetry",
+                              self._handle_telemetry)
         from seaweedfs_tpu.utils.debug import install_debug_routes
         install_debug_routes(self.metrics_http)
         self._register_routes()
@@ -157,8 +169,36 @@ class S3Server:
         self.tracer.node = f"s3@{self.http.host}:{self.http.port}"
         glog.info("s3 gateway up at %s (metrics=%s)", self.url,
                   self.metrics_url)
+        # announce to the master like a filer does, so the cluster
+        # telemetry aggregator can pull this gateway's RED/hotkeys
+        # snapshots from the private metrics listener (skipped in
+        # gateway mode, where the filer itself doesn't register either)
+        if getattr(self.fs, "announce", True):
+            import threading
+            self._announce_stop = threading.Event()
+            threading.Thread(target=self._announce_loop,
+                             daemon=True).start()
+
+    def _announce_loop(self) -> None:
+        from seaweedfs_tpu.utils.httpd import http_json
+
+        def announce():
+            try:
+                http_json("POST",
+                          f"http://{self.fs.master_url}/cluster/register",
+                          {"type": "s3", "url": self.url,
+                           "metrics_url": self.metrics_url}, timeout=5)
+            except Exception as e:
+                glog.vlog(1, "s3 announce to master %s failed: %s",
+                          self.fs.master_url, e)
+
+        announce()
+        while not self._announce_stop.wait(15.0):
+            announce()
 
     def stop(self) -> None:
+        if hasattr(self, "_announce_stop"):
+            self._announce_stop.set()
         self.http.stop()
         self.metrics_http.stop()
         self.metrics.stop_push()
@@ -182,6 +222,14 @@ class S3Server:
     def _handle_metrics(self, req: Request) -> Response:
         return Response(self.metrics.expose_text(),
                         content_type="text/plain; version=0.0.4")
+
+    def telemetry_snapshot(self) -> dict:
+        return {"node": self.url, "server": "s3",
+                "red": self.red.snapshot(),
+                "hotkeys": self.hotkeys.snapshot()}
+
+    def _handle_telemetry(self, req: Request) -> Response:
+        return Response(self.telemetry_snapshot())
 
     # ---- QoS admission ----
     def _handle_qos(self, req: Request) -> Response:
@@ -617,6 +665,9 @@ class S3Server:
             bucket, key = req.match.group(1), req.match.group(2)
             action = "Read" if req.method in ("GET", "HEAD") else "Write"
             self._m_req.inc(action, bucket)
+            # hot-key sketches, post-auth for the same cardinality reason
+            self.hotkeys.record("path", f"/{bucket}/{key}")
+            self.hotkeys.record("tenant", self._tenant_of(req))
             self._refresh_breaker()
             if not self.breaker.acquire(bucket, action):
                 return _err("TooManyRequests", "circuit breaker open", 503)

@@ -37,15 +37,42 @@ class WebDavServer:
             node=f"webdav@{host}:{port}", enabled=tracing_enabled,
             sample_rate=trace_sample)
         self.http.tracer = self.tracer
+        # RED at this edge rides a private metrics listener, same as
+        # the filer: every path on the DAV port is user namespace
+        from seaweedfs_tpu.utils.metrics import Registry, RedRecorder
+        self.metrics = Registry()
+        self.red = RedRecorder(self.metrics, "webdav")
+        self.http.red = self.red
+        self.metrics_http = HttpServer(host, 0)
+        self.metrics_http.add(
+            "GET", "/metrics",
+            lambda req: Response(self.metrics.expose_text(),
+                                 content_type="text/plain; version=0.0.4"))
+        self.metrics_http.add("GET", "/admin/telemetry",
+                              self._handle_telemetry)
         for m in ("OPTIONS", "PROPFIND", "GET", "HEAD", "PUT", "DELETE",
                   "MKCOL", "MOVE", "COPY", "LOCK", "UNLOCK", "PROPPATCH"):
             self.http.add(m, "/.*", self._dispatch)
 
     def start(self) -> None:
         self.http.start()
+        self.metrics_http.start()
 
     def stop(self) -> None:
         self.http.stop()
+        self.metrics_http.stop()
+        self.metrics.stop_push()
+
+    @property
+    def metrics_url(self) -> str:
+        return f"{self.metrics_http.host}:{self.metrics_http.port}"
+
+    def telemetry_snapshot(self) -> dict:
+        return {"node": self.url, "server": "webdav",
+                "red": self.red.snapshot()}
+
+    def _handle_telemetry(self, req: Request) -> Response:
+        return Response(self.telemetry_snapshot())
 
     @property
     def url(self) -> str:

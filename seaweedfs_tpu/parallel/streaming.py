@@ -31,13 +31,13 @@ from __future__ import annotations
 import os
 import queue
 import threading
-import time
 from typing import Optional, Sequence
 
 import numpy as np
 
 from seaweedfs_tpu.models.coder import DEFAULT_SCHEME, ErasureCoder, RSScheme
 from seaweedfs_tpu.storage.erasure_coding import layout
+from seaweedfs_tpu.utils import clockctl
 
 DEFAULT_PIPE_BATCH = 16 * 1024 * 1024
 
@@ -223,16 +223,16 @@ def pipelined_encode_file(base_file_name: str,
     data_pool = _BufferPool()
     parity_pool = _BufferPool()
     slock = threading.Lock()
-    wall0 = time.perf_counter()
+    wall0 = clockctl.monotonic()
 
     def reader_stage(rid: int):
         busy = 0.0
         with open(dat_path, "rb") as f:
             for seq in range(rid, len(descs), readers):
-                t0 = time.perf_counter()
+                t0 = clockctl.monotonic()
                 buf = data_pool.get((k, descs[seq][3]))
                 _read_rows(f, buf, descs[seq], k)
-                busy += time.perf_counter() - t0
+                busy += clockctl.monotonic() - t0
                 pl.put(read_q, (seq, buf))
         _merge_stats(stats, slock, read_s=busy)
 
@@ -243,7 +243,7 @@ def pipelined_encode_file(base_file_name: str,
             if item is None:
                 break
             data, parity = item
-            t0 = time.perf_counter()
+            t0 = clockctl.monotonic()
             if fn is not None:
                 # materialize BEFORE recycling: on the CPU jax backend
                 # device_put may alias the host buffer, so the data array
@@ -253,7 +253,7 @@ def pipelined_encode_file(base_file_name: str,
                 outs.files[i].write(data[i])
             for r in range(m):
                 outs.files[k + r].write(parity[r])
-            busy += time.perf_counter() - t0
+            busy += clockctl.monotonic() - t0
             data_pool.put(data)
             if isinstance(parity, np.ndarray):
                 parity_pool.put(parity)
@@ -273,7 +273,7 @@ def pipelined_encode_file(base_file_name: str,
                 seq, buf = pl.get(read_q)
                 stash[seq] = buf
             data = stash.pop(expected)
-            t0 = time.perf_counter()
+            t0 = clockctl.monotonic()
             if fn is not None:
                 words = data.view(np.uint32)
                 import jax
@@ -285,13 +285,13 @@ def pipelined_encode_file(base_file_name: str,
                     parity = coder.encode_into(data, pbuf)
                 else:
                     parity = np.asarray(coder.encode_array(data))
-            encode_busy += time.perf_counter() - t0
+            encode_busy += clockctl.monotonic() - t0
             pl.put(write_q, (data, parity))
         pl.put(write_q, None)
         writer_t.join()
         pl.join()
         _merge_stats(stats, slock, encode_s=encode_busy,
-                     wall_s=time.perf_counter() - wall0,
+                     wall_s=clockctl.monotonic() - wall0,
                      bytes_in=dat_size, batches=len(descs))
         outs.commit()
     except _Aborted:
@@ -351,7 +351,7 @@ def pipelined_rebuild_files(base_file_name: str,
     data_pool = _BufferPool()
     out_pool = _BufferPool()
     slock = threading.Lock()
-    wall0 = time.perf_counter()
+    wall0 = clockctl.monotonic()
 
     def reader_stage():
         busy = 0.0
@@ -359,7 +359,7 @@ def pipelined_rebuild_files(base_file_name: str,
         try:
             for off in offs:
                 n = min(batch_size, shard_size - off)
-                t0 = time.perf_counter()
+                t0 = clockctl.monotonic()
                 buf = data_pool.get((k, n))
                 for r, f in enumerate(ins):
                     f.seek(off)
@@ -368,7 +368,7 @@ def pipelined_rebuild_files(base_file_name: str,
                         raise IOError(
                             f"short read on {base_file_name}"
                             f"{layout.shard_ext(src[r])} at {off}")
-                busy += time.perf_counter() - t0
+                busy += clockctl.monotonic() - t0
                 pl.put(read_q, buf)
             pl.put(read_q, None)
         finally:
@@ -382,10 +382,10 @@ def pipelined_rebuild_files(base_file_name: str,
             item = pl.get(write_q)
             if item is None:
                 break
-            t0 = time.perf_counter()
+            t0 = clockctl.monotonic()
             for r in range(len(missing)):
                 outs.files[r].write(item[r])
-            busy += time.perf_counter() - t0
+            busy += clockctl.monotonic() - t0
             out_pool.put(item)
         _merge_stats(stats, slock, write_s=busy)
 
@@ -399,17 +399,17 @@ def pipelined_rebuild_files(base_file_name: str,
             buf = pl.get(read_q)
             if buf is None:
                 break
-            t0 = time.perf_counter()
+            t0 = clockctl.monotonic()
             rec = coder.reconstruct_rows(
                 buf, rmat, out_pool.get((len(missing), buf.shape[1])))
-            busy += time.perf_counter() - t0
+            busy += clockctl.monotonic() - t0
             pl.put(write_q, rec)
             data_pool.put(buf)
         pl.put(write_q, None)
         writer_t.join()
         pl.join()
         _merge_stats(stats, slock, encode_s=busy,
-                     wall_s=time.perf_counter() - wall0,
+                     wall_s=clockctl.monotonic() - wall0,
                      bytes_in=shard_size * k, batches=len(offs))
         outs.commit()
     except _Aborted:

@@ -168,6 +168,18 @@ class FilerServer:
             sample_rate=trace_sample)
         self.http.tracer = self.tracer
         self.metrics_http.tracer = self.tracer
+        # cluster telemetry plane: RED histogram at the dispatch edge +
+        # hot path/tenant sketches, both served from the metrics
+        # listener (main port is user namespace) and merged master-side
+        from seaweedfs_tpu.stats.hotkeys import HotKeys
+        from seaweedfs_tpu.utils.metrics import RedRecorder
+        self.red = RedRecorder(self.metrics, "filer")
+        self.http.red = self.red
+        self.hotkeys = HotKeys(dims=("path", "tenant"))
+        self.metrics_http.add("GET", "/admin/hotkeys",
+                              self.hotkeys.handler(self.url))
+        self.metrics_http.add("GET", "/admin/telemetry",
+                              self._handle_telemetry)
         from seaweedfs_tpu.utils.debug import install_debug_routes
         install_debug_routes(self.metrics_http)
         self._register_routes()
@@ -214,7 +226,8 @@ class FilerServer:
             try:
                 http_json("POST",
                           f"http://{self.master_url}/cluster/register",
-                          {"type": "filer", "url": self.url}, timeout=5)
+                          {"type": "filer", "url": self.url,
+                           "metrics_url": self.metrics_url}, timeout=5)
             except Exception as e:
                 glog.vlog(1, "filer announce to master %s failed: %s",
                           self.master_url, e)
@@ -304,6 +317,14 @@ class FilerServer:
         return Response(self.metrics.expose_text(),
                         content_type="text/plain; version=0.0.4")
 
+    def telemetry_snapshot(self) -> dict:
+        return {"node": self.url, "server": "filer",
+                "red": self.red.snapshot(),
+                "hotkeys": self.hotkeys.snapshot()}
+
+    def _handle_telemetry(self, req: Request) -> Response:
+        return Response(self.telemetry_snapshot())
+
     # ---- QoS admission ----
     # exempt: the operator's escape hatch plus long-polls, whose
     # held-open slots would both exhaust the limit and poison the
@@ -337,6 +358,12 @@ class FilerServer:
     def _timed(self, kind: str, handler):
         def wrapped(req: Request) -> Response:
             self._m_req.inc(kind)
+            # hot-key sketches: which paths are hammered and by whom
+            # (tenant = client IP, the same key the QoS buckets use)
+            self.hotkeys.record("path", req.path.rstrip("/") or "/")
+            h = getattr(req, "handler", None)
+            if h is not None:
+                self.hotkeys.record("tenant", h.client_address[0])
             with self._m_lat.time(kind):
                 return handler(req)
         return wrapped

@@ -99,6 +99,20 @@ class MasterServer:
             node=f"master@{host}:{port}", enabled=tracing_enabled,
             sample_rate=trace_sample)
         self.http.tracer = self.tracer
+        # RED edge histogram (one observation site in HttpServer) +
+        # the cluster-wide aggregation/judgement it feeds
+        from seaweedfs_tpu.stats.telemetry import ClusterTelemetry
+        from seaweedfs_tpu.utils.metrics import RedRecorder
+        self.red = RedRecorder(self.metrics, "master")
+        self.http.red = self.red
+        self.telemetry = ClusterTelemetry(
+            on_transition=self._on_slo_transition)
+        self._m_slo_burn = self.metrics.gauge(
+            "master", "slo_burn_rate",
+            "SLO error-budget burn rate", ("class", "window"))
+        self._m_slo_alert = self.metrics.gauge(
+            "master", "slo_alert",
+            "1=fast_burn firing, 0.5=slow_burn, 0=ok", ("class",))
         self._register_routes()
         self._stop = threading.Event()
         self._pruner: Optional[threading.Thread] = None
@@ -117,6 +131,10 @@ class MasterServer:
         self._load_state()
         self._grpc_port = grpc_port
         self._cluster_nodes: dict = {}
+        # (type, url) -> {"metrics_url": ...}; separate from the
+        # liveness map above because the gRPC plane also writes that
+        # one with bare timestamps
+        self._cluster_node_meta: dict = {}
         self._grpc_server = None
         self.grpc_port: Optional[int] = None
 
@@ -154,6 +172,7 @@ class MasterServer:
             ticks += 1
             self.topo.prune_dead_nodes()
             self._save_state()
+            self._feed_slo()
             if self.is_leader():
                 self.repair_queue.tick()
             if ticks % 12 == 0 and self.is_leader():
@@ -350,6 +369,7 @@ class MasterServer:
         r("GET", "/cluster/status", self._handle_cluster_status)
         r("GET", "/cluster/health", self._handle_cluster_health)
         r("GET", "/cluster/qos", self._handle_cluster_qos)
+        r("GET", "/cluster/telemetry", self._handle_cluster_telemetry)
         r("GET", "/cluster/raft/ps", self._handle_raft_ps)
         r("POST", "/cluster/raft/add", self._handle_raft_change("add"))
         r("POST", "/cluster/raft/remove",
@@ -467,10 +487,16 @@ class MasterServer:
 
     def _handle_cluster_register(self, req: Request) -> Response:
         """Filer/broker membership announcements (reference
-        weed/cluster/cluster.go + master ListClusterNodes)."""
+        weed/cluster/cluster.go + master ListClusterNodes). A node
+        that announces a metrics_url makes its telemetry/hotkeys
+        endpoints pullable (filer/S3 serve those on the private
+        metrics listener, which the topology doesn't know)."""
         b = req.json()
         ntype, url = b.get("type", "filer"), b["url"]
         self._cluster_nodes[(ntype, url)] = clockctl.now()
+        if b.get("metrics_url"):
+            self._cluster_node_meta[(ntype, url)] = {
+                "metrics_url": b["metrics_url"]}
         return Response({})
 
     def _handle_cluster_nodes(self, req: Request) -> Response:
@@ -867,6 +893,86 @@ class MasterServer:
                     st.get("cluster_qos_pressure", 0.0),
             },
         })
+
+    # ---- cluster telemetry plane (RED quantiles, hot keys, SLO) ----
+    def telemetry_snapshot(self) -> dict:
+        """This master's own edge contribution to the merged view."""
+        return {"node": self.url, "server": "master",
+                "red": self.red.snapshot()}
+
+    def _on_slo_transition(self, t, cls, old, new, detail) -> None:
+        glog.info("slo: class=%s %s -> %s (%s)", cls, old, new, detail)
+
+    def _telemetry_node_snaps(self) -> list:
+        """Everything reachable without network: our own edge plus
+        the per-volume-server snapshots riding heartbeats."""
+        snaps = [self.telemetry_snapshot()]
+        with self.topo.lock:
+            for n in self.topo.all_nodes():
+                t = getattr(n, "telemetry", None)
+                if t:
+                    snaps.append(t)
+        return snaps
+
+    def _pull_peer_telemetry(self, unreachable: list) -> list:
+        """Filer/S3 snapshots via the /cluster/register membership
+        table (they announce a metrics_url; /admin/telemetry lives
+        there because their main ports are user namespace)."""
+        snaps = []
+        now = clockctl.now()
+        for (ntype, url), seen in list(self._cluster_nodes.items()):
+            if now - seen >= 60:
+                continue
+            meta = self._cluster_node_meta.get((ntype, url)) or {}
+            target = meta.get("metrics_url")
+            if not target:
+                continue
+            try:
+                snaps.append(http_json(
+                    "GET", f"http://{target}/admin/telemetry",
+                    deadline=Deadline.after(3.0)))
+            except Exception as e:
+                unreachable.append({"node": url, "type": ntype,
+                                    "error": type(e).__name__})
+        return snaps
+
+    def _refresh_slo_gauges(self, slo_view: dict) -> None:
+        for cls, judged in slo_view.items():
+            self._m_slo_burn.set(cls, "fast",
+                                 value=judged["fast_burn"])
+            self._m_slo_burn.set(cls, "slow",
+                                 value=judged["slow_burn"])
+            self._m_slo_alert.set(cls, value={
+                "ok": 0.0, "slow_burn": 0.5,
+                "fast_burn": 1.0}[judged["state"]])
+
+    def _feed_slo(self) -> None:
+        """Pulse-cadence SLO evaluation from heartbeat-held snapshots
+        only (no network) — burn-rate windows accumulate even when
+        nobody scrapes /cluster/telemetry."""
+        try:
+            view = self.telemetry.rollup(clockctl.monotonic(),
+                                         self._telemetry_node_snaps())
+            self._refresh_slo_gauges(view["slo"])
+        except Exception as e:
+            glog.vlog(1, "slo feed failed: %s", e)
+
+    def _handle_cluster_telemetry(self, req: Request) -> Response:
+        """Merged cluster view: per-class p50/p99 + error rates from
+        exact histogram merging, cluster top-k hot keys, bucket
+        exemplar trace ids, and the SLO burn-rate judgement."""
+        unreachable: list = []
+        snaps = self._telemetry_node_snaps()
+        if req.query.get("peers", "true") != "false":
+            snaps += self._pull_peer_telemetry(unreachable)
+        view = self.telemetry.rollup(
+            clockctl.monotonic(), snaps,
+            top_k=int(req.query.get("k", 10)))
+        self._refresh_slo_gauges(view["slo"])
+        view.update({"master": self.url,
+                     "is_leader": self.is_leader(),
+                     "unreachable": unreachable})
+        return Response(view)
 
     def _handle_lock(self, req: Request) -> Response:
         body = req.json() or {}

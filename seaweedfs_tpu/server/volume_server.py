@@ -227,6 +227,13 @@ class VolumeServer:
             node=f"volume@{host}:{port}", enabled=tracing_enabled,
             sample_rate=trace_sample)
         self.http.tracer = self.tracer
+        # RED edge histogram (single observation site in HttpServer)
+        # + hot-needle sketch; both ride heartbeats to the master
+        from seaweedfs_tpu.stats.hotkeys import HotKeys
+        from seaweedfs_tpu.utils.metrics import RedRecorder
+        self.red = RedRecorder(self.metrics, "volume")
+        self.http.red = self.red
+        self.hotkeys = HotKeys(dims=("needle",))
 
     # ---- lifecycle ----
     def start(self) -> None:
@@ -379,6 +386,10 @@ class VolumeServer:
         # local overload pressure rides every heartbeat so the master's
         # repair scheduler can back off nodes that are shedding load
         hb["qos_pressure"] = round(self.qos.pressure(), 4)
+        # telemetry snapshot (RED histogram + hot-needle sketch)
+        # piggybacks the same way — the master merges these into the
+        # cluster-wide /cluster/telemetry view
+        hb["telemetry"] = self.telemetry_snapshot()
         if self.grpc_port:
             hb["grpc_port"] = self.grpc_port
         for _attempt in range(2):  # second try after a leader redirect
@@ -439,6 +450,7 @@ class VolumeServer:
                 "is_delta": True, "scrubbing": self._is_scrubbing(),
                 "qos_pressure": round(self.qos.pressure(), 4),
                 "draining": self.draining,
+                "telemetry": self.telemetry_snapshot(),
                 **deltas}
         try:
             self._master_json("POST", "/heartbeat", body,
@@ -473,6 +485,7 @@ class VolumeServer:
                             "scrubbing": self._is_scrubbing(),
                             "qos_pressure": round(self.qos.pressure(), 4),
                             "draining": self.draining,
+                            "telemetry": self.telemetry_snapshot(),
                             **deltas}
                     reply = self._master_json(
                         "POST", "/heartbeat", body,
@@ -554,6 +567,9 @@ class VolumeServer:
         # admission-control snapshot + runtime tuning (cluster.qos)
         r("GET", "/admin/qos", self._admin_qos)
         r("POST", "/admin/qos", self._admin_qos_configure)
+        # hot-needle sketch + full telemetry snapshot (RED histogram)
+        r("GET", "/admin/hotkeys", self.hotkeys.handler(self.url))
+        r("GET", "/admin/telemetry", self._admin_telemetry)
 
     def _admin_ec_batcher(self, req: Request) -> Response:
         if self.ec_batcher is None:
@@ -570,7 +586,8 @@ class VolumeServer:
     # overloaded (shedding /admin/qos would saw off the escape hatch)
     QOS_EXEMPT = ("/status", "/metrics", "/ui", "/debug",
                   "/admin/qos", "/admin/health", "/admin/scrub/status",
-                  "/admin/ec/batcher")
+                  "/admin/ec/batcher", "/admin/hotkeys",
+                  "/admin/telemetry")
 
     def _admission_gate(self, method: str, path: str, headers, client):
         """HttpServer admission hook: classify (propagated header wins
@@ -596,6 +613,14 @@ class VolumeServer:
     def _admin_qos_configure(self, req: Request) -> Response:
         return Response({"url": self.url,
                          **self.qos.configure(**(req.json() or {}))})
+
+    def telemetry_snapshot(self) -> dict:
+        return {"node": self.url, "server": "volume",
+                "red": self.red.snapshot(),
+                "hotkeys": self.hotkeys.snapshot()}
+
+    def _admin_telemetry(self, req: Request) -> Response:
+        return Response(self.telemetry_snapshot())
 
     def _refresh_gauges(self) -> None:
         # runs before every exposition (scrape AND push-gateway loop)
@@ -787,6 +812,7 @@ class VolumeServer:
             return denied
         self._m_req.inc("write")
         vid, key, cookie = self._parse_fid(req)
+        self.hotkeys.record("needle", "%d,%x" % (vid, key))
         n = Needle(id=key, cookie=cookie, data=req.body,
                    name=req.query.get("name", "").encode(),
                    mime=req.query.get("mime", "").encode())
@@ -877,6 +903,7 @@ class VolumeServer:
             return denied
         self._m_req.inc("read")
         vid, key, cookie = self._parse_fid(req)
+        self.hotkeys.record("needle", "%d,%x" % (vid, key))
         if req.headers.get("Range") and \
                 self.store.find_volume(vid) is None and \
                 self.store.has_ec_volume(vid) and \

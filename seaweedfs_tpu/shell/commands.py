@@ -833,6 +833,18 @@ class ShellContext:
         traces.sort(key=lambda t: -t["duration_ms"])
         return {"traces": traces, "unreachable": unreachable}
 
+    def cluster_telemetry(self, top_k: int = 10,
+                          peers: bool = True) -> dict:
+        """Cluster RED/SLO view: the master's merged telemetry rollup —
+        per-class rate/errors/p50/p99 with trace exemplars, the
+        cluster-wide hot-key leaderboard, and per-class SLO burn-rate
+        alert state. Volume snapshots ride heartbeats; filer/S3
+        snapshots are pulled from their registered metrics listeners
+        (peers=False skips those pulls for a heartbeat-only view)."""
+        qs = f"?k={top_k}" + ("" if peers else "&peers=false")
+        return http_json(
+            "GET", f"http://{self.master_url}/cluster/telemetry{qs}")
+
     # ---- ec.balance (reference command_ec_balance.go) ----
     def ec_balance(self, apply: bool = True) -> list[ec_plan.ShardMove]:
         topo = self.topology()

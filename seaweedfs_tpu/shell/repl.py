@@ -64,6 +64,9 @@ HELP = """commands:
   cluster.trace [-trace ID] [-minMs MS] [-limit N]
                                     recent slow traces cluster-wide; with
                                     -trace, that trace's stitched spans
+  cluster.telemetry [-topK N] [-noPeers]
+                                    merged RED quantiles + exemplars,
+                                    hot-key leaderboard, SLO burn alerts
   volume.scrub [-node HOST:PORT] [-volumeId N]   synchronous integrity pass
   lock / unlock
   help / exit
@@ -629,6 +632,10 @@ def run_command(sh: ShellContext, line: str):
             trace_id=flags.get("trace", ""),
             min_ms=float(flags.get("minMs", 0) or 0),
             limit=int(flags.get("limit", 64) or 64))
+    if cmd == "cluster.telemetry":
+        return sh.cluster_telemetry(
+            top_k=int(flags.get("topK", 10) or 10),
+            peers="noPeers" not in flags)
     if cmd == "ec.repair.kick":
         return sh.ec_repair_kick()
     if cmd == "volume.scrub":

@@ -19,7 +19,23 @@ from seaweedfs_tpu.sim.actors import (FilerActor, MasterActor, Transport,
                                       VolumeActor)
 from seaweedfs_tpu.sim.faults import FaultScheduler, parse_schedule
 from seaweedfs_tpu.sim.kernel import SimKernel
+from seaweedfs_tpu.stats.slo import SloEvaluator
 from seaweedfs_tpu.utils.resilience import CLOSED
+
+# Compressed SLO objectives for the sim: incidents run ~40 virtual
+# seconds, so production's 5m/1h burn windows shrink to 6s/15s.
+# Latency targets bracket the sim's service times (interactive reads
+# complete in ~5ms healthy; a 60ms grey-failure band or crash-failover
+# backoff pushes them well past 50ms), so a scripted incident flips
+# ops to "bad" deterministically and a healed fleet flips them back.
+SIM_SLO_OBJECTIVES = {
+    "interactive": {"latency_s": 0.05, "goal": 0.99},
+    "write": {"latency_s": 0.15, "goal": 0.99},
+    "background": {"latency_s": 1.0, "goal": 0.99},
+}
+SIM_FAST_WINDOW_S = 6.0
+SIM_SLOW_WINDOW_S = 15.0
+SIM_SLO_TICK_S = 1.0
 
 
 def percentile(xs: list, q: float) -> float:
@@ -40,6 +56,9 @@ class SimMetrics:
         self.fail_samples: list[str] = []
         self.acked: dict[int, tuple] = {}    # key -> (version, vid)
         self._ver = 0
+        # cumulative per-class [total, bad] for the SLO burn evaluator
+        # (bad = failed, or slower than the class's sim latency target)
+        self.slo_counts = {c: [0, 0] for c in CLASSES}
 
     def next_version(self) -> int:
         self._ver += 1
@@ -63,6 +82,11 @@ class SimMetrics:
             self.fail_total += 1
             if len(self.fail_samples) < 20:
                 self.fail_samples.append(f"{op.tenant}/{op.kind}: {err}")
+        sc = self.slo_counts[op.klass]
+        sc[0] += 1
+        target = SIM_SLO_OBJECTIVES.get(op.klass, {}).get("latency_s", 1.0)
+        if not success or lat > target:
+            sc[1] += 1
 
     def ops_total(self) -> int:
         return sum(ok + fail for ok, fail in self.tenants.values())
@@ -138,6 +162,28 @@ class SimCluster:
         self.master.start()
         for actor in self.volumes:
             actor.start()
+
+        # SLO burn-rate judge: a 1s virtual ticker feeds cumulative
+        # per-class totals and evaluates; alert transitions land in the
+        # kernel log, so the alert timeline is part of log_hash (same
+        # seed => same firing/resolution instants)
+        self.slo = SloEvaluator(
+            objectives=SIM_SLO_OBJECTIVES,
+            fast_window_s=SIM_FAST_WINDOW_S,
+            slow_window_s=SIM_SLOW_WINDOW_S,
+            on_transition=self._note_slo_transition)
+        self.kernel.spawn(self._slo_ticker())
+
+    def _note_slo_transition(self, t, cls, old, new, detail) -> None:
+        self.kernel.note("slo", f"{cls}:{old}->{new}", detail)
+
+    def _slo_ticker(self):
+        while True:
+            for c in CLASSES:
+                total, bad = self.metrics.slo_counts[c]
+                self.slo.feed(self.kernel.now, c, total, bad)
+            self.slo.evaluate(self.kernel.now)
+            yield SIM_SLO_TICK_S
 
     # -- topology access --
     def actor(self, name: str) -> VolumeActor:
@@ -243,6 +289,11 @@ class SimCluster:
             "virtual_s": round(self.kernel.now, 3),
             "events": self.kernel.events_processed,
             "log_hash": self._run_hash(),
+            "slo": {
+                "timeline": [[round(t, 3), cls, old, new]
+                             for t, cls, old, new in self.slo.timeline()],
+                "firing": self.slo.firing(),
+            },
             "client": self.metrics.summary(),
             "repair": {
                 "done": m.repairs_done,

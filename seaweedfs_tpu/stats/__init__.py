@@ -1,0 +1,2 @@
+"""Cluster telemetry plane: hot-key sketches, snapshot aggregation,
+and SLO burn-rate evaluation (see ARCHITECTURE.md "Observability")."""

@@ -15,9 +15,11 @@ test can never leak a virtual clock into the rest of the suite.
 
 Deliberately NOT thread-aware: the simulator is single-threaded by
 construction (that is what makes it bit-reproducible), and production
-never installs anything.  Code that needs wall time for *measurement*
-(bench drivers, tracing timestamps) keeps using ``time`` directly —
-only *behavioral* timers route through here.
+never installs anything.  Histogram/metrics timing routes through
+here too (weedlint's raw-histogram-timer rule enforces it), so
+latency telemetry elapses in virtual time under the sim.  Only span
+wall-timestamps (absolute epochs that leave the process) keep
+``time.time`` with an inline suppression.
 """
 
 from __future__ import annotations

@@ -20,7 +20,31 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
 
 from seaweedfs_tpu.qos import classes as qos_classes
-from seaweedfs_tpu.utils import glog, resilience, tracing
+from seaweedfs_tpu.utils import clockctl, glog, resilience, tracing
+
+# route-family derivation for the RED histogram: a closed, low-
+# cardinality set so (server, route_family, class, status_family)
+# never explodes. Needle fids ("/3,0101f2") collapse to one family;
+# anything not in the control-plane set is user namespace ("fs" —
+# filer paths, S3 objects, DAV trees).
+_NEEDLE_RE = re.compile(r"^/\d+,")
+_CONTROL_FAMILIES = frozenset((
+    "dir", "vol", "col", "cluster", "admin", "metrics", "status",
+    "debug", "ui", "heartbeat", "raft", "scrub", "ec", "delete",
+    "batch"))
+
+
+def route_family(path: str) -> str:
+    if not path or path == "/":
+        return "root"
+    if _NEEDLE_RE.match(path):
+        return "needle"
+    seg = path.split("/", 2)[1]
+    if seg == "__api":
+        return "api"
+    if seg in _CONTROL_FAMILIES:
+        return seg
+    return "fs"
 
 
 class Request:
@@ -155,6 +179,11 @@ class HttpServer:
         # and records it into the node's flight recorder. None -> the
         # shared NOOP span, zero allocation.
         self.tracer = None
+        # metrics.RedRecorder wired by the owning server: ONE
+        # observation site covers every edge's rate/errors/duration,
+        # including requests the gates shed. None -> one attribute
+        # check per request.
+        self.red = None
         # graceful-drain state: once draining, new requests (including
         # ones arriving on kept-alive connections) are answered 503 +
         # Connection: close while in-flight requests run to completion;
@@ -301,6 +330,24 @@ class HttpServer:
                     tracing.detach(tok)
 
             def _dispatch_inner(self, path, length, span):
+                # RED edge observation brackets EVERYTHING — admission
+                # sheds, gate rejects, 404s, handler 500s — so the
+                # duration histogram is the true edge view. clockctl
+                # timing: under the sim's virtual clock the same
+                # histograms elapse in virtual seconds.
+                t_red = clockctl.monotonic()
+                red = server.red
+
+                def red_observe(status):
+                    if red is None:
+                        return
+                    cls = qos_classes.from_headers(self.headers) \
+                        or qos_classes.classify(self.command, path)
+                    red.observe(route_family(path), cls, status,
+                                clockctl.monotonic() - t_red,
+                                exemplar=span.trace_id
+                                if span.sampled else None)
+
                 release = None
                 agate = server.admission_gate
                 if agate is not None:
@@ -308,13 +355,14 @@ class HttpServer:
                                     self.client_address[0])
                     if isinstance(verdict, Response):
                         self._reject(verdict, length)
+                        red_observe(verdict.status)
                         span.finish(status=verdict.status)
                         return
                     release = verdict
                 on_sent = None
                 resp = None
                 out_status = 500
-                t0 = time.perf_counter()
+                t0 = clockctl.monotonic()
                 try:
                     gate = server.body_gate
                     if gate is not None and length and \
@@ -353,7 +401,7 @@ class HttpServer:
                     glog.vlog(2, "%s %s %d %dB %.1fms",
                               self.command, self.path, resp.status,
                               len(resp.body),
-                              (time.perf_counter() - t0) * 1e3)
+                              (clockctl.monotonic() - t0) * 1e3)
                 finally:
                     if on_sent is not None:
                         on_sent()
@@ -362,6 +410,7 @@ class HttpServer:
                         cb()
                     if release is not None:
                         release()
+                    red_observe(out_status)
                     span.finish(status=out_status)
 
             def _send(self, resp):
@@ -445,12 +494,12 @@ class HttpServer:
         self.draining = True
         if self._httpd:
             self._httpd.shutdown()
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = clockctl.monotonic() + timeout
+        while clockctl.monotonic() < deadline:
             with self._inflight_lock:
                 if self._inflight == 0:
                     return True
-            time.sleep(0.02)
+            clockctl.sleep(0.02)
         with self._inflight_lock:
             return self._inflight == 0
 

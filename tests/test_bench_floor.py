@@ -224,3 +224,16 @@ def test_repair_network_floor():
     # the legacy comparator on the SAME layout pays several widths;
     # if the chain stops pre-reducing, this gap collapses
     assert out["repair_network_bytes_per_mb_legacy"] >= 2 * per_mb, out
+
+
+def test_telemetry_overhead_floor():
+    """The always-on telemetry plane (RED histogram observe + hot-key
+    sketch offer per request) must stay within noise of the
+    instrumentation-free read path. Measured ~0-4% on the shared dev
+    core (PERF.md round 13); the floor fails only a catastrophic
+    regression (a lock convoy or per-request allocation storm), not
+    scheduler jitter."""
+    import bench
+
+    out = bench.bench_telemetry_overhead(n_reads=400)
+    assert out["telemetry_on_rps"] > 0.7 * out["telemetry_off_rps"], out

@@ -1,0 +1,344 @@
+"""The cluster telemetry plane (PR 11): mergeable RED histograms with
+trace exemplars, Space-Saving hot-key sketches, the master's
+/cluster/telemetry rollup, and SLO burn-rate alerting.
+
+Four layers:
+
+1. sketch units — Space-Saving error bounds (est - err <= true <= est,
+   err <= N/capacity) and merge commutativity on adversarial streams;
+2. histogram units — snapshot/merge_from is exact elementwise
+   addition, quantiles come from the merged counts, exemplars survive
+   the merge and the OpenMetrics text round-trip;
+3. SLO units — a synthetic bad-fraction feed trips fast_burn at the
+   modeled instant and resolves after the window drains;
+4. e2e — a real master + 2 volume servers + filer: hot traffic, then
+   /cluster/telemetry must report merged per-class p50/p99, the hot
+   path as top-k, and a resolvable trace exemplar
+   (tools/trace_collect.py --exemplar); tools/slo_report.py exits 0
+   on the healthy fleet.
+"""
+
+import json
+import random
+import time
+
+import pytest
+
+from seaweedfs_tpu.stats.slo import FAST_BURN, OK, SloEvaluator
+from seaweedfs_tpu.utils.metrics import (RED_BUCKETS, Histogram,
+                                         RedRecorder, Registry)
+from seaweedfs_tpu.utils.sketch import SpaceSaving
+
+# ----------------------------------------------- Space-Saving sketch
+
+
+def _zipf_stream(n: int, n_keys: int, seed: int) -> list:
+    rng = random.Random(seed)
+    return [f"k{min(int(rng.paretovariate(1.1)), n_keys - 1)}"
+            for _ in range(n)]
+
+
+def test_space_saving_error_bounds():
+    """The Metwally guarantees on a skewed stream 50x the capacity:
+    every tracked estimate brackets the true count and the error never
+    exceeds N/capacity."""
+    cap = 16
+    stream = _zipf_stream(4000, 400, seed=7)
+    truth: dict = {}
+    for k in stream:
+        truth[k] = truth.get(k, 0) + 1
+    sk = SpaceSaving(capacity=cap)
+    for k in stream:
+        sk.offer(k)
+    assert sk.total == len(stream)
+    bound = len(stream) / cap
+    for key, est, err in sk.top():
+        true = truth.get(key, 0)
+        assert est - err <= true <= est, \
+            f"{key}: true {true} outside [{est - err}, {est}]"
+        assert err <= bound, f"{key}: error {err} > N/capacity {bound}"
+    # every key heavier than N/capacity must be tracked
+    tracked = {k for k, _, _ in sk.top()}
+    for key, true in truth.items():
+        if true > bound:
+            assert key in tracked, \
+                f"heavy hitter {key} ({true} > {bound}) evicted"
+
+
+def test_space_saving_merge_commutes_and_bounds():
+    """A merge B and B merge A rank identically (deterministic
+    truncation), and the merged estimates stay upper bounds of the
+    combined true counts."""
+    s1 = _zipf_stream(3000, 300, seed=1)
+    s2 = _zipf_stream(3000, 300, seed=2)
+    truth: dict = {}
+    for k in s1 + s2:
+        truth[k] = truth.get(k, 0) + 1
+
+    def build(stream):
+        sk = SpaceSaving(capacity=24)
+        for k in stream:
+            sk.offer(k)
+        return sk
+
+    ab = build(s1)
+    ab.merge_from(build(s2).snapshot())
+    ba = build(s2)
+    ba.merge_from(build(s1).snapshot())
+    assert ab.top() == ba.top(), "merge is not commutative"
+    assert ab.total == len(s1) + len(s2)
+    for key, est, _err in ab.top():
+        assert truth.get(key, 0) <= est, \
+            f"{key}: merged estimate {est} under true {truth[key]}"
+
+
+def test_space_saving_snapshot_roundtrip():
+    sk = SpaceSaving(capacity=8)
+    for k in _zipf_stream(500, 50, seed=3):
+        sk.offer(k)
+    clone = SpaceSaving.from_snapshot(sk.snapshot())
+    assert clone.top() == sk.top()
+    assert clone.total == sk.total
+
+
+# ------------------------------------------- mergeable RED histogram
+
+
+def test_histogram_merge_is_exact_and_quantiles_follow():
+    """Two nodes' disjoint observations merged = one node observing
+    everything: identical bucket counts, sums, and quantiles."""
+    def h():
+        return Histogram("t_seconds", "t", label_names=("class",),
+                         buckets=RED_BUCKETS)
+
+    a, b, both = h(), h(), h()
+    for i in range(200):
+        v = 0.002 + (i % 10) * 0.01
+        a.observe(v, "interactive")
+        both.observe(v, "interactive")
+    for i in range(100):
+        v = 0.3 + (i % 5) * 0.1
+        b.observe(v, "interactive")
+        both.observe(v, "interactive")
+    merged = h()
+    merged.merge_from(a.snapshot())
+    merged.merge_from(b.snapshot())
+    ms_, bs_ = merged.snapshot()["series"], both.snapshot()["series"]
+    assert [(s[0], s[1]) for s in ms_] == [(s[0], s[1]) for s in bs_]
+    for m, o in zip(ms_, bs_):  # sums differ only by addition order
+        assert m[2] == pytest.approx(o[2])
+    for q in (0.5, 0.9, 0.99):
+        assert merged.quantile(q) == both.quantile(q)
+    # disjoint value ranges: the merged p50 sits in a's range and the
+    # p99 in b's tail
+    assert merged.quantile(0.5) < 0.1 < merged.quantile(0.99)
+
+
+def test_histogram_exemplars_survive_merge_and_exposition():
+    reg = Registry(namespace="TT")
+    red = RedRecorder(reg, "volume")
+    red.observe("needle", "interactive", 200, 0.003, exemplar="aaaa01")
+    red.observe("needle", "interactive", 200, 0.7, exemplar="bbbb02")
+
+    other = Histogram("x", "x", label_names=red.hist.label_names,
+                      buckets=RED_BUCKETS)
+    other.merge_from(red.snapshot())
+    got = other.exemplar_for("volume", "needle", "interactive", "2xx")
+    assert ("1.0", "bbbb02") in got  # 0.7 lands in the 1.0 bucket
+    assert any(tid == "aaaa01" for _le, tid in got)
+
+    # OpenMetrics text: the suffix parses and the last token is still
+    # a float (scrapers that ignore exemplars keep working)
+    text = reg.expose_text()
+    lines = [ln for ln in text.splitlines() if "trace_id=" in ln]
+    assert lines, "no exemplar suffix in exposition"
+    for ln in lines:
+        assert '# {trace_id="' in ln
+        float(ln.rsplit(" ", 1)[1])
+
+
+# --------------------------------------------- SLO burn-rate states
+
+
+def test_slo_trips_fast_burn_and_resolves():
+    """Cumulative feed at 1Hz: healthy -> 30%-bad cliff trips
+    fast_burn (30% of traffic bad vs a 1% budget = burn 30 >= 10),
+    then a healed window drains back to ok."""
+    transitions = []
+    ev = SloEvaluator(
+        objectives={"interactive": {"latency_s": 0.05, "goal": 0.99}},
+        fast_window_s=6.0, slow_window_s=15.0,
+        on_transition=lambda t, cls, old, new, d:
+            transitions.append((t, cls, old, new)))
+    total = bad = 0
+    t = 0.0
+    for _ in range(10):  # healthy
+        t += 1.0
+        total += 100
+        ev.feed(t, "interactive", total, bad)
+        ev.evaluate(t)
+    assert ev.state("interactive") == OK
+    for _ in range(6):  # cliff: 30% bad
+        t += 1.0
+        total += 100
+        bad += 30
+        ev.feed(t, "interactive", total, bad)
+        ev.evaluate(t)
+    assert ev.state("interactive") == FAST_BURN
+    assert ev.firing() == ["interactive"]
+    for _ in range(20):  # healed; both windows drain
+        t += 1.0
+        total += 100
+        ev.feed(t, "interactive", total, bad)
+        ev.evaluate(t)
+    assert ev.state("interactive") == OK
+    assert not ev.firing()
+    # the escalation path may pass through slow_burn on the way up
+    # (the slow window dilutes less traffic, so it can cross its 2x
+    # threshold a tick before the fast window crosses 10x)
+    assert any(new == FAST_BURN for _t, _c, _old, new in transitions)
+    assert transitions[-1][3] == OK
+
+
+def test_slo_tolerates_counter_reset():
+    """A node restart shrinking the merged totals must not produce a
+    negative delta (phantom burn or crash)."""
+    ev = SloEvaluator(fast_window_s=6.0, slow_window_s=15.0)
+    ev.feed(1.0, "write", 1000, 10)
+    ev.feed(2.0, "write", 1100, 12)
+    ev.feed(3.0, "write", 200, 1)  # reset: totals went backwards
+    view = ev.evaluate(3.0)
+    assert view["write"]["fast_burn"] >= 0.0
+    assert ev.state("write") == OK
+
+
+def test_slo_burn_zero_without_traffic():
+    ev = SloEvaluator(fast_window_s=6.0, slow_window_s=15.0)
+    ev.feed(1.0, "background", 50, 50)
+    ev.feed(10.0, "background", 50, 50)  # no new traffic
+    view = ev.evaluate(10.0)
+    assert view["background"]["fast_burn"] == 0.0
+
+
+# ----------------------------------------- sim: deterministic alerts
+
+
+def test_sim_az_loss_slo_timeline_is_reproducible():
+    """The az_loss incident's alert timeline is part of the report and
+    bit-identical across same-seed runs; the incident's own
+    slo_fast_burn_fired / slo_resolved_after_heal invariants hold at
+    the 16-actor tier-1 scale."""
+    from seaweedfs_tpu.sim.incidents import run_incident
+    a = run_incident("az_loss", seed=3, n_actors=16)
+    assert a["passed"], [c for c in a["invariants"] if not c["ok"]]
+    tl = a["slo"]["timeline"]
+    assert any(cls == "interactive" and new == "fast_burn"
+               for _t, cls, _old, new in tl), tl
+    assert not a["slo"]["firing"]
+    b = run_incident("az_loss", seed=3, n_actors=16)
+    assert b["slo"]["timeline"] == tl
+    assert b["log_hash"] == a["log_hash"]
+
+
+# ------------------------------------------------- 3-node end-to-end
+
+
+@pytest.fixture
+def telemetry_stack(tmp_path):
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    ms = MasterServer(volume_size_limit_mb=64, trace_sample=1.0)
+    ms.start()
+    vs1 = VolumeServer([str(tmp_path / "v1")], ms.url, trace_sample=1.0)
+    vs1.start()
+    vs2 = VolumeServer([str(tmp_path / "v2")], ms.url, trace_sample=1.0)
+    vs2.start()
+    time.sleep(0.3)
+    fs = FilerServer(ms.url, default_replication="001", trace_sample=1.0)
+    fs.start()
+    yield ms, vs1, vs2, fs
+    fs.stop()
+    vs2.stop()
+    vs1.stop()
+    ms.stop()
+
+
+def test_cluster_telemetry_merges_three_nodes(telemetry_stack, tmp_path):
+    from seaweedfs_tpu.utils.httpd import http_call, http_json
+    ms, vs1, vs2, fs = telemetry_stack
+
+    payload = b"\x5a" * 4096
+    for i in range(12):
+        status, _, _ = http_call(
+            "POST", f"http://{fs.url}/hot/file{i % 3}", body=payload)
+        assert status == 201
+    for _ in range(40):
+        status, body, _ = http_call("GET", f"http://{fs.url}/hot/file0")
+        assert status == 200 and body == payload
+    # a couple of cold paths so top-k has something to beat
+    for i in range(3):
+        http_call("GET", f"http://{fs.url}/cold/file{i}")
+
+    time.sleep(2.5)  # one heartbeat cycle piggybacks volume snapshots
+
+    tel = http_json("GET", f"http://{ms.url}/cluster/telemetry")
+
+    # merged RED: every class that saw traffic reports sane quantiles
+    per_class = tel["per_class"]
+    assert per_class, "no classes in merged telemetry"
+    reads = per_class.get("interactive") or per_class.get("none")
+    assert reads and reads["count"] >= 40
+    assert 0.0 < reads["p50"] <= reads["p99"] <= 10.0
+    assert reads["slo"]["state"] == "ok"
+
+    # the hot path dominates the cluster top-k in the path dimension
+    top_paths = [(e["key"], e["count"])
+                 for e in tel["top_keys"].get("path", [])]
+    assert top_paths and top_paths[0][0] == "/hot/file0", top_paths
+    assert top_paths[0][1] >= 40
+    # the filer (pulled via /cluster/register metrics_url) and both
+    # volume servers (heartbeat piggyback) all contributed
+    assert fs.url in tel["nodes"]
+    assert vs1.url in tel["nodes"] and vs2.url in tel["nodes"]
+    assert not tel["alerts_firing"]
+
+    # >=1 exemplar, resolvable to a stitched trace in one command
+    exemplars = [ex for view in per_class.values()
+                 for ex in view["exemplars"]]
+    assert exemplars, "no trace exemplars in merged histogram"
+    from tools import trace_collect
+    out = tmp_path / "exemplar_trace.json"
+    rc = trace_collect.main(["--master", ms.url, "--exemplar", "any",
+                             "--node", fs.metrics_url,
+                             "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"], "exemplar stitched to an empty trace"
+
+    # the CI gate: healthy fleet -> slo_report exits 0
+    from tools import slo_report
+    assert slo_report.main(["--master", ms.url]) == 0
+    report = slo_report.render(tel)
+    assert "interactive" in report or "none" in report
+
+
+def test_volume_hotkeys_endpoint(telemetry_stack):
+    """/admin/hotkeys on a volume server ranks the hottest needle."""
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.utils.httpd import http_json
+    ms, vs1, vs2, fs = telemetry_stack
+    mc = MasterClient(ms.url)
+    try:
+        fid = operation.upload_data(mc, b"hot" * 100, name="h").fid
+        for _ in range(25):
+            operation.read_data(mc, fid)
+    finally:
+        mc.stop()
+    ranked = []
+    for vs in (vs1, vs2):
+        snap = http_json("GET", f"http://{vs.url}/admin/hotkeys")
+        ranked += snap["hotkeys"].get("needle", [])
+    assert ranked, "no needle dimension in /admin/hotkeys"
+    assert max(e["count"] for e in ranked) >= 25

@@ -167,13 +167,16 @@ def test_plan_chain_prefers_calm_holders():
 
 def test_metrics_expose_races_writers():
     """Counter.inc / Histogram.observe hammered from threads while
-    expose_text scrapes concurrently: every exposition parses, counter
-    totals only go up, and the final totals are exact."""
+    expose_text scrapes AND merge_from folds in remote snapshots
+    concurrently (the telemetry-plane hot path): every exposition
+    parses, counter totals only go up, and the final totals are
+    exact."""
     from seaweedfs_tpu.utils.metrics import Registry
     reg = Registry(namespace="TST")
     ctr = reg.counter("race", "ops_total", "ops", labels=("kind",))
     hist = reg.histogram("race", "lat_seconds", "lat", labels=("kind",))
     n_writers, per = 4, 2000
+    n_merges, donor_n = 50, 3
     errors = []
 
     def writer(i):
@@ -184,6 +187,21 @@ def test_metrics_expose_races_writers():
         except Exception as e:  # pragma: no cover - the failure mode
             errors.append(e)
 
+    # a "remote node" snapshot folded in over and over, as the master
+    # does with every heartbeat-piggybacked RED snapshot
+    donor = Registry(namespace="TST").histogram(
+        "race", "lat_seconds", "lat", labels=("kind",))
+    for j in range(donor_n):
+        donor.observe(j * 1e-3, "k0", exemplar=f"trace{j}")
+    donor_snap = donor.snapshot()
+
+    def merger():
+        try:
+            for _ in range(n_merges):
+                hist.merge_from(donor_snap)
+        except Exception as e:  # pragma: no cover - the failure mode
+            errors.append(e)
+
     def total_of(text):
         return sum(float(line.rsplit(" ", 1)[1])
                    for line in text.splitlines()
@@ -191,6 +209,7 @@ def test_metrics_expose_races_writers():
 
     threads = [threading.Thread(target=writer, args=(i,))
                for i in range(n_writers)]
+    threads.append(threading.Thread(target=merger))
     for t in threads:
         t.start()
     last = 0.0
@@ -211,7 +230,10 @@ def test_metrics_expose_races_writers():
     hist_counts = sum(float(line.rsplit(" ", 1)[1])
                       for line in final.splitlines()
                       if line.startswith("TST_race_lat_seconds_count"))
-    assert hist_counts == n_writers * per
+    assert hist_counts == n_writers * per + n_merges * donor_n
+    # the merged-in exemplars survived and the suffix still parses
+    # (the scrape loop above float()s the last token of every line)
+    assert 'trace_id="trace' in final
 
 
 # ---- end-to-end: one S3 PUT, one stitched trace ----

@@ -40,6 +40,12 @@ CASES = {
         "clean": ("from seaweedfs_tpu.utils import clockctl\n\n"
                   "def f():\n    return clockctl.monotonic()\n"),
     },
+    "raw-histogram-timer": {
+        "bad": ("import time\n\ndef f():\n"
+                "    return time.perf_counter()\n"),
+        "clean": ("from seaweedfs_tpu.utils import clockctl\n\n"
+                  "def f():\n    return clockctl.monotonic()\n"),
+    },
     "raw-http": {
         "bad": ("import urllib.request\n\ndef f(url):\n"
                 "    return urllib.request.urlopen(url).read()\n"),
@@ -175,6 +181,21 @@ def test_rule_home_files_are_exempt():
     assert "raw-device-discovery" not in rules_of(
         "import jax\nd = jax.devices()\n",
         path="seaweedfs_tpu/parallel/mesh.py")
+
+
+def test_raw_histogram_timer_scoped_to_package():
+    """perf_counter is only a violation inside seaweedfs_tpu/ — bench
+    drivers in tools/ measure wall time on purpose — and clockctl.py
+    itself (the sanctioned home) is exempt."""
+    src = "import time\n\ndef f():\n    return time.perf_counter()\n"
+    assert "raw-histogram-timer" in rules_of(src)
+    assert "raw-histogram-timer" not in rules_of(
+        src, path="tools/bench_thing.py")
+    assert "raw-histogram-timer" not in rules_of(
+        src, path="seaweedfs_tpu/utils/clockctl.py")
+    assert "raw-histogram-timer" in rules_of(
+        "from time import perf_counter as pc\n\ndef f():\n"
+        "    return pc()\n")
 
 
 def test_raw_device_discovery_catches_aliased_imports():

@@ -1,0 +1,79 @@
+"""Per-class SLO report against a running cluster: one table from the
+master's /cluster/telemetry rollup, one exit code for CI.
+
+For every traffic class the master has merged RED data for, prints the
+objective (latency target + availability goal), the observed request
+count / error rate / p50 / p99, the fast- and slow-window burn rates,
+and the alert state. Exits nonzero when any class's burn-rate alert is
+firing — so a chaos drill or deploy pipeline can gate on "the fleet's
+SLOs are healthy" with one command:
+
+  PYTHONPATH=. python tools/slo_report.py --master 127.0.0.1:9333
+  PYTHONPATH=. python tools/slo_report.py --master 127.0.0.1:9333 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from seaweedfs_tpu.utils.httpd import http_json  # noqa: E402
+
+
+def fetch(master: str, peers: bool = True) -> dict:
+    qs = "" if peers else "?peers=false"
+    return http_json("GET", f"http://{master}/cluster/telemetry{qs}",
+                     timeout=10.0)
+
+
+def render(tel: dict) -> str:
+    rows = [f"{'CLASS':<12} {'N':>8} {'ERR%':>6} {'P50ms':>8} "
+            f"{'P99ms':>8} {'TARGETms':>9} {'GOAL':>6} {'FAST':>7} "
+            f"{'SLOW':>7}  STATE"]
+    for cls, view in sorted(tel.get("per_class", {}).items()):
+        slo = view.get("slo") or {}
+        obj = slo.get("objective") or {}
+        p50 = view.get("p50")
+        p99 = view.get("p99")
+        rows.append(
+            f"{cls:<12} {view.get('count', 0):>8} "
+            f"{100.0 * view.get('error_rate', 0.0):>6.2f} "
+            f"{(p50 or 0.0) * 1000:>8.1f} {(p99 or 0.0) * 1000:>8.1f} "
+            f"{obj.get('latency_s', 0.0) * 1000:>9.0f} "
+            f"{obj.get('goal', 0.0):>6.3f} "
+            f"{slo.get('fast_burn', 0.0):>7.2f} "
+            f"{slo.get('slow_burn', 0.0):>7.2f}  "
+            f"{slo.get('state', 'ok')}")
+    firing = tel.get("alerts_firing", [])
+    rows.append(f"alerts firing: {firing if firing else 'none'}")
+    for u in tel.get("unreachable", []):
+        rows.append(f"# unreachable {u.get('node')}: {u.get('error')}")
+    return "\n".join(rows)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-class SLO table from /cluster/telemetry; "
+                    "exit 1 while any burn-rate alert is firing")
+    ap.add_argument("--master", required=True, help="master HOST:PORT")
+    ap.add_argument("--no-peers", action="store_true",
+                    help="heartbeat-held snapshots only (skip pulling "
+                         "filer/S3 metrics listeners)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw telemetry document")
+    args = ap.parse_args(argv)
+
+    tel = fetch(args.master, peers=not args.no_peers)
+    if args.json:
+        print(json.dumps(tel, indent=2, sort_keys=True))
+    else:
+        print(render(tel))
+    return 1 if tel.get("alerts_firing") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,10 +19,18 @@ gateways on their metrics port) or are discovered from a master via
 `--master HOST:PORT` (the master itself + every volume node; filer /
 gateway metrics ports are not in the topology, add them with --node).
 
+`--exemplar CLASS` closes the metrics->traces loop: it asks the
+master's /cluster/telemetry for the RED histogram's per-bucket trace
+exemplars, picks the slowest bucket's trace id for that SLO class
+('any' = slowest overall), and stitches that trace — p99 spike to
+flamegraph in one command.
+
 Usage:
   PYTHONPATH=. python tools/trace_collect.py --master 127.0.0.1:9333
   PYTHONPATH=. python tools/trace_collect.py --node 127.0.0.1:8080 \
       --trace 5e0c0ffee5e0c0ff --out /tmp/trace.json
+  PYTHONPATH=. python tools/trace_collect.py --master 127.0.0.1:9333 \
+      --exemplar interactive --out /tmp/slow.json
 """
 
 from __future__ import annotations
@@ -129,6 +137,32 @@ def to_chrome_trace(spans: list) -> dict:
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+def resolve_exemplar(master: str, cls: str) -> tuple[str, dict]:
+    """Map an SLO class to the trace id its merged RED histogram
+    remembers: the cluster telemetry rollup keeps, per latency bucket,
+    the last sampled X-Weed-Trace id that landed there (OpenMetrics
+    exemplars). Returns (trace_id, context) with the slowest bucket's
+    exemplar — the request an operator staring at a p99 regression
+    wants stitched. cls='any' takes the slowest across all classes."""
+    tel = http_json("GET", f"http://{master}/cluster/telemetry",
+                    timeout=5.0)
+    best: tuple = ()
+    for c, view in sorted(tel.get("per_class", {}).items()):
+        if cls not in ("any", c):
+            continue
+        for ex in view.get("exemplars", []):
+            if ex.get("trace_id"):
+                key = (float("inf") if ex["le"] == "+Inf"
+                       else float(ex["le"]))
+                if not best or key > best[0]:
+                    best = (key, ex["trace_id"],
+                            {"class": c, "le": ex["le"],
+                             "p99": view.get("p99")})
+    if not best:
+        return "", {}
+    return best[1], best[2]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="collect /debug/traces and stitch traces")
@@ -138,6 +172,11 @@ def main(argv=None) -> int:
                     help="explicit HOST:PORT (repeatable)")
     ap.add_argument("--trace", default="",
                     help="stitch this trace id (else: list recent)")
+    ap.add_argument("--exemplar", default="",
+                    help="resolve a trace id from the cluster RED "
+                         "histogram's exemplars for this SLO class "
+                         "('any' = slowest overall) and stitch it; "
+                         "requires --master")
     ap.add_argument("--min-ms", type=float, default=0.0,
                     help="only spans at least this slow")
     ap.add_argument("--limit", type=int, default=512,
@@ -154,6 +193,20 @@ def main(argv=None) -> int:
                   if n not in nodes]
     if not nodes:
         ap.error("no targets: pass --master and/or --node")
+
+    if args.exemplar:
+        if not args.master:
+            ap.error("--exemplar needs --master (it reads "
+                     "/cluster/telemetry)")
+        trace_id, ctx = resolve_exemplar(args.master, args.exemplar)
+        if not trace_id:
+            print(f"no exemplar recorded for class "
+                  f"{args.exemplar!r} yet", file=sys.stderr)
+            return 1
+        print(f"# exemplar: trace {trace_id} "
+              f"(class={ctx['class']} le={ctx['le']}s "
+              f"p99={ctx['p99']})", file=sys.stderr)
+        args.trace = trace_id
 
     spans, unreachable = collect(nodes, trace_id=args.trace,
                                  min_ms=args.min_ms, limit=args.limit)

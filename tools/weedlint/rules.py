@@ -1,4 +1,4 @@
-"""The weedlint rule set: one AST pass, nine invariants.
+"""The weedlint rule set: one AST pass, ten invariants.
 
 Every rule encodes a contract the cluster depends on ambiently — the
 kind that breaks silently at a single call site and only surfaces as a
@@ -12,6 +12,15 @@ raw-clock
     the sim and elapses in wall time mid-simulation.  Measurement-only
     wall-clock reads (bench timing, log timestamps) are legitimate —
     suppress them inline with a justification.
+
+raw-histogram-timer
+    ``time.perf_counter()`` inside ``seaweedfs_tpu/``.  Latency that
+    feeds a histogram (or any derived rate) must be measured with
+    ``clockctl.monotonic()`` — or ``metrics.Histogram.time()``, which
+    wraps it — so virtual-clock sims and frozen-clock tests observe the
+    same durations the telemetry plane reports.  A perf_counter site
+    produces wall-time samples that diverge from every other timer in
+    the process.  Tools outside the package (bench drivers) are exempt.
 
 raw-http
     ``urllib.request.urlopen/Request`` or ``http.client.HTTP(S)
@@ -82,6 +91,8 @@ from typing import Optional
 
 RULES: dict[str, str] = {
     "raw-clock": "time.time/monotonic/sleep outside utils/clockctl.py",
+    "raw-histogram-timer":
+        "time.perf_counter in seaweedfs_tpu/ — time via clockctl",
     "raw-http": "urllib/http.client request outside utils/httpd.py",
     "lock-across-blocking": "with <lock>: body calls blocking I/O",
     "swallowed-exit": "generator handler can swallow GeneratorExit",
@@ -98,6 +109,7 @@ RULES: dict[str, str] = {
 # files that ARE the sanctioned implementation of a contract
 _RULE_HOME = {
     "raw-clock": "utils/clockctl.py",
+    "raw-histogram-timer": "utils/clockctl.py",
     "raw-http": "utils/httpd.py",
     "header-literal": "utils/headers.py",
     "raw-device-discovery": "parallel/mesh.py",
@@ -323,6 +335,13 @@ class Checker(ast.NodeVisitor):
             self.report(node, "raw-clock",
                         f"raw time.{what}() — use clockctl.{'monotonic' if what == 'monotonic' else ('sleep' if what == 'sleep' else 'now')}() so "
                         "virtual-clock sims reach this timer")
+        if canonical == "time.perf_counter" and \
+                self.rel.startswith("seaweedfs_tpu/"):
+            self.report(node, "raw-histogram-timer",
+                        "raw time.perf_counter() — histogram/latency "
+                        "timing must use clockctl.monotonic() (or "
+                        "metrics.Histogram.time()) so sims and tests "
+                        "see the same clock the telemetry plane reports")
         if canonical in _DEVICE_CALLS:
             self.report(node, "raw-device-discovery",
                         f"raw {canonical}() — route through "
