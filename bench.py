@@ -334,6 +334,13 @@ def bench_degraded_read(n_reads: int = 30,
                 adaptive hedging cut the tail to the hedge delay once,
                 then to the fast peer's latency.
 
+    A third mode then re-enables the hot-needle cache (it is held out
+    of the first two — a repeat read of one needle would otherwise be
+    a memory hit and hide the network path being compared): one cold
+    read warms the cache with the reconstructed record, and warm reads
+    measure the cache-hit path end to end, asserting bit-identity
+    against the original bytes on every sample.
+
     SEAWEEDFS_TPU_BENCH_DEGRADED_READS overrides n_reads."""
     import tempfile
 
@@ -396,6 +403,11 @@ def bench_degraded_read(n_reads: int = 30,
                   {"volume_id": vid, "shard_ids": [sid]})
         time.sleep(0.2)  # let heartbeats register the new holders
 
+        # hold the hot-needle cache out of the baseline/hedged modes:
+        # they compare network paths, not cache hits
+        needle_cache = vs1.store.needle_cache
+        vs1.store.needle_cache = None
+
         def measure() -> list:
             # fresh health + location state per mode: the comparison
             # must not inherit the other mode's learned rankings
@@ -429,6 +441,20 @@ def bench_degraded_read(n_reads: int = 30,
             http_call("GET", f"http://{vs1.url}/{fid}", timeout=30)
             breakdown = _stage_breakdown(
                 (vs1.tracer, vs2.tracer, vs3.tracer), t_mark)
+            for node in (vs1, vs2, vs3):
+                node.tracer.sample_rate = 0.01
+            # warm-cache mode: the reconstructed record is admitted on
+            # the first (cold) read, then every read is a memory hit —
+            # no shard hop, no decode. measure() keeps asserting
+            # body == data, so bit-identity of cached reads is checked
+            # on every sample.
+            vs1.store.needle_cache = needle_cache
+            http_call("GET", f"http://{vs1.url}/{fid}", timeout=30)
+            warm = measure()
+            cst = needle_cache.stats() if needle_cache else {}
+            if needle_cache and cst["hits"] < n_reads:
+                raise RuntimeError(
+                    f"warm phase expected cache hits, got {cst}")
         finally:
             mc.stop()
             for vs in (vs3, vs2, vs1):
@@ -436,6 +462,7 @@ def bench_degraded_read(n_reads: int = 30,
             proxy.stop()
             master.stop()
     base_p99, hedged_p99 = _p99_ms(base), _p99_ms(hedged)
+    warm_p99 = _p99_ms(warm)
     return {
         "degraded_read_p99_ms": hedged_p99,
         "degraded_read_nohedge_p99_ms": base_p99,
@@ -444,6 +471,118 @@ def bench_degraded_read(n_reads: int = 30,
         "degraded_read_straggler_ms": straggler_ms,
         "degraded_read_n": n_reads,
         "degraded_read_stage_breakdown_ms": breakdown,
+        "hot_read_warm_p99_ms": warm_p99,
+        "hot_read_speedup_vs_hedged": round(
+            hedged_p99 / max(warm_p99, 0.001), 2),
+    }
+
+
+def bench_conn_hold(n_conns: int = 10000, n_probe: int = 200,
+                    baseline_conns: int = 100) -> dict:
+    """Edge connection-hold sweep: N idle keep-alive connections parked
+    on the selector while a probe connection keeps issuing requests.
+
+    Each connection sends one ping (the serving core parks a socket
+    after its first served request) and then sits idle. Reported:
+
+      thread growth   must stay ~(workers + selector), NOT one thread
+                      per connection — that is the point of the
+                      selector core;
+      RSS growth      per-connection memory, kernel buffers included;
+      probe p99       measured twice IN-RUN, at `baseline_conns` and at
+                      `n_conns` open sockets — idle parked connections
+                      must not tax the served path.
+
+    SEAWEEDFS_TPU_BENCH_CONNS overrides n_conns."""
+    import resource
+    import threading
+
+    from seaweedfs_tpu.utils.httpd import (HttpServer, RawHttpConnection,
+                                           Response)
+
+    n_conns = int(os.environ.get("SEAWEEDFS_TPU_BENCH_CONNS", n_conns))
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want = n_conns * 2 + 512  # client + server end of every socket
+    if soft < want:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(want, hard), hard))
+            soft = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+        except (ValueError, OSError):
+            pass
+        if soft < want:  # fd budget caps the sweep, scale it down
+            n_conns = max(baseline_conns + 16, (soft - 512) // 2)
+
+    workers = 8
+    srv = HttpServer(workers=workers, queue_depth=256)
+    srv.add("GET", "/ping", lambda req: Response({"ok": True}))
+    srv.start()
+
+    def rss_kb() -> int:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+        return 0
+
+    def open_idle(n: int, bag: list) -> None:
+        for _ in range(n):
+            c = RawHttpConnection(f"127.0.0.1:{srv.port}", 10.0)
+            c.send_request("GET", "/ping", None, None)
+            status, _b, _h, _close = c.read_response("GET")
+            if status != 200:
+                raise RuntimeError(f"conn setup ping: HTTP {status}")
+            bag.append(c)
+
+    def probe(n: int) -> list:
+        c = RawHttpConnection(f"127.0.0.1:{srv.port}", 10.0)
+        samples = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            c.send_request("GET", "/ping", None, None)
+            status, _b, _h, _close = c.read_response("GET")
+            samples.append(time.perf_counter() - t0)
+            if status != 200:
+                raise RuntimeError(f"probe: HTTP {status}")
+        c.close()
+        return samples
+
+    conns: list = []
+    try:
+        threads0 = threading.active_count()
+        rss0 = rss_kb()
+        open_idle(baseline_conns, conns)
+        p_base = probe(n_probe)
+        open_idle(n_conns - len(conns), conns)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:  # let the last park land
+            if srv.conn_stats()["parked"] >= n_conns:
+                break
+            time.sleep(0.05)
+        p_full = probe(n_probe)
+        st = srv.conn_stats()
+        thread_growth = threading.active_count() - threads0
+        rss_growth_kb = rss_kb() - rss0
+    finally:
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        srv.stop()
+    base_p99, full_p99 = _p99_ms(p_base), _p99_ms(p_full)
+    return {
+        "conn_hold_n": n_conns,
+        "conn_hold_parked": st["parked"],
+        "conn_hold_thread_growth": thread_growth,
+        "conn_hold_workers": workers,
+        "conn_hold_rss_growth_kb": rss_growth_kb,
+        "conn_hold_kb_per_conn": round(
+            rss_growth_kb / max(n_conns, 1), 2),
+        "conn_hold_probe_p99_ms_100": base_p99,
+        "conn_hold_probe_p99_ms_full": full_p99,
+        "conn_hold_probe_slowdown": round(
+            full_p99 / max(base_p99, 0.001), 2),
     }
 
 
@@ -988,7 +1127,8 @@ def main(argv=None):
     cpu = bench_cpu()  # measured first; never discarded
     e2e = bench_volume_encode()  # CPU-only, also never discarded
     e2e.update(bench_scrub())  # CPU-only integrity read path
-    e2e.update(bench_degraded_read())  # hedged EC read tail latency
+    e2e.update(bench_degraded_read())  # hedged EC read tail + hot cache
+    e2e.update(bench_conn_hold())  # 10k-conn selector edge hold
     e2e.update(bench_filer_put())  # parallel chunk-upload write path
     e2e.update(bench_replicated_write())  # concurrent replica fan-out
     e2e.update(bench_overload())  # QoS admission under overload

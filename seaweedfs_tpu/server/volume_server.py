@@ -86,7 +86,8 @@ class VolumeServer:
                  tracing_enabled: bool = True,
                  trace_sample: float = 0.01,
                  ec_batcher: bool = False,
-                 ec_batch_window_s: float = 0.005):
+                 ec_batch_window_s: float = 0.005,
+                 needle_cache_mb: int = 64):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
         the volume_server_pb gRPC admin plane (0 = ephemeral).
@@ -129,7 +130,12 @@ class VolumeServer:
         volumes' block-groups coalesce for ec_batch_window_s into one
         device-mesh dispatch, with a CPU drain when devices fail
         mid-run. Off (the default) keeps the per-volume coder path.
-        Ignored when an explicit `coder` is passed."""
+        Ignored when an explicit `coder` is passed.
+
+        needle_cache_mb byte-budgets the hot-needle record cache
+        (storage/needle_cache.py) fronting the healthy and degraded-EC
+        read paths; admission follows this server's HotKeys sketch and
+        0 disables the cache entirely."""
         urls = (master_url.split(",") if isinstance(master_url, str)
                 else list(master_url))
         self.master_urls = [u.strip() for u in urls if u.strip()]
@@ -152,6 +158,7 @@ class VolumeServer:
         self.grpc_port: Optional[int] = None
         self._public_url = public_url
         self.store: Optional[Store] = None
+        self.needle_cache = None  # NeedleCache, attached in start()
         self._stop = threading.Event()
         # graceful-drain announcement: rides every heartbeat so the
         # master stops assigning here and grants repair drain grace
@@ -215,6 +222,14 @@ class VolumeServer:
             "volumeServer", "ec_coder_fallbacks",
             "EC batcher mesh dispatch failures drained via CPU",
             ("reason",))
+        # hot-needle record cache + selector-core connection counters,
+        # refreshed at scrape from their owners' stats() snapshots
+        self._m_cache = self.metrics.gauge(
+            "volumeServer", "needle_cache",
+            "hot-needle cache counters", ("stat",))
+        self._m_conns = self.metrics.gauge(
+            "volumeServer", "http_connections",
+            "selector-core connection counters", ("stat",))
         self.metrics.on_expose(self._refresh_gauges)
         self.peer_health = PeerHealth(metrics=self.metrics)
         # admission control: class-weighted slots under an adaptive
@@ -222,6 +237,10 @@ class VolumeServer:
         # socket edge, before their body is buffered
         self.qos = QosGovernor(metrics=self.metrics, enabled=qos)
         self.http.admission_gate = self._admission_gate
+        # lets the selector core size its worker pool off the adaptive
+        # concurrency ceiling and quote governor pressure when shedding
+        self.http.governor = self.qos
+        self._needle_cache_mb = needle_cache_mb
         # distributed-tracing flight recorder; served at /debug/traces
         self.tracer = tracing.Tracer(
             node=f"volume@{host}:{port}", enabled=tracing_enabled,
@@ -266,6 +285,14 @@ class VolumeServer:
         self.store.shard_locations = self._shard_locations
         self.store.resilient_reads = self.resilient_reads
         self.store.remote_partial_reader = self._remote_partial_reader
+        if self._needle_cache_mb > 0:
+            from seaweedfs_tpu.storage.needle_cache import NeedleCache
+            sketch = self.hotkeys.sketches["needle"]
+            self.store.needle_cache = NeedleCache(
+                capacity_bytes=self._needle_cache_mb << 20,
+                hot_fn=lambda vid, nid: sketch.estimate(
+                    "%d,%x" % (vid, nid)))
+        self.needle_cache = self.store.needle_cache
         if self._tcp_port >= 0:
             from seaweedfs_tpu.server.volume_tcp import TcpDataServer
             self.tcp_server = TcpDataServer(self.store, self.http.host,
@@ -570,6 +597,9 @@ class VolumeServer:
         # hot-needle sketch + full telemetry snapshot (RED histogram)
         r("GET", "/admin/hotkeys", self.hotkeys.handler(self.url))
         r("GET", "/admin/telemetry", self._admin_telemetry)
+        # hot-needle record cache snapshot + runtime resize
+        r("GET", "/admin/cache", self._admin_cache)
+        r("POST", "/admin/cache", self._admin_cache_configure)
 
     def _admin_ec_batcher(self, req: Request) -> Response:
         if self.ec_batcher is None:
@@ -587,7 +617,7 @@ class VolumeServer:
     QOS_EXEMPT = ("/status", "/metrics", "/ui", "/debug",
                   "/admin/qos", "/admin/health", "/admin/scrub/status",
                   "/admin/ec/batcher", "/admin/hotkeys",
-                  "/admin/telemetry")
+                  "/admin/telemetry", "/admin/cache")
 
     def _admission_gate(self, method: str, path: str, headers, client):
         """HttpServer admission hook: classify (propagated header wins
@@ -613,6 +643,32 @@ class VolumeServer:
     def _admin_qos_configure(self, req: Request) -> Response:
         return Response({"url": self.url,
                          **self.qos.configure(**(req.json() or {}))})
+
+    def _admin_cache(self, req: Request) -> Response:
+        cache = self.store.needle_cache if self.store else None
+        if cache is None:
+            return Response({"url": self.url, "enabled": False,
+                             "connections": self.http.conn_stats()})
+        return Response({"url": self.url, "enabled": True,
+                         **cache.stats(),
+                         "connections": self.http.conn_stats()})
+
+    def _admin_cache_configure(self, req: Request) -> Response:
+        cache = self.store.needle_cache if self.store else None
+        if cache is None:
+            return Response({"error": "cache disabled"}, status=409)
+        b = req.json() or {}
+        out = cache.configure(
+            capacity_bytes=b.get("capacity_bytes"),
+            admit_min=b.get("admit_min"))
+        if b.get("clear"):
+            for loc in self.store.locations:
+                for vid in list(loc.volumes):
+                    cache.invalidate_volume(vid)
+                for vid in list(loc.ec_volumes):
+                    cache.invalidate_volume(vid)
+            out = cache.stats()
+        return Response({"url": self.url, "enabled": True, **out})
 
     def telemetry_snapshot(self) -> dict:
         return {"node": self.url, "server": "volume",
@@ -641,6 +697,14 @@ class VolumeServer:
                 self._m_disk_free.set(d, value=st.f_bavail * st.f_frsize)
             except OSError:
                 pass
+        cache = store.needle_cache
+        if cache is not None:
+            cs = cache.stats()
+            for stat in ("hits", "misses", "bytes", "evictions",
+                         "items", "rejects", "coalesced"):
+                self._m_cache.set(stat, value=cs[stat])
+        for stat, val in self.http.conn_stats().items():
+            self._m_conns.set(stat, value=val)
 
     def _handle_metrics(self, req: Request) -> Response:
         return Response(self.metrics.expose_text(),
@@ -1277,7 +1341,17 @@ class VolumeServer:
         garbage = v.garbage_level()
         if b.get("check_only"):
             return Response({"garbage_ratio": garbage})
-        v.compact()
+        cache = self.store.needle_cache
+        if cache is not None:
+            # vacuum rewrites offsets under the volume: strict drop,
+            # before AND after compaction (same race shape as
+            # Store.write_volume_needle's double invalidation)
+            cache.invalidate_volume(v.id)
+        try:
+            v.compact()
+        finally:
+            if cache is not None:
+                cache.invalidate_volume(v.id)
         return Response({"garbage_ratio": garbage, "compacted": True})
 
     def _admin_sync(self, req: Request) -> Response:
@@ -1429,6 +1503,10 @@ class VolumeServer:
             v.write_needle_blob(bytes.fromhex(b["blob"]), b["size"])
         except Exception as e:
             return Response({"error": str(e)}, status=409)
+        if self.store.needle_cache is not None:
+            # repair path lands raw records without surfacing the key:
+            # whole-volume drop keeps the cache strictly consistent
+            self.store.needle_cache.invalidate_volume(v.id)
         return Response({})
 
     def _admin_volume_file(self, req: Request) -> Response:
@@ -1582,7 +1660,15 @@ class VolumeServer:
         ev = self.store.find_ec_volume(b["volume_id"])
         if ev is None:
             return Response({"error": "ec volume not found"}, status=404)
-        ev.delete_needle(b["needle_id"])
+        if self.store.needle_cache is not None:
+            self.store.needle_cache.invalidate(
+                b["volume_id"], b["needle_id"])
+        try:
+            ev.delete_needle(b["needle_id"])
+        finally:
+            if self.store.needle_cache is not None:
+                self.store.needle_cache.invalidate(
+                    b["volume_id"], b["needle_id"])
         return Response({})
 
     def _ec_shard_read(self, req: Request) -> Response:
@@ -2084,7 +2170,11 @@ class VolumeServer:
         done = set()
         ev = self.store.find_ec_volume(vid)
         if ev is not None:
+            if self.store.needle_cache is not None:
+                self.store.needle_cache.invalidate(vid, key)
             ev.delete_needle(key)
+            if self.store.needle_cache is not None:
+                self.store.needle_cache.invalidate(vid, key)
             done.add(self.url)
             done.add(f"{self.http.host}:{self.http.port}")
         for entry in info.get("shards", []):

@@ -20,7 +20,8 @@ from seaweedfs_tpu.storage.erasure_coding import layout
 from seaweedfs_tpu.storage.erasure_coding.ec_volume import EcVolume
 from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.super_block import ReplicaPlacement, TTL
-from seaweedfs_tpu.storage.volume import DeletedError, NotFoundError, Volume
+from seaweedfs_tpu.storage.volume import (CookieMismatchError, DeletedError,
+                                          NotFoundError, Volume)
 
 # remote_shard_reader(vid, shard_id, offset, size) -> bytes | None
 RemoteShardReader = Callable[[int, int, int, int], Optional[bytes]]
@@ -71,6 +72,9 @@ class Store:
         # data shards are spread across peers by pulling pre-reduced
         # partial columns instead of k raw shard streams.
         self.remote_partial_reader = None
+        # Hot-needle record cache (storage/needle_cache.py), injected
+        # by the volume server; None keeps every read on the raw path.
+        self.needle_cache = None
         self._lock = threading.RLock()
         # delta channels to master (drained by the heartbeat loop)
         self.new_volumes: list[dict] = []
@@ -123,6 +127,8 @@ class Store:
                     info = self.volume_info(v)
                     loc.delete_volume(vid)
                     self.deleted_volumes.append(info)
+                    if self.needle_cache is not None:
+                        self.needle_cache.invalidate_volume(vid)
                     return True
             return False
 
@@ -139,6 +145,8 @@ class Store:
                     with loc._lock:
                         loc.volumes.pop(vid, None)
                     self.deleted_volumes.append(info)  # delta: gone here
+                    if self.needle_cache is not None:
+                        self.needle_cache.invalidate_volume(vid)
                     return True
             return False
 
@@ -236,7 +244,17 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
-        return v.write_needle(n)
+        if self.needle_cache is not None:
+            # overwrite: invalidate BEFORE (no cache hit serves the old
+            # generation while the write is landing) and again AFTER
+            # (a load that read the old bytes off disk mid-write holds
+            # a stale epoch and cannot be admitted)
+            self.needle_cache.invalidate(vid, n.id)
+        try:
+            return v.write_needle(n)
+        finally:
+            if self.needle_cache is not None:
+                self.needle_cache.invalidate(vid, n.id)
 
     def read_volume_needle(self, vid: int, needle_id: int,
                            cookie: Optional[int] = None) -> Needle:
@@ -247,14 +265,35 @@ class Store:
             # past-TTL data is gone to readers even before the removal
             # grace deletes the files (reference store read path)
             raise NotFoundError(f"volume {vid} expired")
-        return v.read_needle(needle_id, cookie)
+        cache = self.needle_cache
+        if cache is None:
+            return v.read_needle(needle_id, cookie)
+
+        def load():
+            blob, size = v.read_needle_blob(needle_id)
+            return blob, size, v.version, False
+
+        blob, size, version = cache.get_or_load(vid, needle_id, load)
+        # re-parse per hit: CRC re-checked, and handler-side mutation
+        # of n.data (gzip decompress, resize) can't touch the cache
+        n = Needle.from_bytes(blob, size, version)
+        if cookie is not None and n.cookie != cookie:
+            raise CookieMismatchError(
+                f"cookie mismatch for needle {needle_id:x}")
+        return n
 
     def delete_volume_needle(self, vid: int, needle_id: int,
                              cookie: Optional[int] = None) -> int:
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
-        return v.delete_needle(needle_id, cookie)
+        if self.needle_cache is not None:
+            self.needle_cache.invalidate(vid, needle_id)
+        try:
+            return v.delete_needle(needle_id, cookie)
+        finally:
+            if self.needle_cache is not None:
+                self.needle_cache.invalidate(vid, needle_id)
 
     def mark_volume_readonly(self, vid: int, read_only: bool = True) -> bool:
         v = self.find_volume(vid)
@@ -306,6 +345,11 @@ class Store:
                     self.deleted_ec_shards.append(
                         {"id": vid, "ec_index_bits": 1 << sid})
                     break
+        if self.needle_cache is not None:
+            # shard topology changed under the volume; cached records
+            # themselves are still valid bytes, but ec-to-volume
+            # conversion reuses the vid — stay strict
+            self.needle_cache.invalidate_volume(vid)
 
     def find_ec_volume(self, vid: int) -> Optional[EcVolume]:
         for loc in self.locations:
@@ -320,19 +364,43 @@ class Store:
     def read_ec_shard_needle(self, vid: int, needle_id: int,
                              cookie: Optional[int] = None) -> Needle:
         """Locate via .ecx, then read intervals with local -> remote ->
-        degraded-reconstruction fallback (reference store_ec.go:125-163)."""
+        degraded-reconstruction fallback (reference store_ec.go:125-163).
+        With a needle cache wired, the full record blob is read through
+        it single-flight, so a hot degraded needle pays its k-column
+        decode once and serves every later (and concurrent) reader from
+        memory."""
         ev = self.find_ec_volume(vid)
         if ev is None:
             raise NotFoundError(f"ec volume {vid} not found")
-        intervals, offset, size = ev.locate_needle(needle_id)
-        if t.size_is_deleted(size):
-            raise DeletedError(f"needle {needle_id:x} deleted")
-        blob = b"".join(
-            self._read_one_interval(ev, iv) for iv in intervals)
-        n = Needle.from_bytes(blob, size, ev.version)
+        cache = self.needle_cache
+        if cache is None:
+            intervals, offset, size = ev.locate_needle(needle_id)
+            if t.size_is_deleted(size):
+                raise DeletedError(f"needle {needle_id:x} deleted")
+            blob = b"".join(
+                self._read_one_interval(ev, iv) for iv in intervals)
+            version = ev.version
+        else:
+            blob, size, version = cache.get_or_load(
+                vid, needle_id,
+                lambda: self._load_ec_record(ev, needle_id))
+        n = Needle.from_bytes(blob, size, version)
         if cookie is not None and n.cookie != cookie:
             raise NotFoundError(f"cookie mismatch for needle {needle_id:x}")
         return n
+
+    def _load_ec_record(self, ev: EcVolume,
+                        needle_id: int) -> tuple[bytes, int, int, bool]:
+        """Cache loader: the needle's full record blob via the interval
+        ladder. Flags whether any interval was degraded-reconstructed,
+        so the cache force-admits records that cost a decode."""
+        intervals, _offset, size = ev.locate_needle(needle_id)
+        if t.size_is_deleted(size):
+            raise DeletedError(f"needle {needle_id:x} deleted")
+        meter = {"recovered": 0}
+        blob = b"".join(
+            self._read_one_interval(ev, iv, meter) for iv in intervals)
+        return blob, size, ev.version, meter["recovered"] > 0
 
     def _read_record_range(self, ev: EcVolume, rec_offset: int,
                            rel_off: int, length: int) -> bytes:
@@ -399,7 +467,12 @@ class Store:
     def read_ec_needle_data_range(self, vid: int, needle_id: int,
                                   lo: int, length: int) -> bytes:
         """data[lo:lo+length] of an EC needle, reading (and on degraded
-        paths reconstructing) only the covering byte ranges."""
+        paths reconstructing) only the covering byte ranges. A cached
+        full record serves any slice from memory; when the requested
+        range would need reconstruction and the record fits the cache's
+        item cap, the whole record is reconstructed ONCE (single-flight)
+        and every range read after — concurrent waiters included —
+        slices the cached blob instead of paying its own decode."""
         ev = self.find_ec_volume(vid)
         if ev is None:
             raise NotFoundError(f"ec volume {vid} not found")
@@ -408,10 +481,57 @@ class Store:
         offset, size = ev.find_needle_from_ecx(needle_id)
         if t.size_is_deleted(size):
             raise DeletedError(f"needle {needle_id:x} deleted")
+        data_off = t.NEEDLE_HEADER_SIZE + 4
+        cache = self.needle_cache
+        if cache is not None:
+            hit = cache.get(vid, needle_id)
+            if hit is not None:
+                return hit[0][data_off + lo:data_off + lo + length]
+            if (t.get_actual_size(size, ev.version)
+                    <= cache.max_item_bytes()
+                    and self._range_needs_recovery(
+                        ev, offset, data_off + lo, length)):
+                blob, _, _ = cache.get_or_load(
+                    vid, needle_id,
+                    lambda: self._load_ec_record(ev, needle_id))
+                return blob[data_off + lo:data_off + lo + length]
         return self._read_record_range(
-            ev, offset, t.NEEDLE_HEADER_SIZE + 4 + lo, length)
+            ev, offset, data_off + lo, length)
 
-    def _read_one_interval(self, ev: EcVolume, iv: layout.Interval) -> bytes:
+    def _range_needs_recovery(self, ev: EcVolume, rec_offset: int,
+                              rel_off: int, length: int) -> bool:
+        """Would reading this range hit the reconstruction ladder? True
+        when a covering interval's shard is neither local nor (as far
+        as the shard locator knows) held by any reachable peer. Without
+        a locator, missing-local plus no remote reader means recovery."""
+        if length <= 0:
+            return False
+        intervals = layout.locate_data(
+            layout.LARGE_BLOCK_SIZE, layout.SMALL_BLOCK_SIZE,
+            layout.DATA_SHARDS_COUNT * ev.shard_size(),
+            rec_offset + rel_off, length)
+        locs = None
+        for iv in intervals:
+            sid = iv.to_shard_id_and_offset()[0]
+            if sid in ev.shards:
+                continue
+            if self.remote_shard_reader is None:
+                return True
+            if self.shard_locations is None:
+                # remote reader but no topology view: assume the peer
+                # will serve it (tests inject bare readers)
+                continue
+            if locs is None:
+                try:
+                    locs = self.shard_locations(ev.volume_id) or {}
+                except Exception:
+                    return True
+            if not locs.get(sid):
+                return True
+        return False
+
+    def _read_one_interval(self, ev: EcVolume, iv: layout.Interval,
+                           meter: Optional[dict] = None) -> bytes:
         data, shard_id = ev.read_interval(iv)
         if data is not None:
             return data
@@ -423,6 +543,8 @@ class Store:
             if data is not None and len(data) == iv.size:
                 return data
         # degraded: fetch the same range of >= k other shards and reconstruct
+        if meter is not None:
+            meter["recovered"] = meter.get("recovered", 0) + 1
         return self._recover_one_interval(ev, iv, shard_id)
 
 
@@ -583,7 +705,13 @@ class Store:
         delete to peer shard owners, reference store_ec_delete.go)."""
         n = self.read_ec_shard_needle(vid, needle_id, cookie)
         ev = self.find_ec_volume(vid)
-        ev.delete_needle(needle_id)
+        if self.needle_cache is not None:
+            self.needle_cache.invalidate(vid, needle_id)
+        try:
+            ev.delete_needle(needle_id)
+        finally:
+            if self.needle_cache is not None:
+                self.needle_cache.invalidate(vid, needle_id)
         return len(n.data)
 
     # ---- heartbeat ----

@@ -1,22 +1,36 @@
-"""Minimal threaded HTTP/JSON server + client helpers.
+"""Minimal selector-core HTTP/JSON server + client helpers.
 
 The control plane speaks HTTP/JSON end to end (the reference speaks
 gRPC + HTTP; we keep one wire format for the whole plane — long-lived
 streams become periodic POSTs / long-polls). Data paths (uploads, shard
 copy) use raw bodies with query params.
+
+Serving model (reference: Go's netpoller + goroutine-per-request, here
+selectors + a bounded worker pool): ONE selector thread owns the
+listener and every parked keep-alive socket; a connection costs a
+thread only while a request is actually being served. Ready sockets are
+handed to a bounded, demand-grown worker pool, so 10k mostly-idle
+connections hold 10k fds but ~0 threads. Ambient context (Deadline,
+QoS class, trace span, RED observation) is entered per DISPATCHED
+REQUEST inside ``_dispatch`` — never per connection — so a parked
+socket holds no scope and a worker thread never leaks one request's
+scope into the next.
 """
 
 from __future__ import annotations
 
+import collections
 import json
+import queue
 import re
+import select
+import selectors
 import socket
 import threading
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, Callable, Optional
 
 from seaweedfs_tpu.qos import classes as qos_classes
@@ -151,14 +165,814 @@ class HeaderDict:
 Route = tuple[str, re.Pattern, Callable[[Request], Response]]
 
 
-class HttpServer:
-    """Route table + ThreadingHTTPServer. Routes are (METHOD, regex)."""
+class _BufferedReader:
+    """Buffered reader owned by the connection (replaces ``makefile``).
+    Exposes ``has_buffered()`` so the dispatch loop can see pipelined
+    bytes that are already in user space — those would never make the
+    parked socket readable again, so parking on them would strand the
+    request."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    __slots__ = ("_sock", "_buf", "_pos", "_eof")
+    _CHUNK = 65536
+
+    def __init__(self, sock):
+        self._sock = sock
+        self._buf = b""
+        self._pos = 0
+        self._eof = False
+
+    def has_buffered(self) -> bool:
+        return self._pos < len(self._buf)
+
+    def _compact(self) -> None:
+        if self._pos >= len(self._buf):
+            self._buf = b""
+            self._pos = 0
+
+    def readline(self, limit: int = -1) -> bytes:
+        while True:
+            i = self._buf.find(b"\n", self._pos)
+            if i != -1:
+                i += 1
+                if 0 <= limit < i - self._pos:
+                    i = self._pos + limit
+                line = self._buf[self._pos:i]
+                self._pos = i
+                self._compact()
+                return line
+            if 0 <= limit <= len(self._buf) - self._pos:
+                line = self._buf[self._pos:self._pos + limit]
+                self._pos += limit
+                self._compact()
+                return line
+            if self._eof:
+                line = self._buf[self._pos:]
+                self._buf = b""
+                self._pos = 0
+                return line
+            data = self._sock.recv(self._CHUNK)
+            if not data:
+                self._eof = True
+                continue
+            if self._pos:
+                self._buf = self._buf[self._pos:] + data
+                self._pos = 0
+            else:
+                self._buf += data
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:  # read to EOF (not on the server hot path)
+            chunks = [self._buf[self._pos:]]
+            self._buf = b""
+            self._pos = 0
+            while not self._eof:
+                data = self._sock.recv(self._CHUNK)
+                if not data:
+                    self._eof = True
+                    break
+                chunks.append(data)
+            return b"".join(chunks)
+        avail = len(self._buf) - self._pos
+        if avail >= n:
+            out = self._buf[self._pos:self._pos + n]
+            self._pos += n
+            self._compact()
+            return out
+        chunks = [self._buf[self._pos:]] if avail else []
+        self._buf = b""
+        self._pos = 0
+        got = avail
+        while got < n and not self._eof:
+            data = self._sock.recv(min(self._CHUNK, n - got))
+            if not data:
+                self._eof = True
+                break
+            chunks.append(data)
+            got += len(data)
+        return b"".join(chunks)
+
+
+# worker-loop verdicts for one service() slice of a connection
+_PARK = "park"
+_CLOSE = "close"
+
+
+def _fd_readable(sock) -> bool:
+    """Zero-timeout readability probe. poll() where available:
+    select.select() raises ValueError for fds >= FD_SETSIZE (1024),
+    which an edge holding thousands of parked sockets crosses early."""
+    if hasattr(select, "poll"):
+        p = select.poll()
+        p.register(sock.fileno(), select.POLLIN)
+        return bool(p.poll(0))
+    r, _, _ = select.select([sock], [], [], 0)
+    return bool(r)
+
+_BUSY_BODY = b'{"error": "server busy"}'
+
+
+class _ConnHandler(BaseHTTPRequestHandler):
+    """Per-connection handler object; lives as long as the connection
+    (parked or active) and is re-entered by worker threads one request
+    at a time. Subclasses BaseHTTPRequestHandler for its response
+    helpers (send_response/send_error/handle_expect_100) but owns its
+    read loop: ``service()`` runs zero-or-more pipelined requests and
+    reports whether to park the socket back on the selector or close.
+    """
+
+    protocol_version = "HTTP/1.1"
+    # buffered response writes + no Nagle: headers and body coalesce
+    # into one segment instead of trickling out in tiny writes that
+    # collide with delayed ACKs (a flat +40ms/request on keep-alive
+    # connections otherwise)
+    wbufsize = 64 * 1024
+    disable_nagle_algorithm = True
+
+    def __init__(self, sock, addr, srv: "HttpServer"):
+        # deliberately NOT calling super().__init__ — socketserver's
+        # constructor runs the whole request loop inline
+        self.srv = srv
+        self.connection = self.request = sock
+        self.client_address = addr
+        self.server = None
+        self.command = ""
+        self.requestline = ""
+        self.request_version = self.default_request_version
+        self.close_connection = True
+        if self.disable_nagle_algorithm:
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+        self.rfile = _BufferedReader(sock)
+        self.wfile = sock.makefile("wb", self.wbufsize)
+
+    def log_message(self, *args):
+        pass  # request lines are emitted via glog at -v=2
+
+    # ------------------------------------------------ connection loop
+
+    def service(self) -> str:
+        """Serve requests until the connection goes idle (-> park),
+        closes, or errors. Runs on a worker thread; every request
+        re-enters the ambient scopes inside _dispatch, so nothing
+        leaks across requests or across the park/resume boundary."""
+        try:
+            # weedlint: disable=persistent-socket-timeout — re-armed
+            # per service slice; parked sockets idle under the
+            # selector, not under a timeout
+            self.connection.settimeout(self.srv.io_timeout)
+        except OSError:
+            return _CLOSE
+        try:
+            while True:
+                self.close_connection = True
+                self.raw_requestline = self.rfile.readline(65537)
+                if not self.raw_requestline:
+                    return _CLOSE
+                if len(self.raw_requestline) > 65536:
+                    self.requestline = ""
+                    self.request_version = self.default_request_version
+                    self.command = ""
+                    self.send_error(414)
+                    self.wfile.flush()
+                    return _CLOSE
+                if not self.parse_request():
+                    self.wfile.flush()
+                    return _CLOSE
+                if not hasattr(self, "do_" + self.command):
+                    self.send_error(
+                        501, f"Unsupported method ({self.command!r})")
+                    self.wfile.flush()
+                    return _CLOSE
+                self._dispatch()
+                self.wfile.flush()
+                if self.close_connection:
+                    return _CLOSE
+                if not self._pending():
+                    return _PARK
+        except (TimeoutError, socket.timeout, ConnectionError):
+            return _CLOSE
+        except OSError:
+            return _CLOSE
+        except Exception as e:
+            # parity with socketserver.handle_error, minus the spew for
+            # severed connections
+            glog.exception("connection handler error: %s",
+                           type(e).__name__)
+            return _CLOSE
+
+    def _pending(self) -> bool:
+        """True when another request's bytes are already available:
+        buffered in user space (pipelined), buffered inside the TLS
+        record layer, or readable on the socket. Parking such a
+        connection would never wake the selector for it."""
+        if self.rfile.has_buffered():
+            return True
+        try:
+            pending = getattr(self.connection, "pending", None)
+            if pending is not None and pending():
+                return True
+            return _fd_readable(self.connection)
+        except (OSError, ValueError):
+            return True  # let the read loop surface the error
+
+    def handle_expect_100(self):
+        ok = super().handle_expect_100()
+        try:
+            self.wfile.flush()  # interim 100 must hit the wire NOW
+        except OSError:
+            return False
+        return ok
+
+    def shed_busy(self, retry_after: float = 1.0) -> None:
+        """Best-effort canned 503 when the worker queue is full. Runs
+        on the selector thread, so it must never block: one
+        non-blocking send, then close."""
+        try:
+            self.connection.setblocking(False)
+            msg = ("HTTP/1.1 503 Service Unavailable\r\n"
+                   "Content-Type: application/json\r\n"
+                   f"Content-Length: {len(_BUSY_BODY)}\r\n"
+                   f"Retry-After: {retry_after:g}\r\n"
+                   "Connection: close\r\n\r\n").encode("latin-1")
+            self.connection.send(msg + _BUSY_BODY)
+        except OSError:
+            pass
+        self.close_conn()
+
+    def close_conn(self) -> None:
+        try:
+            self.wfile.close()
+        except OSError:
+            pass
+        try:
+            self.connection.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        try:
+            self.connection.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------ request handling
+
+    def parse_request(self) -> bool:
+        """Minimal HTTP/1.1 request parse replacing the stdlib
+        email-parser path (which dominates per-request CPU on
+        the 1KB data path). Sets the same attributes the base
+        class would: command/path/request_version/headers/
+        close_connection, incl. Expect: 100-continue."""
+        self.command = None
+        self.request_version = version = "HTTP/0.9"
+        self.close_connection = True
+        raw = str(self.raw_requestline, "latin-1").rstrip("\r\n")
+        self.requestline = raw
+        parts = raw.split()
+        if len(parts) == 3:
+            command, path, version = parts
+            if not version.startswith("HTTP/"):
+                self.send_error(400,
+                                f"Bad request version {version!r}")
+                return False
+        elif len(parts) == 2:
+            command, path = parts
+        else:
+            self.send_error(400, f"Bad request syntax {raw!r}")
+            return False
+        self.command, self.path = command, path
+        self.request_version = version
+        headers = HeaderDict()
+        n_headers = 0
+        while True:
+            line = self.rfile.readline(65537)
+            if len(line) > 65536:
+                self.send_error(431, "header line too long")
+                return False
+            if line in (b"\r\n", b"\n", b"", b"\r"):
+                break
+            n_headers += 1
+            if n_headers > 100:  # stdlib _MAXHEADERS parity
+                self.send_error(431, "too many headers")
+                return False
+            k, sep, v = line.decode("latin-1").partition(":")
+            if sep:
+                headers.add(k.strip(), v.strip())
+        self.headers = headers
+        conn = (headers.get("Connection") or "").lower()
+        if version >= "HTTP/1.1":
+            self.close_connection = conn == "close"
+        else:
+            self.close_connection = conn != "keep-alive"
+        if version >= "HTTP/1.1" and \
+                headers.get("Expect", "").lower() == "100-continue":
+            if not self.handle_expect_100():
+                return False
+        return True
+
+    def _reject(self, verdict, length):
+        # reject WITHOUT buffering the body: drain it in
+        # discarded 64KB chunks (bounded memory) so the
+        # client finishes sending and can actually read
+        # the 413/429/503; truly huge payloads are cut off
+        # after a few MB like Go's http server does
+        remaining = min(length, 8 << 20)
+        try:
+            while remaining > 0:
+                got = self.rfile.read(min(remaining, 65536))
+                if not got:
+                    break
+                remaining -= len(got)
+        except OSError:
+            pass
+        verdict.headers.setdefault("Connection", "close")
+        self.close_connection = True
+        self._send(verdict)
+
+    def _dispatch(self):
+        server = self.srv
+        length = int(self.headers.get("Content-Length") or 0)
+        if server.draining:
+            # a draining server takes no NEW work; kept-alive
+            # clients get a clean 503 + close so their retry
+            # lands on another replica immediately
+            self._reject(Response(
+                {"error": "draining"}, status=503,
+                headers={"Retry-After": "1"}), length)
+            return
+        with server._inflight_lock:
+            server._inflight += 1
+        try:
+            self._dispatch_traced(length)
+        finally:
+            with server._inflight_lock:
+                server._inflight -= 1
+
+    def _dispatch_traced(self, length):
+        server = self.srv
+        path = urllib.parse.unquote(
+            urllib.parse.urlparse(self.path).path)
+        # server span: continue an inbound X-Weed-Trace or mint
+        # a fresh trace at this edge. Ambient BEFORE the gates
+        # so QoS verdicts annotate it, and around the handler so
+        # nested http_calls inject the header downstream. With
+        # no tracer (or disabled) this is one attribute check
+        # plus the shared NOOP span — no allocation.
+        tracer = server.tracer
+        span = (tracer.server_span(f"{self.command} {path}",
+                                   self.headers)
+                if tracer is not None else tracing.NOOP)
+        tok = tracing.attach(span)
+        try:
+            self._dispatch_inner(path, length, span)
+        finally:
+            tracing.detach(tok)
+
+    def _dispatch_inner(self, path, length, span):
+        server = self.srv
+        # RED edge observation brackets EVERYTHING — admission
+        # sheds, gate rejects, 404s, handler 500s — so the
+        # duration histogram is the true edge view. clockctl
+        # timing: under the sim's virtual clock the same
+        # histograms elapse in virtual seconds.
+        t_red = clockctl.monotonic()
+        red = server.red
+
+        def red_observe(status):
+            if red is None:
+                return
+            cls = qos_classes.from_headers(self.headers) \
+                or qos_classes.classify(self.command, path)
+            red.observe(route_family(path), cls, status,
+                        clockctl.monotonic() - t_red,
+                        exemplar=span.trace_id
+                        if span.sampled else None)
+
+        release = None
+        agate = server.admission_gate
+        if agate is not None:
+            verdict = agate(self.command, path, self.headers,
+                            self.client_address[0])
+            if isinstance(verdict, Response):
+                self._reject(verdict, length)
+                red_observe(verdict.status)
+                span.finish(status=verdict.status)
+                return
+            release = verdict
+        on_sent = None
+        resp = None
+        out_status = 500
+        t0 = clockctl.monotonic()
+        try:
+            gate = server.body_gate
+            if gate is not None and length and \
+                    self.command in ("POST", "PUT"):
+                verdict = gate(path, length)
+                if isinstance(verdict, Response):
+                    out_status = verdict.status
+                    self._reject(verdict, length)
+                    return
+                on_sent = verdict
+            body = self.rfile.read(length) if length else b""
+            # propagated traffic class becomes ambient for the
+            # handler, so its nested http_calls re-inject it
+            cls = qos_classes.from_headers(self.headers)
+            for method, pattern, fn in server.routes:
+                if method != self.command:
+                    continue
+                m = pattern.match(path)
+                if m:
+                    try:
+                        with qos_classes.class_scope(cls):
+                            resp = fn(Request(self, m, body))
+                    except Exception as e:  # surface as 500 JSON
+                        glog.exception(
+                            "handler error: %s %s -> %s",
+                            self.command, path,
+                            type(e).__name__)
+                        resp = Response(
+                            {"error": f"{type(e).__name__}: {e}"},
+                            status=500)
+                    break
+            else:
+                resp = Response({"error": "not found"}, status=404)
+            out_status = resp.status
+            self._send(resp)
+            glog.vlog(2, "%s %s %d %dB %.1fms",
+                      self.command, self.path, resp.status,
+                      len(resp.body),
+                      (clockctl.monotonic() - t0) * 1e3)
+        finally:
+            if on_sent is not None:
+                on_sent()
+            cb = getattr(resp, "on_sent", None)
+            if cb is not None:
+                cb()
+            if release is not None:
+                release()
+            red_observe(out_status)
+            span.finish(status=out_status)
+
+    def _send(self, resp):
+        try:
+            self.send_response(resp.status)
+            self.send_header("Content-Type", resp.content_type)
+            if "Content-Length" not in resp.headers:
+                # HEAD handlers set it to the entity size; the
+                # wire body is still suppressed below
+                self.send_header("Content-Length",
+                                 str(len(resp.body)))
+            for k, v in resp.headers.items():
+                self.send_header(k, v)
+            self.end_headers()
+            if self.command != "HEAD":
+                self.wfile.write(resp.body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
+    # WebDAV verbs
+    do_OPTIONS = do_PROPFIND = do_PROPPATCH = _dispatch
+    do_MKCOL = do_MOVE = do_COPY = do_LOCK = do_UNLOCK = _dispatch
+
+
+class _WorkerPool:
+    """Bounded, demand-grown request worker pool. Threads spawn only
+    when a task arrives and no worker is idle, and exit after sitting
+    idle — a node serving six HttpServers doesn't pay six full pools.
+    submit() never blocks: a full queue returns False and the caller
+    sheds (the selector thread must stay responsive)."""
+
+    def __init__(self, max_workers: int, queue_depth: int,
+                 idle_exit: float = 10.0):
+        self.max_workers = max(1, int(max_workers))
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, queue_depth))
+        self._idle_exit = idle_exit
+        self._lock = threading.Lock()
+        self._threads = 0
+        self._idle = 0
+        self._stopping = False
+
+    def submit(self, fn) -> bool:
+        try:
+            self._q.put_nowait(fn)
+        except queue.Full:
+            return False
+        spawn = False
+        with self._lock:
+            if not self._stopping and self._idle == 0 \
+                    and self._threads < self.max_workers:
+                self._threads += 1
+                spawn = True
+        if spawn:
+            threading.Thread(target=self._work, daemon=True,
+                             name="httpd-worker").start()
+        return True
+
+    def _work(self):
+        while True:
+            with self._lock:
+                self._idle += 1
+            try:
+                fn = self._q.get(timeout=self._idle_exit)
+            except queue.Empty:
+                try:  # one last sweep before shrinking away
+                    fn = self._q.get_nowait()
+                except queue.Empty:
+                    fn = None
+            finally:
+                with self._lock:
+                    self._idle -= 1
+            if fn is None or self._stopping:
+                break
+            try:
+                fn()
+            except Exception:
+                glog.exception("httpd worker task error")
+        respawn = False
+        with self._lock:
+            self._threads -= 1
+            # a task enqueued during our shutdown window must not
+            # strand until the next submit
+            if not self._stopping and not self._q.empty() \
+                    and self._idle == 0 \
+                    and self._threads < self.max_workers:
+                self._threads += 1
+                respawn = True
+        if respawn:
+            threading.Thread(target=self._work, daemon=True,
+                             name="httpd-worker").start()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"threads": self._threads, "idle": self._idle,
+                    "queued": self._q.qsize(),
+                    "max_workers": self.max_workers}
+
+    def stop(self):
+        self._stopping = True
+        for _ in range(self.max_workers):
+            try:
+                self._q.put_nowait(None)
+            except queue.Full:
+                break
+
+
+# selector registration tags for the two non-connection fds
+_ACCEPT = object()
+_WAKE = object()
+
+
+class _SelectorCore:
+    """The connection core: one thread multiplexing the listener +
+    every parked keep-alive socket through a selector; request
+    servicing happens on the bounded worker pool. Exposes ``.socket``
+    (tls.wrap_http_server swaps it for an SSLSocket in place — same
+    fd, so the selector registration survives) and ``server_address``
+    for ThreadingHTTPServer drop-in parity."""
+
+    def __init__(self, srv: "HttpServer", host: str, port: int,
+                 workers: int, queue_depth: int):
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((host, port))
+        sock.listen(1024)
+        sock.setblocking(False)
+        self.socket = sock
+        self.server_address = sock.getsockname()
+        self.srv = srv
+        self._sel = selectors.DefaultSelector()
+        # register the raw fd, not the socket object: a later TLS wrap
+        # detaches the fd into a new SSLSocket and the old object goes
+        # invalid, but the fd (and this registration) live on
+        self._sel.register(sock.fileno(), selectors.EVENT_READ, _ACCEPT)
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, _WAKE)
+        self._pool = _WorkerPool(workers, queue_depth)
+        self._lock = threading.Lock()
+        self._parked: dict = {}          # handler -> parked_at
+        self._inbox: collections.deque = collections.deque()
+        self._conns: set = set()         # every live handler
+        self._accepting = True
+        self._running = True
+        self._accepted = 0
+        self._shed = 0
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ---------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="httpd-selector")
+        self._thread.start()
+
+    def stop_accepting(self) -> None:
+        """Drain phase one: stop taking new connections while the loop
+        keeps serving parked ones (their next request gets the 503 +
+        close from _dispatch's draining check)."""
+        self._accepting = False
+        self._wakeup()
+
+    def shutdown(self) -> None:
+        self._running = False
+        self._wakeup()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._pool.stop()
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+            self._parked.clear()
+        for h in conns:
+            try:
+                h.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            h.close_conn()
+        try:
+            self.socket.close()
+        except OSError:
+            pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._sel.close()
+
+    def _wakeup(self) -> None:
+        try:
+            self._wake_w.send(b"x")
+        except (BlockingIOError, OSError):
+            pass  # a pending wake byte already does the job
+
+    # ---- stats -------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {"connections": len(self._conns),
+                   "parked": len(self._parked),
+                   "accepted": self._accepted,
+                   "shed_busy": self._shed}
+        out.update(self._pool.stats())
+        return out
+
+    # ---- selector loop (single thread) -------------------------------
+
+    def _run(self) -> None:
+        last_sweep = clockctl.monotonic()
+        while self._running:
+            try:
+                events = self._sel.select(timeout=1.0)
+            except OSError:
+                continue
+            if not self._running:
+                break
+            for key, _ in events:
+                tag = key.data
+                if tag is _WAKE:
+                    try:
+                        while self._wake_r.recv(4096):
+                            pass
+                    except (BlockingIOError, OSError):
+                        pass
+                elif tag is _ACCEPT:
+                    if self._accepting:
+                        self._accept_burst()
+                else:  # a parked connection became readable (or EOF'd)
+                    h = tag
+                    try:
+                        self._sel.unregister(key.fileobj)
+                    except (KeyError, ValueError, OSError):
+                        pass
+                    with self._lock:
+                        self._parked.pop(h, None)
+                    self._submit(h)
+            self._drain_inbox()
+            now = clockctl.monotonic()
+            if now - last_sweep >= 5.0:
+                last_sweep = now
+                self._sweep_idle(now)
+
+    def _accept_burst(self) -> None:
+        for _ in range(128):
+            try:
+                # via self.socket, not a captured local: tls.py may
+                # have swapped in an SSLSocket (handshake-in-accept,
+                # same as the threaded server's behavior)
+                conn, addr = self.socket.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError as e:
+                # TLS handshake failures arrive here (ssl.SSLError is
+                # an OSError): that connection is dead, the listener
+                # is fine — keep draining the backlog
+                if type(e).__name__.startswith("SSL"):
+                    continue
+                glog.vlog(1, "accept error: %s", e)
+                return
+            try:
+                conn.setblocking(True)
+            except OSError:
+                continue
+            self._accepted += 1
+            h = _ConnHandler(conn, addr, self.srv)
+            with self._lock:
+                self._conns.add(h)
+            self._submit(h)
+
+    def _submit(self, h) -> None:
+        if self._pool.submit(lambda: self._service(h)):
+            return
+        # worker queue saturated: canned 503 + close, never blocking
+        # the selector thread. Retry-After stretches with governor
+        # pressure so clients back off harder the hotter we run.
+        self._shed += 1
+        gov = self.srv.governor
+        retry = 1.0
+        if gov is not None:
+            try:
+                retry = round(0.5 + 2.0 * gov.pressure(), 1)
+            except Exception:
+                pass
+        h.shed_busy(retry)
+        with self._lock:
+            self._conns.discard(h)
+
+    def _service(self, h) -> None:
+        outcome = h.service()
+        if outcome == _PARK and self._running:
+            with self._lock:
+                self._inbox.append(h)
+            self._wakeup()
+        else:
+            h.close_conn()
+            with self._lock:
+                self._conns.discard(h)
+
+    def _drain_inbox(self) -> None:
+        while True:
+            with self._lock:
+                if not self._inbox:
+                    return
+                h = self._inbox.popleft()
+            if not self._running:
+                h.close_conn()
+                with self._lock:
+                    self._conns.discard(h)
+                continue
+            try:
+                self._sel.register(h.connection, selectors.EVENT_READ, h)
+            except (KeyError, ValueError, OSError):
+                h.close_conn()
+                with self._lock:
+                    self._conns.discard(h)
+                continue
+            with self._lock:
+                self._parked[h] = clockctl.monotonic()
+
+    def _sweep_idle(self, now: float) -> None:
+        timeout = self.srv.idle_timeout
+        with self._lock:
+            stale = [h for h, t in self._parked.items()
+                     if now - t > timeout]
+            for h in stale:
+                self._parked.pop(h, None)
+                self._conns.discard(h)
+        for h in stale:
+            try:
+                self._sel.unregister(h.connection)
+            except (KeyError, ValueError, OSError):
+                pass
+            h.close_conn()
+
+
+class HttpServer:
+    """Route table + selector connection core. Routes are
+    (METHOD, regex); see the module docstring for the serving model."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 workers: Optional[int] = None, queue_depth: int = 2048,
+                 idle_timeout: float = 75.0, io_timeout: float = 60.0):
         self.routes: list[Route] = []
         self.host = host
         self.port = port
-        self._httpd: Optional[ThreadingHTTPServer] = None
+        # worker-pool knobs: `workers` bounds service threads (None ->
+        # sized at start(), QoS-aware when a governor is wired);
+        # `queue_depth` bounds dispatch backlog before canned-503 shed;
+        # `idle_timeout` reaps parked keep-alive sockets; `io_timeout`
+        # bounds per-syscall progress on an ACTIVE request (parked
+        # sockets carry no timeout — the selector owns their idleness).
+        self.workers = workers
+        self.queue_depth = queue_depth
+        self.idle_timeout = idle_timeout
+        self.io_timeout = io_timeout
+        # QosGovernor wired by the owning server (like tracer/red):
+        # sizes the worker pool and shapes shed Retry-After hints
+        self.governor = None
+        self._httpd: Optional[_SelectorCore] = None
         self._thread: Optional[threading.Thread] = None
         # body_gate(path, content_length) is consulted BEFORE the request
         # body is read from the socket: it returns a Response to reject
@@ -205,295 +1019,47 @@ class HttpServer:
                             fn))
 
     def start(self) -> None:
-        routes = self.routes
-        server = self
+        workers = self.workers
+        if workers is None:
+            # QoS-aware sizing: with a governor wired, the pool tracks
+            # the adaptive limiter's ceiling (every admitted request
+            # deserves a thread); without one, a fixed bound
+            gov = self.governor
+            if gov is not None:
+                workers = max(16, min(128, gov.limiter.max_limit))
+            else:
+                workers = 64
+        core = _SelectorCore(self, self.host, self.port,
+                             workers=workers, queue_depth=self.queue_depth)
+        self._httpd = core
+        self.port = core.server_address[1]
+        core.start()
+        self._thread = core._thread
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-            # buffered response writes + no Nagle: headers and body
-            # coalesce into one segment instead of trickling out in
-            # tiny writes that collide with delayed ACKs (a flat
-            # +40ms/request on keep-alive connections otherwise)
-            wbufsize = 64 * 1024
-            disable_nagle_algorithm = True
-
-            def log_message(self, *args):
-                pass  # request lines are emitted via glog at -v=2
-
-            def parse_request(self) -> bool:
-                """Minimal HTTP/1.1 request parse replacing the stdlib
-                email-parser path (which dominates per-request CPU on
-                the 1KB data path). Sets the same attributes the base
-                class would: command/path/request_version/headers/
-                close_connection, incl. Expect: 100-continue."""
-                self.command = None
-                self.request_version = version = "HTTP/0.9"
-                self.close_connection = True
-                raw = str(self.raw_requestline, "latin-1").rstrip("\r\n")
-                self.requestline = raw
-                parts = raw.split()
-                if len(parts) == 3:
-                    command, path, version = parts
-                    if not version.startswith("HTTP/"):
-                        self.send_error(400,
-                                        f"Bad request version {version!r}")
-                        return False
-                elif len(parts) == 2:
-                    command, path = parts
-                else:
-                    self.send_error(400, f"Bad request syntax {raw!r}")
-                    return False
-                self.command, self.path = command, path
-                self.request_version = version
-                headers = HeaderDict()
-                n_headers = 0
-                while True:
-                    line = self.rfile.readline(65537)
-                    if len(line) > 65536:
-                        self.send_error(431, "header line too long")
-                        return False
-                    if line in (b"\r\n", b"\n", b"", b"\r"):
-                        break
-                    n_headers += 1
-                    if n_headers > 100:  # stdlib _MAXHEADERS parity
-                        self.send_error(431, "too many headers")
-                        return False
-                    k, sep, v = line.decode("latin-1").partition(":")
-                    if sep:
-                        headers.add(k.strip(), v.strip())
-                self.headers = headers
-                conn = (headers.get("Connection") or "").lower()
-                if version >= "HTTP/1.1":
-                    self.close_connection = conn == "close"
-                else:
-                    self.close_connection = conn != "keep-alive"
-                if version >= "HTTP/1.1" and \
-                        headers.get("Expect", "").lower() == "100-continue":
-                    if not self.handle_expect_100():
-                        return False
-                return True
-
-            def _reject(self, verdict, length):
-                # reject WITHOUT buffering the body: drain it in
-                # discarded 64KB chunks (bounded memory) so the
-                # client finishes sending and can actually read
-                # the 413/429/503; truly huge payloads are cut off
-                # after a few MB like Go's http server does
-                remaining = min(length, 8 << 20)
-                try:
-                    while remaining > 0:
-                        got = self.rfile.read(min(remaining, 65536))
-                        if not got:
-                            break
-                        remaining -= len(got)
-                except OSError:
-                    pass
-                verdict.headers.setdefault("Connection", "close")
-                self.close_connection = True
-                self._send(verdict)
-
-            def _dispatch(self):
-                length = int(self.headers.get("Content-Length") or 0)
-                if server.draining:
-                    # a draining server takes no NEW work; kept-alive
-                    # clients get a clean 503 + close so their retry
-                    # lands on another replica immediately
-                    self._reject(Response(
-                        {"error": "draining"}, status=503,
-                        headers={"Retry-After": "1"}), length)
-                    return
-                with server._inflight_lock:
-                    server._inflight += 1
-                try:
-                    self._dispatch_traced(length)
-                finally:
-                    with server._inflight_lock:
-                        server._inflight -= 1
-
-            def _dispatch_traced(self, length):
-                path = urllib.parse.unquote(
-                    urllib.parse.urlparse(self.path).path)
-                # server span: continue an inbound X-Weed-Trace or mint
-                # a fresh trace at this edge. Ambient BEFORE the gates
-                # so QoS verdicts annotate it, and around the handler so
-                # nested http_calls inject the header downstream. With
-                # no tracer (or disabled) this is one attribute check
-                # plus the shared NOOP span — no allocation.
-                tracer = server.tracer
-                span = (tracer.server_span(f"{self.command} {path}",
-                                           self.headers)
-                        if tracer is not None else tracing.NOOP)
-                tok = tracing.attach(span)
-                try:
-                    self._dispatch_inner(path, length, span)
-                finally:
-                    tracing.detach(tok)
-
-            def _dispatch_inner(self, path, length, span):
-                # RED edge observation brackets EVERYTHING — admission
-                # sheds, gate rejects, 404s, handler 500s — so the
-                # duration histogram is the true edge view. clockctl
-                # timing: under the sim's virtual clock the same
-                # histograms elapse in virtual seconds.
-                t_red = clockctl.monotonic()
-                red = server.red
-
-                def red_observe(status):
-                    if red is None:
-                        return
-                    cls = qos_classes.from_headers(self.headers) \
-                        or qos_classes.classify(self.command, path)
-                    red.observe(route_family(path), cls, status,
-                                clockctl.monotonic() - t_red,
-                                exemplar=span.trace_id
-                                if span.sampled else None)
-
-                release = None
-                agate = server.admission_gate
-                if agate is not None:
-                    verdict = agate(self.command, path, self.headers,
-                                    self.client_address[0])
-                    if isinstance(verdict, Response):
-                        self._reject(verdict, length)
-                        red_observe(verdict.status)
-                        span.finish(status=verdict.status)
-                        return
-                    release = verdict
-                on_sent = None
-                resp = None
-                out_status = 500
-                t0 = clockctl.monotonic()
-                try:
-                    gate = server.body_gate
-                    if gate is not None and length and \
-                            self.command in ("POST", "PUT"):
-                        verdict = gate(path, length)
-                        if isinstance(verdict, Response):
-                            out_status = verdict.status
-                            self._reject(verdict, length)
-                            return
-                        on_sent = verdict
-                    body = self.rfile.read(length) if length else b""
-                    # propagated traffic class becomes ambient for the
-                    # handler, so its nested http_calls re-inject it
-                    cls = qos_classes.from_headers(self.headers)
-                    for method, pattern, fn in routes:
-                        if method != self.command:
-                            continue
-                        m = pattern.match(path)
-                        if m:
-                            try:
-                                with qos_classes.class_scope(cls):
-                                    resp = fn(Request(self, m, body))
-                            except Exception as e:  # surface as 500 JSON
-                                glog.exception(
-                                    "handler error: %s %s -> %s",
-                                    self.command, path,
-                                    type(e).__name__)
-                                resp = Response(
-                                    {"error": f"{type(e).__name__}: {e}"},
-                                    status=500)
-                            break
-                    else:
-                        resp = Response({"error": "not found"}, status=404)
-                    out_status = resp.status
-                    self._send(resp)
-                    glog.vlog(2, "%s %s %d %dB %.1fms",
-                              self.command, self.path, resp.status,
-                              len(resp.body),
-                              (clockctl.monotonic() - t0) * 1e3)
-                finally:
-                    if on_sent is not None:
-                        on_sent()
-                    cb = getattr(resp, "on_sent", None)
-                    if cb is not None:
-                        cb()
-                    if release is not None:
-                        release()
-                    red_observe(out_status)
-                    span.finish(status=out_status)
-
-            def _send(self, resp):
-                try:
-                    self.send_response(resp.status)
-                    self.send_header("Content-Type", resp.content_type)
-                    if "Content-Length" not in resp.headers:
-                        # HEAD handlers set it to the entity size; the
-                        # wire body is still suppressed below
-                        self.send_header("Content-Length",
-                                         str(len(resp.body)))
-                    for k, v in resp.headers.items():
-                        self.send_header(k, v)
-                    self.end_headers()
-                    if self.command != "HEAD":
-                        self.wfile.write(resp.body)
-                except (BrokenPipeError, ConnectionResetError):
-                    pass
-
-            do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
-            # WebDAV verbs
-            do_OPTIONS = do_PROPFIND = do_PROPPATCH = _dispatch
-            do_MKCOL = do_MOVE = do_COPY = do_LOCK = do_UNLOCK = _dispatch
-
-        class Server(ThreadingHTTPServer):
-            """Tracks live per-connection sockets so stop() can sever
-            them. Without this, keep-alive clients (the pooled
-            http_call) keep riding ESTABLISHED sockets into a server
-            whose listener is closed but whose handler threads live on
-            — a stopped in-process master would keep answering
-            heartbeats like a zombie."""
-            daemon_threads = True
-
-            def __init__(self, *a, **k):
-                self.live_conns: set = set()
-                self._conn_lock = threading.Lock()
-                super().__init__(*a, **k)
-
-            def process_request(self, request, client_address):
-                with self._conn_lock:
-                    self.live_conns.add(request)
-                super().process_request(request, client_address)
-
-            def shutdown_request(self, request):
-                with self._conn_lock:
-                    self.live_conns.discard(request)
-                super().shutdown_request(request)
-
-            def handle_error(self, request, client_address):
-                # severed-at-stop connections die with broken pipes in
-                # their handler threads; that's expected, not a crash.
-                # ONLY connection-class errors are quieted — other
-                # OSErrors (fd exhaustion etc.) must stay visible.
-                import sys
-                exc = sys.exc_info()[1]
-                if isinstance(exc, ConnectionError):
-                    return
-                super().handle_error(request, client_address)
-
-            def close_all_connections(self):
-                with self._conn_lock:
-                    conns = list(self.live_conns)
-                for sock in conns:
-                    try:
-                        sock.shutdown(2)  # SHUT_RDWR: unblock handlers
-                    except OSError:
-                        pass
-
-        self._httpd = Server((self.host, self.port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+    def conn_stats(self) -> dict:
+        """Connection-core counters for metrics / the conn bench:
+        open + parked connections, worker threads, queue depth, busy
+        sheds, in-flight requests."""
+        core = self._httpd
+        out = core.stats() if core is not None else {
+            "connections": 0, "parked": 0, "accepted": 0,
+            "shed_busy": 0, "threads": 0, "idle": 0, "queued": 0,
+            "max_workers": 0}
+        with self._inflight_lock:
+            out["inflight"] = self._inflight
+        return out
 
     def drain(self, timeout: float = 10.0) -> bool:
         """Graceful-stop phase one: refuse new requests (503 + close),
         stop accepting connections, and wait for in-flight requests to
         finish.  Returns True when the server went idle within
         ``timeout``; the caller then runs stop() for the hard close.
-        Idempotent, and safe before start()."""
+        Idempotent, and safe before start(). Parked keep-alive
+        connections stay serviced (their next request gets the 503 +
+        Connection: close) until stop() severs them."""
         self.draining = True
         if self._httpd:
-            self._httpd.shutdown()
+            self._httpd.stop_accepting()
         deadline = clockctl.monotonic() + timeout
         while clockctl.monotonic() < deadline:
             with self._inflight_lock:
@@ -506,8 +1072,6 @@ class HttpServer:
     def stop(self) -> None:
         if self._httpd:
             self._httpd.shutdown()
-            self._httpd.close_all_connections()
-            self._httpd.server_close()
             self._httpd = None
 
 
@@ -576,13 +1140,11 @@ def retry_after_hint(status: int, resp_headers) -> Optional[float]:
     return None
 
 
-# Thread-local keep-alive connection pool: one persistent HTTP/1.1
-# connection per (thread, host). The data path makes millions of tiny
-# requests; per-request TCP setup/teardown (urllib's behavior) costs
-# more than the request itself and floods TIME_WAIT. The reference
-# leans on Go's pooled http.Transport the same way
-# (weed/util/http_util.go).
-_conn_local = threading.local()
+# Process-wide keep-alive connection pool (below, after
+# RawHttpConnection). The data path makes millions of tiny requests;
+# per-request TCP setup/teardown (urllib's behavior) costs more than
+# the request itself and floods TIME_WAIT. The reference leans on Go's
+# pooled http.Transport the same way (weed/util/http_util.go).
 
 
 class RawHttpConnection:
@@ -705,50 +1267,156 @@ def _make_conn(netloc: str, timeout: float) -> RawHttpConnection:
     return RawHttpConnection(netloc, timeout)
 
 
-def _pooled_conn(netloc: str, timeout: float):
-    """Returns (conn, reused): `reused` is True when the socket was
-    already open from a previous request — the only case where an
-    automatic retry is safe (a stale kept-alive socket fails before the
-    server sees anything; a fresh connection that dies mid-response may
-    have EXECUTED the request, so replaying it is the caller's call).
-
-    A pooled socket is liveness-checked before reuse (urllib3's
-    is_connection_dropped): a peer that closed shows readable-EOF, and
-    sending into it would "succeed" into the kernel buffer and only
-    fail at response time — un-retryable for non-idempotent methods.
-    This matters when a server restarts on a reused port."""
-    import select
-    pool = getattr(_conn_local, "conns", None)
-    if pool is None:
-        pool = _conn_local.conns = {}
-    conn = pool.get(netloc)
-    if conn is None:
-        conn = _make_conn(netloc, timeout)
-        pool[netloc] = conn
-        return conn, False
+def _conn_alive(conn: RawHttpConnection) -> bool:
+    """Liveness check before reuse (urllib3's is_connection_dropped):
+    a peer that closed shows readable-EOF, and sending into it would
+    "succeed" into the kernel buffer and only fail at response time —
+    un-retryable for non-idempotent methods. This matters when a
+    server restarts on a reused port."""
     if conn.sock is None:
-        return conn, False
+        return False
     try:
-        readable, _, _ = select.select([conn.sock], [], [], 0)
+        readable = _fd_readable(conn.sock)
     except (OSError, ValueError):
-        readable = [conn.sock]
-    if readable:
-        # EOF or unsolicited bytes: the peer is gone (or the stream is
-        # desynced) — replace with a fresh connection
-        conn.close()
-        conn = _make_conn(netloc, timeout)
-        pool[netloc] = conn
-        return conn, False
-    conn.sock.settimeout(timeout)
-    return conn, True
+        return False
+    # EOF or unsolicited bytes: the peer is gone (or the stream is
+    # desynced) — not reusable
+    return not readable
+
+
+class HttpConnectionPool:
+    """Process-wide keep-alive pool: per-destination bounded idle
+    stacks under one lock. Replaces the per-thread pool, whose idle
+    socket count scaled with threads x destinations (a filer with 64
+    workers kept 64 sockets per volume server alive).
+
+    Checkout/checkin model: acquire() pops a live idle connection (or
+    dials), release() parks it back unless the destination stack or
+    the global cap is full — overflow closes the NEWLY returned socket
+    and a breached global cap also evicts the globally oldest idle one
+    (LRU across destinations). Eviction is breaker-aware twice over:
+    any transport failure drops the whole destination (its siblings
+    share the dead peer), and a circuit breaker opening anywhere in
+    the process evicts that peer's idles via resilience's
+    on_breaker_open hook."""
+
+    def __init__(self, per_dest: int = 4, max_idle: int = 128,
+                 idle_ttl: float = 30.0):
+        self.per_dest = per_dest
+        self.max_idle = max_idle
+        self.idle_ttl = idle_ttl
+        self._lock = threading.Lock()
+        self._idle: dict[str, list] = {}  # netloc -> [(conn, parked_at)]
+        self._total = 0
+        self.dials = 0
+        self.reuses = 0
+        self.evictions = 0
+
+    def acquire(self, netloc: str,
+                timeout: float) -> tuple[RawHttpConnection, bool]:
+        """Returns (conn, reused): `reused` is True when the socket was
+        already open from a previous request — the only case where an
+        automatic retry is safe (a stale kept-alive socket fails before
+        the server sees anything; a fresh connection that dies
+        mid-response may have EXECUTED the request, so replaying it is
+        the caller's call)."""
+        now = clockctl.monotonic()
+        while True:
+            with self._lock:
+                stack = self._idle.get(netloc)
+                if not stack:
+                    break
+                conn, parked_at = stack.pop()
+                if not stack:
+                    del self._idle[netloc]
+                self._total -= 1
+            if now - parked_at > self.idle_ttl or not _conn_alive(conn):
+                self.evictions += 1
+                conn.close()
+                continue
+            # weedlint: disable=persistent-socket-timeout — re-armed
+            # per request with the caller's deadline-capped timeout
+            conn.sock.settimeout(timeout)
+            self.reuses += 1
+            return conn, True
+        self.dials += 1
+        return _make_conn(netloc, timeout), False
+
+    def release(self, conn: RawHttpConnection) -> None:
+        if conn.sock is None:
+            return
+        evicted = None
+        with self._lock:
+            stack = self._idle.get(conn.netloc)
+            if stack is not None and len(stack) >= self.per_dest:
+                self.evictions += 1
+                evicted = conn  # destination stack full: close this one
+            else:
+                if self._total >= self.max_idle:
+                    evicted = self._evict_oldest_locked()
+                if stack is None:
+                    stack = self._idle.setdefault(conn.netloc, [])
+                stack.append((conn, clockctl.monotonic()))
+                self._total += 1
+        if evicted is not None:
+            evicted.close()
+
+    def _evict_oldest_locked(self):
+        """Drop the globally least-recently-parked idle connection
+        (LRU destination eviction). Caller holds the lock."""
+        oldest_key, oldest_i, oldest_t = None, -1, None
+        for key, stack in self._idle.items():
+            # index 0 is the oldest entry of each destination stack
+            t = stack[0][1]
+            if oldest_t is None or t < oldest_t:
+                oldest_key, oldest_i, oldest_t = key, 0, t
+        if oldest_key is None:
+            return None
+        conn, _ = self._idle[oldest_key].pop(oldest_i)
+        if not self._idle[oldest_key]:
+            del self._idle[oldest_key]
+        self._total -= 1
+        self.evictions += 1
+        return conn
+
+    def drop(self, netloc: str) -> None:
+        """Evict every idle connection to `netloc` — called on any
+        transport failure and when the peer's breaker opens (the
+        siblings ride the same dead peer)."""
+        with self._lock:
+            stack = self._idle.pop(netloc, None)
+            if stack:
+                self._total -= len(stack)
+                self.evictions += len(stack)
+        for conn, _ in stack or ():
+            conn.close()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"idle": self._total,
+                    "destinations": len(self._idle),
+                    "dials": self.dials, "reuses": self.reuses,
+                    "evictions": self.evictions}
+
+
+_POOL = HttpConnectionPool()
+
+
+def _breaker_evict(peer: str) -> None:
+    # peer keys are 'ip:port' or a full URL; the pool keys by netloc
+    _POOL.drop(urllib.parse.urlsplit(peer).netloc
+               if "//" in peer else peer)
+
+
+resilience.on_breaker_open(_breaker_evict)
+
+
+def _pooled_conn(netloc: str, timeout: float):
+    return _POOL.acquire(netloc, timeout)
 
 
 def _drop_conn(netloc: str) -> None:
-    pool = getattr(_conn_local, "conns", None)
-    if pool is not None:
-        conn = pool.pop(netloc, None)
-        if conn is not None:
-            conn.close()
+    _POOL.drop(netloc)
 
 
 def http_call(method: str, url: str, body: Optional[bytes] = None,
@@ -825,23 +1493,29 @@ def _http_call_impl(method: str, url: str, body: Optional[bytes] = None,
     for attempt in (0, 1):
         sent = False
         reused = False
+        conn = None
         try:
             # inside the try: connection setup itself can raise
             # (SYN timeout, DNS failure, bad netloc) and must surface
             # as ConnectionError like every other transport failure
-            conn, reused = _pooled_conn(parsed.netloc, timeout)
+            conn, reused = _POOL.acquire(parsed.netloc, timeout)
             conn.send_request(method, target, body, headers)
             sent = True
             status, data, resp_headers, will_close = \
                 conn.read_response(method)
             if will_close:
-                _drop_conn(parsed.netloc)
+                conn.close()
+            else:
+                _POOL.release(conn)
             return status, data, resp_headers
         except (BrokenPipeError, ConnectionResetError,
                 ConnectionRefusedError, ConnectionAbortedError,
                 ConnectionError, socket.timeout, ValueError,
                 OSError) as e:
-            _drop_conn(parsed.netloc)
+            if conn is not None:
+                conn.close()
+            # the destination's idle siblings share the dead peer
+            _POOL.drop(parsed.netloc)
             last_err = e
             # Replay rules (Go http.Transport's): only on a REUSED
             # kept-alive socket, and only when the request either

@@ -61,6 +61,17 @@ CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half-open"
 
+# Process-wide breaker-open listeners: fn(peer_key) runs when any
+# PeerHealth-tracked breaker transitions closed/half-open -> open.
+# httpd's connection pool registers here to evict the dead peer's idle
+# keep-alive sockets (they ride the same host the breaker just
+# declared down). Hooks must be cheap and never raise.
+_BREAKER_OPEN_HOOKS: list = []
+
+
+def on_breaker_open(fn) -> None:
+    _BREAKER_OPEN_HOOKS.append(fn)
+
 
 class DeadlineExceeded(ConnectionError):
     """A call's time budget ran out before (or while) it was made.
@@ -416,7 +427,15 @@ class PeerHealth:
 
     def record(self, url: str, ok: bool,
                latency_s: Optional[float] = None) -> None:
-        self.breaker(url).record(ok, latency_s)
+        br = self.breaker(url)
+        was_open = br.state == OPEN
+        br.record(ok, latency_s)
+        if not ok and br.state == OPEN and not was_open:
+            for fn in _BREAKER_OPEN_HOOKS:
+                try:
+                    fn(url)
+                except Exception:
+                    pass
         if self._c_outcomes is not None:
             self._c_outcomes.inc("ok" if ok else "error")
 

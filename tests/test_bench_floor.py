@@ -155,6 +155,29 @@ def test_degraded_read_floor(monkeypatch):
     # hedged tail must also beat the straggler in absolute terms
     assert out["degraded_read_p99_ms"] < \
         out["degraded_read_straggler_ms"], out
+    # warm hot-needle-cache reads skip the shard hop entirely: the bar
+    # is 3x under the hedged tail (measured: sub-ms vs ~50ms). The
+    # bench itself asserts bit-identity of every cached read sample.
+    assert out["hot_read_warm_p99_ms"] * 3 <= \
+        out["degraded_read_p99_ms"], out
+
+
+def test_conn_hold_floor(monkeypatch):
+    """Small-N tier-1 cut of the 10k-connection hold (the full sweep
+    rides `SEAWEEDFS_TPU_BENCH_CONNS` in the nightly bench): hundreds
+    of idle keep-alive sockets must park on the selector without
+    growing the thread count past the worker pool, and the probe p99
+    with every socket open must stay within 2x of the 100-conn
+    in-run baseline."""
+    import bench
+
+    monkeypatch.setenv("SEAWEEDFS_TPU_BENCH_CONNS", "400")
+    out = bench.bench_conn_hold(n_probe=100)
+    assert out["conn_hold_parked"] >= out["conn_hold_n"], out
+    assert out["conn_hold_thread_growth"] <= \
+        out["conn_hold_workers"] + 2, out
+    assert out["conn_hold_probe_p99_ms_full"] <= \
+        2 * max(out["conn_hold_probe_p99_ms_100"], 0.5), out
 
 
 def test_filer_put_floor(monkeypatch):

@@ -422,6 +422,10 @@ class _EcChaosCluster:
         http_json("POST", f"http://{src}/admin/ec/delete_shards",
                   {"volume_id": vid, "shard_ids": [sid]})
         time.sleep(0.2)
+        # every read must take the remote shard hop these scenarios
+        # exercise — a warm needle cache would serve repeats from
+        # memory and starve the breaker of probe traffic
+        self.vs1.store.needle_cache = None
 
     def read(self, deadline_s=None, timeout=30.0):
         headers = ({DEADLINE_HEADER: f"{deadline_s:.3f}"}

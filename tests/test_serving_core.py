@@ -1,0 +1,265 @@
+"""Selector serving core: ambient-scope re-entry per dispatched
+request, scope hygiene across worker-thread reuse and parked
+connections, bounded threads under many idle keepalives, and the
+client-side keepalive pool (reuse, bounds, breaker eviction)."""
+
+import json
+import threading
+import time
+
+from seaweedfs_tpu.qos import classes as qos_classes
+from seaweedfs_tpu.utils import resilience, tracing
+from seaweedfs_tpu.utils.httpd import (HttpConnectionPool, HttpServer,
+                                       RawHttpConnection, Response,
+                                       http_call, http_json)
+
+
+def _raw(port):
+    return RawHttpConnection(f"127.0.0.1:{port}", 5.0)
+
+
+def _req(conn, target, headers=None):
+    """One keepalive request on a raw connection -> (status, json)."""
+    conn.send_request("GET", target, None, headers)
+    status, body, _hdrs, _close = conn.read_response("GET")
+    return status, (json.loads(body) if body else None)
+
+
+def _scope_server(workers=1):
+    """One-worker server whose /scope handler reports every ambient
+    scope it sees — the worker thread is reused across requests, so
+    any leak from a previous request shows up immediately."""
+    srv = HttpServer(workers=workers, queue_depth=64)
+    srv.tracer = tracing.Tracer(node="t", enabled=True, sample_rate=1.0)
+
+    def scope(req):
+        span = tracing.current_span()
+        dl = resilience.current_deadline()
+        out = {
+            "class": qos_classes.current_class(),
+            "deadline": None if dl is None else dl.remaining(),
+            "trace": span.trace_id if span is not None else None,
+            "thread": threading.current_thread().name,
+        }
+        if req.query.get("enter_deadline"):
+            # handler-level deadline scope (volume-server idiom) must
+            # end with the request, not stick to the worker thread
+            with resilience.deadline_scope(
+                    resilience.Deadline.after(5.0)):
+                out["entered"] = resilience.current_deadline() \
+                    .remaining() > 0
+        return Response(out)
+
+    srv.add("GET", "/scope", scope)
+    srv.start()
+    return srv
+
+
+def test_scopes_reentered_per_request_not_per_connection():
+    srv = _scope_server(workers=1)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        # request 1 rides a traffic class + enters a deadline scope
+        _, body, _ = http_call("GET", f"{base}/scope?enter_deadline=1",
+                               headers={"X-Weed-Class": "background"})
+        first = json.loads(body)
+        assert first["class"] == "background"
+        assert first["entered"] is True
+        assert first["trace"]
+        # request 2: same server, same (sole) worker thread, NO
+        # headers — every scope must be fresh, nothing inherited
+        second = http_json("GET", f"{base}/scope")
+        assert second is not None
+        assert second["thread"] == first["thread"]  # thread reused
+        assert second["class"] is None              # ...scopes aren't
+        assert second["deadline"] is None
+        assert second["trace"] and second["trace"] != first["trace"]
+    finally:
+        srv.stop()
+
+
+def test_keepalive_connection_parks_without_scope():
+    """A parked keepalive connection holds no thread and no scope:
+    the next request on it re-enters everything at dispatch."""
+    srv = _scope_server(workers=2)
+    try:
+        conn = _raw(srv.port)
+        _, r1 = _req(conn, "/scope",
+                     headers={"X-Weed-Class": "interactive"})
+        assert r1["class"] == "interactive"
+        # connection now parked in the selector — no worker attached
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if srv.conn_stats()["parked"] >= 1:
+                break
+            time.sleep(0.01)
+        assert srv.conn_stats()["parked"] >= 1
+        _, r2 = _req(conn, "/scope")  # same socket, no class
+        assert r2["class"] is None
+        assert r2["deadline"] is None
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_idle_keepalive_connections_bounded_threads():
+    """Many idle keepalive connections are parked by the selector, not
+    held by threads: the thread count stays ~(workers + acceptor),
+    nowhere near one-per-connection."""
+    n_conns = 120
+    srv = HttpServer(workers=4, queue_depth=256)
+    srv.add("GET", "/ping", lambda req: Response({"ok": True}))
+    srv.start()
+    conns = []
+    try:
+        before = threading.active_count()
+        for _ in range(n_conns):
+            c = _raw(srv.port)
+            assert _req(c, "/ping")[0] == 200
+            conns.append(c)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if srv.conn_stats()["parked"] >= n_conns:
+                break
+            time.sleep(0.02)
+        st = srv.conn_stats()
+        assert st["parked"] >= n_conns
+        grown = threading.active_count() - before
+        # bounded by the pool, not the connection count
+        assert grown <= 4 + 2, f"thread growth {grown} for {n_conns} conns"
+        # the parked sockets still serve: requests interleave fine
+        for c in conns[::17]:
+            assert _req(c, "/ping")[0] == 200
+    finally:
+        for c in conns:
+            c.close()
+        srv.stop()
+
+
+def test_worker_pool_sheds_when_saturated():
+    """queue_depth overflow gets a canned 503 from the selector thread
+    instead of an unbounded backlog."""
+    gate = threading.Event()
+    srv = HttpServer(workers=1, queue_depth=1)
+
+    def slow(req):
+        gate.wait(5.0)
+        return Response({"ok": True})
+
+    srv.add("GET", "/slow", slow)
+    srv.start()
+    try:
+        conns = []
+        for _ in range(12):
+            c = _raw(srv.port)
+            c.send_request("GET", "/slow", None, None)
+            conns.append(c)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if srv.conn_stats()["shed_busy"] > 0:
+                break
+            time.sleep(0.02)
+        assert srv.conn_stats()["shed_busy"] > 0
+        gate.set()
+        for c in conns:
+            c.close()
+    finally:
+        gate.set()
+        srv.stop()
+
+
+# ---- client-side keepalive pool ----
+
+def test_client_pool_reuses_connections(monkeypatch):
+    import seaweedfs_tpu.utils.httpd as httpd_mod
+    pool = HttpConnectionPool(per_dest=4, max_idle=16)
+    monkeypatch.setattr(httpd_mod, "_POOL", pool)
+    srv = HttpServer()
+    srv.add("GET", "/ping", lambda req: Response({"ok": True}))
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/ping"
+        for _ in range(6):
+            status, _, _ = http_call("GET", url)
+            assert status == 200
+        st = pool.stats()
+        assert st["dials"] == 1
+        assert st["reuses"] == 5
+        assert st["idle"] <= 4
+    finally:
+        srv.stop()
+
+
+class _FakeConn:
+    def __init__(self, netloc):
+        self.netloc = netloc
+        self.sock = object()  # non-None: release() parks it
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+        self.sock = None
+
+
+def test_client_pool_bounds_and_lru_eviction():
+    """Global idle cap evicts the least-recently-used destination's
+    oldest connection, and per-destination stacks stay bounded."""
+    pool = HttpConnectionPool(per_dest=2, max_idle=3)
+    a1, a2, a3 = (_FakeConn("a:1") for _ in range(3))
+    pool.release(a1)
+    pool.release(a2)
+    pool.release(a3)  # per-dest stack full: the returned conn closes
+    assert a3.closed and not a1.closed
+    assert pool.stats()["idle"] == 2
+    b, c = _FakeConn("b:2"), _FakeConn("c:3")
+    pool.release(b)
+    pool.release(c)   # global cap 3: globally-oldest idle (a1) evicted
+    st = pool.stats()
+    assert st["idle"] == 3
+    assert a1.closed and not a2.closed
+    assert not b.closed and not c.closed
+    pool.drop("a:1")
+    pool.drop("b:2")
+    pool.drop("c:3")
+    assert pool.stats()["idle"] == 0
+    assert a2.closed and b.closed and c.closed
+
+
+def test_client_pool_breaker_eviction(monkeypatch):
+    """A peer breaker opening flushes that destination's idle
+    connections (they point at a node we just declared bad)."""
+    import seaweedfs_tpu.utils.httpd as httpd_mod
+    pool = HttpConnectionPool(per_dest=4, max_idle=16)
+    monkeypatch.setattr(httpd_mod, "_POOL", pool)
+    srv = HttpServer()
+    srv.add("GET", "/ping", lambda req: Response({"ok": True}))
+    srv.start()
+    try:
+        dest = f"127.0.0.1:{srv.port}"
+        status, _, _ = http_call("GET", f"http://{dest}/ping")
+        assert status == 200
+        assert pool.stats()["idle"] == 1
+        httpd_mod._breaker_evict(dest)
+        assert pool.stats()["idle"] == 0
+    finally:
+        srv.stop()
+
+
+def test_pooled_call_transport_failure_drops_destination(monkeypatch):
+    """Any transport failure drops every idle connection to that
+    destination — a dead server's stale sockets don't get replayed."""
+    import seaweedfs_tpu.utils.httpd as httpd_mod
+    pool = HttpConnectionPool(per_dest=4, max_idle=16)
+    monkeypatch.setattr(httpd_mod, "_POOL", pool)
+    srv = HttpServer()
+    srv.add("GET", "/ping", lambda req: Response({"ok": True}))
+    srv.start()
+    dest = f"127.0.0.1:{srv.port}"
+    status, _, _ = http_call("GET", f"http://{dest}/ping")
+    assert status == 200
+    srv.stop()
+    try:
+        http_call("GET", f"http://{dest}/ping", timeout=2.0)
+    except ConnectionError:
+        pass
+    assert pool.stats()["idle"] == 0

@@ -1,0 +1,178 @@
+"""Open-connection sweep against one HTTP edge: RSS / threads / p99.
+
+Ramps idle keep-alive connections against a node (each sends one ping
+so the selector core parks its socket, then sits silent) and at every
+checkpoint reports what the held connections actually cost:
+
+  conns      sockets currently held open by this tool
+  parked     the server's own count of selector-parked sockets
+             (from GET /admin/cache `connections` or /healthz, when
+             the target exposes one; blank against a bare edge)
+  threads    server-side thread count if reachable, else this tool's
+  rss_mb     this process's resident set (proxy for per-conn cost when
+             profiling a server in the same container; pass --pid to
+             sample another process's /proc/<pid>/status instead)
+  p99_ms     probe-request p99 over a separate keep-alive connection,
+             measured fresh at each checkpoint
+
+The interesting shape: threads and p99 should stay FLAT as conns grow
+(the selector parks idle sockets; only the bounded worker pool serves),
+while rss grows linearly at a few KB per connection.
+
+Usage:
+  PYTHONPATH=. python tools/conn_profile.py --node 127.0.0.1:8080 \
+      [--max-conns 10000] [--checkpoints 8] [--probes 100] \
+      [--path /status] [--pid N] [--json]
+
+Needs an fd budget of ~max-conns + slack; the tool raises its own
+RLIMIT_NOFILE soft limit toward the hard limit and scales the sweep
+down if that still falls short.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from seaweedfs_tpu.utils import clockctl  # noqa: E402
+from seaweedfs_tpu.utils.httpd import RawHttpConnection, http_json  # noqa: E402
+
+
+def rss_kb(pid: str = "self") -> int:
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return 0
+
+
+def threads_of(pid: str = "self") -> int:
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("Threads:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return 0
+
+
+def server_conn_stats(node: str) -> dict:
+    """Best-effort: volume servers expose conn_stats on /admin/cache."""
+    try:
+        out = http_json("GET", f"http://{node}/admin/cache", timeout=3.0)
+        return out.get("connections", {}) or {}
+    except Exception:  # noqa: BLE001 — bare edges have no admin surface
+        return {}
+
+
+def raise_fd_limit(want: int) -> int:
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < want:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(want, hard), hard))
+        except (ValueError, OSError):
+            pass
+        soft = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    return soft
+
+
+def open_idle(node: str, path: str, n: int, bag: list) -> None:
+    for _ in range(n):
+        c = RawHttpConnection(node, 10.0)
+        c.send_request("GET", path, None, None)
+        status, _body, _hdrs, will_close = c.read_response("GET")
+        if status != 200:
+            raise SystemExit(f"setup ping {path}: HTTP {status}")
+        if will_close:
+            raise SystemExit(
+                "target closes after each request (no keep-alive) — "
+                "an idle-connection sweep cannot hold sockets against it")
+        bag.append(c)
+
+
+def probe_p99_ms(node: str, path: str, n: int) -> float:
+    c = RawHttpConnection(node, 10.0)
+    samples = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        c.send_request("GET", path, None, None)
+        status, _body, _hdrs, _close = c.read_response("GET")
+        samples.append(time.perf_counter() - t0)
+        if status != 200:
+            raise SystemExit(f"probe {path}: HTTP {status}")
+    c.close()
+    samples.sort()
+    return round(samples[min(len(samples) - 1,
+                             int(len(samples) * 0.99))] * 1000.0, 3)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--node", required=True, help="HOST:PORT to sweep")
+    ap.add_argument("--max-conns", type=int, default=10000)
+    ap.add_argument("--checkpoints", type=int, default=8)
+    ap.add_argument("--probes", type=int, default=100)
+    ap.add_argument("--path", default="/status",
+                    help="GET target for pings/probes (default /status)")
+    ap.add_argument("--pid", default="self",
+                    help="sample /proc/<pid> RSS+threads (default: self)")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON line per checkpoint")
+    args = ap.parse_args(argv)
+
+    soft = raise_fd_limit(args.max_conns + 512)
+    max_conns = min(args.max_conns, max(64, soft - 512))
+    if max_conns < args.max_conns:
+        print(f"# fd soft limit {soft}: sweeping to {max_conns} "
+              f"instead of {args.max_conns}", file=sys.stderr)
+
+    step = max(1, max_conns // max(1, args.checkpoints))
+    targets = sorted({min(max_conns, step * (i + 1))
+                      for i in range(args.checkpoints)} | {max_conns})
+
+    if not args.json:
+        print(f"{'conns':>7} {'parked':>7} {'threads':>8} "
+              f"{'rss_mb':>8} {'p99_ms':>8}")
+    conns: list = []
+    try:
+        for target in targets:
+            open_idle(args.node, args.path, target - len(conns), conns)
+            clockctl.sleep(0.2)  # let the last responses park
+            st = server_conn_stats(args.node)
+            row = {
+                "conns": len(conns),
+                "parked": st.get("parked"),
+                "threads": st.get("threads") or threads_of(args.pid),
+                "rss_mb": round(rss_kb(args.pid) / 1024.0, 1),
+                "p99_ms": probe_p99_ms(args.node, args.path,
+                                       args.probes),
+            }
+            if args.json:
+                print(json.dumps(row), flush=True)
+            else:
+                print(f"{row['conns']:>7} "
+                      f"{'' if row['parked'] is None else row['parked']:>7} "
+                      f"{row['threads']:>8} {row['rss_mb']:>8} "
+                      f"{row['p99_ms']:>8}", flush=True)
+    finally:
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
