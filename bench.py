@@ -754,6 +754,11 @@ def bench_filer_put(size_mb: int = 4, chunk_kb: int = 256,
                           advertise=proxy.url)
         vs.start()
         fs = FilerServer(master.url)
+        # pin the buffered ingest path: this bench compares the wide
+        # upload pool against the serial loop at a fixed RTT, and the
+        # streaming pipeline caps fan-out at STREAM_INFLIGHT by design
+        # (its own bench is bench_filer_streaming_rss)
+        fs.streaming_ingest = False
         fs.start()
         try:
             def put_and_verify(name: str) -> float:
@@ -1027,6 +1032,311 @@ def bench_overload(n_reads: int = 12, n_bg: int = 24,
     }
 
 
+def _vm_hwm_kb(pid: int) -> int:
+    """Peak resident set (VmHWM) of a live process, in KB — the
+    kernel's own high-water mark, so no sampling thread can miss a
+    transient allocation spike."""
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmHWM:"):
+                return int(line.split()[1])
+    raise RuntimeError(f"no VmHWM in /proc/{pid}/status")
+
+
+def _stream_put(filer_url: str, path: str, size: int, seed: int,
+                block: int = 1 << 20) -> tuple[int, str]:
+    """Stream a deterministic `size`-byte body to the filer block at a
+    time over a raw socket — no full copy of the body ever exists in
+    this process, so the filer child's RSS is the only place body
+    memory can accumulate. Returns (status, sha256 of what was sent);
+    regenerating with the same seed replays the identical stream."""
+    import hashlib
+    import socket as _socket
+
+    rng = np.random.default_rng(seed)
+    h = hashlib.sha256()
+    host, port = filer_url.split(":")
+    s = _socket.create_connection((host, int(port)), timeout=300)
+    try:
+        s.sendall(f"POST {path} HTTP/1.1\r\nHost: {filer_url}\r\n"
+                  f"Content-Length: {size}\r\n"
+                  f"Connection: close\r\n\r\n".encode())
+        sent = 0
+        while sent < size:
+            n = min(block, size - sent)
+            blk = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            h.update(blk)
+            s.sendall(blk)
+            sent += n
+        s.settimeout(300)
+        resp = b""
+        while b"\r\n" not in resp:
+            got = s.recv(65536)
+            if not got:
+                break
+            resp += got
+        status = int(resp.split(b" ", 2)[1]) if resp else 0
+        return status, h.hexdigest()
+    finally:
+        s.close()
+
+
+def _stream_get_sha(filer_url: str, path: str) -> tuple[int, int, str]:
+    """GET `path` and hash the body as it arrives (raw socket,
+    Connection: close) — the comparator readback must not re-buffer a
+    256MB object in the parent either. Returns (status, bytes,
+    sha256)."""
+    import hashlib
+    import socket as _socket
+
+    host, port = filer_url.split(":")
+    s = _socket.create_connection((host, int(port)), timeout=300)
+    try:
+        s.sendall(f"GET {path} HTTP/1.1\r\nHost: {filer_url}\r\n"
+                  f"Connection: close\r\n\r\n".encode())
+        s.settimeout(300)
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            got = s.recv(65536)
+            if not got:
+                raise ConnectionError("EOF before response headers")
+            buf += got
+        head, body = buf.split(b"\r\n\r\n", 1)
+        status = int(head.split(b" ", 2)[1])
+        length = None
+        for line in head.split(b"\r\n")[1:]:
+            k, _, v = line.partition(b":")
+            if k.strip().lower() == b"content-length":
+                length = int(v.strip())
+        h = hashlib.sha256()
+        n = len(body)
+        h.update(body)
+        while length is None or n < length:
+            got = s.recv(1 << 20)
+            if not got:
+                break
+            if length is not None and n + len(got) > length:
+                got = got[:length - n]
+            h.update(got)
+            n += len(got)
+        return status, n, h.hexdigest()
+    finally:
+        s.close()
+
+
+def bench_filer_streaming_rss(size_mb: int = 256,
+                              chunk_mb: int = 4) -> dict:
+    """Bounded-memory streaming ingest: the filer's peak RSS while
+    ingesting a 256MB-class PUT must be a few CHUNK_SIZE buffers, not
+    the body.
+
+    The filer runs ALONE in a child process (`--filer-child` mode of
+    this script) so /proc/<pid>/status VmHWM isolates its memory from
+    the master, the volume server, and the client, which all stay in
+    this process. The client streams a deterministic body over a raw
+    socket block at a time (no full copy exists anywhere), a warm-up
+    PUT charges thread pools and pooled sockets outside the window,
+    and the VmHWM delta across the big PUT is the write path's true
+    peak. The buffered comparator child (streaming_ingest off)
+    re-ingests the same byte stream — its delta is the whole body, the
+    number the streaming path deletes — and the two stored objects
+    must match chunk-for-chunk (layout) and byte-for-byte (streamed
+    readback hash vs sent hash). SEAWEEDFS_TPU_BENCH_STREAM_MB
+    overrides the body size."""
+    import tempfile
+
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.utils.httpd import http_call
+
+    size_mb = int(os.environ.get("SEAWEEDFS_TPU_BENCH_STREAM_MB",
+                                 size_mb))
+    size = size_mb * 1024 * 1024
+    chunk = chunk_mb * 1024 * 1024
+    with tempfile.TemporaryDirectory() as d:
+        master = MasterServer(volume_size_limit_mb=1024)
+        master.start()
+        vs = VolumeServer([d], master.url)
+        vs.start()
+
+        def run_child(streaming: bool, name: str) -> dict:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--filer-child", master.url, str(chunk),
+                 "1" if streaming else "0"],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE)
+            try:
+                info = json.loads(proc.stdout.readline())
+                url, pid = info["url"], info["pid"]
+                st, _ = _stream_put(url, f"/warm/{name}",
+                                    2 * chunk + 7, seed=7)
+                if st != 201:
+                    raise RuntimeError(f"warm-up PUT failed: {st}")
+                before = _vm_hwm_kb(pid)
+                t0 = time.perf_counter()
+                st, sha_sent = _stream_put(url, f"/rss/{name}", size,
+                                           seed=29)
+                dt = time.perf_counter() - t0
+                if st != 201:
+                    raise RuntimeError(f"PUT failed: HTTP {st}")
+                delta_kb = _vm_hwm_kb(pid) - before
+                st, got_n, sha_read = _stream_get_sha(
+                    url, f"/rss/{name}")
+                if st != 200 or got_n != size:
+                    raise RuntimeError(
+                        f"readback failed: HTTP {st}, {got_n} bytes")
+                st, ebody, _ = http_call(
+                    "GET", f"http://{url}/__api/entry?path=/rss/{name}",
+                    timeout=60)
+                layout = [(c["offset"], c["size"]) for c in
+                          json.loads(ebody)["entry"]["chunks"]]
+                return {"delta_kb": delta_kb, "mbps": size / dt / 1e6,
+                        "sha_sent": sha_sent, "sha_read": sha_read,
+                        "layout": layout}
+            finally:
+                proc.stdin.close()
+                proc.wait(timeout=60)
+
+        try:
+            streamed = run_child(True, "streamed")
+            buffered = run_child(False, "buffered")
+        finally:
+            vs.stop()
+            master.stop()
+    identical = (streamed["sha_sent"] == streamed["sha_read"]
+                 == buffered["sha_sent"] == buffered["sha_read"]
+                 and streamed["layout"] == buffered["layout"])
+    return {
+        "filer_streaming_rss_mb": round(streamed["delta_kb"] / 1024, 1),
+        "filer_streaming_rss_buffered_mb": round(
+            buffered["delta_kb"] / 1024, 1),
+        "filer_streaming_body_mb": size_mb,
+        "filer_streaming_chunk_mb": chunk_mb,
+        "filer_streaming_budget_mb": 3 * chunk_mb,
+        "filer_streaming_mbps": round(streamed["mbps"], 1),
+        "filer_streaming_bit_identical": identical,
+    }
+
+
+def bench_replica_divergence_repair(n_writes: int = 10,
+                                    deadline_s: float = 0.5) -> dict:
+    """The divergence drill as numbers: writes issued while one
+    replica leg is blackholed (netchaos proxy) must all ack on the
+    sloppy quorum (zero failures), each missed leg becomes a journal
+    hint, the first read on the lagging replica after the heal repairs
+    in-line, and the drain settles every debt leaving the replicas
+    bit-identical (raw needle records).
+
+    Dark-window write latency is bounded by REPLICATE_DEADLINE_S (set
+    to `deadline_s` here) until the peer breaker opens, then failing
+    legs cost nothing — the p99 proves divergence never blocks the
+    client. drain_s runs from the heal to an empty journal and
+    includes the breaker's half-open wait (open_for=5s), the honest
+    time-to-settle. SEAWEEDFS_TPU_BENCH_DIVERGENCE_WRITES overrides
+    n_writes."""
+    import tempfile
+
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.file_id import parse_needle_id_cookie
+    from seaweedfs_tpu.utils.httpd import http_call, http_json
+    from tools.netchaos import ChaosProxy
+
+    n_writes = int(os.environ.get(
+        "SEAWEEDFS_TPU_BENCH_DIVERGENCE_WRITES", n_writes))
+
+    def blob(url: str, vid: int, key: int) -> dict:
+        return http_json("GET", f"http://{url}/admin/needle_blob"
+                         f"?volumeId={vid}&key={key}")
+
+    with tempfile.TemporaryDirectory() as d:
+        master = MasterServer(volume_size_limit_mb=64)
+        master.start()
+        vs1 = VolumeServer([os.path.join(d, "v1")], master.url)
+        vs1.start()
+        peer_port = _free_port()
+        proxy = ChaosProxy("127.0.0.1", peer_port).start()
+        vs2 = VolumeServer([os.path.join(d, "v2")], master.url,
+                           port=peer_port, advertise=proxy.url)
+        vs2.start()
+        mc = MasterClient(master.url, cache_ttl=0.0)
+        vs1_direct = f"{vs1.http.host}:{vs1.http.port}"
+        vs1.REPLICATE_DEADLINE_S = deadline_s
+        try:
+            payload = b"\x5a" * 4096
+            a = mc.assign(replication="001")
+            if a.get("error"):
+                raise RuntimeError(f"assign failed: {a['error']}")
+            st, _, _ = http_call("POST",
+                                 f"http://{vs1_direct}/{a['fid']}",
+                                 body=payload, timeout=30)
+            if st != 201:
+                raise RuntimeError(f"healthy write failed: {st}")
+
+            proxy.set_fault(mode="blackhole")
+            fids, dark = [], []
+            failed = 0
+            for i in range(n_writes):
+                a = mc.assign(replication="001")
+                if a.get("error"):
+                    raise RuntimeError(f"assign failed: {a['error']}")
+                t0 = time.perf_counter()
+                st, _, _ = http_call(
+                    "POST", f"http://{vs1_direct}/{a['fid']}",
+                    body=payload, timeout=30)
+                dark.append(time.perf_counter() - t0)
+                if st != 201:
+                    failed += 1
+                else:
+                    fids.append(a["fid"])
+            hints = len(vs1.hint_journal.pending_for(proxy.url))
+
+            proxy.set_fault(mode="pass")
+            t_heal = time.perf_counter()
+            # first read on the lagging replica: the 404 pulls the
+            # needle from the healthy sibling in-line
+            t0 = time.perf_counter()
+            st, got, _ = http_call("GET",
+                                   f"http://{proxy.url}/{fids[0]}",
+                                   timeout=30)
+            repair_ms = (time.perf_counter() - t0) * 1000
+            if st != 200 or got != payload:
+                raise RuntimeError(f"read repair failed: HTTP {st}")
+
+            give_up = time.time() + 60
+            while len(vs1.hint_journal) and time.time() < give_up:
+                vs1.drain_hints()
+                time.sleep(0.05)
+            if len(vs1.hint_journal):
+                raise RuntimeError("hint journal never drained")
+            drain_s = time.perf_counter() - t_heal
+
+            identical = True
+            for fid in fids:
+                vid = int(fid.split(",")[0])
+                key, _ = parse_needle_id_cookie(fid.split(",", 1)[1])
+                identical = identical and (
+                    blob(vs1_direct, vid, key) == blob(proxy.url, vid,
+                                                       key))
+        finally:
+            mc.stop()
+            vs2.stop()
+            vs1.stop()
+            proxy.stop()
+            master.stop()
+    return {
+        "divergence_writes": n_writes,
+        "divergence_failed_writes": failed,
+        "divergence_hints_journaled": hints,
+        "divergence_dark_write_p99_ms": _p99_ms(dark),
+        "divergence_read_repair_ms": round(repair_ms, 1),
+        "divergence_drain_s": round(drain_s, 2),
+        "divergence_deadline_ms": deadline_s * 1000,
+        "divergence_bit_identical": identical,
+    }
+
+
 # Backend-detection outcomes, keyed by (command, schedule): probing is
 # expensive (BENCH_r05 burned 4 x 300s timeouts re-attempting a hung
 # relay), so one process never probes the same backend twice.
@@ -1124,6 +1434,24 @@ def main(argv=None):
                               "tpu_fallback_reason": "device_put",
                               "error": repr(e)[-300:]}))
         return 0
+    if "--filer-child" in argv:
+        # Child mode for bench_filer_streaming_rss: host ONLY the
+        # filer here so /proc/<pid>/status VmHWM measures the filer's
+        # write-path memory, not the client's or the volume server's.
+        # Args: master_url chunk_size streaming(0|1). Exits when the
+        # parent closes stdin.
+        import seaweedfs_tpu.server.filer_server as fsrv
+        from seaweedfs_tpu.server.filer_server import FilerServer
+        i = argv.index("--filer-child")
+        fsrv.CHUNK_SIZE = int(argv[i + 2])
+        fs = FilerServer(argv[i + 1])
+        fs.streaming_ingest = argv[i + 3] == "1"
+        fs.start()
+        print(json.dumps({"url": fs.url, "pid": os.getpid()}),
+              flush=True)
+        sys.stdin.read()
+        fs.stop()
+        return 0
     cpu = bench_cpu()  # measured first; never discarded
     e2e = bench_volume_encode()  # CPU-only, also never discarded
     e2e.update(bench_scrub())  # CPU-only integrity read path
@@ -1134,6 +1462,8 @@ def main(argv=None):
     e2e.update(bench_overload())  # QoS admission under overload
     e2e.update(bench_telemetry_overhead())  # RED+sketch plane cost
     e2e.update(bench_repair_network())  # partial-column repair ingress
+    e2e.update(bench_filer_streaming_rss())  # bounded-memory ingest
+    e2e.update(bench_replica_divergence_repair())  # hinted-handoff drill
     tpu, attempts, err = tpu_probe_with_retries()
     if tpu is not None:
         print(json.dumps({

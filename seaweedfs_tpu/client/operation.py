@@ -12,9 +12,11 @@ import urllib.parse
 from typing import Optional
 
 from seaweedfs_tpu.client.wdclient import MasterClient
+from seaweedfs_tpu.storage.file_id import parse_needle_id_cookie
+from seaweedfs_tpu.utils import headers as weed_headers
 from seaweedfs_tpu.utils import tracing
-from seaweedfs_tpu.utils.httpd import HttpError, http_call
-from seaweedfs_tpu.utils.resilience import hedged
+from seaweedfs_tpu.utils.httpd import HttpError, http_call, http_json
+from seaweedfs_tpu.utils.resilience import Deadline, hedged
 
 
 class UploadResult:
@@ -75,39 +77,80 @@ def read_data(mc: MasterClient, fid: str,
     and a stalled first pick triggers a hedged backup fetch on the
     next-ranked replica — the serial walk failed over only after a
     full timeout, paying the slowest server's tail on every read.
-    delete_file below stays serial: deletes are not safe to race."""
+    delete_file below stays serial: deletes are not safe to race.
+
+    Two divergence-era behaviors ride the fetch:
+    - cache-aware routing: a replica whose response carries the
+      cache-hot header gets a bounded per-needle affinity entry in the
+      MasterClient, and is tried first on the next read of the same
+      needle (fairness guard in affinity_get keeps the other replicas
+      warm);
+    - read-repair reporting: a replica that answered 404 while a
+      sibling served the bytes is lagging a quorum write — after the
+      successful read, each lagging holder gets a best-effort
+      /admin/replica_repair nudge so it pulls the needle now instead
+      of waiting for the owner's hint drain."""
     vid = int(fid.split(",")[0])
+    try:
+        key, _cookie = parse_needle_id_cookie(fid.split(",", 1)[1])
+    except (IndexError, ValueError):
+        key = None
     urls = [loc["url"] for loc in mc.lookup_volume(vid)]
     if not urls:
         raise RuntimeError("no locations")
     errors: list[Exception] = []
+    lagging: list[str] = []
     headers = {}
     if byte_range is not None:
         lo, hi = byte_range
         headers["Range"] = f"bytes={lo}-{hi}"
 
-    def fetch(url: str) -> Optional[bytes]:
+    def fetch(url: str):
         try:
-            status, body, _ = http_call("GET", f"http://{url}/{fid}",
-                                        headers=headers or None)
+            status, body, hdrs = http_call(
+                "GET", f"http://{url}/{fid}", headers=headers or None)
         except ConnectionError as e:
             errors.append(e)
             return None
         if status == 200 or (status == 206 and byte_range is not None):
-            return body
+            return (url, body, hdrs)
+        if status == 404:
+            # may be legitimately absent everywhere; only report once
+            # some sibling proves it exists by serving it
+            lagging.append(url)
         errors.append(HttpError(status, body))
         return None
 
     health = mc.peer_health
     tracing.annotate("read.replicas", len(urls))
-    out = hedged(fetch, health.rank(urls), health=health)
-    if out is not None:
-        return out
-    # every replica failed: the holder set may have moved — drop the
-    # cached lookup so the next attempt sees fresh locations
-    mc.invalidate(vid)
-    raise errors[-1] if errors else RuntimeError(
-        f"no replica of {fid} answered")
+    ranked = health.rank(urls)
+    if key is not None:
+        preferred = mc.affinity_get(vid, key)
+        if preferred in ranked:
+            ranked = [preferred] + [u for u in ranked if u != preferred]
+    out = hedged(fetch, ranked, health=health)
+    if out is None:
+        # every replica failed: the holder set may have moved — drop
+        # the cached lookup so the next attempt sees fresh locations
+        mc.invalidate(vid)
+        if key is not None:
+            mc.affinity_drop(vid, key)
+        raise errors[-1] if errors else RuntimeError(
+            f"no replica of {fid} answered")
+    url, body, hdrs = out
+    if key is not None:
+        if hdrs.get(weed_headers.CACHE_HOT):
+            mc.affinity_note(vid, key, url)
+        for lag in lagging:
+            if lag == url:
+                continue
+            try:
+                http_json("POST", f"http://{lag}/admin/replica_repair",
+                          {"volume_id": vid, "key": key},
+                          deadline=Deadline.after(5.0))
+            except (ConnectionError, HttpError):
+                pass  # best-effort: the hint drain still covers it
+    return body
 
 
 def delete_file(mc: MasterClient, fid: str) -> bool:

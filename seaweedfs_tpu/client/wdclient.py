@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from typing import Optional
 
 from seaweedfs_tpu.utils import clockctl
@@ -40,6 +41,9 @@ class MasterClient:
         self._assign_pools: dict[tuple, tuple[float, list[dict]]] = {}
         self._assign_jwt_mode = False  # JWT replies disable pooling
         self._peer_health = None  # lazy; see peer_health
+        # cache-aware routing: (vid, key) -> [replica url, use count]
+        # for needles some replica advertised as cache-hot (bounded LRU)
+        self._affinity: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
         # push-mode state
         self._vidmap: dict[int, list[dict]] = {}
@@ -233,6 +237,51 @@ class MasterClient:
         with self._lock:
             self._cache.pop(vid, None)
             self._ec_cache.pop(vid, None)
+
+    # ---- cache-aware read routing ----
+    # A replica that served a read out of its hot-needle record cache
+    # says so via the X-Weed-Cache-Hot response header; read_data notes
+    # it here and prefers that replica on the next read of the same
+    # needle, so repeat reads of a hot needle stop spraying across
+    # replicas (each miss on a cold sibling pays a disk read AND warms
+    # a duplicate cache entry). Fairness guard: every Nth affinity hit
+    # deliberately falls back to normal health ranking so the sibling
+    # caches still see a trickle of the hot key and a single replica
+    # can't become the sole owner of the working set.
+
+    AFFINITY_CAP = 4096     # bounded: ~100 bytes/entry worst case
+    AFFINITY_FAIRNESS = 8   # every Nth hit re-ranks instead
+
+    def affinity_get(self, vid: int, key: int) -> Optional[str]:
+        """Preferred replica url for this needle, or None (unknown, or
+        this hit is the fairness guard's turn to re-rank)."""
+        with self._lock:
+            ent = self._affinity.get((vid, key))
+            if ent is None:
+                return None
+            self._affinity.move_to_end((vid, key))
+            ent[1] += 1
+            if ent[1] % self.AFFINITY_FAIRNESS == 0:
+                return None
+            return ent[0]
+
+    def affinity_note(self, vid: int, key: int, url: str) -> None:
+        """Record that `url` served (vid, key) cache-hot."""
+        with self._lock:
+            ent = self._affinity.get((vid, key))
+            if ent is not None:
+                if ent[0] != url:
+                    ent[0] = url
+                    ent[1] = 0
+                self._affinity.move_to_end((vid, key))
+                return
+            self._affinity[(vid, key)] = [url, 0]
+            while len(self._affinity) > self.AFFINITY_CAP:
+                self._affinity.popitem(last=False)
+
+    def affinity_drop(self, vid: int, key: int) -> None:
+        with self._lock:
+            self._affinity.pop((vid, key), None)
 
     def assign(self, count: int = 1, collection: str = "",
                replication: str = "", ttl: str = "",

@@ -751,13 +751,37 @@ class S3Server:
         return _err("MethodNotAllowed", req.method, 405)
 
     def _put_object(self, req: Request, bucket: str, key: str) -> Response:
+        """Object PUT rides the filer's streaming ingest: the body is
+        chunked as it arrives (bounded memory — a 5GB upload costs ~3
+        chunk buffers), with the md5 ETag folded in stream order.
+        SigV4 stays compatible: the payload hash is taken from
+        x-amz-content-sha256, never recomputed from the body."""
         tags = _parse_tag_header(req.headers.get("x-amz-tagging", ""))
-        resp, etag = self._store_object(bucket, key, req.body,
-                                        req.headers.get("Content-Type", ""),
-                                        tags=tags)
-        if resp is not None:
-            return resp
-        return Response(b"", headers={"ETag": f'"{etag}"'})
+        bucket_entry = self.filer.find_entry(f"{BUCKETS_PATH}/{bucket}")
+        if bucket_entry is None:
+            return _err("NoSuchBucket", bucket, 404)
+        # quota is priced on the DECLARED length — the honest number
+        # available before the body is consumed
+        declared = int(req.headers.get("Content-Length") or 0)
+        denied = self._check_quota(bucket, bucket_entry, declared)
+        if denied is not None:
+            return denied
+        md5 = hashlib.md5()
+        content, chunks, size = self.fs._ingest_body(
+            req, bucket, self.fs.default_replication, hasher=md5)
+        now = clockctl.now()
+        entry = Entry(
+            full_path=f"{BUCKETS_PATH}/{bucket}/{key}",
+            attr=Attr(mtime=now, crtime=now,
+                      mime=req.headers.get("Content-Type", ""),
+                      file_size=size, md5=md5.digest(),
+                      collection=bucket))
+        for k, v in (tags or {}).items():
+            entry.extended[TAG_PREFIX + k] = v
+        entry.content = content
+        entry.chunks = chunks
+        self.filer.create_entry(entry)
+        return Response(b"", headers={"ETag": f'"{md5.hexdigest()}"'})
 
     def _store_object(self, bucket: str, key: str, data: bytes,
                       mime: str, tags: Optional[dict] = None
@@ -921,23 +945,23 @@ class S3Server:
         return Response(_xml(root), content_type="application/xml")
 
     def _upload_part(self, req: Request, bucket: str, key: str) -> Response:
+        """Multipart part upload, streamed through the same bounded-
+        memory ingest as object PUT."""
         upload_id = req.query["uploadId"]
         part = int(req.query["partNumber"])
         if self.filer.find_entry(f"{UPLOADS_PATH}/{upload_id}") is None:
             return _err("NoSuchUpload", upload_id, 404)
-        data = req.body
-        md5 = hashlib.md5(data).digest()
+        md5 = hashlib.md5()
+        content, chunks, size = self.fs._ingest_body(
+            req, bucket, self.fs.default_replication, hasher=md5)
         now = clockctl.now()
         entry = Entry(f"{UPLOADS_PATH}/{upload_id}/{part:05d}.part",
-                      attr=Attr(mtime=now, crtime=now, md5=md5,
-                                file_size=len(data)))
-        if len(data) <= 2048:
-            entry.content = data
-        else:
-            entry.chunks = self.fs._upload_chunks(
-                data, bucket, self.fs.default_replication)
+                      attr=Attr(mtime=now, crtime=now, md5=md5.digest(),
+                                file_size=size))
+        entry.content = content
+        entry.chunks = chunks
         self.filer.create_entry(entry)
-        return Response(b"", headers={"ETag": f'"{md5.hex()}"'})
+        return Response(b"", headers={"ETag": f'"{md5.hexdigest()}"'})
 
     def _complete_multipart(self, req: Request, bucket: str,
                             key: str) -> Response:

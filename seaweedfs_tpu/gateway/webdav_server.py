@@ -176,15 +176,16 @@ class WebDavServer:
     def _put(self, req: Request) -> Response:
         path = self._fpath(req.path)
         from seaweedfs_tpu.filer.entry import Attr
+        # the filer's streaming ingest: chunked as bytes arrive,
+        # bounded memory, inline-vs-chunks decided by the same head
+        content, chunks, size = self.fs._ingest_body(req, "", "")
         now = clockctl.now()
         entry = Entry(full_path=path,
                       attr=Attr(mtime=now, crtime=now,
                                 mime=req.headers.get("Content-Type", ""),
-                                file_size=len(req.body)))
-        if len(req.body) <= 2048:
-            entry.content = req.body
-        else:
-            entry.chunks = self.fs._upload_chunks(req.body, "", "")
+                                file_size=size))
+        entry.content = content
+        entry.chunks = chunks
         try:
             self.filer.create_entry(entry)
         except IsADirectoryError:

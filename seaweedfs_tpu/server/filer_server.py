@@ -18,8 +18,8 @@ import json
 import os
 import threading
 import time
-from concurrent.futures import CancelledError, ThreadPoolExecutor, \
-    as_completed
+from concurrent.futures import (FIRST_COMPLETED, CancelledError,
+                                ThreadPoolExecutor, as_completed, wait)
 from typing import Optional
 
 from seaweedfs_tpu.client import operation
@@ -53,6 +53,32 @@ READ_DEADLINE_S = 30.0  # edge deadline for a filer GET without one
 # multiply into unbounded sockets/threads.
 UPLOAD_WORKERS = int(os.environ.get("SEAWEEDFS_TPU_FILER_UPLOAD_WORKERS",
                                     "8"))
+# streaming ingest memory cap: at most this many chunk uploads in
+# flight while the NEXT chunk buffer fills — peak body memory is
+# (STREAM_INFLIGHT + 1) * CHUNK_SIZE regardless of object size
+STREAM_INFLIGHT = 2
+# fids are pre-minted in waves this big while streaming (the object's
+# total chunk count is unknown until EOF); unwritten leftovers are
+# just unused fids
+STREAM_ASSIGN_WAVE = 8
+
+
+def _read_full(stream, n: int) -> bytes:
+    """Read exactly n bytes from a BodyStream (short only at end of
+    body): chunked transfer encoding hands out one wire chunk per
+    read, so a single read() can come up short mid-body."""
+    out = stream.read(n)
+    if len(out) >= n or not out:
+        return out
+    parts = [out]
+    got = len(out)
+    while got < n:
+        piece = stream.read(n - got)
+        if not piece:
+            break
+        parts.append(piece)
+        got += len(piece)
+    return b"".join(parts)
 
 
 def _ttl_seconds(ttl: str) -> int:
@@ -145,6 +171,10 @@ class FilerServer:
         # parallel_uploads=False keeps the serial per-chunk
         # assign+upload loop as the bench comparator
         self.parallel_uploads = True
+        # streaming_ingest=False buffers whole bodies before chunking
+        # — the bit-for-bit comparator for the streaming path (same
+        # convention as parallel_uploads/qos)
+        self.streaming_ingest = True
         self._upload_pool: Optional[ThreadPoolExecutor] = None
         self._upload_pool_lock = threading.Lock()
         # per-volume-server breakers/latency for hedged chunk fetches
@@ -392,7 +422,6 @@ class FilerServer:
         if req.query.get("mkdir") == "true":
             self.filer.mkdirs(path)
             return Response({"path": path}, status=201)
-        data = req.body
         # per-path rules from filer.conf fill in what the request omits
         rule = self._current_filer_conf().match_storage_rule(path)
         if rule.read_only:
@@ -404,24 +433,55 @@ class FilerServer:
         ttl = req.query.get("ttl", "") or rule.ttl
         mime = (req.headers.get("Content-Type")
                 or "application/octet-stream")
+        content, chunks, size = self._ingest_body(
+            req, collection, replication, ttl, disk_type=rule.disk_type)
         now = clockctl.now()
         entry = Entry(full_path=path,
                       attr=Attr(mtime=now, crtime=now, mime=mime,
-                                file_size=len(data),
+                                file_size=size,
                                 collection=collection,
                                 ttl_sec=_ttl_seconds(ttl),
                                 replication=replication))
-        if len(data) <= INLINE_LIMIT and not self.cipher:
-            entry.content = data
-        else:
-            entry.chunks = self._upload_chunks(data, collection, replication,
-                                               ttl,
-                                               disk_type=rule.disk_type)
+        entry.content = content
+        entry.chunks = chunks
         try:
             self.filer.create_entry(entry)
         except IsADirectoryError:
+            # the chunks just uploaded have no owning entry: GC them
+            self._delete_chunks([c.fid for c in chunks])
             return Response({"error": "is a directory"}, status=409)
-        return Response({"name": entry.name, "size": len(data)}, status=201)
+        return Response({"name": entry.name, "size": size}, status=201)
+
+    def _ingest_body(self, req: Request, collection: str,
+                     replication: str, ttl: str = "",
+                     disk_type: str = "", hasher=None
+                     ) -> tuple[bytes, list[FileChunk], int]:
+        """Consume one request body into ``(inline_content, chunks,
+        size)`` — the single ingest point the filer PUT, S3 PUT/part,
+        and WebDAV PUT all ride. With a live ``req.stream`` (and
+        streaming_ingest on) the body is chunked AS IT ARRIVES under
+        the STREAM_INFLIGHT buffer cap; otherwise the buffered
+        comparator path. ``hasher`` (e.g. hashlib.md5) is fed every
+        body byte in order — the S3 ETag without a second pass."""
+        stream = getattr(req, "stream", None)
+        if stream is None or not self.streaming_ingest:
+            data = req.body
+            if hasher is not None:
+                hasher.update(data)
+            if len(data) <= INLINE_LIMIT and not self.cipher:
+                return data, [], len(data)
+            return b"", self._upload_chunks(
+                data, collection, replication, ttl,
+                disk_type=disk_type), len(data)
+        head = _read_full(stream, INLINE_LIMIT + 1)
+        if hasher is not None:
+            hasher.update(head)
+        if len(head) <= INLINE_LIMIT and not self.cipher:
+            return head, [], len(head)
+        chunks, size = self._stream_chunks(head, stream, collection,
+                                           replication, ttl, disk_type,
+                                           hasher=hasher)
+        return b"", chunks, size
 
     def _get_upload_pool(self) -> ThreadPoolExecutor:
         if self._upload_pool is None:
@@ -512,6 +572,118 @@ class FilerServer:
             raise HttpError(500, f"chunk upload failed: "
                                  f"{first_err}".encode())
         return maybe_manifestize(save_one, chunks)
+
+    def _stream_chunks(self, prefix: bytes, stream, collection: str,
+                       replication: str, ttl: str = "",
+                       disk_type: str = "", hasher=None
+                       ) -> tuple[list[FileChunk], int]:
+        """Bounded-memory streaming twin of _upload_chunks: chunk i+1
+        fills from the socket while chunks i and i-1 upload through
+        the shared pool — at most STREAM_INFLIGHT uploads in flight,
+        so peak body memory is ~3 chunk buffers for a 5GB PUT and a
+        5KB one alike. fids are pre-minted in STREAM_ASSIGN_WAVE
+        batches (total chunk count is unknown until EOF). Chunk
+        boundaries are the same CHUNK_SIZE grid as the buffered path,
+        so the stored object is bit-identical. On the first upload
+        error OR a client disconnect mid-stream, outstanding uploads
+        are cancelled, every chunk that already landed is deleted (no
+        orphans), and the error propagates."""
+        save_one = lambda blob: self._save_chunk(  # noqa: E731
+            blob, 0, collection, replication, ttl, disk_type)
+        upload_cls = current_class()
+        upload_span = tracing.current_span()
+
+        def upload_in_class(a, piece, off):
+            with class_scope(upload_cls), tracing.span_scope(upload_span):
+                return self._upload_one_chunk(a, piece, off)
+
+        def next_piece(lead: bytes) -> bytes:
+            want = CHUNK_SIZE - len(lead)
+            more = _read_full(stream, want) if want > 0 else b""
+            if hasher is not None and more:
+                hasher.update(more)
+            return (lead + more) if lead else more
+
+        assigns: list[dict] = []
+
+        def next_assign() -> dict:
+            if not assigns:
+                wave = self.mc.assign_many(
+                    STREAM_ASSIGN_WAVE, collection=collection,
+                    replication=replication, ttl=ttl, disk=disk_type)
+                assigns.extend(a for a in wave if not a.get("error"))
+            if assigns:
+                return assigns.pop(0)
+            # batch minting degraded (JWT-mode flip, master error
+            # tail): fall back to a single assign, which raises its
+            # own error if the master really is down
+            a = self.mc.assign(collection=collection,
+                               replication=replication, ttl=ttl,
+                               disk=disk_type)
+            if a.get("error"):
+                raise HttpError(500, a["error"].encode())
+            return a
+
+        pool = self._get_upload_pool() if self.parallel_uploads else None
+        chunks: list[Optional[FileChunk]] = []
+        futures: dict = {}  # future -> chunk index
+        first_err: Optional[Exception] = None
+        size = 0
+
+        def harvest(done) -> None:
+            nonlocal first_err
+            for fut in done:
+                i = futures.pop(fut)
+                try:
+                    chunks[i] = fut.result()
+                except CancelledError:
+                    pass
+                except Exception as e:
+                    if first_err is None:
+                        first_err = e
+
+        try:
+            piece = next_piece(prefix)
+            while piece and first_err is None:
+                off = size
+                size += len(piece)
+                if pool is None:
+                    chunks.append(self._save_chunk(
+                        piece, off, collection, replication, ttl,
+                        disk_type))
+                else:
+                    chunks.append(None)
+                    futures[pool.submit(upload_in_class, next_assign(),
+                                        piece, off)] = len(chunks) - 1
+                    while len(futures) >= STREAM_INFLIGHT:
+                        done, _ = wait(list(futures),
+                                       return_when=FIRST_COMPLETED)
+                        harvest(done)
+                        if first_err is not None:
+                            break
+                if first_err is not None:
+                    break
+                piece = next_piece(b"")
+        except Exception as e:
+            # the socket died mid-stream (client disconnect, lying
+            # Content-Length) or a serial upload failed
+            if first_err is None:
+                first_err = e
+        if first_err is not None:
+            for fut in futures:
+                fut.cancel()
+        if futures:
+            # normal EOF: the last ≤STREAM_INFLIGHT uploads are still
+            # in flight — wait them out (cancel only on error above)
+            wait(list(futures))
+            harvest(list(futures))
+        if first_err is not None:
+            self._delete_chunks([c.fid for c in chunks if c is not None])
+            if isinstance(first_err, (HttpError, ConnectionError)):
+                raise first_err
+            raise HttpError(500, f"chunk upload failed: "
+                                 f"{first_err}".encode())
+        return maybe_manifestize(save_one, chunks), size
 
     def _upload_one_chunk(self, a: dict, piece: bytes,
                           offset: int) -> FileChunk:

@@ -87,7 +87,8 @@ class VolumeServer:
                  trace_sample: float = 0.01,
                  ec_batcher: bool = False,
                  ec_batch_window_s: float = 0.005,
-                 needle_cache_mb: int = 64):
+                 needle_cache_mb: int = 64,
+                 hinted_handoff: bool = True):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
         the volume_server_pb gRPC admin plane (0 = ephemeral).
@@ -135,7 +136,15 @@ class VolumeServer:
         needle_cache_mb byte-budgets the hot-needle record cache
         (storage/needle_cache.py) fronting the healthy and degraded-EC
         read paths; admission follows this server's HotKeys sketch and
-        0 disables the cache entirely."""
+        0 disables the cache entirely.
+
+        hinted_handoff turns replicated writes into a sloppy quorum:
+        a write whose primary + majority of replica legs land is acked,
+        and each missed leg becomes a persisted hint
+        (storage/hinted_handoff.py) that a background drain replays
+        through the raw needle-blob transfer once the peer heals. Off =
+        the legacy any-leg-fails-the-write contract, kept as the
+        comparator for the divergence drill."""
         urls = (master_url.split(",") if isinstance(master_url, str)
                 else list(master_url))
         self.master_urls = [u.strip() for u in urls if u.strip()]
@@ -187,6 +196,11 @@ class VolumeServer:
         self.resilient_reads = resilient_reads
         self.parallel_replication = parallel_replication
         self._fsync = fsync
+        # sloppy-quorum replication: journal of missed replica legs,
+        # drained by a background thread once the peer heals
+        self.hinted_handoff = hinted_handoff
+        self.hint_journal = None  # HintJournal, attached in start()
+        self._hint_thread: Optional[threading.Thread] = None
         # lazily-built shared pool for the concurrent replica fan-out
         self._replicate_pool: Optional[object] = None
         self._replicate_pool_lock = threading.Lock()
@@ -283,8 +297,17 @@ class VolumeServer:
         self.store.remote_shard_reader = self._remote_shard_reader
         self.store.peer_health = self.peer_health
         self.store.shard_locations = self._shard_locations
+        self.store.shard_pressure = self._shard_pressure
         self.store.resilient_reads = self.resilient_reads
         self.store.remote_partial_reader = self._remote_partial_reader
+        if self.hinted_handoff:
+            from seaweedfs_tpu.storage.hinted_handoff import HintJournal
+            self.hint_journal = HintJournal(
+                os.path.join(self._store_dirs[0], "hints.journal"),
+                fsync=self._fsync)
+            self._hint_thread = threading.Thread(
+                target=self._hint_drain_loop, daemon=True)
+            self._hint_thread.start()
         if self._needle_cache_mb > 0:
             from seaweedfs_tpu.storage.needle_cache import NeedleCache
             sketch = self.hotkeys.sketches["needle"]
@@ -352,6 +375,10 @@ class VolumeServer:
                 self.heartbeat_once()
             except Exception:
                 pass
+        if self._hint_thread is not None:
+            self._hint_thread.join(timeout=2.0)
+        if self.hint_journal is not None:
+            self.hint_journal.close()
         self.metrics.stop_push()
         if self.tcp_server is not None:
             self.tcp_server.stop()
@@ -568,6 +595,10 @@ class VolumeServer:
         r("GET", "/admin/needle", self._admin_needle)
         r("GET", "/admin/needle_blob", self._admin_needle_blob)
         r("POST", "/admin/write_needle_blob", self._admin_write_needle_blob)
+        # divergence repair: clients report a lagging replica here, the
+        # hint journal is inspectable for drills and the ops shell
+        r("POST", "/admin/replica_repair", self._admin_replica_repair)
+        r("GET", "/admin/hints", self._admin_hints)
         # EC rpcs
         r("POST", "/admin/ec/generate", self._ec_generate)
         r("POST", "/admin/ec/rebuild", self._ec_rebuild)
@@ -617,7 +648,7 @@ class VolumeServer:
     QOS_EXEMPT = ("/status", "/metrics", "/ui", "/debug",
                   "/admin/qos", "/admin/health", "/admin/scrub/status",
                   "/admin/ec/batcher", "/admin/hotkeys",
-                  "/admin/telemetry", "/admin/cache")
+                  "/admin/telemetry", "/admin/cache", "/admin/hints")
 
     def _admission_gate(self, method: str, path: str, headers, client):
         """HttpServer admission hook: classify (propagated header wins
@@ -979,7 +1010,17 @@ class VolumeServer:
             # compressed, malformed range) — fall through to full read
         try:
             if self.store.find_volume(vid) is not None:
-                n = self.store.read_volume_needle(vid, key, cookie)
+                try:
+                    n = self.store.read_volume_needle(vid, key, cookie)
+                except (NotFoundError, ValueError):
+                    # divergence suspect: this replica may have missed a
+                    # quorum write (404) or hold a torn record (CRC) —
+                    # pull from a peer and serve the repaired copy.
+                    # DeletedError never repairs: tombstones are
+                    # authoritative here
+                    n = self._pull_repair(vid, key, cookie)
+                    if n is None:
+                        raise
             elif self.store.has_ec_volume(vid):
                 n = self.store.read_ec_shard_needle(vid, key, cookie)
             else:
@@ -1019,6 +1060,12 @@ class VolumeServer:
                 return Response(b"", status=404, content_type="text/plain")
         mime = (n.mime.decode(errors="replace")
                 if n.mime else "application/octet-stream")
+        # cache-aware routing: advertise when this read was (or is now)
+        # backed by the hot-needle cache so clients can prefer this
+        # replica for the next read of the same needle
+        cache = self.store.needle_cache
+        if cache is not None and cache.contains(vid, key):
+            headers[weed_headers.CACHE_HOT] = "1"
         from seaweedfs_tpu.utils.httpd import (RangeNotSatisfiable,
                                                parse_byte_range)
         try:
@@ -1158,7 +1205,14 @@ class VolumeServer:
         instead of sum(peers). Per-peer circuit breakers fail fast on
         known-down replicas; any failure drops the cached peer list so
         the next write re-resolves the (possibly moved) topology
-        instead of pinning the error for the cache TTL."""
+        instead of pinning the error for the cache TTL.
+
+        With hinted handoff on, the fan-out is a SLOPPY QUORUM: the
+        local write plus a majority of the peer legs completes the
+        request, and each missed leg is journaled as a hint the drain
+        thread replays after the peer heals (read-repair covers reads
+        that hit the lagging replica meanwhile). Only falling below
+        the quorum fails the write."""
         vid = int(req.match.group(1))
         vol = self.store.find_volume(vid)
         if vol is not None and \
@@ -1209,11 +1263,210 @@ class VolumeServer:
             errs = [send(u) for u in others]
         else:
             errs = list(self._replicate_pool_get().map(send, others))
-        errs = [e for e in errs if e]
-        if errs:
-            self._replica_cache.pop(vid, None)
-            return "; ".join(errs)
-        return None
+        failed = [(u, e) for u, e in zip(others, errs) if e]
+        if not failed:
+            return None
+        self._replica_cache.pop(vid, None)
+        # quorum of the PEER legs (the local write already landed):
+        # floor(len/2) keeps a 2-copy volume writable with its only
+        # peer dark — availability-biased, the hint closes the gap
+        if self.hinted_handoff and self.hint_journal is not None \
+                and len(others) - len(failed) >= len(others) // 2:
+            key, cookie = parse_needle_id_cookie(req.match.group(2))
+            for url, why in failed:
+                self.hint_journal.record(op, vid, key, cookie, url,
+                                         fid=req.match.group(2))
+                glog.warning("replica %s missed %s of %d,%x (%s); "
+                             "hint journaled", url, op, vid, key, why)
+            if span is not None:
+                span.annotate("replica.hinted", len(failed))
+            self._m_req.inc("replica_hinted")
+            return None
+        return "; ".join(why for _, why in failed)
+
+    # cadence of the hint drain pass (the pass itself is cheap when
+    # nothing is pending: one dict snapshot)
+    HINT_DRAIN_INTERVAL_S = 2.0
+
+    def _hint_drain_loop(self) -> None:
+        while not self._stop.wait(self.HINT_DRAIN_INTERVAL_S):
+            try:
+                with class_scope(BACKGROUND):
+                    self.drain_hints()
+            except Exception as e:
+                glog.warning("hint drain pass failed (will retry): %s", e)
+
+    def drain_hints(self, limit: int = 256) -> int:
+        """One drain pass: replay up to `limit` pending hints, oldest
+        first, skipping peers whose breaker is still open. Returns the
+        number repaid. Public so drills can force a synchronous drain
+        instead of waiting out the loop cadence."""
+        j = self.hint_journal
+        if j is None or self.store is None:
+            return 0
+        drained = 0
+        for h in j.pending()[:limit]:
+            if self._stop.is_set():
+                break
+            if not self.peer_health.allow(h["peer"]):
+                continue
+            try:
+                ok = self._replay_hint(h)
+            except Exception as e:
+                glog.warning("hint replay %s failed: %s", h, e)
+                ok = False
+            if ok:
+                j.ack(h["seq"])
+                drained += 1
+        if drained:
+            self._m_req.inc("hint_drained")
+        return drained
+
+    def _replay_hint(self, h: dict) -> bool:
+        """Repay one hint. True means the debt is settled (replayed,
+        or moot: needle/volume gone locally, peer no longer hosts the
+        volume); False means keep it pending for the next pass."""
+        url = h["peer"]
+        vid, key = int(h["vid"]), int(h["key"])
+        if self._is_self(url):
+            return True  # topology moved the replica onto us
+        if h["op"] == "delete":
+            t0 = clockctl.monotonic()
+            try:
+                status, _, _ = http_call(
+                    "DELETE", f"http://{url}/{vid},{h['fid']}"
+                    "?type=replicate",
+                    deadline=Deadline.after(10.0))
+            except ConnectionError:
+                self.peer_health.record(url, False)
+                return False
+            self.peer_health.record(url, True, clockctl.monotonic() - t0)
+            return status < 400 or status == 404
+        v = self.store.find_volume(vid)
+        if v is None:
+            return True  # volume left this node: nothing to hand off
+        try:
+            blob, size = v.read_needle_blob(key)
+        except Exception:
+            # deleted (or never committed) since the hint was taken —
+            # the delete got its own hint, this one is moot
+            return True
+        t0 = clockctl.monotonic()
+        try:
+            status, _, _ = http_call(
+                "POST", f"http://{url}/admin/write_needle_blob",
+                json_body={"volume_id": vid, "blob": blob.hex(),
+                           "size": size},
+                deadline=Deadline.after(20.0))
+        except ConnectionError:
+            self.peer_health.record(url, False)
+            return False
+        self.peer_health.record(url, True, clockctl.monotonic() - t0)
+        # 404 = the peer no longer hosts the volume (moved/rebuilt):
+        # the debt is no longer owed to THIS peer
+        return status < 400 or status == 404
+
+    # budget for one peer blob fetch during in-line read repair when
+    # the read arrived without an ambient deadline
+    PULL_REPAIR_DEADLINE_S = 10.0
+
+    def _pull_repair(self, vid: int, key: int,
+                     cookie: Optional[int] = None) -> Optional[Needle]:
+        """In-line read repair: this replica is missing (or holds a
+        corrupt copy of) a needle that a replicated volume should have.
+        Pull the raw record from a healthy peer, land it locally with
+        strict cache invalidation, and return the repaired needle —
+        the read that detected the divergence is also the one that
+        heals it. Returns None when no peer can supply the record
+        (including the legitimate case: the needle never existed)."""
+        if not self.hinted_handoff:
+            return None
+        v = self.store.find_volume(vid)
+        if v is None or v.read_only or v.is_expired():
+            return None
+        if v.super_block.replica_placement.to_byte() == 0:
+            return None  # single copy: nothing to diverge from
+        peers = self._replica_peers(vid)
+        if not peers:
+            return None
+        dl = current_deadline() or \
+            Deadline.after(self.PULL_REPAIR_DEADLINE_S)
+        blob = None
+        size = 0
+        for url in self.peer_health.rank(peers):
+            if not self.peer_health.allow(url):
+                continue
+            t0 = clockctl.monotonic()
+            try:
+                out = http_json(
+                    "GET", f"http://{url}/admin/needle_blob"
+                    f"?volumeId={vid}&key={key}", deadline=dl)
+            except HttpError:
+                # the peer answered but doesn't have it either
+                self.peer_health.record(url, True,
+                                        clockctl.monotonic() - t0)
+                continue
+            except ConnectionError:
+                self.peer_health.record(url, False)
+                continue
+            self.peer_health.record(url, True, clockctl.monotonic() - t0)
+            blob, size = bytes.fromhex(out["blob"]), int(out["size"])
+            break
+        if blob is None:
+            return None
+        cache = self.store.needle_cache
+        if cache is not None:
+            # same double-invalidation discipline as
+            # Store.write_volume_needle: no stale epoch can be admitted
+            cache.invalidate(vid, key)
+        try:
+            v.write_needle_blob(blob, size)
+        except Exception as e:
+            glog.warning("read repair of %d,%x failed to land: %s",
+                         vid, key, e)
+            return None
+        finally:
+            if cache is not None:
+                cache.invalidate(vid, key)
+        self._m_req.inc("read_repair")
+        glog.info("read-repaired %d,%x from a peer replica", vid, key)
+        try:
+            return self.store.read_volume_needle(vid, key, cookie)
+        except Exception:
+            return None
+
+    def _admin_replica_repair(self, req: Request) -> Response:
+        """A reader observed this replica lagging (404 here while a
+        sibling served the needle): pull the record from a peer now
+        instead of waiting for the owner's hint drain."""
+        b = req.json()
+        vid, key = int(b["volume_id"]), int(b["key"])
+        if self.store.find_volume(vid) is None:
+            return Response({"error": f"volume {vid} not found"},
+                            status=404)
+        try:
+            self.store.read_volume_needle(vid, key)
+            return Response({"repaired": False, "present": True})
+        except DeletedError:
+            # our tombstone is authoritative — the reporter raced a
+            # delete, which the delete fan-out/hints will settle
+            return Response({"repaired": False, "present": True})
+        except (NotFoundError, ValueError):
+            pass
+        n = self._pull_repair(vid, key)
+        if n is None:
+            return Response(
+                {"error": "no peer could supply the needle"}, status=409)
+        return Response({"repaired": True, "size": len(n.data)})
+
+    def _admin_hints(self, req: Request) -> Response:
+        j = self.hint_journal
+        if j is None:
+            return Response({"url": self.url, "enabled": False,
+                             "pending": 0})
+        return Response({"url": self.url, "enabled": True,
+                         **j.stats(),
+                         "hints": j.pending()[:100]})
 
     def _handle_status(self, req: Request) -> Response:
         hb = self.store.collect_heartbeat()
@@ -1835,7 +2088,9 @@ class VolumeServer:
             locs = self._shard_locations(vid)
         except (ConnectionError, HttpError):
             locs = {}
-        urls += [u for u in locs.get(sid, []) if u not in urls]
+        rest = [u for u in locs.get(sid, []) if u not in urls]
+        urls += self.peer_health.rank(
+            rest, pressure=self._shard_pressure(vid))
         for u in urls:
             if not self.peer_health.allow(u) and len(urls) > 1:
                 continue
@@ -1889,7 +2144,8 @@ class VolumeServer:
             for u in us:
                 if u not in urls:
                     urls.append(u)
-        urls = self.peer_health.rank(urls)
+        urls = self.peer_health.rank(urls,
+                                     pressure=self._shard_pressure(vid))
         copied = 0
         for ext in (".ecx", ".ecj", ".vif"):
             if os.path.exists(base + ext):
@@ -2058,7 +2314,8 @@ class VolumeServer:
             for u in us:
                 if u not in urls:
                     urls.append(u)
-        for u in self.peer_health.rank(urls):
+        for u in self.peer_health.rank(
+                urls, pressure=self._shard_pressure(vid)):
             try:
                 resp = http_json(
                     "GET",
@@ -2153,7 +2410,9 @@ class VolumeServer:
         dl = current_deadline()
         sub = dl.sub(max(0.5, 0.4 * dl.remaining())) \
             if dl is not None else None
-        return hedged(fetch, self.peer_health.rank(urls),
+        return hedged(fetch,
+                      self.peer_health.rank(
+                          urls, pressure=self._shard_pressure(vid)),
                       health=self.peer_health, deadline=sub)
 
     def _ec_delete_fanout(self, vid: int, key: int, cookie: int) -> int:

@@ -1,6 +1,6 @@
 """Scripted incident library + machine-checked invariants.
 
-Four incidents, each a pure function of (seed, n_actors):
+Five incidents, each a pure function of (seed, n_actors):
 
   az_loss          grey-failure prelude (scripted latency band on every
                    link), then correlated crash of one whole AZ; the
@@ -21,6 +21,13 @@ Four incidents, each a pure function of (seed, n_actors):
                    polite load; the governor must keep interactive p99
                    bounded, shed the flood (not the polite tenants),
                    and still leave the flooder its background slot.
+  partition_heal_mid_repair
+                   a spread of nodes is partitioned (blackholed, not
+                   crashed) long enough to trigger a repair wave, then
+                   heals while the wave drains; the master must rejoin
+                   the victims, settle the half-finished wave, lose no
+                   acked write, and re-close every breaker — the sim
+                   rehearsal of the hinted-handoff divergence drill.
 
 ``run_incident`` returns a JSON-able report: per-invariant verdicts,
 client/repair metrics, the event-log hash (bit-reproducibility), and
@@ -40,6 +47,10 @@ from seaweedfs_tpu.stats.slo import FAST_BURN
 # interactive p99 ceiling (virtual seconds) for every incident: service
 # time is ~4ms, so 250ms allows one failover + backoff but not collapse
 P99_BOUND_S = 0.25
+# partition incidents pay one full wedged-peer read timeout (0.6s in
+# FilerActor) + failover service time on the unlucky tail read; crash
+# incidents never do (a dead socket refuses instantly)
+P99_PARTITION_BOUND_S = 0.75
 TENANT_MIN_OK_RATIO = 0.85
 
 
@@ -281,11 +292,97 @@ def _tenant_flood(cluster: SimCluster, n_actors: int, rate: float) -> list:
     return checks
 
 
+def _partition_heal_mid_repair(cluster: SimCluster, n_actors: int,
+                               rate: float) -> list:
+    """Network partition (not a crash): a spread of nodes goes dark on
+    the wire long enough for the master to declare them dead and start
+    a repair wave, then the partition heals while the wave is still
+    draining. The victims' heartbeats resume, the master must rejoin
+    them (dead set emptied), the half-finished wave must settle without
+    wedging (queue and active drain, degraded set clears), no acked
+    write may be lost, and breakers against the healed nodes must
+    re-close. This is the sim rehearsal of the hinted-handoff drill:
+    writes during the partition succeed on the surviving quorum, and
+    heal-time repair closes the divergence window."""
+    # part_len is tuned so the heal lands while the wave is still
+    # draining: dead declared ~t_part+10, scan grace 5s, so the wave
+    # starts ~t_part+15 and the heal at t_part+18 catches it mid-queue.
+    # That matters beyond fidelity to the name — a completed repair
+    # removes the dead holder from the layout, so a partition long
+    # enough to re-home EVERY victim volume leaves the healed nodes
+    # holding nothing, and no traffic (hence no breaker probe) ever
+    # dials them again
+    duration, t_part, part_len = 45.0, 8.0, 18.0
+    victims = [f"vol-{i}" for i in range(0, n_actors, 9)]
+    schedule = []
+    for v in victims:
+        # both directions: outbound kills the victim's heartbeats,
+        # inbound kills client and repair traffic to it
+        schedule.append({"link": f"{v}->*", "fault": "blackhole",
+                         "start": t_part, "duration": part_len})
+        schedule.append({"link": f"*->{v}", "fault": "blackhole",
+                         "start": t_part, "duration": part_len})
+    cluster.faults.events[:] = parse_schedule(schedule)
+    wl = ZipfWorkload(default_tenants(4, rate), seed=cluster.kernel.seed)
+    cluster.load(wl.generate(duration))
+    # run exactly to the heal instant and snapshot the repair plane:
+    # the wave must already be engaged when the partition lifts
+    cluster.run(t_part + part_len)
+    m = cluster.master
+    wave_at_heal = (len(m._queue), len(m._active), m.repairs_done)
+    dead_at_heal = sorted(m.dead)
+    cluster.run(duration)
+    _settle(cluster, wl, duration, 30.0)
+    cluster.run_until_converged(duration + 90.0)
+    # consume the whole settle window: the wave often finishes BEFORE
+    # the heal (converged almost immediately), and breaker probes only
+    # ride real traffic
+    cluster.run(max(cluster.kernel.now + 8.0, duration + 32.0))
+    checks: list = []
+    lost = cluster.lost_acked_writes()
+    checks.append(_check(
+        "zero_acked_write_loss", not lost,
+        f"{len(lost)} acked writes unreadable" if lost
+        else f"{len(cluster.metrics.acked)} acked writes all readable"))
+    # a blackholed peer (unlike a crashed one) answers nothing: the
+    # first read to touch it pays its full timeout before failing over,
+    # so the honest p99 ceiling is one wedged-peer timeout + failover,
+    # not the steady-state bound — collapse would still blow through it
+    p99 = percentile(cluster.metrics.lat[INTERACTIVE], 0.99)
+    checks.append(_check(
+        "interactive_p99_bounded", p99 <= P99_PARTITION_BOUND_S,
+        f"p99={p99 * 1000:.1f}ms "
+        f"bound={P99_PARTITION_BOUND_S * 1000:.0f}ms"))
+    _tenant_invariant(cluster, checks)
+    checks.append(_check(
+        "partition_detected", bool(dead_at_heal),
+        f"{len(dead_at_heal)}/{len(victims)} victims declared dead "
+        f"during the partition" if dead_at_heal
+        else "master never declared a victim dead"))
+    checks.append(_check(
+        "repair_wave_engaged_before_heal", any(wave_at_heal),
+        f"at heal: queued={wave_at_heal[0]} active={wave_at_heal[1]} "
+        f"done={wave_at_heal[2]}"))
+    still_dead = [v for v in victims if v in m.dead]
+    checks.append(_check(
+        "victims_rejoined_after_heal", not still_dead,
+        f"still dead: {still_dead}" if still_dead
+        else f"all {len(victims)} victims heartbeating again"))
+    checks.append(_check(
+        "repair_wave_settled", not m._queue and not m._active
+        and not cluster.degraded_vids(),
+        f"queue={len(m._queue)} active={len(m._active)} "
+        f"degraded={len(cluster.degraded_vids())}"))
+    _breaker_invariant(cluster, checks)
+    return checks
+
+
 INCIDENTS = {
     "az_loss": _az_loss,
     "rolling_restart": _rolling_restart,
     "herd_repair": _herd_repair,
     "tenant_flood": _tenant_flood,
+    "partition_heal_mid_repair": _partition_heal_mid_repair,
 }
 
 

@@ -79,7 +79,10 @@ def plan_chain(sources: dict[int, Sequence[str]],
             [int(sid), [int(c) for c in coeffs]])
     hops = [{"url": u, "members": sorted(m)}
             for u, m in members.items()]
-    hops.sort(key=lambda h: -len(h["members"]))
+    # most-members-first; among equal-width hops, the less-pressured
+    # holder goes earlier (its reply unblocks the chain sooner)
+    hops.sort(key=lambda h: (-len(h["members"]),
+                             (pressure or {}).get(h["url"], 0.0)))
     return hops
 
 

@@ -101,6 +101,14 @@ class NeedleCache:
             self.misses += 1
             return None
 
+    def contains(self, vid: int, needle_id: int) -> bool:
+        """Non-mutating membership probe — no LRU touch, no hit/miss
+        accounting. Feeds the cache-hot response header for
+        cache-aware read routing; a probe must not make an entry look
+        hotter or skew the stats the admission policy reads."""
+        with self._lock:
+            return (vid, needle_id) in self._entries
+
     def get_or_load(self, vid: int, needle_id: int, loader):
         """Single-flight read-through. ``loader() -> (blob, size,
         version, force_admit)`` runs at most once per concurrent cold

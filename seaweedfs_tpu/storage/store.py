@@ -65,6 +65,10 @@ class Store:
         # fan-out-everything behavior (tests inject bare readers).
         self.peer_health = None
         self.shard_locations: Optional[Callable[[int], dict]] = None
+        # shard_pressure(vid) -> {url: pressure 0..1}: peers' advertised
+        # QoS backlog, folded into holder ranking as a tiebreak between
+        # similarly-healthy candidates (injected by the volume server)
+        self.shard_pressure: Optional[Callable[[int], dict]] = None
         self.resilient_reads = True
         # remote_partial_reader(vid, {sid: [coeffs]}, offset, size,
         # n_rows) -> (n_rows, size) uint8 array | None. Injected by the
@@ -619,12 +623,17 @@ class Store:
         except Exception:
             return list(sids), len(sids)
         from seaweedfs_tpu.utils.resilience import CLOSED
+        try:
+            pres = self.shard_pressure(vid) if self.shard_pressure \
+                else None
+        except Exception:
+            pres = None
 
         def sid_key(sid: int) -> tuple[int, float]:
             urls = locs.get(sid) or []
             if not urls:
                 return (3, float("inf"))  # no known holder: try last
-            br = health.breaker(health.rank(urls)[0])
+            br = health.breaker(health.rank(urls, pressure=pres)[0])
             if br.state == CLOSED:
                 return (0, br.score())
             if br.probe_ripe():

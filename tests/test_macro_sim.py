@@ -113,6 +113,19 @@ def test_az_loss_converges_at_16_actors():
     assert r["repair"]["converged_at"] is not None
 
 
+def test_partition_heal_mid_repair_at_16_actors():
+    r = run_incident("partition_heal_mid_repair", seed=0, n_actors=16)
+    assert r["passed"], [c for c in r["invariants"] if not c["ok"]]
+    # partitioned ≠ crashed: every write during the window still acked
+    assert r["client"]["failed"] == 0
+    # the wave engaged (victims declared dead triggered repairs) and
+    # the partition healed mid-flight, not after convergence
+    assert r["repair"]["done"] > 0
+    by_check = {c["name"]: c for c in r["invariants"]}
+    assert by_check["repair_wave_engaged_before_heal"]["ok"]
+    assert by_check["breakers_reclosed"]["ok"]
+
+
 def test_unknown_incident_raises():
     with pytest.raises(KeyError):
         run_incident("kraken", n_actors=16)

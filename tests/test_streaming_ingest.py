@@ -1,0 +1,219 @@
+"""Streaming-ingest edge cases: the filer consumes request bodies
+incrementally (BodyStream -> _ingest_body -> _stream_chunks), so the
+contract under test is framing, not plumbing — empty bodies, exact
+chunk-grid sizes, lying Content-Length in both directions, client
+disconnect mid-stream (orphan GC), fsync durability, and bit-identity
+with the buffered comparator path."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import seaweedfs_tpu.server.filer_server as fsrv
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils.httpd import http_call
+
+CHUNK = 64 * 1024
+
+
+@pytest.fixture
+def cluster(tmp_path, monkeypatch):
+    monkeypatch.setattr(fsrv, "CHUNK_SIZE", CHUNK)
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    yield master, vs, fs
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def _put(fs, path, data, expect=201):
+    st, body, _ = http_call("POST", f"http://{fs.url}{path}", body=data,
+                            timeout=60)
+    assert st == expect, (st, body)
+
+
+def _get(fs, path, expect=200):
+    st, body, _ = http_call("GET", f"http://{fs.url}{path}", timeout=60)
+    assert st == expect, st
+    return body
+
+
+def _chunks(fs, path):
+    import json
+    st, body, _ = http_call(
+        "GET", f"http://{fs.url}/__api/entry?path={path}")
+    assert st == 200, body
+    return json.loads(body)["entry"]["chunks"]
+
+
+def _raw_put(fs, path, declared_len, payload) -> bytes:
+    """Hand-framed request so Content-Length can lie; returns whatever
+    response bytes the server managed to send before closing."""
+    host, port = fs.url.split(":")
+    s = socket.create_connection((host, int(port)), timeout=10)
+    try:
+        s.sendall(f"POST {path} HTTP/1.1\r\nHost: {fs.url}\r\n"
+                  f"Content-Length: {declared_len}\r\n\r\n"
+                  .encode() + payload)
+        s.shutdown(socket.SHUT_WR)
+        out = b""
+        s.settimeout(10)
+        try:
+            while True:
+                got = s.recv(65536)
+                if not got:
+                    break
+                out += got
+        except (socket.timeout, ConnectionError):
+            pass
+        return out
+    finally:
+        s.close()
+
+
+def test_zero_byte_put(cluster):
+    _, _, fs = cluster
+    _put(fs, "/edge/empty", b"")
+    assert _get(fs, "/edge/empty") == b""
+    assert _chunks(fs, "/edge/empty") == []
+
+
+def test_exact_chunk_boundary_sizes(cluster):
+    """Sizes ON the chunk grid produce exactly size//CHUNK chunks (no
+    empty tail chunk); one byte over rolls a 1-byte chunk."""
+    _, _, fs = cluster
+    rng = np.random.default_rng(11)
+    for size, n_chunks in ((CHUNK, 1), (2 * CHUNK, 2), (CHUNK + 1, 2),
+                           (3 * CHUNK - 1, 3)):
+        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        path = f"/edge/grid-{size}.bin"
+        _put(fs, path, data)
+        chunks = _chunks(fs, path)
+        assert len(chunks) == n_chunks, (size, chunks)
+        assert [c["offset"] for c in chunks] == \
+            [i * CHUNK for i in range(n_chunks)]
+        assert sum(c["size"] for c in chunks) == size
+        assert _get(fs, path) == data
+
+
+def test_inline_threshold_still_inlines(cluster):
+    """Streaming peeks INLINE_LIMIT+1 bytes before deciding: small
+    bodies stay inline in the entry, one byte over goes to a chunk."""
+    _, _, fs = cluster
+    small = b"s" * fsrv.INLINE_LIMIT
+    _put(fs, "/edge/inline", small)
+    assert _chunks(fs, "/edge/inline") == []
+    assert _get(fs, "/edge/inline") == small
+    big = b"b" * (fsrv.INLINE_LIMIT + 1)
+    _put(fs, "/edge/spill", big)
+    assert len(_chunks(fs, "/edge/spill")) == 1
+    assert _get(fs, "/edge/spill") == big
+
+
+def test_streaming_vs_buffered_bit_identity(cluster):
+    """The acceptance comparator: same body through the streaming and
+    the buffered path lands the same chunk grid and the same bytes."""
+    _, _, fs = cluster
+    rng = np.random.default_rng(12)
+    data = rng.integers(0, 256, 5 * CHUNK + 777,
+                        dtype=np.uint8).tobytes()
+    assert fs.streaming_ingest
+    _put(fs, "/edge/streamed.bin", data)
+    fs.streaming_ingest = False
+    try:
+        _put(fs, "/edge/buffered.bin", data)
+    finally:
+        fs.streaming_ingest = True
+    streamed = [(c["offset"], c["size"])
+                for c in _chunks(fs, "/edge/streamed.bin")]
+    buffered = [(c["offset"], c["size"])
+                for c in _chunks(fs, "/edge/buffered.bin")]
+    assert streamed == buffered
+    assert _get(fs, "/edge/streamed.bin") == data
+    assert _get(fs, "/edge/buffered.bin") == data
+
+
+def test_content_length_lying_long_gcs_orphans(cluster):
+    """Content-Length declares MORE than the client sends, then the
+    client hangs up: the chunks already uploaded must be deleted (no
+    orphans) and no entry may appear."""
+    _, _, fs = cluster
+    deleted: list = []
+    real_delete = fs._delete_chunks
+    fs._delete_chunks = lambda fids: (deleted.extend(fids),
+                                      real_delete(fids))[1]
+    try:
+        # 2 full chunks land (the inflight cap forces chunk 0 to be
+        # harvested before chunk 2 is read), then the socket dies
+        payload = b"x" * (2 * CHUNK + CHUNK // 2)
+        _raw_put(fs, "/edge/liar-long", 4 * CHUNK, payload)
+        deadline = time.time() + 10
+        while not deleted and time.time() < deadline:
+            time.sleep(0.05)
+        assert deleted, "orphaned chunks were never GCed"
+    finally:
+        fs._delete_chunks = real_delete
+    _get(fs, "/edge/liar-long", expect=404)
+
+
+def test_excess_body_beyond_content_length(cluster):
+    """Content-Length declares LESS than the client sends: exactly the
+    declared bytes are ingested; the excess is unsolicited pipeline
+    garbage the server must not splice into the object."""
+    _, _, fs = cluster
+    body = b"d" * 100
+    resp = _raw_put(fs, "/edge/liar-short", 100, body + b"\x00GARBAGE" * 8)
+    assert b"201" in resp.split(b"\r\n", 1)[0], resp[:200]
+    assert _get(fs, "/edge/liar-short") == body
+
+
+def test_fsync_volume_accepts_streamed_put(tmp_path, monkeypatch):
+    """fsync=True volumes force a durable fsync per commit batch; the
+    streamed multi-chunk PUT must ride that unchanged and read back
+    bit-identical after a volume-server restart (proof the bytes were
+    on disk, not in page-cache-only buffers of a dead process)."""
+    monkeypatch.setattr(fsrv, "CHUNK_SIZE", CHUNK)
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "vf")], master.url, fsync=True)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    try:
+        rng = np.random.default_rng(13)
+        data = rng.integers(0, 256, 3 * CHUNK + 19,
+                            dtype=np.uint8).tobytes()
+        _put(fs, "/edge/durable.bin", data)
+        assert _get(fs, "/edge/durable.bin") == data
+        vs.stop()
+        vs2 = VolumeServer([str(tmp_path / "vf")], master.url,
+                           fsync=True)
+        vs2.start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                st, got, _ = http_call(
+                    "GET", f"http://{fs.url}/edge/durable.bin",
+                    timeout=60)
+                if st == 200 and got == data:
+                    break
+                time.sleep(0.2)
+            assert got == data
+        finally:
+            vs2.stop()
+    finally:
+        fs.stop()
+        try:
+            vs.stop()
+        except Exception:
+            pass
+        master.stop()

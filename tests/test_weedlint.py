@@ -90,6 +90,17 @@ CASES = {
         "clean": ("from seaweedfs_tpu.parallel import mesh\n\n"
                   "def f():\n    return mesh.devices()\n"),
     },
+    "unbounded-body-read": {
+        "bad": ("def handler(req):\n"
+                "    return len(req.body)\n"),
+        "clean": ("def handler(req):\n"
+                  "    n = 0\n"
+                  "    while True:\n"
+                  "        piece = req.stream.read(65536)\n"
+                  "        if not piece:\n"
+                  "            return n\n"
+                  "        n += len(piece)\n"),
+    },
     "ambient-scope-loss": {
         "bad": ("from seaweedfs_tpu.utils.tracing import current_span\n\n"
                 "def f(pool):\n"
@@ -203,6 +214,25 @@ def test_raw_device_discovery_catches_aliased_imports():
         "from jax import devices as dv\n\ndef f():\n    return dv()\n")
     assert "raw-device-discovery" in rules_of(
         "import jax as j\n\ndef f():\n    return j.local_devices()\n")
+
+
+def test_unbounded_body_read_variants():
+    """The rule hunts all three shapes — req.body, .readall(), bare
+    stream-ish .read() — but leaves sized reads and non-stream
+    receivers alone (a local file handle reads to EOF legitimately)."""
+    assert "unbounded-body-read" in rules_of(
+        "def h(sock):\n    return sock.read()\n")
+    assert "unbounded-body-read" in rules_of(
+        "def h(req):\n    return req.stream.readall()\n")
+    assert "unbounded-body-read" not in rules_of(
+        "def h(req):\n    return req.stream.read(4096)\n")
+    assert "unbounded-body-read" not in rules_of(
+        "def h(path):\n    with open(path) as f:\n"
+        "        return f.read()\n")
+    # the streaming reader's home implements the contract
+    assert "unbounded-body-read" not in rules_of(
+        "def h(req):\n    return req.body\n",
+        path="seaweedfs_tpu/utils/httpd.py")
 
 
 def test_syntax_error_reported_not_crashed():

@@ -110,20 +110,29 @@ def test_concurrent_puts_stress(cluster):
 
 
 def test_parallel_put_batches_assigns(cluster):
-    """A 16-chunk PUT mints its fids in one master round trip (assign
-    count=N), not one RPC per chunk like the serial loop."""
+    """A 16-chunk PUT mints its fids in STREAM_ASSIGN_WAVE batches
+    (assign count=N as the body arrives — 2 round trips here), not one
+    RPC per chunk like the serial loop; the buffered comparator path
+    still mints the whole object in one round trip."""
     master, vs, fs = cluster
     calls = []
     real_assign = fs.mc.assign
     fs.mc.assign = lambda **kw: (calls.append(kw), real_assign(**kw))[1]
     data = bytes(range(256)) * 4096  # 1MB = 16 x 64KB chunks
     _put(fs, "/batch/a.bin", data)
+    assert len(calls) == 16 // fsrv.STREAM_ASSIGN_WAVE, calls
+    assert all(c["count"] == fsrv.STREAM_ASSIGN_WAVE for c in calls)
+    fs.streaming_ingest = False
+    calls.clear()
+    _put(fs, "/batch/b.bin", data)
     assert len(calls) == 1, calls
     assert calls[0]["count"] == 16
     fs.parallel_uploads = False
     calls.clear()
-    _put(fs, "/batch/b.bin", data)
+    _put(fs, "/batch/c.bin", data)
     assert len(calls) == 16
+    fs.parallel_uploads = True
+    fs.streaming_ingest = True
 
 
 def test_upload_failure_cancels_and_cleans_orphans(cluster, monkeypatch):
@@ -177,20 +186,25 @@ def test_assign_many_mints_sequential_fids(cluster):
 
 
 def test_replica_write_failure_invalidates_cache(tmp_path):
-    """One replica answering 5xx fails the client write AND drops the
-    cached peer list, so the next write re-resolves topology instead of
-    pinning the error for the cache TTL."""
+    """One replica answering 5xx on a 2-copy volume: under the sloppy
+    quorum the write still succeeds (primary + hint), but the cached
+    peer list is dropped so the next write re-resolves topology; with
+    hinted handoff off, the legacy any-leg-fails-the-write contract
+    (500 naming the replica) still holds — it is the divergence-drill
+    comparator."""
     from tools.netchaos import ChaosProxy
     import bench
 
     master = MasterServer(volume_size_limit_mb=64)
     master.start()
-    vs1 = VolumeServer([str(tmp_path / "v1")], master.url)
+    vs1 = VolumeServer([str(tmp_path / "v1")], master.url,
+                       hinted_handoff=False)
     vs1.start()
     peer_port = bench._free_port()
     proxy = ChaosProxy("127.0.0.1", peer_port).start()
     vs2 = VolumeServer([str(tmp_path / "v2")], master.url,
-                       port=peer_port, advertise=proxy.url)
+                       port=peer_port, advertise=proxy.url,
+                       hinted_handoff=False)
     vs2.start()
     mc = MasterClient(master.url, cache_ttl=0.0)
     try:
@@ -207,7 +221,7 @@ def test_replica_write_failure_invalidates_cache(tmp_path):
         a2 = mc.assign(replication="001")
         st, body, _ = http_call("POST", f"http://{vs1_direct}/{a2['fid']}",
                                 body=b"failing write")
-        assert st == 500
+        assert st == 500  # legacy contract: any failed leg fails it
         assert b"replica" in body and proxy.url.encode() in body
         assert vid not in vs1._replica_cache  # invalidated
 
@@ -217,6 +231,22 @@ def test_replica_write_failure_invalidates_cache(tmp_path):
                              body=b"recovered write")
         assert st == 201  # cache refreshed, peer reachable again
         assert vid in vs1._replica_cache
+
+        # quorum mode on the same faulted topology: the write succeeds,
+        # the missed leg becomes a journaled hint, cache still dropped
+        vs1.hinted_handoff = True
+        from seaweedfs_tpu.storage.hinted_handoff import HintJournal
+        vs1.hint_journal = HintJournal(str(tmp_path / "hints.journal"))
+        proxy.set_fault(mode="http_error", http_status=500)
+        a4 = mc.assign(replication="001")
+        vid4 = int(a4["fid"].split(",")[0])
+        st, _, _ = http_call("POST", f"http://{vs1_direct}/{a4['fid']}",
+                             body=b"quorum write")
+        assert st == 201
+        assert len(vs1.hint_journal) == 1
+        hint = vs1.hint_journal.pending()[0]
+        assert hint["op"] == "write" and hint["peer"] == proxy.url
+        assert vid4 not in vs1._replica_cache  # still invalidated
     finally:
         mc.stop()
         vs2.stop()

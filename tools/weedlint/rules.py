@@ -80,6 +80,17 @@ raw-device-discovery
     classified ``fallback_reason``) instead of re-hanging on a flaky
     relay per call site, and so the driver's virtual-device request is
     honored before any backend initializes.
+
+unbounded-body-read
+    a whole-body materialization outside the streaming reader's home
+    in ``utils/httpd.py``: ``req.body`` / ``request.body`` (the lazy
+    property buffers the ENTIRE request body), ``.readall()`` on a
+    stream, or a bare no-arg ``.read()`` on a socket/rfile/stream-ish
+    receiver.  Body memory must be the handler's explicit budget —
+    chunk-at-a-time via ``req.stream.read(n)`` (the filer
+    ``_ingest_body`` idiom) — or a 5GB PUT costs 5GB of filer RSS.
+    Deliberate small-body sites (JSON admin endpoints) are baselined;
+    new code streams.
 """
 
 from __future__ import annotations
@@ -104,6 +115,9 @@ RULES: dict[str, str] = {
         "submit of closure using ambient scope without re-entry",
     "raw-device-discovery":
         "jax.devices()/local_devices() outside parallel/mesh.py",
+    "unbounded-body-read":
+        "whole-body read (req.body/.readall()/bare .read()) outside "
+        "utils/httpd.py",
 }
 
 # files that ARE the sanctioned implementation of a contract
@@ -113,6 +127,7 @@ _RULE_HOME = {
     "raw-http": "utils/httpd.py",
     "header-literal": "utils/headers.py",
     "raw-device-discovery": "parallel/mesh.py",
+    "unbounded-body-read": "utils/httpd.py",
 }
 
 _HEADER_PREFIX = "X-Weed-"
@@ -129,6 +144,11 @@ _TRACKED_MODULES = ("time", "urllib.request", "urllib", "http.client",
 _DEVICE_CALLS = {"jax.devices", "jax.local_devices",
                  "jax.device_count", "jax.local_device_count"}
 _BLOCKING_TERMINALS = {"http_call", "http_json", "urlopen"}
+# receivers whose no-arg .read() means "buffer to EOF" (sockets, HTTP
+# body streams) rather than a small local file
+_STREAMISH = re.compile(r"(?:^_*|_)(?:sock(?:et)?|rfile|wfile|stream|"
+                        r"conn(?:ection)?|resp(?:onse)?|body)s?$",
+                        re.IGNORECASE)
 _AMBIENT_READERS = {"current_span", "current_deadline", "current_class"}
 _SCOPE_ENTRIES = {"span_scope", "deadline_scope", "class_scope",
                   "attach", "child_scope"}
@@ -318,6 +338,16 @@ class Checker(ast.NodeVisitor):
 
     # ---- per-node rules ----
 
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr == "body" and isinstance(node.value, ast.Name) \
+                and node.value.id in ("req", "request"):
+            self.report(node, "unbounded-body-read",
+                        "req.body buffers the whole request body — "
+                        "consume req.stream.read(n) chunk-at-a-time "
+                        "(the _ingest_body idiom) so body memory is "
+                        "the handler's explicit budget")
+        self.generic_visit(node)
+
     def visit_Constant(self, node: ast.Constant) -> None:
         if isinstance(node.value, str) and \
                 node.value.startswith(_HEADER_PREFIX):
@@ -373,6 +403,19 @@ class Checker(ast.NodeVisitor):
                 self.report(node, "unbounded-pool",
                             "Queue() without maxsize — unbounded queues "
                             "turn overload into memory growth")
+
+        if terminal == "readall" and isinstance(node.func, ast.Attribute):
+            self.report(node, "unbounded-body-read",
+                        ".readall() materializes the whole stream — "
+                        "loop .read(n) under an explicit buffer budget")
+        elif terminal == "read" and isinstance(node.func, ast.Attribute) \
+                and not node.args and not node.keywords:
+            recv = _terminal(node.func.value)
+            if recv is not None and _STREAMISH.search(recv):
+                self.report(
+                    node, "unbounded-body-read",
+                    f"bare {recv}.read() buffers to EOF — pass a size "
+                    "and loop so a large peer body can't balloon RSS")
 
         if terminal == "submit" and isinstance(node.func, ast.Attribute) \
                 and node.args:
