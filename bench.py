@@ -1408,6 +1408,142 @@ def tpu_probe_with_retries(delays=TPU_ATTEMPT_DELAYS,
     return done((None, len(delays), last_err))
 
 
+def bench_profiler_overhead(n_reads: int = 600,
+                            concurrency: int = 8) -> dict:
+    """Round-16 continuous-profiling cost: the telemetry-overhead read
+    sweep again, but toggling the always-on wall-stack sampler
+    (shipped default: 19 Hz) instead of the RED plane. The sampler's
+    per-request cost is one module-global check in profiler.tag plus
+    two thread-local dict stores when active; the sampling itself
+    lives on a dedicated thread waking 19 times a second. The PERF.md
+    round-16 claim is "within noise at the default rate"; the paired
+    interleaved sweeps (ON/OFF/ON/OFF so CPU-frequency drift hits both
+    arms) are the evidence."""
+    import concurrent.futures
+    import tempfile
+
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    with tempfile.TemporaryDirectory() as d:
+        master = MasterServer(volume_size_limit_mb=64)
+        master.start()
+        vs = VolumeServer([d], master.url)
+        vs.start()
+        time.sleep(0.3)
+        mc = MasterClient(master.url)
+        try:
+            fids = [operation.upload_data(
+                mc, b"\xa5" * 4096, name=f"t{i}").fid
+                for i in range(32)]
+
+            def read_one(i):
+                operation.read_data(mc, fids[i % len(fids)])
+
+            def sweep() -> float:
+                t0 = time.perf_counter()
+                with concurrent.futures.ThreadPoolExecutor(
+                        concurrency) as ex:
+                    list(ex.map(read_one, range(n_reads)))
+                return n_reads / (time.perf_counter() - t0)
+
+            sweep()  # warm connections + page cache
+            on_rps, off_rps = [], []
+            for _ in range(2):
+                if not vs.sampler.running:
+                    vs.sampler.start()
+                on_rps.append(sweep())
+                vs.sampler.stop()
+                off_rps.append(sweep())
+            vs.sampler.start()
+        finally:
+            mc.stop()
+            vs.stop()
+            master.stop()
+    on, off = max(on_rps), max(off_rps)
+    return {
+        "profiler_on_rps": round(on, 1),
+        "profiler_off_rps": round(off, 1),
+        "profiler_overhead_pct": round((off - on) / off * 100, 2)
+        if off else 0.0,
+    }
+
+
+def bench_tenant_flood(duration_s: float = 1.0,
+                       victim_rate: float = 40.0,
+                       cap_rate: float = 50.0) -> dict:
+    """Round-16 tenant-isolation drill at the governor seam: an
+    aggressor tenant floods the write class as fast as a thread can
+    submit while a victim tenant offers a modest paced write load.
+    Both tenants share one QosGovernor (one node's admission control);
+    the only knob that separates them is the per-(class, tenant) token
+    bucket (`tenant_class_rates`). Two arms:
+
+    - uncapped: no tenant buckets — the aggressor eats the adaptive
+      concurrency limit and the victim sheds on `limit`;
+    - capped: writes carry a per-tenant rate of `cap_rate` req/s — the
+      aggressor is clipped to the cap and the victim (offering under
+      the cap) keeps its admitted/s.
+
+    The victim's admitted/s in the capped arm is the isolation floor
+    the qos tests assert."""
+    import threading as _threading
+
+    from seaweedfs_tpu.qos import WRITE
+    from seaweedfs_tpu.qos.governor import QosGovernor
+
+    def arm(capped: bool) -> dict:
+        gov = QosGovernor(initial_limit=32)
+        if capped:
+            gov.configure(tenant_class_rates={WRITE: cap_rate})
+        stop = _threading.Event()
+        counts = {"aggressor": 0, "victim": 0}
+
+        def aggressor():
+            while not stop.is_set():
+                g = gov.admit(WRITE, tenant="aggressor")
+                if g.ok:
+                    counts["aggressor"] += 1
+                    g.release()
+
+        def victim():
+            period = 1.0 / victim_rate
+            nxt = time.perf_counter()
+            while not stop.is_set():
+                g = gov.admit(WRITE, tenant="victim")
+                if g.ok:
+                    counts["victim"] += 1
+                    g.release()
+                nxt += period
+                delay = nxt - time.perf_counter()
+                if delay > 0:
+                    stop.wait(delay)
+
+        threads = [
+            _threading.Thread(target=aggressor, name="flood-aggressor"),
+            _threading.Thread(target=victim, name="flood-victim")]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        return {k: round(v / dt, 1) for k, v in counts.items()}
+
+    uncapped = arm(capped=False)
+    capped = arm(capped=True)
+    return {
+        "flood_uncapped_aggressor_rps": uncapped["aggressor"],
+        "flood_uncapped_victim_rps": uncapped["victim"],
+        "flood_capped_aggressor_rps": capped["aggressor"],
+        "flood_capped_victim_rps": capped["victim"],
+    }
+
+
 def classify_tpu_failure(err):
     """Map a probe failure string onto a stable fallback reason for
     the BENCH json. Delegates to parallel/mesh.classify_failure so the
@@ -1461,6 +1597,8 @@ def main(argv=None):
     e2e.update(bench_replicated_write())  # concurrent replica fan-out
     e2e.update(bench_overload())  # QoS admission under overload
     e2e.update(bench_telemetry_overhead())  # RED+sketch plane cost
+    e2e.update(bench_profiler_overhead())  # wall-stack sampler cost
+    e2e.update(bench_tenant_flood())  # per-tenant class-rate isolation
     e2e.update(bench_repair_network())  # partial-column repair ingress
     e2e.update(bench_filer_streaming_rss())  # bounded-memory ingest
     e2e.update(bench_replica_divergence_repair())  # hinted-handoff drill

@@ -56,7 +56,8 @@ class MasterClient:
                      else list(grpc_address))
             self._kc_thread = threading.Thread(
                 target=self._keep_connected_loop,
-                args=(addrs, client_type, client_address), daemon=True)
+                args=(addrs, client_type, client_address), daemon=True,
+                name="grpc-keepalive")
             self._kc_thread.start()
 
     # ---- KeepConnected push stream ----

@@ -155,7 +155,8 @@ class RaftNode:
 
     # ---- lifecycle ----
     def start(self) -> None:
-        t = threading.Thread(target=self._ticker, daemon=True)
+        t = threading.Thread(target=self._ticker, daemon=True,
+                             name="raft-ticker")
         t.start()
         self._threads.append(t)
 
@@ -245,7 +246,8 @@ class RaftNode:
                     if len(votes) * 2 > len(self.peers) + 1:
                         done.set()
 
-        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True,
+                                    name="raft-vote")
                    for p in self.peers]
         for t in threads:
             t.start()
@@ -308,7 +310,7 @@ class RaftNode:
             self._inflight.update(peers)
         for peer in peers:
             threading.Thread(target=self._replicate_to, args=(peer,),
-                             daemon=True).start()
+                             daemon=True, name="raft-replicate").start()
         if not self.peers:
             # single-node: everything is instantly committed
             with self._commit_cond:

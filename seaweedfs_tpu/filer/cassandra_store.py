@@ -318,7 +318,8 @@ class MiniCassandraServer:
         self.host, self.port = self._srv.getsockname()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="cassandra-accept")
 
     def start(self) -> "MiniCassandraServer":
         self._thread.start()
@@ -338,7 +339,7 @@ class MiniCassandraServer:
             except OSError:
                 return
             threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+                             daemon=True, name="cassandra-conn").start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         f = conn.makefile("rb")

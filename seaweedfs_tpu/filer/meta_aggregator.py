@@ -104,7 +104,8 @@ class MetaAggregator:
                 lambda ev: self.log.append(self.self_url, ev.to_dict()))
 
     def start(self) -> None:
-        threading.Thread(target=self._discovery_loop, daemon=True).start()
+        threading.Thread(target=self._discovery_loop, daemon=True,
+                         name="meta-discovery").start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -120,7 +121,8 @@ class MetaAggregator:
                     if peer == self.self_url or peer in self._followers:
                         continue
                     t = threading.Thread(target=self._follow_peer,
-                                         args=(peer,), daemon=True)
+                                         args=(peer,), daemon=True,
+                                         name="meta-follow")
                     self._followers[peer] = t
                     t.start()
             self._stop.wait(3.0)

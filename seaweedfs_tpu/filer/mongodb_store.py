@@ -285,7 +285,8 @@ class MiniMongoServer:
         self.host, self.port = self._srv.getsockname()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="mongodb-accept")
 
     def start(self) -> "MiniMongoServer":
         self._thread.start()
@@ -305,7 +306,7 @@ class MiniMongoServer:
             except OSError:
                 return
             threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+                             daemon=True, name="mongodb-conn").start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         f = conn.makefile("rb")

@@ -267,7 +267,8 @@ class MiniMysqlServer:
         self.host, self.port = self._srv.getsockname()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="mysql-accept")
 
     def start(self) -> "MiniMysqlServer":
         self._thread.start()
@@ -287,7 +288,7 @@ class MiniMysqlServer:
             except OSError:
                 return
             threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+                             daemon=True, name="mysql-conn").start()
 
     # ---- per-connection protocol ----
     def _serve_conn(self, conn: socket.socket) -> None:

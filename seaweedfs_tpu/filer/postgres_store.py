@@ -199,7 +199,8 @@ class MiniPostgresServer:
         self.host, self.port = self._srv.getsockname()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="postgres-accept")
 
     def start(self) -> "MiniPostgresServer":
         self._thread.start()
@@ -219,7 +220,7 @@ class MiniPostgresServer:
             except OSError:
                 return
             threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+                             daemon=True, name="postgres-conn").start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         f = conn.makefile("rb")

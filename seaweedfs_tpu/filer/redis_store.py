@@ -194,7 +194,8 @@ class MiniRedisServer:
         self.host, self.port = self._srv.getsockname()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="redis-accept")
 
     def start(self) -> "MiniRedisServer":
         self._thread.start()
@@ -214,7 +215,7 @@ class MiniRedisServer:
             except OSError:
                 return
             threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+                             daemon=True, name="redis-conn").start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         f = conn.makefile("rb")

@@ -38,7 +38,8 @@ class FtpServer:
         return f"{self.host}:{self.port}"
 
     def start(self) -> None:
-        threading.Thread(target=self._accept_loop, daemon=True).start()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="ftp-accept").start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -54,7 +55,7 @@ class FtpServer:
             except OSError:
                 return
             threading.Thread(target=_FtpSession(self, conn).run,
-                             daemon=True).start()
+                             daemon=True, name="ftp-session").start()
 
 
 class _FtpSession:

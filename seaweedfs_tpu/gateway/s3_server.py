@@ -36,7 +36,7 @@ from seaweedfs_tpu.utils import clockctl
 from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
 from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.qos import INTERACTIVE, WRITE, QosGovernor
-from seaweedfs_tpu.utils import glog, tracing
+from seaweedfs_tpu.utils import glog, profiler, tracing
 from seaweedfs_tpu.utils.httpd import HttpServer, Request, Response
 
 BUCKETS_PATH = "/buckets"
@@ -103,7 +103,8 @@ class S3Server:
                  circuit_breaker: Optional[CircuitBreaker] = None,
                  qos: bool = True,
                  tracing_enabled: bool = True,
-                 trace_sample: float = 0.01):
+                 trace_sample: float = 0.01,
+                 profile_hz: float = profiler.DEFAULT_HZ):
         # filer_server: in-process FilerServer (gateway composes chunk
         # lists directly; the data path still flows through volume servers)
         self.fs = filer_server
@@ -159,6 +160,20 @@ class S3Server:
                               self.hotkeys.handler(self.url))
         self.metrics_http.add("GET", "/admin/telemetry",
                               self._handle_telemetry)
+        # continuous profiling + per-(class, tenant) ledger. Tenant at
+        # the gateway = the request's ACCESS KEY (same identity the
+        # governor buckets on), so /cluster/telemetry chargeback rows
+        # name S3 principals, not NAT'd client IPs. /admin/profile
+        # rides the private listener like /metrics.
+        from seaweedfs_tpu.stats.ledger import ResourceLedger
+        self.sampler = profiler.WallSampler(hz=profile_hz)
+        self.ledger = ResourceLedger()
+        self.http.ledger = self.ledger
+        self.http.tenant_fn = self._tenant_from_headers
+        self.metrics_http.add("GET", "/admin/profile",
+                              profiler.make_profile_handler(
+                                  self.sampler, lambda: self.url,
+                                  "s3"))
         from seaweedfs_tpu.utils.debug import install_debug_routes
         install_debug_routes(self.metrics_http)
         self._register_routes()
@@ -166,6 +181,7 @@ class S3Server:
     def start(self) -> None:
         self.http.start()
         self.metrics_http.start()
+        self.sampler.start()
         self.tracer.node = f"s3@{self.http.host}:{self.http.port}"
         glog.info("s3 gateway up at %s (metrics=%s)", self.url,
                   self.metrics_url)
@@ -177,7 +193,7 @@ class S3Server:
             import threading
             self._announce_stop = threading.Event()
             threading.Thread(target=self._announce_loop,
-                             daemon=True).start()
+                             name="s3-announce", daemon=True).start()
 
     def _announce_loop(self) -> None:
         from seaweedfs_tpu.utils.httpd import http_json
@@ -197,6 +213,7 @@ class S3Server:
             announce()
 
     def stop(self) -> None:
+        self.sampler.stop()
         if hasattr(self, "_announce_stop"):
             self._announce_stop.set()
         self.http.stop()
@@ -226,7 +243,8 @@ class S3Server:
     def telemetry_snapshot(self) -> dict:
         return {"node": self.url, "server": "s3",
                 "red": self.red.snapshot(),
-                "hotkeys": self.hotkeys.snapshot()}
+                "hotkeys": self.hotkeys.snapshot(),
+                "ledger": self.ledger.snapshot()}
 
     def _handle_telemetry(self, req: Request) -> Response:
         return Response(self.telemetry_snapshot())
@@ -238,6 +256,19 @@ class S3Server:
     def _handle_qos_configure(self, req: Request) -> Response:
         return Response({"url": self.url,
                          **self.qos.configure(**(req.json() or {}))})
+
+    @staticmethod
+    def _tenant_from_headers(headers, client_ip: str) -> str:
+        """HttpServer.tenant_fn: ledger row identity from the SigV4
+        Authorization credential, client IP for anonymous traffic
+        (same keying as _tenant_of minus the presigned-query form,
+        which the dispatch hook can't see)."""
+        auth = headers.get("Authorization", "") or ""
+        if auth.startswith("AWS4-HMAC-SHA256 "):
+            m = re.search(r"Credential=([^/,]+)", auth)
+            if m:
+                return m.group(1)
+        return client_ip
 
     @staticmethod
     def _tenant_of(req: Request) -> str:

@@ -169,7 +169,8 @@ class FuseConnection:
     # ---- loop ----
     def serve_forever(self, background: bool = True):
         if background:
-            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="fuse-loop")
             self._thread.start()
         else:
             self._loop()

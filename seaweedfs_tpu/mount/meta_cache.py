@@ -83,7 +83,8 @@ class MetaCache:
                     self._apply_event(ev)
                     cursor = max(cursor, ev.tsns)
 
-        t = _th.Thread(target=loop, daemon=True)
+        t = _th.Thread(target=loop, daemon=True,
+                       name="meta-cache-subscribe")
         t.start()
         prev = self._detach
         self._detach = lambda: (stop.set(),

@@ -182,7 +182,8 @@ class MiniKafkaBroker:
         self.host, self.port = self._srv.getsockname()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="kafka-accept")
 
     def start(self) -> "MiniKafkaBroker":
         self._thread.start()
@@ -206,7 +207,7 @@ class MiniKafkaBroker:
             except OSError:
                 return
             threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+                             daemon=True, name="kafka-conn").start()
 
     def _serve(self, conn: socket.socket) -> None:
         try:

@@ -43,7 +43,13 @@ import numpy as np
 from seaweedfs_tpu.models.coder import (DEFAULT_SCHEME, ErasureCoder,
                                         RSScheme)
 from seaweedfs_tpu.qos import CLASSES, current_class
-from seaweedfs_tpu.utils import clockctl, glog
+from seaweedfs_tpu.utils import clockctl, glog, profiler
+from seaweedfs_tpu.utils.metrics import RED_BUCKETS, Histogram
+
+# coalesced-batch-size buckets: powers of two up to the default
+# max_batch, so "how full are my mesh dispatches" reads straight off
+# the histogram
+BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
 _STOP = object()
 _CLASS_RANK = {c: i for i, c in enumerate(CLASSES)}
@@ -56,17 +62,19 @@ def _rank(cls: Optional[str]) -> int:
 
 
 class _Job:
-    __slots__ = ("kind", "data", "mat", "n", "cls", "deadline", "future")
+    __slots__ = ("kind", "data", "mat", "n", "cls", "submitted",
+                 "deadline", "future")
 
     def __init__(self, kind: str, data: np.ndarray,
                  mat: Optional[np.ndarray], n: int, cls: Optional[str],
-                 deadline: float):
+                 submitted: float):
         self.kind = kind          # "encode" | "rebuild"
         self.data = data          # (k, n4) uint8, column-padded to 4
         self.mat = mat            # rebuild only: (r, k) uint8
         self.n = n                # original column count pre-padding
         self.cls = cls
-        self.deadline = deadline
+        self.submitted = submitted
+        self.deadline = submitted  # + window_s, set by the scheduler
         self.future: Future = Future()
 
 
@@ -109,6 +117,17 @@ class EcBatchScheduler:
         self.cpu_batches = 0
         self.coder_fallbacks = 0
         self.max_coalesced = 0
+        # RED-discipline wait histogram (submit -> dispatch, labelled by
+        # QoS class) + coalescing-quality histogram; both ride stats()
+        # as mergeable snapshots, same transport as the serving RED
+        self.wait_hist = Histogram(
+            "ec_batch_wait_seconds",
+            "submit-to-dispatch queueing delay", ("class",),
+            buckets=RED_BUCKETS)
+        self.size_hist = Histogram(
+            "ec_batch_coalesced_jobs",
+            "jobs coalesced per dispatched batch",
+            buckets=BATCH_SIZE_BUCKETS)
         self._stopped = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="ec-batcher")
@@ -129,8 +148,8 @@ class EcBatchScheduler:
                 axis=1)
         if cls is None:
             cls = current_class()
-        job = _Job(kind, data, mat, n, cls,
-                   clockctl.monotonic() + self.window_s)
+        job = _Job(kind, data, mat, n, cls, clockctl.monotonic())
+        job.deadline = job.submitted + self.window_s
         self._q.put(job)  # bounded: blocks -> backpressure
         return job.future
 
@@ -188,14 +207,23 @@ class EcBatchScheduler:
         self.jobs_total += len(batch)
         self.batches_total += 1
         self.max_coalesced = max(self.max_coalesced, len(batch))
+        now = clockctl.monotonic()
+        for j in batch:
+            self.wait_hist.observe(max(0.0, now - j.submitted),
+                                   j.cls or "-")
+        self.size_hist.observe(len(batch))
         # QoS ordering: a group containing an interactive job dispatches
         # before an all-background group
         batch.sort(key=lambda j: (_rank(j.cls), j.deadline))
         groups: dict[tuple, list] = {}
         for j in batch:
             groups.setdefault((j.kind,) + j.data.shape, []).append(j)
-        for jobs in groups.values():
-            self._run_group(jobs)
+        # profiler attribution: the dispatcher thread does the batch's
+        # work, so samples land under the batch's best (first) class
+        with profiler.scope(cls=batch[0].cls or "background",
+                            route="ec-batch"):
+            for jobs in groups.values():
+                self._run_group(jobs)
 
     def _run_group(self, jobs: list) -> None:
         if self._mesh_healthy():
@@ -285,6 +313,8 @@ class EcBatchScheduler:
             "coder_fallbacks": self.coder_fallbacks,
             "max_coalesced": self.max_coalesced,
             "fallback_reason": self.fallback_reason,
+            "wait_hist": self.wait_hist.snapshot(),
+            "size_hist": self.size_hist.snapshot(),
         }
 
 

@@ -101,7 +101,8 @@ class _Pipeline:
                 pass
             except BaseException as e:  # noqa: BLE001 — must reach caller
                 self.fail(e)
-        t = threading.Thread(target=run, daemon=True)
+        t = threading.Thread(target=run, daemon=True,
+                             name="ec-stream")
         t.start()
         self._threads.append(t)
         return t

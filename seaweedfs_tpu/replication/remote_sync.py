@@ -97,7 +97,8 @@ class FilerRemoteSync:
                     cursor = self.run_once(cursor, wait=5.0)
                 except (ConnectionError, HttpError):
                     self._stop.wait(1.0)
-        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="remote-sync")
         self._thread.start()
 
     def stop(self) -> None:

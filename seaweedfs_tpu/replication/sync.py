@@ -71,7 +71,8 @@ def _grpc_event_stream(client, since_ns: int, path_prefix: str,
         except Exception as e:
             q.put(("err", e))
 
-    threading.Thread(target=pump, daemon=True).start()
+    threading.Thread(target=pump, daemon=True,
+                     name="sync-pump").start()
     try:
         while True:
             try:
@@ -194,7 +195,8 @@ class FilerSync:
                     log.warning("sync pass failed, retrying: %s", e)
                     self._stop.wait(0.5)
                 self._stop.wait(0.05)
-        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="filer-sync")
         self._thread.start()
 
     def stop(self) -> None:

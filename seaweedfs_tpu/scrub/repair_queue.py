@@ -22,7 +22,7 @@ import threading
 
 from seaweedfs_tpu.qos import BACKGROUND, class_scope
 from seaweedfs_tpu.storage.erasure_coding import layout
-from seaweedfs_tpu.utils import clockctl, glog, tracing
+from seaweedfs_tpu.utils import clockctl, glog, profiler, tracing
 from seaweedfs_tpu.utils.httpd import http_json
 from seaweedfs_tpu.utils.limiter import TokenBucket
 from seaweedfs_tpu.utils.resilience import Deadline
@@ -311,6 +311,7 @@ class RepairQueue:
                 self.last_wave_size = len(to_run)
         for task in to_run:
             threading.Thread(target=self._run, args=(task,),
+                             name=f"repair-{task.vid}",
                              daemon=True).start()
 
     def _run(self, task: RepairTask) -> None:
@@ -325,7 +326,11 @@ class RepairQueue:
         status, err = 200, ""
         tok = tracing.attach(span)
         try:
-            self._run_traced(task, span)
+            # wall samples of this worker attribute to background
+            # repair, not an anonymous thread
+            with profiler.scope(cls=BACKGROUND, route="repair",
+                                trace_id=span.trace_id):
+                self._run_traced(task, span)
         except BaseException as e:  # pragma: no cover - _run_traced
             status, err = 500, f"{type(e).__name__}: {e}"  # swallows
             raise

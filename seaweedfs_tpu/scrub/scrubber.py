@@ -25,7 +25,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from seaweedfs_tpu.utils import clockctl
+from seaweedfs_tpu.qos import BACKGROUND
+from seaweedfs_tpu.utils import clockctl, profiler
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.needle import CrcError, Needle
 from seaweedfs_tpu.storage.super_block import SuperBlock
@@ -90,7 +91,8 @@ class Scrubber:
 
     # ---- lifecycle ----
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(target=self._loop,
+                                        name="scrubber", daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
@@ -103,7 +105,10 @@ class Scrubber:
         # server serves foreground traffic before it re-reads cold data
         while not self._stop.wait(self.interval_s):
             try:
-                self.run_once()
+                # scope re-entry per pass: wall samples of scrub I/O
+                # land under class background / route scrub
+                with profiler.scope(cls=BACKGROUND, route="scrub"):
+                    self.run_once()
             except Exception as e:
                 glog.warning("scrub pass failed (will retry): %s", e)
 

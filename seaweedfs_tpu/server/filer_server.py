@@ -37,7 +37,7 @@ from seaweedfs_tpu.filer.filerstore import make_store
 from seaweedfs_tpu.qos import (BACKGROUND, QosGovernor, class_scope,
                                classify, current_class, from_headers)
 from seaweedfs_tpu.utils import headers as weed_headers
-from seaweedfs_tpu.utils import clockctl, glog, tracing
+from seaweedfs_tpu.utils import clockctl, glog, profiler, tracing
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call)
 from seaweedfs_tpu.utils.resilience import (Deadline, PeerHealth,
@@ -105,7 +105,8 @@ class FilerServer:
                  announce: bool = True, grpc_port: Optional[int] = None,
                  qos: bool = True,
                  tracing_enabled: bool = True,
-                 trace_sample: float = 0.01):
+                 trace_sample: float = 0.01,
+                 profile_hz: float = profiler.DEFAULT_HZ):
         # qos=False disables admission control entirely (the
         # bit-for-bit comparator, same convention as parallel_uploads)
         # cipher=True encrypts every chunk (AES-256-GCM, per-chunk key in
@@ -210,6 +211,19 @@ class FilerServer:
                               self.hotkeys.handler(self.url))
         self.metrics_http.add("GET", "/admin/telemetry",
                               self._handle_telemetry)
+        # continuous profiling + per-(class, tenant) ledger; tenant at
+        # the filer edge = client IP, matching the governor's buckets.
+        # /admin/profile serves from the metrics listener (main port
+        # is user namespace), but tagging happens on the MAIN port's
+        # dispatch — same split as tracing.
+        from seaweedfs_tpu.stats.ledger import ResourceLedger
+        self.sampler = profiler.WallSampler(hz=profile_hz)
+        self.ledger = ResourceLedger()
+        self.http.ledger = self.ledger
+        self.metrics_http.add("GET", "/admin/profile",
+                              profiler.make_profile_handler(
+                                  self.sampler, lambda: self.url,
+                                  "filer"))
         from seaweedfs_tpu.utils.debug import install_debug_routes
         install_debug_routes(self.metrics_http)
         self._register_routes()
@@ -217,6 +231,7 @@ class FilerServer:
     def start(self) -> None:
         self.http.start()
         self.metrics_http.start()
+        self.sampler.start()
         self.tracer.node = f"filer@{self.http.host}:{self.http.port}"
         glog.info("filer server up at %s (store=%s, metrics=%s)",
                   self.url, self.filer.store.name, self.metrics_url)
@@ -234,7 +249,8 @@ class FilerServer:
         if not self.announce:
             return
         self._announce_stop = threading.Event()
-        threading.Thread(target=self._announce_loop, daemon=True).start()
+        threading.Thread(target=self._announce_loop,
+                         name="filer-announce", daemon=True).start()
         # merged view of every peer filer's change log (reference
         # filer/meta_aggregator.go; peers from master cluster membership)
         from seaweedfs_tpu.filer.meta_aggregator import MetaAggregator
@@ -267,6 +283,7 @@ class FilerServer:
             announce()
 
     def stop(self) -> None:
+        self.sampler.stop()
         if hasattr(self, "_announce_stop"):
             self._announce_stop.set()
         if hasattr(self, "meta_aggregator"):
@@ -305,7 +322,8 @@ class FilerServer:
                     except Exception as e:
                         glog.warning("chunk gc: delete %s failed: %s",
                                      fid, e)
-        threading.Thread(target=work, daemon=True).start()
+        threading.Thread(target=work, name="chunk-gc",
+                         daemon=True).start()
 
     # ---- routes ----
     def _register_routes(self) -> None:
@@ -350,7 +368,8 @@ class FilerServer:
     def telemetry_snapshot(self) -> dict:
         return {"node": self.url, "server": "filer",
                 "red": self.red.snapshot(),
-                "hotkeys": self.hotkeys.snapshot()}
+                "hotkeys": self.hotkeys.snapshot(),
+                "ledger": self.ledger.snapshot()}
 
     def _handle_telemetry(self, req: Request) -> Response:
         return Response(self.telemetry_snapshot())

@@ -28,7 +28,7 @@ from seaweedfs_tpu.cluster.volume_growth import (NoFreeSpaceError,
                                                  grow_by_type)
 from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
 from seaweedfs_tpu.utils import headers as weed_headers
-from seaweedfs_tpu.utils import clockctl, glog, tracing
+from seaweedfs_tpu.utils import clockctl, glog, profiler, tracing
 from seaweedfs_tpu.utils.httpd import (HttpServer, Request, Response,
                                        http_json)
 from seaweedfs_tpu.utils.resilience import Deadline, PeerHealth
@@ -48,7 +48,8 @@ class MasterServer:
                  repair_coalesce_window_s: float = 0.0,
                  qos: bool = True,
                  tracing_enabled: bool = True,
-                 trace_sample: float = 0.01):
+                 trace_sample: float = 0.01,
+                 profile_hz: float = profiler.DEFAULT_HZ):
         self.topo = Topology(volume_size_limit=volume_size_limit_mb * 1024 * 1024)
         self.jwt_signing_key = jwt_signing_key
         from seaweedfs_tpu.utils.metrics import Registry
@@ -105,6 +106,12 @@ class MasterServer:
         from seaweedfs_tpu.utils.metrics import RedRecorder
         self.red = RedRecorder(self.metrics, "master")
         self.http.red = self.red
+        # wall-stack sampler + per-(class, tenant) resource ledger:
+        # the master's own burn joins the cluster rollup it serves
+        from seaweedfs_tpu.stats.ledger import ResourceLedger
+        self.sampler = profiler.WallSampler(hz=profile_hz)
+        self.ledger = ResourceLedger()
+        self.http.ledger = self.ledger
         self.telemetry = ClusterTelemetry(
             on_transition=self._on_slo_transition)
         self._m_slo_burn = self.metrics.gauge(
@@ -141,18 +148,21 @@ class MasterServer:
     # ---- lifecycle ----
     def start(self) -> None:
         self.http.start()
+        self.sampler.start()
         self.tracer.node = f"master@{self.http.host}:{self.http.port}"
         if self._grpc_port is not None:
             from seaweedfs_tpu.server.master_grpc import start_master_grpc
             self._grpc_server, self.grpc_port = start_master_grpc(
                 self, self.http.host, self._grpc_port)
-        self._pruner = threading.Thread(target=self._prune_loop, daemon=True)
+        self._pruner = threading.Thread(target=self._prune_loop, daemon=True,
+                                        name="master-prune")
         self._pruner.start()
         glog.info("master server up at %s (peers=%s)", self.url,
                   ",".join(self.peers) if self.peers else "-")
 
     def stop(self) -> None:
         self._stop.set()
+        self.sampler.stop()
         self.repair_queue.stop()
         self.metrics.stop_push()
         self._save_state()
@@ -174,7 +184,10 @@ class MasterServer:
             self._save_state()
             self._feed_slo()
             if self.is_leader():
-                self.repair_queue.tick()
+                # profiler scope: repair waves sample as background
+                # work under route "repair", not anonymous thread time
+                with profiler.scope(cls=BACKGROUND, route="repair"):
+                    self.repair_queue.tick()
             if ticks % 12 == 0 and self.is_leader():
                 self._auto_vacuum()
 
@@ -389,6 +402,9 @@ class MasterServer:
         r("POST", "/ec/repair/kick", self._handle_repair_kick)
         r("GET", "/admin/qos", self._admin_qos)
         r("POST", "/admin/qos", self._admin_qos_configure)
+        # folded-stack window from the wall sampler (prof_collect)
+        r("GET", "/admin/profile", profiler.make_profile_handler(
+            self.sampler, lambda: self.url, "master"))
         r("POST", "/raft/vote", self._handle_raft("on_request_vote"))
         r("POST", "/raft/append", self._handle_raft("on_append_entries"))
         r("POST", "/raft/snapshot", self._handle_raft("on_install_snapshot"))
@@ -403,7 +419,8 @@ class MasterServer:
     # directory status.
     QOS_EXEMPT = ("/heartbeat", "/raft/", "/cluster/", "/metrics", "/ui",
                   "/debug", "/scrub/report", "/ec/repair/", "/admin/lock",
-                  "/admin/unlock", "/admin/qos", "/dir/leave", "/col/")
+                  "/admin/unlock", "/admin/qos", "/admin/profile",
+                  "/dir/leave", "/col/")
 
     def _admission_gate(self, method: str, path: str, headers, client):
         """HttpServer admission hook for the master's serving edge —
@@ -898,7 +915,8 @@ class MasterServer:
     def telemetry_snapshot(self) -> dict:
         """This master's own edge contribution to the merged view."""
         return {"node": self.url, "server": "master",
-                "red": self.red.snapshot()}
+                "red": self.red.snapshot(),
+                "ledger": self.ledger.snapshot()}
 
     def _on_slo_transition(self, t, cls, old, new, detail) -> None:
         glog.info("slo: class=%s %s -> %s (%s)", cls, old, new, detail)

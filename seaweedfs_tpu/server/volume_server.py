@@ -38,7 +38,7 @@ from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.volume import (CookieMismatchError, DeletedError,
                                           NotFoundError)
 from seaweedfs_tpu.utils import headers as weed_headers
-from seaweedfs_tpu.utils import clockctl, glog, tracing
+from seaweedfs_tpu.utils import clockctl, glog, profiler, tracing
 from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
                                        Response, http_call, http_json)
 from seaweedfs_tpu.utils.resilience import (Deadline, PeerHealth,
@@ -88,7 +88,8 @@ class VolumeServer:
                  ec_batcher: bool = False,
                  ec_batch_window_s: float = 0.005,
                  needle_cache_mb: int = 64,
-                 hinted_handoff: bool = True):
+                 hinted_handoff: bool = True,
+                 profile_hz: float = profiler.DEFAULT_HZ):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
         the volume_server_pb gRPC admin plane (0 = ephemeral).
@@ -144,7 +145,12 @@ class VolumeServer:
         (storage/hinted_handoff.py) that a background drain replays
         through the raw needle-blob transfer once the peer heals. Off =
         the legacy any-leg-fails-the-write contract, kept as the
-        comparator for the divergence drill."""
+        comparator for the divergence drill.
+
+        profile_hz sets the always-on wall-stack sampler's rate
+        (utils/profiler.py; 19Hz default, prime so it can't phase-lock
+        with periodic work). 0 disables: no sampler thread, and the
+        per-request scope tagging collapses to one global check."""
         urls = (master_url.split(",") if isinstance(master_url, str)
                 else list(master_url))
         self.master_urls = [u.strip() for u in urls if u.strip()]
@@ -267,10 +273,17 @@ class VolumeServer:
         self.red = RedRecorder(self.metrics, "volume")
         self.http.red = self.red
         self.hotkeys = HotKeys(dims=("needle",))
+        # continuous profiling + per-(class, tenant) resource ledger;
+        # both ride the telemetry piggyback to the master
+        from seaweedfs_tpu.stats.ledger import ResourceLedger
+        self.sampler = profiler.WallSampler(hz=profile_hz)
+        self.ledger = ResourceLedger()
+        self.http.ledger = self.ledger
 
     # ---- lifecycle ----
     def start(self) -> None:
         self.http.start()
+        self.sampler.start()
         self.tracer.node = f"volume@{self.http.host}:{self.http.port}"
         # register the ADVERTISED address with the master when one is
         # set, so peers route to us through it (chaos-proxy interpose)
@@ -306,7 +319,8 @@ class VolumeServer:
                 os.path.join(self._store_dirs[0], "hints.journal"),
                 fsync=self._fsync)
             self._hint_thread = threading.Thread(
-                target=self._hint_drain_loop, daemon=True)
+                target=self._hint_drain_loop, daemon=True,
+                name="hint-drain")
             self._hint_thread.start()
         if self._needle_cache_mb > 0:
             from seaweedfs_tpu.storage.needle_cache import NeedleCache
@@ -328,7 +342,8 @@ class VolumeServer:
         self._register_routes()
         self.heartbeat_once()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
-                                           daemon=True)
+                                           daemon=True,
+                                           name="volume-heartbeat")
         self._hb_thread.start()
         from seaweedfs_tpu.scrub import Scrubber
         self.scrubber = Scrubber(self.store,
@@ -350,6 +365,7 @@ class VolumeServer:
         the group commit, then send a final draining heartbeat so the
         grace clock restarts from the actual departure."""
         self._stop.set()
+        self.sampler.stop()
         if self.scrubber is not None:
             self.scrubber.stop()
         graceful = graceful and self.store is not None
@@ -628,6 +644,9 @@ class VolumeServer:
         # hot-needle sketch + full telemetry snapshot (RED histogram)
         r("GET", "/admin/hotkeys", self.hotkeys.handler(self.url))
         r("GET", "/admin/telemetry", self._admin_telemetry)
+        # folded-stack window from the wall sampler (prof_collect)
+        r("GET", "/admin/profile", profiler.make_profile_handler(
+            self.sampler, lambda: self.url, "volume"))
         # hot-needle record cache snapshot + runtime resize
         r("GET", "/admin/cache", self._admin_cache)
         r("POST", "/admin/cache", self._admin_cache_configure)
@@ -648,7 +667,8 @@ class VolumeServer:
     QOS_EXEMPT = ("/status", "/metrics", "/ui", "/debug",
                   "/admin/qos", "/admin/health", "/admin/scrub/status",
                   "/admin/ec/batcher", "/admin/hotkeys",
-                  "/admin/telemetry", "/admin/cache", "/admin/hints")
+                  "/admin/telemetry", "/admin/cache", "/admin/hints",
+                  "/admin/profile")
 
     def _admission_gate(self, method: str, path: str, headers, client):
         """HttpServer admission hook: classify (propagated header wins
@@ -702,9 +722,17 @@ class VolumeServer:
         return Response({"url": self.url, "enabled": True, **out})
 
     def telemetry_snapshot(self) -> dict:
-        return {"node": self.url, "server": "volume",
+        snap = {"node": self.url, "server": "volume",
                 "red": self.red.snapshot(),
-                "hotkeys": self.hotkeys.snapshot()}
+                "hotkeys": self.hotkeys.snapshot(),
+                "ledger": self.ledger.snapshot()}
+        if self.hint_journal is not None:
+            # journal size/age ride the heartbeat so the master can
+            # fire hints_stale when a drain wedges
+            st = self.hint_journal.stats()
+            snap["hints"] = {"pending_rows": st["pending_rows"],
+                             "oldest_debt_age_s": st["oldest_debt_age_s"]}
+        return snap
 
     def _admin_telemetry(self, req: Request) -> Response:
         return Response(self.telemetry_snapshot())
@@ -1030,6 +1058,10 @@ class VolumeServer:
             return Response(b"", status=404, content_type="text/plain")
         except CookieMismatchError:
             return Response(b"", status=404, content_type="text/plain")
+        h = getattr(req, "handler", None)
+        self.ledger.charge_disk(
+            len(n.data),
+            tenant=h.client_address[0] if h is not None else "-")
         headers = {}
         if n.is_compressed:
             accept = req.headers.get("Accept-Encoding", "")
@@ -1291,7 +1323,8 @@ class VolumeServer:
     def _hint_drain_loop(self) -> None:
         while not self._stop.wait(self.HINT_DRAIN_INTERVAL_S):
             try:
-                with class_scope(BACKGROUND):
+                with class_scope(BACKGROUND), \
+                        profiler.scope(cls=BACKGROUND, route="hints"):
                     self.drain_hints()
             except Exception as e:
                 glog.warning("hint drain pass failed (will retry): %s", e)

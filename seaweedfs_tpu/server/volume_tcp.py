@@ -51,7 +51,8 @@ class TcpDataServer:
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._accept_loop,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="volume-tcp-accept")
         self._thread.start()
 
     def stop(self) -> None:
@@ -68,7 +69,8 @@ class TcpDataServer:
             except OSError:
                 return
             threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+                             daemon=True,
+                             name="volume-tcp-conn").start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:

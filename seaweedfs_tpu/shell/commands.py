@@ -833,6 +833,63 @@ class ShellContext:
         traces.sort(key=lambda t: -t["duration_ms"])
         return {"traces": traces, "unreachable": unreachable}
 
+    def cluster_profile(self, seconds: float = 5.0,
+                        top_k: int = 20) -> dict:
+        """Cluster CPU-profile view: pull a `seconds`-long wall-stack
+        window from the master's and every volume server's always-on
+        sampler (/admin/profile) and merge the folded tables — "where
+        is the cluster spending its wall time, by QoS class and route,
+        right now". Returns the top stacks by sample count plus the
+        per-class share split; tools/prof_collect.py turns the same
+        data into a flamegraph file. Filers and S3 gateways serve the
+        endpoint on their metrics port, which the master's topology
+        doesn't know; use the tool's --node to include them."""
+        from seaweedfs_tpu.utils import profiler
+        targets = [self.master_url]
+        try:
+            out = http_json("GET",
+                            f"http://{self.master_url}/cluster/qos")
+            targets += [n["url"] for n in out.get("nodes", [])
+                        if n.get("url") and n["url"] not in targets]
+        except Exception:
+            pass
+        tables = []
+        nodes = []
+        unreachable = []
+        for url in targets:
+            try:
+                snap = http_json(
+                    "GET",
+                    f"http://{url}/admin/profile?seconds={seconds:g}",
+                    timeout=seconds + 10.0)
+            except Exception as e:
+                unreachable.append({"node": url,
+                                    "error": type(e).__name__})
+                continue
+            tables.append(snap.get("folded", {}))
+            nodes.append({"node": snap.get("node", url),
+                          "server": snap.get("server", "?"),
+                          "samples": snap.get("samples", 0)})
+        merged = profiler.merge_folded(tables)
+        total = sum(merged.values())
+        by_class: dict[str, int] = defaultdict(int)
+        for stack, n in merged.items():
+            head = stack.split(";", 1)[0]
+            key = head.split(":", 1)[1] if head.startswith("class:") \
+                else "(untagged)"
+            by_class[key] += n
+        top = sorted(merged.items(), key=lambda kv: -kv[1])[:top_k]
+        return {
+            "seconds": seconds, "samples": total, "nodes": nodes,
+            "per_class": {c: {"samples": n,
+                              "share": round(n / total, 4) if total
+                              else 0.0}
+                          for c, n in sorted(by_class.items(),
+                                             key=lambda kv: -kv[1])},
+            "top_stacks": [{"stack": s, "samples": n} for s, n in top],
+            "unreachable": unreachable,
+        }
+
     def cluster_telemetry(self, top_k: int = 10,
                           peers: bool = True) -> dict:
         """Cluster RED/SLO view: the master's merged telemetry rollup —

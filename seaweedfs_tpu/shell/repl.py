@@ -67,6 +67,10 @@ HELP = """commands:
   cluster.telemetry [-topK N] [-noPeers]
                                     merged RED quantiles + exemplars,
                                     hot-key leaderboard, SLO burn alerts
+  cluster.profile [-seconds N] [-topK N]
+                                    merged wall-stack window from every
+                                    node's sampler: per-class CPU share
+                                    + hottest stacks
   volume.scrub [-node HOST:PORT] [-volumeId N]   synchronous integrity pass
   lock / unlock
   help / exit
@@ -636,6 +640,10 @@ def run_command(sh: ShellContext, line: str):
         return sh.cluster_telemetry(
             top_k=int(flags.get("topK", 10) or 10),
             peers="noPeers" not in flags)
+    if cmd == "cluster.profile":
+        return sh.cluster_profile(
+            seconds=float(flags.get("seconds", 5) or 5),
+            top_k=int(flags.get("topK", 20) or 20))
     if cmd == "ec.repair.kick":
         return sh.ec_repair_kick()
     if cmd == "volume.scrub":

@@ -14,11 +14,18 @@ from __future__ import annotations
 from typing import Optional
 
 from seaweedfs_tpu.stats.hotkeys import HotKeys
+from seaweedfs_tpu.stats.ledger import ResourceLedger
 from seaweedfs_tpu.stats.slo import SloEvaluator
 from seaweedfs_tpu.utils.metrics import RED_BUCKETS, Histogram
 
 # label order of the RED histogram: see metrics.RedRecorder
 _L_SERVER, _L_ROUTE, _L_CLASS, _L_STATUS = range(4)
+
+# hint-journal staleness thresholds (SloEvaluator-adjacent: a simple
+# level alert, not a burn rate — journal debt is a stock, not a flow).
+# Either condition on ANY node fires `hints_stale` in alerts_firing.
+HINTS_PENDING_MAX = 1024
+HINTS_AGE_MAX_S = 60.0
 
 
 def red_class_rollup(snapshot: dict, latency_targets: dict) -> dict:
@@ -69,14 +76,17 @@ class ClusterTelemetry:
     @staticmethod
     def merge(node_snaps: list) -> tuple:
         """Merge node telemetry snapshots ({"node", "server", "red",
-        "hotkeys"}) into (red Histogram, HotKeys, contributing
-        node urls)."""
+        "hotkeys", "ledger"?, "hints"?}) into (red Histogram, HotKeys,
+        ResourceLedger, per-node hint-journal rows, contributing node
+        urls)."""
         red = Histogram(
             "cluster_red", "merged RED",
             label_names=("server", "route_family", "class",
                          "status_family"),
             buckets=RED_BUCKETS)
         hot = HotKeys(dims=())
+        ledger = ResourceLedger()
+        hints = []
         nodes = []
         for snap in node_snaps:
             if not snap:
@@ -85,9 +95,14 @@ class ClusterTelemetry:
                 red.merge_from(snap["red"])
             if snap.get("hotkeys"):
                 hot.merge_from(snap["hotkeys"])
+            if snap.get("ledger"):
+                ledger.merge_from(snap["ledger"])
+            if snap.get("hints"):
+                hints.append({"node": snap.get("node", ""),
+                              **snap["hints"]})
             if snap.get("node"):
                 nodes.append(snap["node"])
-        return red, hot, nodes
+        return red, hot, ledger, hints, nodes
 
     def rollup(self, now: float, node_snaps: list,
                top_k: int = 10) -> dict:
@@ -95,7 +110,7 @@ class ClusterTelemetry:
         error rates, cluster top-k hot keys, bucket exemplars, and
         the SLO judgement (feeding the burn-rate windows as a side
         effect)."""
-        red, hot, nodes = self.merge(node_snaps)
+        red, hot, ledger, hints, nodes = self.merge(node_snaps)
         targets = {c: o["latency_s"]
                    for c, o in self.slo.objectives.items()}
         merged_snap = red.snapshot()
@@ -120,14 +135,27 @@ class ClusterTelemetry:
         for cls, judged in slo_view.items():
             if cls in per_class:
                 per_class[cls]["slo"] = judged
+        alerts = list(self.slo.firing())
+        stale = [h for h in hints
+                 if h.get("pending_rows", 0) > HINTS_PENDING_MAX
+                 or h.get("oldest_debt_age_s", 0.0) > HINTS_AGE_MAX_S]
+        if stale:
+            alerts.append("hints_stale")
         return {
             "per_class": per_class,
             "top_keys": hot.top(top_k),
             "key_totals": {d: sk.total
                            for d, sk in hot.sketches.items()},
+            # per-(class, tenant) chargeback: cluster-merged CPU-ms,
+            # wire bytes, disk reads — hottest tenants first
+            "ledger": {"fields": ["class", "tenant", "requests",
+                                  "cpu_ms", "bytes_in", "bytes_out",
+                                  "disk_bytes_read"],
+                       "rows": ledger.snapshot()["rows"][:max(top_k, 20)]},
+            "hints": hints,
             "nodes": sorted(nodes),
             "slo": slo_view,
-            "alerts_firing": self.slo.firing(),
+            "alerts_firing": alerts,
         }
 
 

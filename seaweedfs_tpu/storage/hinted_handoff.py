@@ -13,7 +13,7 @@ transfer once the peer heals.
 Format: append-only JSONL, one record per line.
 
     {"seq": 7, "op": "write", "vid": 3, "key": 23, "cookie": 9,
-     "peer": "127.0.0.1:8081", "fid": "17c0b2a9"}
+     "peer": "127.0.0.1:8081", "fid": "17c0b2a9", "ts": 1754000000.0}
     {"ack": 7}
 
 Appends are the only hot-path writes (one line per missed leg, only
@@ -34,6 +34,8 @@ import json
 import os
 import threading
 from typing import Optional
+
+from seaweedfs_tpu.utils import clockctl
 
 # rewrite the file once this many ack rows accumulate — bounds journal
 # growth at ~2x the peak pending set between compactions
@@ -71,6 +73,9 @@ class HintJournal:
                         self._acked_rows += 1
                     elif "seq" in rec:
                         seq = int(rec["seq"])
+                        # journals written before debts carried
+                        # timestamps: age from load, not epoch zero
+                        rec.setdefault("ts", clockctl.now())
                         self._pending[seq] = rec
                         self._index[self._key_of(rec)] = seq
                         self._next_seq = max(self._next_seq, seq + 1)
@@ -108,7 +113,7 @@ class HintJournal:
             self._next_seq += 1
             rec = {"seq": seq, "op": op, "vid": int(vid),
                    "key": int(key), "cookie": int(cookie),
-                   "peer": peer, "fid": fid}
+                   "peer": peer, "fid": fid, "ts": clockctl.now()}
             self._pending[seq] = rec
             self._index[self._key_of(rec)] = seq
             self._append_locked(rec)
@@ -160,8 +165,20 @@ class HintJournal:
             self._compact_locked()
 
     def stats(self) -> dict:
+        """Journal size and staleness, piggybacked on volume
+        heartbeats so the telemetry plane can alert (hints_stale) on a
+        wedged drain: oldest_debt_age_s is how long the OLDEST unpaid
+        hint has been waiting — a healthy drain keeps it near zero
+        once the peer heals."""
         with self._lock:
+            oldest = min((r.get("ts", 0.0)
+                          for r in self._pending.values()),
+                         default=None)
             return {"path": self.path, "pending": len(self._pending),
+                    "pending_rows": len(self._pending),
+                    "oldest_debt_age_s": (
+                        max(0.0, clockctl.now() - oldest)
+                        if oldest is not None else 0.0),
                     "next_seq": self._next_seq,
                     "acked_rows": self._acked_rows}
 

@@ -31,6 +31,7 @@ from typing import Callable, Optional
 _monotonic: Callable[[], float] = time.monotonic
 _sleep: Callable[[float], None] = time.sleep
 _now: Callable[[], float] = time.time
+_thread_time: Callable[[], float] = time.thread_time
 
 
 def monotonic() -> float:
@@ -57,6 +58,17 @@ def sleep(seconds: float) -> None:
     _sleep(seconds)
 
 
+def thread_time() -> float:
+    """Per-thread CPU clock, for resource accounting (the ledger's
+    dispatch-boundary deltas).  Unlike the behavioral clocks, install()
+    does NOT redirect this onto the virtual timeline by default: CPU
+    burned under a sim is still real CPU, and attributing virtual
+    seconds as CPU-milliseconds would fabricate chargeback rows.  A
+    deterministic test that wants synthetic CPU deltas passes
+    ``thread_time_fn`` explicitly."""
+    return _thread_time()
+
+
 def is_virtual() -> bool:
     return _monotonic is not time.monotonic
 
@@ -70,17 +82,21 @@ def _no_real_sleep(seconds: float) -> None:
 @contextmanager
 def install(monotonic_fn: Callable[[], float],
             sleep_fn: Optional[Callable[[float], None]] = None,
-            now_fn: Optional[Callable[[], float]] = None):
+            now_fn: Optional[Callable[[], float]] = None,
+            thread_time_fn: Optional[Callable[[], float]] = None):
     """Install a clock override for the duration of a with-block.
     Nested installs restore correctly (LIFO).  ``now_fn`` defaults to
     ``monotonic_fn``: the virtual timeline serves both clocks, which
-    keeps now()-vs-now() comparisons coherent inside the sim."""
-    global _monotonic, _sleep, _now
-    prev = (_monotonic, _sleep, _now)
+    keeps now()-vs-now() comparisons coherent inside the sim.
+    ``thread_time_fn`` defaults to staying REAL (see thread_time)."""
+    global _monotonic, _sleep, _now, _thread_time
+    prev = (_monotonic, _sleep, _now, _thread_time)
     _monotonic = monotonic_fn
     _sleep = sleep_fn if sleep_fn is not None else _no_real_sleep
     _now = now_fn if now_fn is not None else monotonic_fn
+    if thread_time_fn is not None:
+        _thread_time = thread_time_fn
     try:
         yield
     finally:
-        _monotonic, _sleep, _now = prev
+        _monotonic, _sleep, _now, _thread_time = prev

@@ -34,7 +34,8 @@ from http.server import BaseHTTPRequestHandler
 from typing import Any, Callable, Optional
 
 from seaweedfs_tpu.qos import classes as qos_classes
-from seaweedfs_tpu.utils import clockctl, glog, resilience, tracing
+from seaweedfs_tpu.utils import (clockctl, glog, profiler, resilience,
+                                 tracing)
 
 # route-family derivation for the RED histogram: a closed, low-
 # cardinality set so (server, route_family, class, status_family)
@@ -658,6 +659,36 @@ class _ConnHandler(BaseHTTPRequestHandler):
 
     def _dispatch_inner(self, path, length, span):
         server = self.srv
+        fam = route_family(path)
+        eff_cls = qos_classes.from_headers(self.headers) \
+            or qos_classes.classify(self.command, path)
+        # continuous-profiling scope: the wall sampler attributes this
+        # thread's stacks to (class, route) while the request runs.
+        # With no sampler active tag() is one global check.
+        ptok = profiler.tag(eff_cls, fam,
+                            span.trace_id if span.sampled else None)
+        ledger = server.ledger
+        t_cpu = clockctl.thread_time() if ledger is not None else 0.0
+        status, bytes_in, bytes_out = 500, 0, 0
+        try:
+            status, bytes_in, bytes_out = self._dispatch_gated(
+                path, length, span, fam, eff_cls)
+        finally:
+            profiler.untag(ptok)
+            if ledger is not None:
+                # the handler ran on THIS thread, so the per-thread
+                # CPU clock delta is exactly the request's burn
+                tenant = (server.tenant_fn(self.headers,
+                                           self.client_address[0])
+                          if server.tenant_fn is not None
+                          else self.client_address[0])
+                ledger.observe_request(
+                    eff_cls, tenant,
+                    cpu_s=clockctl.thread_time() - t_cpu,
+                    bytes_in=bytes_in, bytes_out=bytes_out)
+
+    def _dispatch_gated(self, path, length, span, fam, eff_cls):
+        server = self.srv
         # RED edge observation brackets EVERYTHING — admission
         # sheds, gate rejects, 404s, handler 500s — so the
         # duration histogram is the true edge view. clockctl
@@ -669,9 +700,7 @@ class _ConnHandler(BaseHTTPRequestHandler):
         def red_observe(status):
             if red is None:
                 return
-            cls = qos_classes.from_headers(self.headers) \
-                or qos_classes.classify(self.command, path)
-            red.observe(route_family(path), cls, status,
+            red.observe(fam, eff_cls, status,
                         clockctl.monotonic() - t_red,
                         exemplar=span.trace_id
                         if span.sampled else None)
@@ -685,10 +714,11 @@ class _ConnHandler(BaseHTTPRequestHandler):
                 self._reject(verdict, length)
                 red_observe(verdict.status)
                 span.finish(status=verdict.status)
-                return
+                return verdict.status, 0, 0
             release = verdict
         on_sent = None
         resp = None
+        stream = None
         out_status = 500
         t0 = clockctl.monotonic()
         try:
@@ -699,7 +729,7 @@ class _ConnHandler(BaseHTTPRequestHandler):
                 if isinstance(verdict, Response):
                     out_status = verdict.status
                     self._reject(verdict, length)
-                    return
+                    return out_status, 0, 0
                 on_sent = verdict
             # the body stays ON THE WIRE until the handler asks for
             # it: streaming handlers pull req.stream a chunk at a
@@ -708,16 +738,17 @@ class _ConnHandler(BaseHTTPRequestHandler):
             chunked = "chunked" in (
                 self.headers.get("Transfer-Encoding") or "").lower()
             stream = BodyStream(self.rfile, length, chunked)
-            # propagated traffic class becomes ambient for the
-            # handler, so its nested http_calls re-inject it
-            cls = qos_classes.from_headers(self.headers)
+            # the effective class (propagated header, else edge
+            # classification) becomes ambient for the handler, so
+            # nested http_calls re-inject it and ledger disk charges
+            # land in the same (class, tenant) row as the request
             for method, pattern, fn in server.routes:
                 if method != self.command:
                     continue
                 m = pattern.match(path)
                 if m:
                     try:
-                        with qos_classes.class_scope(cls):
+                        with qos_classes.class_scope(eff_cls):
                             resp = fn(Request(self, m, stream=stream))
                     except Exception as e:  # surface as 500 JSON
                         glog.exception(
@@ -752,6 +783,9 @@ class _ConnHandler(BaseHTTPRequestHandler):
                 release()
             red_observe(out_status)
             span.finish(status=out_status)
+        return (out_status,
+                stream.consumed if stream is not None else 0,
+                len(resp.body) if resp is not None else 0)
 
     def _send(self, resp):
         try:
@@ -1138,6 +1172,14 @@ class HttpServer:
         # including requests the gates shed. None -> one attribute
         # check per request.
         self.red = None
+        # stats.ledger.ResourceLedger wired by the owning server: the
+        # dispatch bracket bills each request's thread-CPU delta and
+        # wire bytes to (class, tenant). None -> one attribute check.
+        self.ledger = None
+        # tenant_fn(headers, client_ip) -> str names the ledger row's
+        # tenant; None -> client ip (the filer/volume tier's identity;
+        # the S3 gateway overrides with the request's access key).
+        self.tenant_fn = None
         # graceful-drain state: once draining, new requests (including
         # ones arriving on kept-alive connections) are answered 503 +
         # Connection: close while in-flight requests run to completion;

@@ -350,7 +350,8 @@ class Registry:
                 except Exception as e:
                     glog.vlog(1, "metrics push to %s failed: %s", url, e)
 
-        self._push_thread = threading.Thread(target=loop, daemon=True)
+        self._push_thread = threading.Thread(target=loop, daemon=True,
+                                             name="metrics-push")
         self._push_thread.start()
 
     def stop_push(self) -> None:

@@ -305,3 +305,17 @@ def test_telemetry_overhead_floor():
 
     out = bench.bench_telemetry_overhead(n_reads=400)
     assert out["telemetry_on_rps"] > 0.7 * out["telemetry_off_rps"], out
+
+
+def test_profiler_overhead_floor():
+    """The always-on wall-stack sampler at its default 19Hz must stay
+    within noise of the sampler-off read path: per request the cost is
+    one registry tag/untag plus the ledger's thread-CPU delta, and the
+    19 wakes a second are amortized across every in-flight request.
+    Measured ~0-5% (PERF.md round 16); same catastrophic-only floor as
+    the telemetry test — interleaved ON/OFF sweeps, not a tight bound,
+    so scheduler jitter can't flake it."""
+    import bench
+
+    out = bench.bench_profiler_overhead(n_reads=400)
+    assert out["profiler_on_rps"] > 0.7 * out["profiler_off_rps"], out

@@ -101,6 +101,15 @@ CASES = {
                   "            return n\n"
                   "        n += len(piece)\n"),
     },
+    "unnamed-thread": {
+        "bad": ("import threading\n\n"
+                "def f(fn):\n"
+                "    threading.Thread(target=fn, daemon=True).start()\n"),
+        "clean": ("import threading\n\n"
+                  "def f(fn):\n"
+                  "    threading.Thread(target=fn, daemon=True,\n"
+                  "                     name='worker').start()\n"),
+    },
     "ambient-scope-loss": {
         "bad": ("from seaweedfs_tpu.utils.tracing import current_span\n\n"
                 "def f(pool):\n"

@@ -189,7 +189,7 @@ class ChaosProxy:
                 return
             self.stats["connections"] += 1
             t = threading.Thread(target=self._handle, args=(client,),
-                                 daemon=True)
+                                 daemon=True, name="chaos-conn")
             self._threads.append(t)
             t.start()
 
@@ -242,7 +242,7 @@ class ChaosProxy:
             self._track(upstream)
             up = threading.Thread(
                 target=self._pump, args=(client, upstream, True),
-                daemon=True)
+                daemon=True, name="chaos-pump")
             up.start()
             self._pump(upstream, client, False)
             up.join(timeout=2.0)

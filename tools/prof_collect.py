@@ -1,0 +1,152 @@
+"""Cluster flamegraph collector: pull /admin/profile windows from
+every node and merge them into one folded-stack file.
+
+Every server runs an always-on wall-clock sampler (utils/profiler.py)
+whose stacks are prefixed with the ambient request scope
+(``class:<cls>;route:<family>``), so the merged output answers "where
+does the cluster spend its wall time, and on whose behalf" in one
+artifact. The folded format (``frame;frame;frame count``) feeds
+directly into flamegraph.pl / speedscope / inferno.
+
+Modes:
+
+- collect (default): fetch a ``--seconds N`` window from each node
+  concurrently-ish (sequentially, but every node buffers its own
+  window server-side), merge the folded tables, write them to --out
+  (or stdout). ``--seconds 0`` grabs each sampler's cumulative table
+  instead of a fresh window.
+- ``--diff baseline.folded``: after collecting, compare per-frame
+  inclusive shares against a previously saved folded file and print
+  the top regressions — "which frame grew the most as a fraction of
+  total samples". This is the two-command perf-regression loop:
+  collect a baseline before the change, diff after it.
+
+Targets come from ``--node HOST:PORT`` (repeatable — volume servers
+and the master serve /admin/profile on their main port; filers and S3
+gateways on their metrics port) or are discovered from a master via
+``--master HOST:PORT`` (the master itself + every volume node; filer /
+gateway metrics ports are not in the topology, add them with --node).
+
+Usage:
+  PYTHONPATH=. python tools/prof_collect.py --master 127.0.0.1:9333 \
+      --seconds 10 --out cluster.folded
+  PYTHONPATH=. python tools/prof_collect.py --master 127.0.0.1:9333 \
+      --seconds 10 --diff cluster.folded
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from seaweedfs_tpu.utils import profiler  # noqa: E402
+from seaweedfs_tpu.utils.httpd import http_json  # noqa: E402
+
+
+def discover_nodes(master: str) -> list:
+    """Master + every volume node (GET /cluster/qos lists them)."""
+    nodes = [master]
+    try:
+        out = http_json("GET", f"http://{master}/cluster/qos",
+                        timeout=5.0)
+        for n in out.get("nodes", []):
+            url = n.get("url", "")
+            if url and url not in nodes:
+                nodes.append(url)
+    except Exception:
+        pass
+    return nodes
+
+
+def collect(nodes: list, seconds: float) -> tuple[list, list]:
+    """Fetch one profile window per node.
+    Returns (windows, unreachable): windows are the raw /admin/profile
+    JSON docs (node, server, samples, folded...)."""
+    windows: list = []
+    unreachable: list = []
+    for node in nodes:
+        try:
+            snap = http_json(
+                "GET",
+                f"http://{node}/admin/profile?seconds={seconds:g}",
+                # the node holds the request open for the whole window
+                timeout=seconds + 10.0)
+        except Exception as e:  # noqa: BLE001 — report, keep collecting
+            unreachable.append({"node": node, "error": str(e)})
+            continue
+        windows.append(snap)
+    return windows, unreachable
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="collect /admin/profile windows and merge them "
+                    "into one cluster flamegraph (folded stacks)")
+    ap.add_argument("--master", default="",
+                    help="discover nodes from this master")
+    ap.add_argument("--node", action="append", default=[],
+                    help="explicit HOST:PORT (repeatable)")
+    ap.add_argument("--seconds", type=float, default=10.0,
+                    help="window length per node (0 = cumulative)")
+    ap.add_argument("--out", default="",
+                    help="write merged folded stacks here (else stdout)")
+    ap.add_argument("--diff", default="",
+                    help="baseline .folded file: report top frame-share "
+                         "regressions instead of dumping stacks")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows to show with --diff")
+    args = ap.parse_args(argv)
+
+    nodes = list(args.node)
+    if args.master:
+        nodes += [n for n in discover_nodes(args.master)
+                  if n not in nodes]
+    if not nodes:
+        ap.error("no targets: pass --master and/or --node")
+
+    windows, unreachable = collect(nodes, args.seconds)
+    for u in unreachable:
+        print(f"# unreachable {u['node']}: {u['error']}",
+              file=sys.stderr)
+    if not windows:
+        print("no profile windows collected", file=sys.stderr)
+        return 1
+
+    merged = profiler.merge_folded([w.get("folded", {})
+                                    for w in windows])
+    total = sum(merged.values())
+    print(f"# merged {total} samples from {len(windows)} node(s): "
+          + ", ".join(f"{w.get('node', '?')}({w.get('samples', 0)})"
+                      for w in windows),
+          file=sys.stderr)
+
+    if args.diff:
+        with open(args.diff) as fh:
+            baseline = profiler.parse_folded(fh.read())
+        rows = profiler.diff_folded(baseline, merged, top_n=args.top)
+        if not rows:
+            print("no frame grew its share beyond the noise floor")
+            return 0
+        print(f"{'DELTA':>7} {'BASE':>6} {'NOW':>6}  FRAME")
+        for r in rows:
+            print(f"{r['delta'] * 100:>+6.1f}% "
+                  f"{r['base_share'] * 100:>5.1f}% "
+                  f"{r['cur_share'] * 100:>5.1f}%  {r['frame']}")
+        return 0
+
+    text = profiler.to_folded_text(merged)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text)
+        print(f"wrote {len(merged)} stacks ({total} samples) "
+              f"to {args.out}")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,4 @@
-"""The weedlint rule set: one AST pass, ten invariants.
+"""The weedlint rule set: one AST pass, eleven invariants.
 
 Every rule encodes a contract the cluster depends on ambiently — the
 kind that breaks silently at a single call site and only surfaces as a
@@ -91,6 +91,14 @@ unbounded-body-read
     ``_ingest_body`` idiom) — or a 5GB PUT costs 5GB of filer RSS.
     Deliberate small-body sites (JSON admin endpoints) are baselined;
     new code streams.
+
+unnamed-thread
+    ``threading.Thread(...)`` without a ``name=`` kwarg.  The wall
+    sampler (utils/profiler.py) prefixes every untagged thread's
+    stacks with ``thread:<name>``, and ``Thread-7`` in a cluster
+    flamegraph is unattributable.  Every long-lived thread states its
+    role; ephemeral helpers still benefit (their samples group under
+    one label instead of a counter-suffixed spray).
 """
 
 from __future__ import annotations
@@ -118,6 +126,9 @@ RULES: dict[str, str] = {
     "unbounded-body-read":
         "whole-body read (req.body/.readall()/bare .read()) outside "
         "utils/httpd.py",
+    "unnamed-thread":
+        "threading.Thread without name= — unattributable in the "
+        "profiler's flamegraphs",
 }
 
 # files that ARE the sanctioned implementation of a contract
@@ -140,7 +151,7 @@ _HTTP_CALLS = {
 # modules whose aliases we track for canonical-name resolution
 _TRACKED_MODULES = ("time", "urllib.request", "urllib", "http.client",
                     "http", "socket", "queue", "concurrent.futures",
-                    "concurrent", "jax")
+                    "concurrent", "jax", "threading")
 _DEVICE_CALLS = {"jax.devices", "jax.local_devices",
                  "jax.device_count", "jax.local_device_count"}
 _BLOCKING_TERMINALS = {"http_call", "http_json", "urlopen"}
@@ -390,6 +401,13 @@ class Checker(ast.NodeVisitor):
                     self.scopes[-1].create_conn.append(node)
         if terminal == "settimeout" and self.scopes:
             self.scopes[-1].has_settimeout = True
+
+        if canonical == "threading.Thread" and \
+                not any(kw.arg == "name" for kw in node.keywords):
+            self.report(node, "unnamed-thread",
+                        "Thread without name= — the wall sampler labels "
+                        "untagged stacks thread:<name>, and Thread-7 in "
+                        "a cluster flamegraph is unattributable")
 
         if terminal == "ThreadPoolExecutor":
             if not node.args and not any(kw.arg == "max_workers"
