@@ -805,6 +805,218 @@ def bench_filer_put(size_mb: int = 4, chunk_kb: int = 256,
     }
 
 
+def bench_filer_ops(n_shards: int = 3, n_identity_ops: int = 240,
+                    n_timed_ops: int = 600, store_ms: float = 4.0,
+                    concurrency: int = 32) -> dict:
+    """Filer metadata scale-out: aggregate namespace ops/s on an
+    N-shard consistent-hash ring (hot-entry + negative-lookup caches
+    on) vs the single-filer comparator with caches OFF, driven by the
+    sim's seeded zipf workload over a 10^6 keyspace.
+
+    Each filer's store sits behind a single-writer latency shim
+    (`store_ms` held under the store lock per entry op) — the stand-in
+    for a real DB backend, and the per-shard bottleneck that sharding
+    divides and the entry cache bypasses.  Writes are small enough to
+    stay inline (no volume servers, no assigns), so the client's warm
+    path can be asserted master-free.
+
+    Correctness rides along: the SAME op log is applied to both
+    clusters and compared op-by-op (status + file bytes + normalized
+    listings), then the full namespace is walked through the routed
+    listing path and compared after the concurrent timed phase
+    (deterministic per-key payloads make concurrent replay
+    order-independent).  Also measured: master calls during warm GETs
+    (must be 0) and store reads for 10 repeated GETs of one absent
+    path (the negative cache must make it <= 1)."""
+    import hashlib
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.sim.workload import (TenantSpec, ZipfWorkload,
+                                            namespace_path)
+    from seaweedfs_tpu.utils import clockctl
+    from seaweedfs_tpu.utils.httpd import http_json
+
+    class LatencyStore:
+        """Single-writer DB stand-in: every entry op holds the store
+        lock for the shim latency, so one shard's metadata throughput
+        is capped at ~1/store_ms ops/s unless the cache absorbs it."""
+
+        def __init__(self, inner, delay_s: float):
+            self.inner = inner
+            self.delay_s = delay_s
+            self.name = inner.name
+            self.op_lock = threading.Lock()
+            self.reads = 0
+
+        def _op(self, fn, *a, **kw):
+            with self.op_lock:
+                clockctl.sleep(self.delay_s)
+                return fn(*a, **kw)
+
+        def find_entry(self, p):
+            self.reads += 1
+            return self._op(self.inner.find_entry, p)
+
+        def insert_entry(self, e):
+            return self._op(self.inner.insert_entry, e)
+
+        def update_entry(self, e):
+            return self._op(self.inner.update_entry, e)
+
+        def delete_entry(self, p):
+            return self._op(self.inner.delete_entry, p)
+
+        def delete_folder_children(self, p):
+            return self._op(self.inner.delete_folder_children, p)
+
+        def list_directory_entries(self, *a, **kw):
+            return self._op(self.inner.list_directory_entries, *a, **kw)
+
+        def __getattr__(self, name):  # kv_*, close, ...
+            return getattr(self.inner, name)
+
+    def build_cluster(n: int, entry_cache: bool):
+        master = MasterServer()
+        master.start()
+        filers = []
+        for _ in range(n):
+            f = FilerServer(master.url, sharding=(n > 1),
+                            entry_cache=entry_cache, qos=False,
+                            tracing_enabled=False)
+            f.filer.store.inner = LatencyStore(f.filer.store.inner,
+                                               store_ms / 1000.0)
+            f.start()
+            filers.append(f)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ring = http_json("GET",
+                             f"http://{master.url}/cluster/filers")
+            if len(ring.get("filers", [])) == n:
+                break
+            clockctl.sleep(0.05)
+        for f in filers:
+            f._adopt_ring()
+        mc = MasterClient(master.url)
+        return master, filers, mc
+
+    def payload(key: int) -> bytes:
+        return (f"k{key}:" * 64).encode()[:512]  # inline (< 2KB)
+
+    def norm_listing(body: bytes):
+        rows = json.loads(body).get("Entries", [])
+        return sorted((r["FullPath"], r["FileSize"]) for r in rows)
+
+    def apply_one(mc, op):
+        path = namespace_path(op.key)
+        if op.kind == "write":
+            status, body, _ = mc.filer_call("PUT", path,
+                                            body=payload(op.key))
+            return ("w", path, status)
+        if op.kind == "scan":
+            d = path.rsplit("/", 1)[0]
+            status, body, _ = mc.filer_call("GET", d)
+            return ("s", d, status,
+                    norm_listing(body) if status == 200 else None)
+        status, body, _ = mc.filer_call("GET", path)
+        return ("r", path, status,
+                hashlib.sha256(body).hexdigest()
+                if status == 200 else None)
+
+    def walk(mc) -> list:
+        """Full namespace through the ROUTED listing path."""
+        out, stack = [], ["/"]
+        while stack:
+            d = stack.pop()
+            status, body, _ = mc.filer_call("GET", d)
+            if status != 200:
+                continue
+            for r in json.loads(body).get("Entries", []):
+                if r["IsDirectory"]:
+                    stack.append(r["FullPath"])
+                else:
+                    s, b, _ = mc.filer_call("GET", r["FullPath"])
+                    out.append((r["FullPath"], s,
+                                hashlib.sha256(b).hexdigest()))
+        return sorted(out)
+
+    # Metadata traffic is stat/lookup-dominated (every S3 GET/HEAD is a
+    # filer read; writes are the minority) — a 90/8/2 read/write/scan
+    # mix, zipf-skewed, is the workload the entry caches exist for.
+    wl = ZipfWorkload([TenantSpec("tenant-0", 100.0, mix=(0.90, 0.08, 0.02)),
+                       TenantSpec("tenant-1", 100.0, mix=(0.90, 0.08, 0.02))],
+                      seed=1009, write_size=512)
+    ops = wl.generate((n_identity_ops + n_timed_ops) / 200.0)
+    identity_ops = ops[:n_identity_ops]
+    timed_ops = ops[n_identity_ops:n_identity_ops + n_timed_ops]
+
+    ma, fa, mca = build_cluster(n_shards, entry_cache=True)
+    mb, fb, mcb = build_cluster(1, entry_cache=False)
+    try:
+        # --- phase 1: sequential identity apply (also warms caches)
+        rec_a = [apply_one(mca, op) for op in identity_ops]
+        rec_b = [apply_one(mcb, op) for op in identity_ops]
+        identical = rec_a == rec_b
+
+        # --- phase 2: master-free warm GETs
+        warm = [namespace_path(op.key) for op in identity_ops
+                if op.kind == "write"][:50]
+        mca.filer_ring()
+        calls0 = mca.master_calls
+        for p in warm:
+            mca.filer_call("GET", p)
+        master_calls_warm = mca.master_calls - calls0
+
+        # --- phase 3: negative-lookup cache vs repeated misses
+        missing = "/zipf/b000/never-written"
+        reads0 = sum(f.filer.store.inner.reads for f in fa)
+        for _ in range(10):
+            mca.filer_call("GET", missing)
+        neg_store_reads = sum(f.filer.store.inner.reads
+                              for f in fa) - reads0
+
+        # --- phase 4: timed concurrent replay on both clusters
+        def replay(mc) -> float:
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=concurrency) as pool:
+                list(pool.map(lambda op: apply_one(mc, op), timed_ops))
+            return time.perf_counter() - t0
+
+        dt_a = replay(mca)
+        dt_b = replay(mcb)
+
+        # --- phase 5: full-namespace walk must still match
+        walk_identical = walk(mca) == walk(mcb)
+
+        redirects = sum(
+            f._m_shard._values.get(("redirect",), 0) for f in fa)
+        hit_rate = (fa[0].filer.entry_cache.snapshot()["hit_rate"]
+                    if fa[0].filer.entry_cache else 0.0)
+    finally:
+        for f in fa + fb:
+            f.stop()
+        ma.stop()
+        mb.stop()
+
+    ops_a = n_timed_ops / dt_a
+    ops_b = n_timed_ops / dt_b
+    return {
+        "filer_ops_per_sec": round(ops_a, 1),
+        "filer_ops_per_sec_1shard": round(ops_b, 1),
+        "filer_ops_scaleout_speedup": round(ops_a / ops_b, 2),
+        "filer_ops_shards": n_shards,
+        "filer_ops_bit_identical": bool(identical and walk_identical),
+        "filer_ops_master_calls_warm_get": master_calls_warm,
+        "filer_ops_neg_lookup_store_reads": neg_store_reads,
+        "filer_ops_redirects": redirects,
+        "filer_ops_cache_hit_rate": hit_rate,
+        "filer_ops_store_ms": store_ms,
+    }
+
+
 def bench_replicated_write(n_writes: int = 20,
                            slow_ms: float = 40.0) -> dict:
     """Replicated-write tail latency: concurrent replica fan-out vs
@@ -1602,6 +1814,7 @@ def main(argv=None):
     e2e.update(bench_repair_network())  # partial-column repair ingress
     e2e.update(bench_filer_streaming_rss())  # bounded-memory ingest
     e2e.update(bench_replica_divergence_repair())  # hinted-handoff drill
+    e2e.update(bench_filer_ops())  # sharded namespace scale-out
     tpu, attempts, err = tpu_probe_with_retries()
     if tpu is not None:
         print(json.dumps({

@@ -183,7 +183,9 @@ def cmd_filer(args):
                      store=args.store, store_dir=args.dir,
                      default_replication=args.defaultReplication,
                      cipher=args.encryptVolumeData,
-                     grpc_port=args.port + 10000 if args.grpc else None)
+                     grpc_port=args.port + 10000 if args.grpc else None,
+                     sharding=args.sharding,
+                     entry_cache=not args.noEntryCache)
     fs.start()
     _start_push(args, ("filer", fs))
     extra = " cipher" if args.encryptVolumeData else ""
@@ -910,6 +912,12 @@ def main(argv=None):
                     help="AES-256-GCM encrypt chunks (reference flag)")
     fl.add_argument("-ftp", action="store_true", help="serve FTP gateway")
     fl.add_argument("-ftpPort", type=int, default=0)
+    fl.add_argument("-sharding", action="store_true",
+                    help="join the consistent-hash filer shard ring; "
+                         "mis-routed ops 307 to the owning peer")
+    fl.add_argument("-noEntryCache", action="store_true",
+                    help="disable the hot-entry + negative-lookup cache "
+                         "(bit-for-bit comparator mode)")
     fl.add_argument("-grpc", action="store_true",
                     help="serve the filer_pb gRPC plane on port+10000")
     fl.add_argument("-mq", action="store_true",

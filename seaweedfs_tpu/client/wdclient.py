@@ -37,6 +37,17 @@ class MasterClient:
         self.cache_ttl = cache_ttl
         self._cache: dict[int, tuple[float, list[dict]]] = {}
         self._ec_cache: dict[int, tuple[float, list[dict]]] = {}
+        # singleflight guards for cache refreshes: key -> Event held by
+        # the one caller doing the master round trip; concurrent
+        # readers of an EXPIRED entry serve the stale value while the
+        # refresh flies, readers of a cold miss wait on the Event
+        self._sf: dict = {}
+        # every master round trip counts here — the master-free warm
+        # path is asserted by watching this stay flat
+        self.master_calls = 0
+        # filer shard ring (filer/shard_ring.py), pulled once from
+        # /cluster/filers and refreshed on X-Weed-Shard epoch mismatch
+        self._filer_ring = None
         # (collection, replication, ttl, disk) -> (expires, [fid dicts])
         self._assign_pools: dict[tuple, tuple[float, list[dict]]] = {}
         self._assign_jwt_mode = False  # JWT replies disable pooling
@@ -153,6 +164,8 @@ class MasterClient:
         leader hints; several rounds with backoff ride out an election
         in progress (reference wdclient retries until a leader answers,
         masterclient.go:135-146)."""
+        with self._lock:
+            self.master_calls += 1
         last_err: Exception = RuntimeError("no masters")
         for attempt in range(rounds):
             candidates = [self._leader] + [u for u in self.master_urls
@@ -203,41 +216,139 @@ class MasterClient:
                     self._peer_health = PeerHealth()
         return self._peer_health
 
+    def _lookup_singleflight(self, cache: dict, vid: int, kind: str,
+                             fetch) -> list[dict]:
+        """TTL'd cache read with SINGLEFLIGHT refresh: one master
+        round trip per expiry, not one per concurrent reader.
+        Readers that find an EXPIRED entry serve the stale locations
+        while the one refresher flies (locations drift slowly, and a
+        wrong read self-corrects through invalidate()); readers of a
+        cold miss wait for the refresher and make their own call only
+        if it failed."""
+        with self._lock:
+            hit = cache.get(vid)
+            if hit and clockctl.now() - hit[0] < self.cache_ttl:
+                return hit[1]
+            sf_key = (kind, vid)
+            ev = self._sf.get(sf_key)
+            refresher = ev is None
+            if refresher:
+                ev = self._sf[sf_key] = threading.Event()
+        if not refresher:
+            if hit is not None:
+                return hit[1]  # stale-while-revalidate
+            ev.wait(15.0)
+            with self._lock:
+                hit = cache.get(vid)
+            if hit is not None:
+                return hit[1]
+        try:
+            locs = fetch()
+            with self._lock:
+                cache[vid] = (clockctl.now(), locs)
+            return locs
+        finally:
+            if refresher:
+                with self._lock:
+                    self._sf.pop(sf_key, None)
+                ev.set()
+
     def lookup_volume(self, vid: int, collection: str = "") -> list[dict]:
         with self._lock:
             # push-fed vidMap first (LookupFileIdWithFallback)
             locs = self._vidmap.get(vid)
             if locs:
                 return list(locs)
-            hit = self._cache.get(vid)
-            if hit and clockctl.now() - hit[0] < self.cache_ttl:
-                return hit[1]
-        out = self._call(
-            "GET", f"/dir/lookup?volumeId={vid}&collection={collection}")
-        locs = out.get("locations", [])
-        with self._lock:
-            self._cache[vid] = (clockctl.now(), locs)
-        return locs
+        return self._lookup_singleflight(
+            self._cache, vid, "vol",
+            lambda: self._call(
+                "GET",
+                f"/dir/lookup?volumeId={vid}&collection={collection}"
+            ).get("locations", []))
 
     def lookup_file_id(self, fid: str) -> list[str]:
         vid = int(fid.split(",")[0])
         return [f"http://{l['url']}/{fid}" for l in self.lookup_volume(vid)]
 
     def lookup_ec_volume(self, vid: int) -> list[dict]:
-        with self._lock:
-            hit = self._ec_cache.get(vid)
-            if hit and clockctl.now() - hit[0] < self.cache_ttl:
-                return hit[1]
-        out = self._call("GET", f"/dir/lookup_ec?volumeId={vid}")
-        shards = out.get("shards", [])
-        with self._lock:
-            self._ec_cache[vid] = (clockctl.now(), shards)
-        return shards
+        return self._lookup_singleflight(
+            self._ec_cache, vid, "ec",
+            lambda: self._call(
+                "GET", f"/dir/lookup_ec?volumeId={vid}"
+            ).get("shards", []))
 
     def invalidate(self, vid: int) -> None:
         with self._lock:
             self._cache.pop(vid, None)
             self._ec_cache.pop(vid, None)
+
+    # ---- filer shard ring (master-free namespace warm path) ----
+    def filer_ring(self, refresh: bool = False):
+        """The filer shard ring, pulled from the master's
+        /cluster/filers once and cached forever — refreshed only on
+        explicit request (an X-Weed-Shard epoch mismatch). Warm
+        namespace ops therefore cost ZERO master round trips."""
+        with self._lock:
+            ring = self._filer_ring
+        if ring is not None and not refresh:
+            return ring
+        from seaweedfs_tpu.filer.shard_ring import ShardRing
+        out = self._call("GET", "/cluster/filers")
+        ring = ShardRing.from_dict(out)
+        with self._lock:
+            # epochs only move forward: a concurrent refresh may have
+            # already installed a newer ring
+            if (self._filer_ring is None
+                    or ring.epoch >= self._filer_ring.epoch):
+                self._filer_ring = ring
+            return self._filer_ring
+
+    def note_shard_epoch(self, epoch: int) -> None:
+        """A response carried X-Weed-Shard with this ring epoch; if
+        it is ahead of ours, our ring has drifted — re-pull."""
+        ring = self._filer_ring
+        if ring is None or epoch > ring.epoch:
+            try:
+                self.filer_ring(refresh=True)
+            except Exception:
+                pass  # keep routing on the stale ring; redirects still work
+
+    def filer_url_for(self, path: str) -> str:
+        """The filer shard owning `path` ("" when none registered)."""
+        ring = self.filer_ring()
+        return ring.owner_for_path(path) if len(ring) else ""
+
+    def filer_call(self, method: str, path: str, body=None,
+                   json_body=None, query: str = "", headers=None,
+                   deadline=None) -> tuple[int, bytes, dict]:
+        """One namespace op routed DIRECTLY to the owning shard — the
+        master-free warm path. A 307 shard redirect (stale ring) is
+        followed once, after refreshing the ring from the epoch in the
+        X-Weed-Shard header."""
+        from urllib.parse import quote
+
+        from seaweedfs_tpu.filer.shard_ring import parse_shard_header
+        from seaweedfs_tpu.utils import headers as weed_headers
+        from seaweedfs_tpu.utils.httpd import http_call
+        target = self.filer_url_for(path)
+        if not target:
+            raise ConnectionError("no filer shards registered")
+        qs = f"?{query}" if query else ""
+        status, out, hdrs = http_call(
+            method, f"http://{target}{quote(path)}{qs}", body=body,
+            json_body=json_body, headers=headers, deadline=deadline)
+        if status == 307:
+            epoch, owner = parse_shard_header(
+                hdrs.get(weed_headers.SHARD, ""))
+            if epoch:
+                self.note_shard_epoch(epoch)
+            retry_at = owner or self.filer_url_for(path)
+            if retry_at and retry_at != target:
+                status, out, hdrs = http_call(
+                    method, f"http://{retry_at}{quote(path)}{qs}",
+                    body=body, json_body=json_body, headers=headers,
+                    deadline=deadline)
+        return status, out, hdrs
 
     # ---- cache-aware read routing ----
     # A replica that served a read out of its hot-needle record cache

@@ -15,6 +15,7 @@ from typing import Callable, Iterator, Optional
 
 from seaweedfs_tpu.filer.entry import (Attr, Entry, FileChunk,
                                        new_directory_entry)
+from seaweedfs_tpu.filer.entry_cache import EntryCache
 from seaweedfs_tpu.filer.filerstore import FilerStore, MemoryStore
 from seaweedfs_tpu.filer.filerstore_hardlink import (HardLinkStore,
                                                      new_hard_link_id)
@@ -177,12 +178,26 @@ class Filer:
                  delete_chunks_fn: Optional[Callable[[list[str]], None]] = None,
                  meta_log_dir: Optional[str] = None,
                  read_chunk_fn: "Optional[Callable[[FileChunk], bytes]]"
-                 = None):
+                 = None, entry_cache: bool = True):
         # read_chunk_fn takes a FileChunk and returns its PLAINTEXT bytes
         # (filechunk_manifest.ReadFn) — used to expand manifests on GC
         # every store is wrapped for hard-link resolution (reference
         # filer.go always wraps in FilerStoreWrapper + hardlink layer)
         self.store = HardLinkStore(store or MemoryStore())
+        # hot-entry + negative-lookup cache over the store; every
+        # mutation funnels through _notify, which invalidates.
+        # entry_cache=False is the bit-for-bit comparator switch (same
+        # convention as parallel_uploads / qos).
+        self.entry_cache: Optional[EntryCache] = \
+            EntryCache() if entry_cache else None
+        if self.entry_cache is not None:
+            # store-level hook: even out-of-band mutations through
+            # filer.store (tools, tests, replication shims) invalidate;
+            # store-write-then-invalidate keeps the fence proof intact.
+            cache = self.entry_cache
+            self.store.invalidate_fn = (
+                lambda p: cache.invalidate(p) if p is not None
+                else cache.clear())
         self.meta_log = MetaLog(persist_dir=meta_log_dir)
         self.delete_chunks_fn = delete_chunks_fn
         self.read_chunk_fn = read_chunk_fn  # to expand manifest chunks on GC
@@ -216,7 +231,22 @@ class Filer:
 
     def find_entry(self, full_path: str) -> Optional[Entry]:
         full_path = _norm(full_path)
-        return self.store.find_entry(full_path)
+        cache = self.entry_cache
+        if cache is None:
+            return self.store.find_entry(full_path)
+        cached, d = cache.get(full_path)
+        if cached:
+            return Entry.from_dict(d) if d is not None else None
+        token = cache.begin(full_path)
+        entry = self.store.find_entry(full_path)
+        if entry is None:
+            cache.put_negative(full_path, token)
+        elif not entry.hard_link_id:
+            # hard-linked names alias one shared KV record: an update
+            # through a sibling name would not invalidate this one, so
+            # linked entries are never cached
+            cache.put(full_path, entry.to_dict(), token)
+        return entry
 
     def update_entry(self, entry: Entry) -> None:
         old = self.store.find_entry(entry.full_path)
@@ -412,6 +442,12 @@ class Filer:
 
     def _notify(self, directory: str, old_entry: Optional[dict],
                 new_entry: Optional[dict]) -> None:
+        # invalidate BEFORE publishing: once a subscriber sees the
+        # event, this filer must already answer with the new state
+        if self.entry_cache is not None:
+            for d in (old_entry, new_entry):
+                if d is not None:
+                    self.entry_cache.invalidate(d["full_path"])
         self.meta_log.append(MetaLogEvent(
             directory, old_entry, new_entry,
             signature=getattr(self._sig, "value", 0)))

@@ -13,7 +13,7 @@ from __future__ import annotations
 import json
 import threading
 import uuid
-from typing import Optional
+from typing import Callable, Optional
 
 from seaweedfs_tpu.filer.entry import Entry
 from seaweedfs_tpu.filer.filerstore import FilerStore
@@ -33,6 +33,16 @@ class HardLinkStore(FilerStore):
         self.inner = inner
         self.name = inner.name
         self._lock = threading.RLock()
+        # post-mutation hook: called with the entry path after every
+        # write-side op (None means "everything changed"). Lets the
+        # filer's entry cache stay coherent even when callers mutate
+        # through filer.store directly instead of the Filer API.
+        self.invalidate_fn: Optional[Callable[[Optional[str]], None]] = None
+
+    def _invalidate(self, full_path: Optional[str]) -> None:
+        fn = self.invalidate_fn
+        if fn is not None:
+            fn(full_path)
 
     # ---- shared metadata record ----
     def _meta_key(self, link_id: str) -> bytes:
@@ -85,8 +95,10 @@ class HardLinkStore(FilerStore):
                     "entry": entry.to_dict(),
                 })
                 self.inner.insert_entry(self._strip(entry))
+            self._invalidate(entry.full_path)
             return
         self.inner.insert_entry(entry)
+        self._invalidate(entry.full_path)
 
     def update_entry(self, entry: Entry) -> None:
         if entry.hard_link_id:
@@ -95,8 +107,10 @@ class HardLinkStore(FilerStore):
                 meta["entry"] = entry.to_dict()
                 self._save_meta(entry.hard_link_id, meta)
                 self.inner.update_entry(self._strip(entry))
+            self._invalidate(entry.full_path)
             return
         self.inner.update_entry(entry)
+        self._invalidate(entry.full_path)
 
     def find_entry(self, full_path: str) -> Optional[Entry]:
         entry = self.inner.find_entry(full_path)
@@ -104,6 +118,7 @@ class HardLinkStore(FilerStore):
 
     def delete_entry(self, full_path: str) -> None:
         self.inner.delete_entry(full_path)
+        self._invalidate(full_path)
 
     def unlink(self, link_id: str) -> int:
         """Decrement the link counter; returns the remaining count.
@@ -121,6 +136,7 @@ class HardLinkStore(FilerStore):
 
     def delete_folder_children(self, full_path: str) -> None:
         self.inner.delete_folder_children(full_path)
+        self._invalidate(None)
 
     def list_directory_entries(self, dir_path: str, start_name: str = "",
                                include_start: bool = False,

@@ -96,6 +96,11 @@ class MetaAggregator:
         self.self_url = self_url
         self.get_peers_fn = get_peers_fn
         self.log = AggregatedLog()
+        # called with (peer_url, event_dict) for every PEER event as it
+        # arrives (local events already flow through the local MetaLog's
+        # own listeners) — the filer server hooks shard-cache
+        # invalidation for remote-owned parents here
+        self.listeners: list[Callable[[str, dict], None]] = []
         self._stop = threading.Event()
         self._followers: dict[str, threading.Thread] = {}
         self._lock = threading.Lock()
@@ -143,3 +148,8 @@ class MetaAggregator:
             for ev in out.get("events", []):
                 cursor = max(cursor, ev["tsns"])
                 self.log.append(peer, ev)
+                for listener in list(self.listeners):
+                    try:
+                        listener(peer, ev)
+                    except Exception:
+                        pass

@@ -241,10 +241,15 @@ class S3Server:
                         content_type="text/plain; version=0.0.4")
 
     def telemetry_snapshot(self) -> dict:
-        return {"node": self.url, "server": "s3",
+        snap = {"node": self.url, "server": "s3",
                 "red": self.red.snapshot(),
                 "hotkeys": self.hotkeys.snapshot(),
                 "ledger": self.ledger.snapshot()}
+        # S3 HEAD-heavy traffic is the negative-lookup cache's reason
+        # to exist — surface its hit rates where operators look
+        if self.filer.entry_cache is not None:
+            snap["entry_cache"] = self.filer.entry_cache.snapshot()
+        return snap
 
     def _handle_telemetry(self, req: Request) -> Response:
         return Response(self.telemetry_snapshot())

@@ -124,6 +124,11 @@ class QosGovernor:
         # with a configured bucket uses it INSTEAD of the global one
         # (the global stays the catch-all for unconfigured classes).
         self.class_tenants: dict = {}
+        # per-(class, tenant) OVERRIDE caps: one specific aggressor
+        # clipped without touching anyone else. Installed by operators
+        # or by the ledger-driven auto-capper (stats/autocap.py); wins
+        # over both bucket layers above.
+        self.tenant_caps: dict = {}
         self._lock = threading.Lock()
         self._inflight = {c: 0 for c in CLASSES}
         self._admitted = {c: 0 for c in CLASSES}
@@ -182,7 +187,8 @@ class QosGovernor:
         if cls not in self._inflight:
             cls = BACKGROUND
         if tenant is not None:
-            bucket = self.class_tenants.get(cls, self.tenants)
+            bucket = (self.tenant_caps.get((cls, tenant))
+                      or self.class_tenants.get(cls, self.tenants))
             ok, ra = bucket.try_consume(tenant, cost)
             if not ok:
                 with self._lock:
@@ -228,6 +234,25 @@ class QosGovernor:
                 else prev + 0.2 * (dt * 1000.0 - prev)
         self.limiter.observe(dt)
 
+    # ---- per-tenant override caps (autocap + operators) ----
+    def set_tenant_cap(self, cls: str, tenant, rate: float,
+                       burst: Optional[float] = None) -> None:
+        """Cap ONE (class, tenant) pair at `rate` req/s; rate <= 0
+        removes the cap.  This is the hook stats/autocap.py's
+        ledger-driven loop drives."""
+        key = (cls, tenant)
+        if rate <= 0:
+            self.tenant_caps.pop(key, None)
+            return
+        prev = self.tenant_caps.get(key)
+        if prev is None:
+            self.tenant_caps[key] = TenantBuckets(rate, burst)
+        else:
+            prev.configure(rate, burst)
+
+    def clear_tenant_cap(self, cls: str, tenant) -> None:
+        self.tenant_caps.pop((cls, tenant), None)
+
     # ---- pressure (what scrubber / repair queue subscribe to) ----
     def pressure(self) -> float:
         """[0,1]: how close this node is to shedding.  Max of a
@@ -264,6 +289,10 @@ class QosGovernor:
                 "tenant_class_buckets": {
                     c: b.snapshot()
                     for c, b in sorted(self.class_tenants.items())},
+                "tenant_caps": {
+                    f"{c}:{t}": b.snapshot()
+                    for (c, t), b in sorted(self.tenant_caps.items(),
+                                            key=lambda kv: str(kv[0]))},
                 **self.limiter.snapshot()}
 
     def configure(self, **kw) -> dict:
@@ -303,4 +332,11 @@ class QosGovernor:
                     self.class_tenants[cls] = TenantBuckets(rate, burst)
                 else:
                     prev.configure(rate, burst)
+        if "tenant_caps" in kw:
+            # {"<class>:<tenant>": req/s; <= 0 removes} — the operator
+            # spelling of set_tenant_cap (cluster.qos / POST /admin/qos)
+            for key, rate in (kw["tenant_caps"] or {}).items():
+                cls, _, tenant = str(key).partition(":")
+                if cls in CLASSES and tenant:
+                    self.set_tenant_cap(cls, tenant, float(rate))
         return self.snapshot()

@@ -31,9 +31,12 @@ from seaweedfs_tpu.filer.filechunk_manifest import (MANIFEST_BATCH,
                                                     resolve_chunk_manifest)
 from seaweedfs_tpu.filer.filechunks import (non_overlapping_visible_intervals,
                                             view_from_visibles)
+from seaweedfs_tpu.filer.entry_cache import EntryCache
 from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.filer.filer_conf import FilerConf, PathConf
 from seaweedfs_tpu.filer.filerstore import make_store
+from seaweedfs_tpu.filer.shard_ring import (ShardRing, format_shard_header,
+                                            parent_dir)
 from seaweedfs_tpu.qos import (BACKGROUND, QosGovernor, class_scope,
                                classify, current_class, from_headers)
 from seaweedfs_tpu.utils import headers as weed_headers
@@ -106,7 +109,9 @@ class FilerServer:
                  qos: bool = True,
                  tracing_enabled: bool = True,
                  trace_sample: float = 0.01,
-                 profile_hz: float = profiler.DEFAULT_HZ):
+                 profile_hz: float = profiler.DEFAULT_HZ,
+                 sharding: bool = False,
+                 entry_cache: bool = True):
         # qos=False disables admission control entirely (the
         # bit-for-bit comparator, same convention as parallel_uploads)
         # cipher=True encrypts every chunk (AES-256-GCM, per-chunk key in
@@ -147,7 +152,22 @@ class FilerServer:
             kwargs["port"] = int(db_port)
         self.filer = Filer(make_store(store, **kwargs),
                            delete_chunks_fn=self._delete_chunks,
-                           read_chunk_fn=self._read_chunk)
+                           read_chunk_fn=self._read_chunk,
+                           entry_cache=entry_cache)
+        # horizontal metadata scale-out: when sharding=True this filer
+        # is one member of a consistent-hash ring over DIRECTORIES
+        # (filer/shard_ring.py) — it serves only the namespace slices
+        # it owns and 307-redirects (or forwards) the rest.  Opt-in:
+        # plain multi-filer deployments (meta aggregation, sync)
+        # replicate the whole namespace everywhere and must not start
+        # bouncing requests just because several filers registered.
+        self.sharding = sharding
+        self.shard_ring: Optional[ShardRing] = None
+        self._ring_pinned = False
+        # positive facts about CANONICAL ancestor rows this shard has
+        # already ensured on their owners; invalidated by peer meta
+        # events so a remote delete re-triggers the ensure walk
+        self._remote_parents = EntryCache(capacity=4096, neg_capacity=0)
         self.filer_conf = FilerConf.load(self.filer.store)
         self._filer_conf_loaded = clockctl.now()
         self._filer_conf_write_lock = threading.Lock()
@@ -169,6 +189,9 @@ class FilerServer:
             "filer", "request_total", "filer requests", ("type",))
         self._m_lat = self.metrics.histogram(
             "filer", "request_seconds", "filer request latency", ("type",))
+        self._m_shard = self.metrics.counter(
+            "filer", "shard_route_total", "sharded routing outcomes",
+            ("outcome",))
         # parallel_uploads=False keeps the serial per-chunk
         # assign+upload loop as the bench comparator
         self.parallel_uploads = True
@@ -220,6 +243,11 @@ class FilerServer:
         self.sampler = profiler.WallSampler(hz=profile_hz)
         self.ledger = ResourceLedger()
         self.http.ledger = self.ledger
+        # ledger -> governor feedback: a tenant dominating the window's
+        # burn gets a per-tenant rate cap without operator action
+        # (stats/autocap.py); ticked from the announce loop
+        from seaweedfs_tpu.stats.autocap import LedgerAutoCapper
+        self.autocap = LedgerAutoCapper(self.ledger, self.qos)
         self.metrics_http.add("GET", "/admin/profile",
                               profiler.make_profile_handler(
                                   self.sampler, lambda: self.url,
@@ -256,6 +284,9 @@ class FilerServer:
         from seaweedfs_tpu.filer.meta_aggregator import MetaAggregator
         self.meta_aggregator = MetaAggregator(
             self.url, self._list_peer_filers, self.filer.meta_log)
+        # peer mutations invalidate OUR caches: a remote create/delete
+        # must kill any local hot/negative fact about that path
+        self.meta_aggregator.listeners.append(self._on_peer_meta_event)
         self.meta_aggregator.start()
 
     def _list_peer_filers(self) -> list[str]:
@@ -279,8 +310,274 @@ class FilerServer:
                           self.master_url, e)
 
         announce()
+        self._adopt_ring()
         while not self._announce_stop.wait(15.0):
             announce()
+            self._adopt_ring()
+            self.autocap.maybe_tick()
+
+    # ------------------------------------------------------------------
+    # namespace sharding (filer/shard_ring.py)
+
+    def set_shard_ring(self, ring: Optional[ShardRing],
+                       pin: bool = False) -> None:
+        """Install the filer ring.  pin=True stops the announce loop
+        from adopting master-published rings (tests/tools drive the
+        topology by hand)."""
+        self.shard_ring = ring
+        if pin:
+            self._ring_pinned = True
+        # new epoch, new ownership: every "I already ensured this
+        # ancestor on its owner" fact may now point at the wrong shard
+        self._remote_parents.clear()
+
+    def _adopt_ring(self) -> None:
+        """Pull the master's filer ring; install only forward epochs."""
+        if not self.sharding or self._ring_pinned:
+            return
+        from seaweedfs_tpu.utils.httpd import http_json
+        try:
+            out = http_json(
+                "GET", f"http://{self.master_url}/cluster/filers",
+                timeout=5)
+            ring = ShardRing.from_dict(out)
+        except Exception as e:
+            glog.vlog(1, "filer ring pull from master failed: %s", e)
+            return
+        cur = self.shard_ring
+        if cur is None or ring.epoch > cur.epoch:
+            self.set_shard_ring(ring)
+            glog.info("filer %s adopted ring epoch %d (%d members)",
+                      self.url, ring.epoch, len(ring))
+
+    def _shard_active(self) -> bool:
+        # a member not (yet) in the ring serves everything locally —
+        # redirecting by a ring that excludes us would bounce forever
+        ring = self.shard_ring
+        return (self.sharding and ring is not None and len(ring) > 1
+                and self.url in ring)
+
+    def _shard_redirect(self, req: Request,
+                        path: str) -> Optional[Response]:
+        """None when this shard should serve `path`; otherwise the
+        response that moves the request to the owner.
+
+        GET/HEAD/PUT/POST are 307-redirected (bodies are streamed, so
+        the filer can't replay them to a peer); DELETE is forwarded
+        in-place so dumb clients still work.  Redirects carry
+        ``X-Weed-Shard: <epoch>:<owner>`` so shard-aware clients
+        (wdclient.filer_call) detect ring drift and re-resolve.  The
+        ``X-Weed-Shard-Forwarded`` loop guard forces local service:
+        during an epoch change two shards may briefly disagree about
+        ownership, and serving the forwarder's view beats bouncing."""
+        if not self._shard_active():
+            return None
+        ring = self.shard_ring
+        owner = ring.owner_for_path(path)
+        if not owner or owner == self.url:
+            self._m_shard.inc("local")
+            return None
+        if req.headers.get(weed_headers.SHARD_FORWARDED):
+            self._m_shard.inc("forced_local")
+            return None
+        from urllib.parse import quote, urlencode
+        qs = urlencode(req.query)
+        loc = f"http://{owner}{quote(path)}" + (f"?{qs}" if qs else "")
+        hdr = format_shard_header(ring.epoch, owner)
+        if req.method == "DELETE":
+            self._m_shard.inc("forward")
+            status, body, hdrs = http_call(
+                "DELETE", loc,
+                headers={weed_headers.SHARD_FORWARDED: "1"}, timeout=60)
+            return Response(
+                body, status=status,
+                content_type=hdrs.get("Content-Type")
+                or "application/json",
+                headers={weed_headers.SHARD: hdr})
+        self._m_shard.inc("redirect")
+        return Response(
+            {"error": "wrong shard", "owner": owner,
+             "ring_epoch": ring.epoch},
+            status=307,
+            headers={weed_headers.SHARD: hdr, "Location": loc})
+
+    def _on_peer_meta_event(self, peer: str, ev: dict) -> None:
+        """MetaAggregator listener: a peer's mutation invalidates our
+        hot/negative entries AND our remote-parent facts for the
+        touched paths (a peer deleting a directory we 'ensured' means
+        the next local create must re-run the ensure walk)."""
+        cache = self.filer.entry_cache
+        for d in (ev.get("old_entry"), ev.get("new_entry")):
+            if not d:
+                continue
+            p = d.get("full_path", "")
+            if not p:
+                continue
+            if cache is not None:
+                cache.invalidate(p)
+            self._remote_parents.invalidate(p)
+
+    def _ensure_parents_remote(self, dir_path: str) -> None:
+        """After a local create: make sure every ancestor directory's
+        CANONICAL row exists on the shard owning its parent, else the
+        new subtree is invisible to listings walking down from the
+        root.  Positive facts are cached (_remote_parents, invalidated
+        by peer meta events), so the warm-path cost is one dict hit.
+        Failures are logged, not raised — the entry itself is durable,
+        and the next write under the same directory retries."""
+        if not self._shard_active():
+            return
+        from urllib.parse import quote
+        ring = self.shard_ring
+        d = dir_path if dir_path.startswith("/") else "/" + dir_path
+        try:
+            while d and d != "/":
+                cached, fact = self._remote_parents.get(d)
+                if cached and fact is not None:
+                    # inductively, everything above was ensured too
+                    break
+                token = self._remote_parents.begin(d)
+                owner = ring.owner_for_path(d)
+                if owner == self.url:
+                    if self.filer.find_entry(d) is None:
+                        self.filer.mkdirs(d)
+                else:
+                    status, body, _ = http_call(
+                        "POST", f"http://{owner}{quote(d)}?mkdir=true",
+                        headers={weed_headers.SHARD_FORWARDED: "1"},
+                        timeout=30)
+                    if status >= 400:
+                        raise HttpError(status, body)
+                self._remote_parents.put(d, {"full_path": d}, token)
+                d = parent_dir(d)
+        except Exception as e:
+            glog.warning("ensure-parents for %s failed: %s", dir_path, e)
+
+    def _list_entries_routed(self, dir_path: str, start_name: str = "",
+                             limit: int = 1024) -> list[Entry]:
+        """Listing of dir_path from the shard that owns it (children
+        rows live on owner(dir), so a listing is always single-shard);
+        local when unsharded or self-owned."""
+        if self._shard_active():
+            owner = self.shard_ring.owner(dir_path)
+            if owner and owner != self.url:
+                from urllib.parse import urlencode
+                qs = urlencode({"dir": dir_path, "start": start_name,
+                                "limit": limit, "resolved": "true"})
+                status, body, _ = http_call(
+                    "GET", f"http://{owner}/__api/list?{qs}",
+                    headers={weed_headers.SHARD_FORWARDED: "1"},
+                    timeout=30)
+                if status != 200:
+                    raise HttpError(status, body)
+                return [Entry.from_dict(d)
+                        for d in json.loads(body).get("entries", [])]
+        return self.filer.list_entries(dir_path, start_name=start_name,
+                                       limit=limit)
+
+    def _delete_entry_sharded(self, path: str, recursive: bool) -> None:
+        """Recursive delete across shards: the canonical children of
+        `path` live on owner(path); each child's delete is routed to
+        ITS row's owner (which recurses the same way).  The final
+        local sweep removes this shard's canonical row plus any
+        skeleton remnants beneath it — those are directories only, so
+        chunk GC is untouched."""
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            raise FileNotFoundError(path)
+        if entry.is_directory:
+            from urllib.parse import quote
+            child_owner = self.shard_ring.owner(path)
+            while True:
+                children = self._list_entries_routed(path, limit=256)
+                if not children:
+                    break
+                if not recursive:
+                    raise OSError(f"directory {path} not empty")
+                for child in children:
+                    if child_owner == self.url:
+                        self._delete_entry_sharded(child.full_path, True)
+                    else:
+                        status, body, _ = http_call(
+                            "DELETE",
+                            f"http://{child_owner}"
+                            f"{quote(child.full_path)}?recursive=true",
+                            headers={weed_headers.SHARD_FORWARDED: "1"},
+                            timeout=60)
+                        if status >= 400 and status != 404:
+                            raise HttpError(status, body)
+        self.filer.delete_entry(path, recursive=True)
+
+    def _rename_sharded(self, frm: str, to: str) -> None:
+        """Cross-shard rename: children first (a reader never sees the
+        new tree without its leaves), then the row itself moves — a
+        meta-only insert at the destination's owner (chunks ride
+        along verbatim) followed by a LOCAL row delete without chunk
+        GC.  Runs on owner(parent(frm)), i.e. where frm's row lives."""
+        entry = self.filer.find_entry(frm)
+        if entry is None:
+            raise FileNotFoundError(frm)
+        ring = self.shard_ring
+        if entry.is_directory:
+            child_owner = ring.owner(frm)
+            children = self._list_entries_routed(frm, limit=1 << 20)
+            for child in children:
+                c_to = to + child.full_path[len(frm):]
+                if child_owner == self.url:
+                    self._rename_sharded(child.full_path, c_to)
+                else:
+                    status, body, _ = http_call(
+                        "POST", f"http://{child_owner}/__api/rename",
+                        json_body={"from": child.full_path, "to": c_to},
+                        headers={weed_headers.SHARD_FORWARDED: "1"},
+                        timeout=60)
+                    if status >= 400:
+                        raise HttpError(status, body)
+        row = entry.to_dict()
+        row["full_path"] = to
+        self._ensure_parents_remote(parent_dir(to))
+        to_owner = ring.owner_for_path(to)
+        if to_owner == self.url:
+            self.filer.mkdirs(parent_dir(to))
+            old = self.filer.store.inner.find_entry(to)
+            self.filer.store.inner.insert_entry(Entry.from_dict(row))
+            self.filer._notify(parent_dir(to),
+                               old.to_dict() if old else None, row)
+        else:
+            status, body, _ = http_call(
+                "POST", f"http://{to_owner}/__api/entry",
+                json_body={"entry": row, "meta_only": True},
+                headers={weed_headers.SHARD_FORWARDED: "1"}, timeout=60)
+            if status >= 400:
+                raise HttpError(status, body)
+        # drop the source ROW only — its chunks now belong to `to`
+        self.filer.store.inner.delete_entry(frm)
+        self.filer._notify(parent_dir(frm), entry.to_dict(), None)
+
+    def _shard_status(self) -> dict:
+        ring = self.shard_ring
+        out = {
+            "url": self.url,
+            "sharding": self.sharding,
+            "active": self._shard_active(),
+            "ring": ring.to_dict() if ring is not None else None,
+            "routing": {k[0]: v
+                        for k, v in self._m_shard._values.items()},
+            "remote_parents": self._remote_parents.snapshot(),
+            "autocap": self.autocap.snapshot(),
+        }
+        if self.filer.entry_cache is not None:
+            out["entry_cache"] = self.filer.entry_cache.snapshot()
+        return out
+
+    def _api_shard_status(self, req: Request) -> Response:
+        return Response(self._shard_status())
+
+    def _api_shard_ring_set(self, req: Request) -> Response:
+        b = req.json()
+        ring = ShardRing.from_dict(b)
+        self.set_shard_ring(ring, pin=bool(b.get("pin")))
+        return Response({"epoch": ring.epoch, "members": len(ring)})
 
     def stop(self) -> None:
         self.sampler.stop()
@@ -341,6 +638,8 @@ class FilerServer:
         r("GET", "/__api/filer_conf", self._api_filer_conf_get)
         r("POST", "/__api/filer_conf", self._api_filer_conf_set)
         r("GET", "/__api/meta_events", self._api_meta_events)
+        r("GET", "/__api/shard/status", self._api_shard_status)
+        r("POST", "/__api/shard/ring", self._api_shard_ring_set)
         r("GET", r"/__api/chunk/(\S+)", self._api_chunk_blob)
         r("GET", "/__api/remote/status", self._api_remote_status)
         r("POST", "/__api/remote/configure", self._api_remote_configure)
@@ -366,10 +665,16 @@ class FilerServer:
                         content_type="text/plain; version=0.0.4")
 
     def telemetry_snapshot(self) -> dict:
-        return {"node": self.url, "server": "filer",
+        snap = {"node": self.url, "server": "filer",
                 "red": self.red.snapshot(),
                 "hotkeys": self.hotkeys.snapshot(),
-                "ledger": self.ledger.snapshot()}
+                "ledger": self.ledger.snapshot(),
+                "autocap": self.autocap.snapshot()}
+        if self.filer.entry_cache is not None:
+            snap["entry_cache"] = self.filer.entry_cache.snapshot()
+        if self.shard_ring is not None:
+            snap["shard"] = self._shard_status()
+        return snap
 
     def _handle_telemetry(self, req: Request) -> Response:
         return Response(self.telemetry_snapshot())
@@ -378,7 +683,7 @@ class FilerServer:
     # exempt: the operator's escape hatch plus long-polls, whose
     # held-open slots would both exhaust the limit and poison the
     # adaptive limiter's latency estimate with 30s samples
-    QOS_EXEMPT = ("/__api/qos", "/__api/meta_events")
+    QOS_EXEMPT = ("/__api/qos", "/__api/meta_events", "/__api/shard")
 
     def _admission_gate(self, method, path, headers, client):
         if not self.qos.enabled:
@@ -438,8 +743,12 @@ class FilerServer:
     # ---- write ----
     def _handle_write(self, req: Request) -> Response:
         path = req.path.rstrip("/") or "/"
+        misroute = self._shard_redirect(req, path)
+        if misroute is not None:
+            return misroute
         if req.query.get("mkdir") == "true":
             self.filer.mkdirs(path)
+            self._ensure_parents_remote(path)
             return Response({"path": path}, status=201)
         # per-path rules from filer.conf fill in what the request omits
         rule = self._current_filer_conf().match_storage_rule(path)
@@ -469,6 +778,8 @@ class FilerServer:
             # the chunks just uploaded have no owning entry: GC them
             self._delete_chunks([c.fid for c in chunks])
             return Response({"error": "is a directory"}, status=409)
+        # make the new subtree reachable from listings on other shards
+        self._ensure_parents_remote(entry.dir_path)
         return Response({"name": entry.name, "size": size}, status=201)
 
     def _ingest_body(self, req: Request, collection: str,
@@ -731,14 +1042,17 @@ class FilerServer:
     # ---- read ----
     def _handle_read(self, req: Request) -> Response:
         path = req.path.rstrip("/") or "/"
+        misroute = self._shard_redirect(req, path)
+        if misroute is not None:
+            return misroute
         entry = self.filer.find_entry(path)
         if entry is None:
             return Response({"error": "not found"}, status=404)
         if entry.is_directory:
             limit = int(req.query.get("limit", 1024))
             last = req.query.get("lastFileName", "")
-            entries = self.filer.list_entries(path, start_name=last,
-                                              limit=limit)
+            entries = self._list_entries_routed(path, start_name=last,
+                                                limit=limit)
             return Response({
                 "Path": path,
                 "Entries": [self._entry_json(e) for e in entries],
@@ -872,12 +1186,18 @@ class FilerServer:
 
     def _handle_delete(self, req: Request) -> Response:
         path = req.path.rstrip("/") or "/"
+        misroute = self._shard_redirect(req, path)
+        if misroute is not None:
+            return misroute
         denied = self._check_writable(path)
         if denied:
             return denied
         recursive = req.query.get("recursive") == "true"
         try:
-            self.filer.delete_entry(path, recursive=recursive)
+            if self._shard_active():
+                self._delete_entry_sharded(path, recursive)
+            else:
+                self.filer.delete_entry(path, recursive=recursive)
         except FileNotFoundError:
             return Response({"error": "not found"}, status=404)
         except OSError as e:
@@ -891,6 +1211,28 @@ class FilerServer:
                   or self._check_writable(b["to"]))
         if denied:
             return denied
+        if self._shard_active():
+            frm, to = b["from"], b["to"]
+            # the rename runs where frm's ROW lives: owner(parent(frm))
+            owner = self.shard_ring.owner_for_path(frm)
+            if (owner and owner != self.url
+                    and not req.headers.get(weed_headers.SHARD_FORWARDED)):
+                self._m_shard.inc("forward")
+                status, body, hdrs = http_call(
+                    "POST", f"http://{owner}/__api/rename", json_body=b,
+                    headers={weed_headers.SHARD_FORWARDED: "1"},
+                    timeout=60)
+                return Response(
+                    body, status=status,
+                    content_type=hdrs.get("Content-Type")
+                    or "application/json",
+                    headers={weed_headers.SHARD: format_shard_header(
+                        self.shard_ring.epoch, owner)})
+            try:
+                self._rename_sharded(frm, to)
+            except FileNotFoundError:
+                return Response({"error": "not found"}, status=404)
+            return Response({"path": to})
         try:
             entry = self.filer.rename_entry(b["from"], b["to"])
         except FileNotFoundError:
@@ -955,13 +1297,22 @@ class FilerServer:
     def _api_list_entries(self, req: Request) -> Response:
         """Full RAW entry rows of one directory (listing JSON on GET
         <dir> is trimmed for humans; store adapters resolve hard links
-        themselves — same contract as entry?raw=true)."""
-        entries = self.filer.store.inner.list_directory_entries(
-            req.query["dir"],
-            start_name=req.query.get("start", ""),
-            include_start=req.query.get("include_start") == "true",
-            limit=int(req.query.get("limit", 1024)),
-            prefix=req.query.get("prefix", ""))
+        themselves — same contract as entry?raw=true). resolved=true
+        serves the RESOLVED view instead (hard links followed, through
+        the entry cache) — what a peer shard wants for cross-shard
+        listings."""
+        if req.query.get("resolved") == "true":
+            entries = self.filer.list_entries(
+                req.query["dir"],
+                start_name=req.query.get("start", ""),
+                limit=int(req.query.get("limit", 1024)))
+        else:
+            entries = self.filer.store.inner.list_directory_entries(
+                req.query["dir"],
+                start_name=req.query.get("start", ""),
+                include_start=req.query.get("include_start") == "true",
+                limit=int(req.query.get("limit", 1024)),
+                prefix=req.query.get("prefix", ""))
         return Response({"entries": [e.to_dict() for e in entries]})
 
     def _api_kv_get(self, req: Request) -> Response:

@@ -142,6 +142,11 @@ class MasterServer:
         # liveness map above because the gRPC plane also writes that
         # one with bare timestamps
         self._cluster_node_meta: dict = {}
+        # epoch-stamped filer shard ring (filer/shard_ring.py): the
+        # epoch bumps exactly when the live filer set changes, so
+        # clients can detect drift from one integer compare
+        self._filer_ring = None
+        self._filer_ring_lock = threading.Lock()
         self._grpc_server = None
         self.grpc_port: Optional[int] = None
 
@@ -394,6 +399,7 @@ class MasterServer:
         r("POST", "/cluster/register", self._handle_cluster_register)
         r("POST", "/dir/leave", self._handle_dir_leave)
         r("GET", "/cluster/nodes", self._handle_cluster_nodes)
+        r("GET", "/cluster/filers", self._handle_cluster_filers)
         r("POST", "/col/delete", self._handle_col_delete)
         r("GET", "/ui", self._handle_ui)
         r("GET", "/", self._handle_ui)
@@ -514,6 +520,11 @@ class MasterServer:
         if b.get("metrics_url"):
             self._cluster_node_meta[(ntype, url)] = {
                 "metrics_url": b["metrics_url"]}
+        if ntype == "filer":
+            # bump the ring epoch NOW rather than lazily at read time,
+            # so a client pulling right after a membership change can't
+            # observe new members under the old epoch
+            self._current_filer_ring()
         return Response({})
 
     def _handle_cluster_nodes(self, req: Request) -> Response:
@@ -523,6 +534,25 @@ class MasterServer:
                  for (t, u), seen in self._cluster_nodes.items()
                  if now - seen < 60 and (not ntype or t == ntype)]
         return Response({"cluster_nodes": nodes})
+
+    def _live_filers(self) -> list[str]:
+        now = clockctl.now()
+        return sorted(u for (t, u), seen in self._cluster_nodes.items()
+                      if t == "filer" and now - seen < 60)
+
+    def _current_filer_ring(self):
+        from seaweedfs_tpu.filer.shard_ring import ring_if_changed
+        with self._filer_ring_lock:
+            new = ring_if_changed(self._filer_ring, self._live_filers())
+            if new is not None:
+                self._filer_ring = new
+            return self._filer_ring
+
+    def _handle_cluster_filers(self, req: Request) -> Response:
+        """The filer shard ring: {"epoch": N, "filers": [...]}.
+        wdclient pulls this once and re-pulls on X-Weed-Shard epoch
+        mismatch; filer servers pull it to learn their own ring."""
+        return Response(self._current_filer_ring().to_dict())
 
     def _handle_col_list(self, req: Request) -> Response:
         # only collections that still HOLD volumes: stale delta

@@ -1323,9 +1323,7 @@ class VolumeServer:
     def _hint_drain_loop(self) -> None:
         while not self._stop.wait(self.HINT_DRAIN_INTERVAL_S):
             try:
-                with class_scope(BACKGROUND), \
-                        profiler.scope(cls=BACKGROUND, route="hints"):
-                    self.drain_hints()
+                self.drain_hints()
             except Exception as e:
                 glog.warning("hint drain pass failed (will retry): %s", e)
 
@@ -1333,24 +1331,32 @@ class VolumeServer:
         """One drain pass: replay up to `limit` pending hints, oldest
         first, skipping peers whose breaker is still open. Returns the
         number repaid. Public so drills can force a synchronous drain
-        instead of waiting out the loop cadence."""
+        instead of waiting out the loop cadence.
+
+        The BACKGROUND class scope lives HERE, not in the loop: every
+        replayed write must carry the background QoS class to the peer
+        (http_call stamps X-Weed-Class from the ambient scope), so a
+        drain burst after a partition heals queues behind foreground
+        traffic — including when a drill invokes this synchronously."""
         j = self.hint_journal
         if j is None or self.store is None:
             return 0
         drained = 0
-        for h in j.pending()[:limit]:
-            if self._stop.is_set():
-                break
-            if not self.peer_health.allow(h["peer"]):
-                continue
-            try:
-                ok = self._replay_hint(h)
-            except Exception as e:
-                glog.warning("hint replay %s failed: %s", h, e)
-                ok = False
-            if ok:
-                j.ack(h["seq"])
-                drained += 1
+        with class_scope(BACKGROUND), \
+                profiler.scope(cls=BACKGROUND, route="hints"):
+            for h in j.pending()[:limit]:
+                if self._stop.is_set():
+                    break
+                if not self.peer_health.allow(h["peer"]):
+                    continue
+                try:
+                    ok = self._replay_hint(h)
+                except Exception as e:
+                    glog.warning("hint replay %s failed: %s", h, e)
+                    ok = False
+                if ok:
+                    j.ack(h["seq"])
+                    drained += 1
         if drained:
             self._m_req.inc("hint_drained")
         return drained

@@ -747,6 +747,26 @@ class ShellContext:
                 node["health"] = {"error": type(e).__name__}
         return out
 
+    def cluster_shards(self) -> dict:
+        """Namespace-sharding view: the master's filer ring (members +
+        epoch) enriched with each filer's /__api/shard/status — routing
+        outcome counters (local/redirect/forward/forced_local), entry
+        cache + negative-lookup hit rates, autocap state.  Unreachable
+        filers are reported, not fatal."""
+        try:
+            ring = http_json("GET",
+                             f"http://{self.master_url}/cluster/filers")
+        except Exception as e:
+            ring = {"error": type(e).__name__}
+        shards = []
+        for url in ring.get("filers", []):
+            try:
+                shards.append(http_json(
+                    "GET", f"http://{url}/__api/shard/status"))
+            except Exception as e:
+                shards.append({"url": url, "error": type(e).__name__})
+        return {"ring": ring, "shards": shards}
+
     def cluster_qos(self, configure: Optional[dict] = None,
                     node: str = "") -> dict:
         """QoS view of the cluster: the master's per-node pressure

@@ -64,6 +64,7 @@ HELP = """commands:
   cluster.trace [-trace ID] [-minMs MS] [-limit N]
                                     recent slow traces cluster-wide; with
                                     -trace, that trace's stitched spans
+  cluster.shards                    filer ring + per-shard routing/cache stats
   cluster.telemetry [-topK N] [-noPeers]
                                     merged RED quantiles + exemplars,
                                     hot-key leaderboard, SLO burn alerts
@@ -616,6 +617,8 @@ def run_command(sh: ShellContext, line: str):
         return sh.ec_repair_status()
     if cmd == "cluster.health":
         return sh.cluster_health()
+    if cmd == "cluster.shards":
+        return sh.cluster_shards()
     if cmd == "cluster.qos":
         conf = {}
         for flag, key, cast in (("limit", "limit", int),

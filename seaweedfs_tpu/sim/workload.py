@@ -97,6 +97,19 @@ class ZipfWorkload:
         return ops
 
 
+def namespace_path(key: int, tenant: str = "zipf",
+                   fanout: int = 256) -> str:
+    """Map a workload key onto a filer namespace path.
+
+    Keys land in ``/<tenant>/<bucket>/k<key>`` where bucket =
+    key % fanout — a two-level tree whose ~fanout directories spread
+    across a shard ring (ownership hashes the DIRECTORY), while each
+    key keeps a stable home so replaying the same op log against two
+    clusters touches identical paths.  This is the bridge between the
+    seeded zipf op log and the filer-namespace benchmarks."""
+    return f"/{tenant}/b{key % fanout:03d}/k{key}"
+
+
 def default_tenants(n_tenants: int = 4, total_rate: float = 400.0,
                     flood_tenant: str | None = None,
                     flood_rate: float = 0.0) -> list[TenantSpec]:

@@ -41,6 +41,16 @@ SYNC_SIGNATURE = "X-Weed-Sync-Signature"
 
 # loop guard on follower->leader proxying during elections (master)
 PROXIED = "X-Weed-Proxied"
+# filer namespace sharding: "<ring_epoch>:<owner_url>" on 307
+# redirects / forwarded responses for mis-routed namespace ops
+# (server/filer_server.py); clients compare the epoch against their
+# cached ring and re-pull /cluster/filers on drift
+# (client/wdclient.py, filer/shard_ring.py owns the format)
+SHARD = "X-Weed-Shard"
+# loop guard on shard-to-shard forwarding of mis-routed mutations: a
+# forwarded op that still looks mis-routed (ring disagreement between
+# shards mid-epoch-change) is served locally instead of bouncing
+SHARD_FORWARDED = "X-Weed-Shard-Forwarded"
 
 # ---- cache-aware read routing ----
 

@@ -1,0 +1,404 @@
+"""Horizontal metadata scale-out: the sharded filer namespace.
+
+Covers the ring itself (deterministic ownership, spread, epoch bumps),
+the routed request plane (307 + X-Weed-Shard on mis-routes, the
+forwarded-loop guard), cross-shard rename and recursive delete, the
+entry cache's per-path fence guard (a cached miss must not outlive the
+entry's creation), peer-meta-event invalidation, the master-free warm
+read path, singleflight volume lookups, the ledger-driven tenant
+autocapper, and the BACKGROUND class stamp on hinted-handoff drains.
+"""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.entry_cache import EntryCache
+from seaweedfs_tpu.filer.shard_ring import (ShardRing, format_shard_header,
+                                            parent_dir, parse_shard_header,
+                                            ring_if_changed)
+from seaweedfs_tpu.utils import headers as weed_headers
+from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+
+# --------------------------------------------------------------- ring
+
+def test_ring_deterministic_ownership_and_spread():
+    members = ["h1:8888", "h2:8888", "h3:8888"]
+    a = ShardRing(members)
+    b = ShardRing(list(reversed(members)))  # order must not matter
+    dirs = [f"/zipf/b{i:03d}" for i in range(300)]
+    assert [a.owner(d) for d in dirs] == [b.owner(d) for d in dirs]
+    # entry rows live with their parent's listing
+    for d in dirs[:20]:
+        assert a.owner_for_path(d + "/k1") == a.owner(d)
+    # vnode hashing keeps the split within sanity of even: every
+    # member owns a real share of 300 directories
+    spread = a.spread(dirs)
+    assert set(spread) == set(members)
+    assert min(spread.values()) >= 30, spread
+
+
+def test_ring_epoch_bumps_only_on_membership_change():
+    r1 = ring_if_changed(None, ["a", "b"])
+    assert r1.epoch == 1
+    assert ring_if_changed(r1, ["b", "a"]) is None  # same set
+    r2 = ring_if_changed(r1, ["a", "b", "c"])
+    assert r2.epoch == 2
+    rt = ShardRing.from_dict(r2.to_dict())
+    assert rt.members == r2.members and rt.epoch == r2.epoch
+    assert rt.owner("/x/y") == r2.owner("/x/y")
+
+
+def test_shard_header_roundtrip_and_garbage():
+    assert parse_shard_header(format_shard_header(7, "h:88")) == (7, "h:88")
+    assert parse_shard_header("junk")[0] == 0
+    assert parse_shard_header("") == (0, "")
+    assert parent_dir("/a/b/c") == "/a/b"
+    assert parent_dir("/a") == "/"
+    assert parent_dir("/") == "/"
+
+
+# -------------------------------------------------- entry cache fences
+
+def test_entry_cache_fence_is_per_path():
+    c = EntryCache()
+    tok = c.begin("/a")
+    c.invalidate("/b")  # unrelated write must NOT reject /a's fill
+    assert c.put("/a", {"p": "/a"}, tok) is True
+    assert c.get("/a") == (True, {"p": "/a"})
+
+    tok = c.begin("/a")
+    c.invalidate("/a")  # same-path write in flight: fill is stale
+    assert c.put("/a", {"p": "stale"}, tok) is False
+    assert c.get("/a") == (False, None)
+    assert c.stale_fills == 1
+
+
+def test_entry_cache_negative_fact_cannot_outlive_create():
+    c = EntryCache()
+    # reader starts its store read, sees "absent"...
+    tok = c.begin("/x")
+    # ...but a create lands (store-write THEN invalidate) before the
+    # reader can publish the miss: the stale negative must be rejected
+    c.invalidate("/x")
+    assert c.put_negative("/x", tok) is False
+    assert c.get("/x") == (False, None)  # never a cached miss
+    # a fresh read after the create caches normally
+    tok = c.begin("/x")
+    assert c.put("/x", {"p": "/x"}, tok) is True
+
+
+def test_entry_cache_clear_fences_everything_in_flight():
+    c = EntryCache()
+    tok = c.begin("/a")
+    c.clear()
+    assert c.put("/a", {"p": "/a"}, tok) is False
+    assert c.put_negative("/b", tok) is False
+
+
+def test_entry_cache_negative_invalidated_by_create_via_filer():
+    from seaweedfs_tpu.filer.entry import Attr, Entry
+    from seaweedfs_tpu.filer.filer import Filer
+
+    f = Filer(entry_cache=True)
+    assert f.find_entry("/t/missing") is None
+    assert f.entry_cache.snapshot()["neg_fills"] >= 1
+    f.create_entry(Entry("/t/missing", attr=Attr(mode=0o644)))
+    got = f.find_entry("/t/missing")
+    assert got is not None and got.full_path == "/t/missing"
+
+
+# ------------------------------------------------- sharded cluster e2e
+
+@pytest.fixture(scope="module")
+def shard_cluster():
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+
+    master = MasterServer()
+    master.start()
+    filers = []
+    for _ in range(3):
+        f = FilerServer(master.url, sharding=True, entry_cache=True,
+                        qos=False, tracing_enabled=False)
+        f.start()
+        filers.append(f)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        ring = http_json("GET", f"http://{master.url}/cluster/filers")
+        if len(ring.get("filers", [])) == 3:
+            break
+        time.sleep(0.05)
+    for f in filers:
+        f._adopt_ring()
+    mc = MasterClient(master.url)
+    yield master, filers, mc
+    for f in filers:
+        f.stop()
+    master.stop()
+
+
+def _owner_of(filers, path):
+    ring = filers[0].shard_ring
+    url = ring.owner_for_path(path)
+    return next(f for f in filers if f.url == url)
+
+
+def _non_owner_of(filers, path):
+    ring = filers[0].shard_ring
+    url = ring.owner_for_path(path)
+    return next(f for f in filers if f.url != url)
+
+
+def test_misrouted_request_redirects_with_epoch(shard_cluster):
+    master, filers, mc = shard_cluster
+    path = "/routes/d1/file.txt"
+    st, _, _ = mc.filer_call("PUT", path, body=b"routed")
+    assert st in (200, 201)
+    wrong = _non_owner_of(filers, path)
+    st, _, hdrs = http_call("GET", f"http://{wrong.url}{path}")
+    assert st == 307
+    h = {k.lower(): v for k, v in hdrs.items()}
+    epoch, owner = parse_shard_header(h[weed_headers.SHARD.lower()])
+    assert epoch == filers[0].shard_ring.epoch
+    assert owner == filers[0].shard_ring.owner_for_path(path)
+    assert h["location"].endswith(path)
+    # the forwarded guard breaks redirect loops: the same request with
+    # the loop header is served locally (miss — the row isn't here)
+    st, _, _ = http_call("GET", f"http://{wrong.url}{path}",
+                         headers={weed_headers.SHARD_FORWARDED: "1"})
+    assert st == 404
+
+
+def _two_dirs_with_distinct_owners(filers, base):
+    ring = filers[0].shard_ring
+    d1 = f"{base}/d000"
+    for i in range(1, 64):
+        d2 = f"{base}/d{i:03d}"
+        if ring.owner(d2) != ring.owner(d1):
+            return d1, d2
+    raise AssertionError("ring put 64 dirs on one shard")
+
+
+def test_cross_shard_rename_moves_row_and_bytes(shard_cluster):
+    master, filers, mc = shard_cluster
+    d1, d2 = _two_dirs_with_distinct_owners(filers, "/ren")
+    frm, to = f"{d1}/a.bin", f"{d2}/a.bin"
+    st, _, _ = mc.filer_call("PUT", frm, body=b"payload-x")
+    assert st in (200, 201)
+    # rename lands on ANY shard; the handler forwards to frm's owner
+    st, _, _ = http_call(
+        "POST", f"http://{filers[0].url}/__api/rename",
+        json_body={"from": frm, "to": to})
+    assert st == 200
+    st, body, _ = mc.filer_call("GET", to)
+    assert (st, body) == (200, b"payload-x")
+    st, _, _ = mc.filer_call("GET", frm)
+    assert st == 404
+    # the destination directory's single-shard listing sees the row
+    st, body, _ = mc.filer_call("GET", d2)
+    assert st == 200
+    import json as _json
+    names = [r["FullPath"] for r in _json.loads(body)["Entries"]]
+    assert to in names
+
+
+def test_recursive_delete_spans_shards(shard_cluster):
+    master, filers, mc = shard_cluster
+    d1, d2 = _two_dirs_with_distinct_owners(filers, "/rmtree")
+    paths = [f"{d1}/f1", f"{d1}/f2", f"{d2}/f3"]
+    for p in paths:
+        st, _, _ = mc.filer_call("PUT", p, body=b"x")
+        assert st in (200, 201)
+    assert filers[0].shard_ring.owner(d1) != filers[0].shard_ring.owner(d2)
+    st, _, _ = mc.filer_call("DELETE", "/rmtree",
+                             query="recursive=true")
+    assert st in (200, 204)
+    for p in paths + [d1, d2, "/rmtree"]:
+        st, _, _ = mc.filer_call("GET", p)
+        assert st == 404, p
+
+
+def test_negative_cache_miss_dies_on_create(shard_cluster):
+    master, filers, mc = shard_cluster
+    path = "/negcluster/d0/late.txt"
+    st, _, _ = mc.filer_call("GET", path)
+    assert st == 404  # negative fact now cached on the owner
+    st, _, _ = mc.filer_call("PUT", path, body=b"born")
+    assert st in (200, 201)
+    st, body, _ = mc.filer_call("GET", path)
+    assert (st, body) == (200, b"born")
+
+
+def test_peer_meta_event_invalidates_remote_cache(shard_cluster):
+    master, filers, mc = shard_cluster
+    path = "/peerinv/d0/seen.txt"
+    owner = _owner_of(filers, path)
+    other = _non_owner_of(filers, path)
+    # plant a (wrong) local fact on a non-owner shard, then mutate the
+    # path at its owner: the peer meta event must kill the stale fact
+    tok = other.filer.entry_cache.begin(path)
+    other.filer.entry_cache.put(path, {"FullPath": path, "stale": True},
+                                tok)
+    st, _, _ = mc.filer_call("PUT", path, body=b"fresh")
+    assert st in (200, 201)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        cached, _ = other.filer.entry_cache.get(path)
+        if not cached:
+            break
+        time.sleep(0.1)
+    cached, _ = other.filer.entry_cache.get(path)
+    assert not cached, "peer create did not invalidate the stale fact"
+
+
+def test_warm_get_is_master_free(shard_cluster):
+    master, filers, mc = shard_cluster
+    paths = [f"/warm/d0/f{i}" for i in range(5)]
+    for p in paths:
+        st, _, _ = mc.filer_call("PUT", p, body=b"w")
+        assert st in (200, 201)
+    mc.filer_ring()  # ring already cached; this must not refetch
+    before = mc.master_calls
+    for p in paths * 3:
+        st, _, _ = mc.filer_call("GET", p)
+        assert st == 200
+    assert mc.master_calls == before
+
+
+# ------------------------------------------- singleflight volume lookup
+
+def test_concurrent_lookups_singleflight_one_master_call(tmp_path):
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    try:
+        seed = MasterClient(master.url)
+        fid = operation.upload_data(seed, b"payload", name="f").fid
+        vid = int(fid.split(",")[0])
+
+        mc = MasterClient(master.url)  # cold cache, no pushed vidmap
+        start = threading.Barrier(32)
+        results = []
+
+        def look():
+            start.wait(5.0)
+            results.append(mc.lookup_volume(vid))
+
+        before = mc.master_calls
+        threads = [threading.Thread(target=look) for _ in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+        assert len(results) == 32
+        assert all(r == results[0] and r for r in results)
+        # 32 concurrent readers collapse onto ONE master round trip
+        assert mc.master_calls - before == 1
+    finally:
+        vs.stop()
+        master.stop()
+
+
+# --------------------------------------------------- ledger autocapper
+
+def test_autocap_clips_flood_tenant_and_forgives():
+    from seaweedfs_tpu.qos.governor import QosGovernor
+    from seaweedfs_tpu.stats.autocap import LedgerAutoCapper
+    from seaweedfs_tpu.stats.ledger import ResourceLedger
+
+    ledger = ResourceLedger()
+    gov = QosGovernor(enabled=True)
+    ac = LedgerAutoCapper(ledger, gov, interval_s=1.0,
+                          min_requests=50, release_ticks=2)
+    ac.tick()  # baseline window
+
+    for _ in range(500):  # request flood: cheap ops, one tenant
+        ledger.observe_request("interactive", "flood")
+    for _ in range(10):
+        ledger.observe_request("interactive", "quiet")
+    out = ac.tick()
+    assert [c["tenant"] for c in out["installed"]] == ["flood"]
+    assert ("interactive", "flood") in gov.tenant_caps
+    assert ("interactive", "quiet") not in gov.tenant_caps
+
+    # two quiet windows: the cap lifts without operator action
+    released = []
+    for _ in range(3):
+        released += ac.tick()["released"]
+    assert [c["tenant"] for c in released] == ["flood"]
+    assert ("interactive", "flood") not in gov.tenant_caps
+    snap = ac.snapshot()
+    assert snap["caps_installed"] == 1 and snap["caps_released"] == 1
+
+
+def test_autocap_never_caps_aggregate_rows():
+    from seaweedfs_tpu.qos.governor import QosGovernor
+    from seaweedfs_tpu.stats.autocap import LedgerAutoCapper
+    from seaweedfs_tpu.stats.ledger import OTHER_TENANT, ResourceLedger
+
+    ledger = ResourceLedger()
+    gov = QosGovernor(enabled=True)
+    ac = LedgerAutoCapper(ledger, gov, interval_s=1.0, min_requests=10)
+    ac.tick()
+    for _ in range(100):
+        ledger.observe_request("interactive", OTHER_TENANT)
+        ledger.observe_request("write", "-")
+    out = ac.tick()
+    assert out["installed"] == []
+    assert not gov.tenant_caps
+
+
+# ------------------------------------- hinted handoff drains BACKGROUND
+
+def test_hint_drain_stamps_background_class(tmp_path):
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.qos.classes import BACKGROUND
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.file_id import parse_needle_id_cookie
+    from seaweedfs_tpu.utils.httpd import HttpServer, Response
+
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url,
+                      hinted_handoff=True)
+    vs.start()
+    peer = HttpServer()
+    seen = []
+
+    @peer.route("POST", "/admin/write_needle_blob")
+    def sink(req):
+        seen.append(dict(req.headers.items()))
+        return Response({"ok": True})
+
+    peer.start()
+    try:
+        mc = MasterClient(master.url)
+        fid = operation.upload_data(mc, b"owed-bytes", name="f").fid
+        vid_s, tail = fid.split(",", 1)
+        key, cookie = parse_needle_id_cookie(tail)
+        peer_url = f"{peer.host}:{peer.port}"
+        vs.hint_journal.record("write", int(vid_s), key, cookie,
+                               peer_url, fid=tail)
+        # a synchronous (drill-style) drain must ALSO carry the stamp —
+        # the class scope lives inside drain_hints, not the loop
+        assert vs.drain_hints() == 1
+        assert len(seen) == 1
+        got = {k.lower(): v for k, v in seen[0].items()}
+        assert got.get(weed_headers.CLASS.lower()) == BACKGROUND
+        assert len(vs.hint_journal) == 0  # repaid and acked
+    finally:
+        peer.stop()
+        vs.stop()
+        master.stop()

@@ -110,6 +110,13 @@ CASES = {
                   "    threading.Thread(target=fn, daemon=True,\n"
                   "                     name='worker').start()\n"),
     },
+    "filer-cache-bypass": {
+        "path": "seaweedfs_tpu/server/filer_server.py",
+        "bad": ("def h(self, path):\n"
+                "    return self.filer.store.find_entry(path)\n"),
+        "clean": ("def h(self, path):\n"
+                  "    return self.filer.find_entry(path)\n"),
+    },
     "ambient-scope-loss": {
         "bad": ("from seaweedfs_tpu.utils.tracing import current_span\n\n"
                 "def f(pool):\n"
@@ -127,27 +134,34 @@ CASES = {
 }
 
 
+def _case_path(rule: str) -> str:
+    # path-scoped rules (e.g. filer-cache-bypass) carry the file the
+    # fixture must pretend to live in
+    return CASES[rule].get("path", "seaweedfs_tpu/x.py")
+
+
 @pytest.mark.parametrize("rule", sorted(CASES))
 def test_rule_flags_violation(rule):
-    assert rule in rules_of(CASES[rule]["bad"]), \
+    assert rule in rules_of(CASES[rule]["bad"], path=_case_path(rule)), \
         f"{rule}: violating fixture not flagged"
 
 
 @pytest.mark.parametrize("rule", sorted(CASES))
 def test_rule_passes_clean_counterpart(rule):
-    assert rule not in rules_of(CASES[rule]["clean"]), \
+    assert rule not in rules_of(CASES[rule]["clean"],
+                                path=_case_path(rule)), \
         f"{rule}: clean fixture wrongly flagged"
 
 
 @pytest.mark.parametrize("rule", sorted(CASES))
 def test_rule_suppressible_inline(rule):
     bad = CASES[rule]["bad"]
-    flagged = check_source("seaweedfs_tpu/x.py", bad)
+    flagged = check_source(_case_path(rule), bad)
     line_no = next(v.line for v in flagged if v.rule == rule)
     lines = bad.splitlines(keepends=True)
     lines[line_no - 1] = (lines[line_no - 1].rstrip("\n")
                           + f"  # weedlint: disable={rule}\n")
-    assert rule not in rules_of("".join(lines)), \
+    assert rule not in rules_of("".join(lines), path=_case_path(rule)), \
         f"{rule}: inline suppression ignored"
 
 
@@ -242,6 +256,18 @@ def test_unbounded_body_read_variants():
     assert "unbounded-body-read" not in rules_of(
         "def h(req):\n    return req.body\n",
         path="seaweedfs_tpu/utils/httpd.py")
+
+
+def test_filer_cache_bypass_scoping():
+    """The rule bites only inside server/filer_server.py, and the raw
+    row-level API (.store.inner.find_entry) stays legal there."""
+    bad = ("def h(self, path):\n"
+           "    return self.filer.store.find_entry(path)\n")
+    assert "filer-cache-bypass" not in rules_of(bad)  # other files
+    assert "filer-cache-bypass" not in rules_of(
+        ("def h(self, path):\n"
+         "    return self.filer.store.inner.find_entry(path)\n"),
+        path="seaweedfs_tpu/server/filer_server.py")
 
 
 def test_syntax_error_reported_not_crashed():

@@ -1,0 +1,152 @@
+"""Live shard profile: per-shard ops/s, cache hit rates, mis-routes.
+
+Polls the master's filer ring (GET /cluster/filers) and every member's
+`/__api/shard/status`, printing one line per shard with rates computed
+from successive samples:
+
+  ops/s           served requests (local + forced_local routing
+                  outcomes — what this shard actually executed)
+  redir/s fwd/s   mis-routed requests it bounced (307) or proxied —
+                  a high rate means clients hold a stale ring
+  hit%% neg%%      hot-entry and negative-lookup cache hit rates
+                  (lifetime, from filer/entry_cache.py counters)
+
+This is the operator's "is the namespace actually spreading" view: a
+healthy N-shard cluster shows ops/s on every member and a mis-route
+rate near zero once clients have pulled the current ring epoch.
+
+Usage:
+  PYTHONPATH=. python tools/shard_profile.py --master 127.0.0.1:9333 \
+      [--interval 2] [--duration 10] [--json]
+  PYTHONPATH=. python tools/shard_profile.py --filer 127.0.0.1:8888 --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from seaweedfs_tpu.utils import clockctl  # noqa: E402
+from seaweedfs_tpu.utils.httpd import http_json  # noqa: E402
+
+
+def discover_filers(master: str) -> list:
+    out = http_json("GET", f"http://{master}/cluster/filers", timeout=5.0)
+    return out.get("filers", [])
+
+
+def fetch_status(filer: str) -> dict:
+    return http_json("GET", f"http://{filer}/__api/shard/status",
+                     timeout=5.0)
+
+
+def _served(snap: dict) -> float:
+    routing = snap.get("routing", {})
+    if routing:
+        return routing.get("local", 0) + routing.get("forced_local", 0)
+    # unsharded filer: no routing decisions — fall back to cache totals
+    cache = snap.get("entry_cache", {})
+    return (cache.get("hits", 0) + cache.get("neg_hits", 0)
+            + cache.get("misses", 0))
+
+
+def _row(filer: str, prev: dict, cur: dict, dt: float) -> dict:
+    routing = cur.get("routing", {})
+    p_routing = (prev or {}).get("routing", {})
+    cache = cur.get("entry_cache", {})
+    looked = (cache.get("hits", 0) + cache.get("neg_hits", 0)
+              + cache.get("misses", 0))
+    return {
+        "shard": filer,
+        "active": cur.get("active", False),
+        "ops_per_s": round((_served(cur) - _served(prev or {})) / dt, 1),
+        "redirect_per_s": round(
+            (routing.get("redirect", 0)
+             - p_routing.get("redirect", 0)) / dt, 1),
+        "forward_per_s": round(
+            (routing.get("forward", 0)
+             - p_routing.get("forward", 0)) / dt, 1),
+        "hit_rate": round(cache.get("hits", 0) / looked, 3)
+        if looked else 0.0,
+        "neg_hit_rate": round(cache.get("neg_hits", 0) / looked, 3)
+        if looked else 0.0,
+        "hot_size": cache.get("entries", 0),
+        "neg_size": cache.get("negatives", 0),
+    }
+
+
+def _print_rows(ts: float, ring: dict, rows: list) -> None:
+    print(f"[{time.strftime('%H:%M:%S', time.localtime(ts))}] "
+          f"ring epoch={ring.get('epoch')} members={len(ring.get('filers', []))}")
+    for r in rows:
+        print(f"    {r['shard']:<22} active={str(r['active']):<5} "
+              f"ops/s={r['ops_per_s']:<8} redir/s={r['redirect_per_s']:<6} "
+              f"fwd/s={r['forward_per_s']:<6} hit={r['hit_rate']:<6} "
+              f"neg={r['neg_hit_rate']:<6} "
+              f"cached={r['hot_size']}+{r['neg_size']}")
+
+
+def run(master: str, filers: list, interval: float, duration: float,
+        as_json: bool, once: bool) -> int:
+    ring: dict = {"filers": filers}
+    if master:
+        try:
+            ring = http_json("GET", f"http://{master}/cluster/filers",
+                             timeout=5.0)
+            filers = ring.get("filers", []) or filers
+        except Exception as e:
+            print(f"master {master} unreachable: {e}", file=sys.stderr)
+            if not filers:
+                return 2
+    if not filers:
+        print("no filers (give --master or --filer)", file=sys.stderr)
+        return 2
+    prev: dict = {}
+    deadline = clockctl.monotonic() + duration
+    while True:
+        cur = {}
+        rows = []
+        for f in filers:
+            try:
+                cur[f] = fetch_status(f)
+            except Exception as e:
+                rows.append({"shard": f, "error": str(e)})
+                continue
+            rows.append(_row(f, prev.get(f), cur[f],
+                             interval if prev else 1.0))
+        ts = clockctl.now()
+        if as_json:
+            print(json.dumps({"ts": ts, "ring": ring, "shards": rows}))
+        else:
+            _print_rows(ts, ring, rows)
+        prev = cur
+        if once or clockctl.monotonic() >= deadline:
+            return 0
+        clockctl.sleep(interval)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--master", default="",
+                    help="master HOST:PORT for ring discovery")
+    ap.add_argument("--filer", action="append", default=[],
+                    help="filer HOST:PORT (repeatable; skips discovery)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--once", action="store_true",
+                    help="one sample and exit")
+    args = ap.parse_args(argv)
+    args.master = args.master.removeprefix("http://")
+    args.filer = [f.removeprefix("http://") for f in args.filer]
+    return run(args.master, args.filer, args.interval, args.duration,
+               args.as_json, args.once)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

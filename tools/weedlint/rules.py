@@ -99,6 +99,16 @@ unnamed-thread
     flamegraph is unattributable.  Every long-lived thread states its
     role; ephemeral helpers still benefit (their samples group under
     one label instead of a counter-suffixed spray).
+
+filer-cache-bypass
+    a ``<anything>.store.find_entry(...)`` call inside
+    ``seaweedfs_tpu/server/filer_server.py``.  Handler reads must go
+    through ``filer.find_entry`` so the hot-entry + negative-lookup
+    cache (filer/entry_cache.py) sees every lookup — a raw store read
+    both misses the cache's hit-rate win and, worse, can resurrect a
+    fact the cache already invalidated.  The row-level escape hatch
+    ``.store.inner.find_entry`` stays legal: it is the explicit "raw
+    store row, no resolution" API that meta-import and sync sinks use.
 """
 
 from __future__ import annotations
@@ -129,6 +139,10 @@ RULES: dict[str, str] = {
     "unnamed-thread":
         "threading.Thread without name= — unattributable in the "
         "profiler's flamegraphs",
+    "filer-cache-bypass":
+        ".store.find_entry in server/filer_server.py bypasses the "
+        "entry cache — call filer.find_entry (or .inner.find_entry "
+        "for raw rows)",
 }
 
 # files that ARE the sanctioned implementation of a contract
@@ -434,6 +448,15 @@ class Checker(ast.NodeVisitor):
                     node, "unbounded-body-read",
                     f"bare {recv}.read() buffers to EOF — pass a size "
                     "and loop so a large peer body can't balloon RSS")
+
+        if terminal == "find_entry" \
+                and isinstance(node.func, ast.Attribute) \
+                and _terminal(node.func.value) == "store" \
+                and self.rel == "seaweedfs_tpu/server/filer_server.py":
+            self.report(node, "filer-cache-bypass",
+                        ".store.find_entry bypasses the entry cache — "
+                        "read through filer.find_entry (cached) or "
+                        ".store.inner.find_entry (explicit raw row)")
 
         if terminal == "submit" and isinstance(node.func, ast.Attribute) \
                 and node.args:
