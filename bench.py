@@ -1430,6 +1430,179 @@ def bench_filer_streaming_rss(size_mb: int = 256,
     }
 
 
+def _drain_get(netloc: str, path: str, *, digest: bool = False,
+               timeout: float = 300.0):
+    """GET `path` from `netloc` and DISCARD the body as it arrives
+    (recv_into one reusable 1MB scratch buffer) so client-side
+    allocation never gates the server throughput being measured.
+    Returns (status, nbytes, seconds, sha256|None) — pass digest=True
+    for the one read per mode that witnesses bit-identity."""
+    import hashlib
+    import socket as _socket
+
+    host, port = netloc.split(":")
+    t0 = time.perf_counter()
+    s = _socket.create_connection((host, int(port)), timeout=timeout)
+    try:
+        s.sendall(f"GET {path} HTTP/1.1\r\nHost: {netloc}\r\n"
+                  f"Connection: close\r\n\r\n".encode())
+        s.settimeout(timeout)
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            got = s.recv(65536)
+            if not got:
+                raise ConnectionError("EOF before response headers")
+            buf += got
+        head, body = buf.split(b"\r\n\r\n", 1)
+        status = int(head.split(b" ", 2)[1])
+        length = None
+        for line in head.split(b"\r\n")[1:]:
+            k, _, v = line.partition(b":")
+            if k.strip().lower() == b"content-length":
+                length = int(v.strip())
+        h = hashlib.sha256() if digest else None
+        n = len(body)
+        if h:
+            h.update(body)
+        scratch = bytearray(1 << 20)
+        view = memoryview(scratch)
+        while length is None or n < length:
+            got = s.recv_into(scratch)
+            if not got:
+                break
+            if h:
+                h.update(view[:got])
+            n += got
+        return (status, n, time.perf_counter() - t0,
+                h.hexdigest() if h else None)
+    finally:
+        s.close()
+
+
+def bench_read_plane(size_mb: int = 256, clients: int = 32) -> dict:
+    """Zero-copy read plane: sendfile GETs vs the buffered path they
+    replace, and volume-direct redirects vs filer proxying.
+
+    One `size_mb` needle is served from a live volume server four
+    ways: single-stream and `clients`-way concurrent, each with the
+    descriptor/sendfile path on (`zero_copy=True`, the default) and
+    off (the buffered comparator). The client drains bodies into a
+    reusable scratch buffer so both modes see the same (minimal)
+    client cost; one hashed read per mode proves the fast path is
+    bit-identical before any timing counts. The buffered path pays
+    the read() copy into user space, the CRC recompute over the whole
+    payload, and the socket write copy; the sendfile path pays none
+    of them — the reported speedup is the whole point of the plane.
+
+    The redirect lane PUTs a single-chunk file through the filer and
+    fetches it with auto-follow disabled: the raw 302 must carry ZERO
+    proxied payload bytes (the filer drops out of the data path
+    entirely), and following it must be bit-identical to the
+    `?proxy=1` comparator. SEAWEEDFS_TPU_BENCH_READ_MB /
+    SEAWEEDFS_TPU_BENCH_READ_CLIENTS override the sizes."""
+    import hashlib
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+    size_mb = int(os.environ.get("SEAWEEDFS_TPU_BENCH_READ_MB",
+                                 size_mb))
+    clients = int(os.environ.get("SEAWEEDFS_TPU_BENCH_READ_CLIENTS",
+                                 clients))
+    size = size_mb << 20
+    rng = np.random.default_rng(41)
+    data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+    sha = hashlib.sha256(data).hexdigest()
+
+    with tempfile.TemporaryDirectory() as d:
+        master = MasterServer(volume_size_limit_mb=1024)
+        master.start()
+        vs = VolumeServer([d], master.url)
+        vs.start()
+        fsrv = FilerServer(master.url)
+        fsrv.start()
+        try:
+            a = http_json("GET", f"http://{master.url}/dir/assign")
+            st, _, _ = http_call("POST",
+                                 f"http://{a['url']}/{a['fid']}",
+                                 body=data, timeout=600)
+            if st >= 300:
+                raise RuntimeError(f"seed upload failed: HTTP {st}")
+            netloc, path = a["url"], f"/{a['fid']}"
+
+            def measure(zero_copy: bool) -> tuple[float, float]:
+                vs.zero_copy = zero_copy
+                st, n, _, got = _drain_get(netloc, path, digest=True)
+                if st != 200 or n != size or got != sha:
+                    raise RuntimeError(
+                        f"readback mismatch (zero_copy={zero_copy}): "
+                        f"HTTP {st}, {n} bytes")
+                single = 0.0
+                for _ in range(3):
+                    _, n, dt, _ = _drain_get(netloc, path)
+                    single = max(single, n / dt / 1e6)
+                with ThreadPoolExecutor(max_workers=clients) as pool:
+                    t0 = time.perf_counter()
+                    futs = [pool.submit(_drain_get, netloc, path)
+                            for _ in range(clients)]
+                    total = sum(f.result()[1] for f in futs)
+                    agg = total / (time.perf_counter() - t0) / 1e6
+                return single, agg
+
+            zc_single, zc_agg = measure(True)
+            buf_single, buf_agg = measure(False)
+            vs.zero_copy = True
+
+            # ---- redirect lane: single-chunk file through the filer
+            small = data[:3 << 20]
+            st, _, _ = http_call("POST",
+                                 f"http://{fsrv.url}/bench/one.bin",
+                                 body=small, timeout=120)
+            if st != 201:
+                raise RuntimeError(f"filer PUT failed: HTTP {st}")
+            st, raw_body, h = http_call(
+                "GET", f"http://{fsrv.url}/bench/one.bin",
+                follow_redirects=False, timeout=120)
+            redirected = st == 302
+            proxied_on_redirect = len(raw_body)
+            loc = next((v for k, v in h.items()
+                        if k.lower() == "location"), "")
+            direct = b""
+            if redirected:
+                _, direct, _ = http_call("GET", loc, timeout=120)
+            _, proxied, _ = http_call(
+                "GET", f"http://{fsrv.url}/bench/one.bin?proxy=1",
+                timeout=120)
+            redirect_identical = (redirected and direct == small
+                                  and proxied == small)
+        finally:
+            fsrv.stop()
+            vs.stop()
+            master.stop()
+
+    return {
+        "read_plane_mb": size_mb,
+        "read_plane_single_mbps": round(zc_single, 1),
+        "read_plane_single_buffered_mbps": round(buf_single, 1),
+        "read_plane_speedup": round(zc_single / buf_single, 2),
+        "read_plane_agg_clients": clients,
+        "read_plane_agg_mbps": round(zc_agg, 1),
+        "read_plane_agg_buffered_mbps": round(buf_agg, 1),
+        "read_plane_bit_identical": True,  # hashed reads gate above
+        # payload bytes that crossed the filer on the redirected GET:
+        # the 302 body. 0 == the filer left the data path.
+        "read_plane_redirect_proxied_bytes": proxied_on_redirect,
+        # server hops the payload crosses: volume->client direct vs
+        # volume->filer->client proxied
+        "read_plane_redirect_payload_hops": 1 if redirected else 2,
+        "read_plane_redirect_bit_identical": redirect_identical,
+    }
+
+
 def bench_replica_divergence_repair(n_writes: int = 10,
                                     deadline_s: float = 0.5) -> dict:
     """The divergence drill as numbers: writes issued while one
@@ -1813,6 +1986,7 @@ def main(argv=None):
     e2e.update(bench_tenant_flood())  # per-tenant class-rate isolation
     e2e.update(bench_repair_network())  # partial-column repair ingress
     e2e.update(bench_filer_streaming_rss())  # bounded-memory ingest
+    e2e.update(bench_read_plane())  # sendfile GETs + volume redirects
     e2e.update(bench_replica_divergence_repair())  # hinted-handoff drill
     e2e.update(bench_filer_ops())  # sharded namespace scale-out
     tpu, attempts, err = tpu_probe_with_retries()

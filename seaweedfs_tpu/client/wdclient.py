@@ -320,11 +320,16 @@ class MasterClient:
 
     def filer_call(self, method: str, path: str, body=None,
                    json_body=None, query: str = "", headers=None,
-                   deadline=None) -> tuple[int, bytes, dict]:
+                   deadline=None, follow_redirects: bool = True
+                   ) -> tuple[int, bytes, dict]:
         """One namespace op routed DIRECTLY to the owning shard — the
         master-free warm path. A 307 shard redirect (stale ring) is
         followed once, after refreshing the ring from the epoch in the
-        X-Weed-Shard header."""
+        X-Weed-Shard header. A 302 volume-direct redirect (the filer's
+        zero-copy read plane pointing a GET at a volume replica's
+        JWT-stamped URL) is honored transparently inside http_call;
+        follow_redirects=False surfaces the raw 302 instead — the
+        read-plane bench uses it to prove 0 proxied payload bytes."""
         from urllib.parse import quote
 
         from seaweedfs_tpu.filer.shard_ring import parse_shard_header
@@ -336,7 +341,8 @@ class MasterClient:
         qs = f"?{query}" if query else ""
         status, out, hdrs = http_call(
             method, f"http://{target}{quote(path)}{qs}", body=body,
-            json_body=json_body, headers=headers, deadline=deadline)
+            json_body=json_body, headers=headers, deadline=deadline,
+            follow_redirects=follow_redirects)
         if status == 307:
             epoch, owner = parse_shard_header(
                 hdrs.get(weed_headers.SHARD, ""))
@@ -347,7 +353,8 @@ class MasterClient:
                 status, out, hdrs = http_call(
                     method, f"http://{retry_at}{quote(path)}{qs}",
                     body=body, json_body=json_body, headers=headers,
-                    deadline=deadline)
+                    deadline=deadline,
+                    follow_redirects=follow_redirects)
         return status, out, hdrs
 
     # ---- cache-aware read routing ----

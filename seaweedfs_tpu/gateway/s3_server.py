@@ -156,6 +156,11 @@ class S3Server:
         self.red = RedRecorder(self.metrics, "s3")
         self.http.red = self.red
         self.hotkeys = HotKeys(dims=("path", "tenant"))
+        # volume_redirect=False relays every object GET through the
+        # gateway + filer — the bit-identity comparator for the 302
+        # volume-direct path (both this flag AND the filer's must be
+        # on for the gateway to redirect)
+        self.volume_redirect = True
         self.metrics_http.add("GET", "/admin/hotkeys",
                               self.hotkeys.handler(self.url))
         self.metrics_http.add("GET", "/admin/telemetry",
@@ -747,6 +752,19 @@ class S3Server:
                     "ETag": f'"{entry.attr.md5.hex()}"',
                     "Last-Modified": _http_date(entry.attr.mtime),
                 })
+            # zero-copy read plane: a single-chunk object's payload
+            # skips the gateway+filer relay — 302 to the JWT-stamped
+            # volume URL (which sendfiles it); http_call-based clients
+            # follow transparently, re-sending Range at the target.
+            # ?proxy=1 forces the relay (comparator/debug).
+            if self.volume_redirect and self.fs.volume_redirect \
+                    and req.query.get("proxy") != "1":
+                loc = self.fs.volume_direct_url(entry)
+                if loc is not None:
+                    self._m_req.inc("ReadRedirect", bucket)
+                    return Response(b"", status=302,
+                                    content_type="application/xml",
+                                    headers={"Location": loc})
             # edge deadline, same contract as the filer's GET: honor an
             # inbound X-Weed-Deadline (or mint the default) so chunk
             # fetches behind a dead volume server give up inside the
@@ -754,27 +772,30 @@ class S3Server:
             from seaweedfs_tpu.server.filer_server import READ_DEADLINE_S
             from seaweedfs_tpu.utils.resilience import (Deadline,
                                                         deadline_scope)
-            with deadline_scope(Deadline.from_headers(
-                    req.headers, default=READ_DEADLINE_S)):
-                data = self.fs._read_entry_bytes(entry)
             from seaweedfs_tpu.utils.httpd import (RangeNotSatisfiable,
                                                    parse_byte_range)
+            total = entry.file_size()
             try:
                 rng = parse_byte_range(req.headers.get("Range", ""),
-                                       len(data))
+                                       total)
             except RangeNotSatisfiable:
                 resp = _err("InvalidRange",
                             "the requested range is not satisfiable", 416)
-                resp.headers["Content-Range"] = f"bytes */{len(data)}"
+                resp.headers["Content-Range"] = f"bytes */{total}"
                 return resp
-            if rng is not None:
-                lo, hi = rng
-                piece = data[lo:hi + 1]
-                return Response(piece, status=206,
-                                content_type=entry.attr.mime
-                                or "application/octet-stream",
-                                headers={"Content-Range":
-                                         f"bytes {lo}-{hi}/{len(data)}"})
+            with deadline_scope(Deadline.from_headers(
+                    req.headers, default=READ_DEADLINE_S)):
+                if rng is not None:
+                    # ranged GET assembles only the overlapping chunks
+                    lo, hi = rng
+                    piece = self.fs._read_entry_range(entry, lo,
+                                                      hi - lo + 1)
+                    return Response(piece, status=206,
+                                    content_type=entry.attr.mime
+                                    or "application/octet-stream",
+                                    headers={"Content-Range":
+                                             f"bytes {lo}-{hi}/{total}"})
+                data = self.fs._read_entry_bytes(entry)
             return Response(data, content_type=entry.attr.mime
                             or "application/octet-stream",
                             headers={"ETag": f'"{entry.attr.md5.hex()}"'})

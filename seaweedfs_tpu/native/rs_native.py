@@ -154,11 +154,21 @@ def impl_name() -> str:
     return lib.gf_impl_name().decode()
 
 
-def crc32c(data: bytes | np.ndarray, crc: int = 0) -> int:
+def crc32c(data: bytes | bytearray | memoryview | np.ndarray,
+           crc: int = 0) -> int:
+    """CRC32-C over any byte-shaped buffer WITHOUT copying it: bytes,
+    bytearray, and memoryview all go through np.frombuffer (a view of
+    the caller's memory), so checksumming a window of a cached record
+    costs the table walk and nothing else. ``crc`` chains: feeding
+    windows ``a`` then ``b`` with the running value equals one pass
+    over ``a+b`` — the read plane verifies Range responses piecewise
+    on exactly this property."""
     lib = _load()
     assert lib is not None
     if isinstance(data, np.ndarray):
-        data = np.ascontiguousarray(data, dtype=np.uint8)
-        return int(lib.crc32c(crc, data.ctypes.data, data.size))
-    buf = (ctypes.c_char * len(data)).from_buffer_copy(data)
-    return int(lib.crc32c(crc, buf, len(data)))
+        buf = np.ascontiguousarray(data, dtype=np.uint8)
+    else:
+        buf = np.frombuffer(data, dtype=np.uint8)
+    if buf.size == 0:
+        return crc & 0xFFFFFFFF
+    return int(lib.crc32c(crc, buf.ctypes.data, buf.size))

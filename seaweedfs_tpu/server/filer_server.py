@@ -41,8 +41,10 @@ from seaweedfs_tpu.qos import (BACKGROUND, QosGovernor, class_scope,
                                classify, current_class, from_headers)
 from seaweedfs_tpu.utils import headers as weed_headers
 from seaweedfs_tpu.utils import clockctl, glog, profiler, tracing
-from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Request,
-                                       Response, http_call)
+from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer,
+                                       RangeNotSatisfiable, Request,
+                                       Response, http_call,
+                                       parse_byte_range)
 from seaweedfs_tpu.utils.resilience import (Deadline, PeerHealth,
                                             current_deadline,
                                             deadline_scope, hedged)
@@ -199,6 +201,16 @@ class FilerServer:
         # — the bit-for-bit comparator for the streaming path (same
         # convention as parallel_uploads/qos)
         self.streaming_ingest = True
+        # volume_redirect=False proxies every GET through this filer —
+        # the bit-identity comparator for the 302 volume-direct path
+        # (eligible single-chunk entries answer with a JWT-stamped
+        # volume URL instead of relaying the payload)
+        self.volume_redirect = True
+        # below this size the proxy hop is cheaper than a client
+        # round-trip + new connection, and the filer's reader cache /
+        # deadline-bounded hedged fetches keep serving the hot small
+        # tail — only bulk reads skip the filer
+        self.volume_redirect_min = 256 * 1024
         self._upload_pool: Optional[ThreadPoolExecutor] = None
         self._upload_pool_lock = threading.Lock()
         # per-volume-server breakers/latency for hedged chunk fetches
@@ -1058,16 +1070,77 @@ class FilerServer:
                 "Entries": [self._entry_json(e) for e in entries],
                 "ShouldDisplayLoadMore": len(entries) == limit,
             })
+        # zero-copy read plane: an eligible single-chunk entry's
+        # payload never relays through this filer — the client is
+        # pointed straight at a volume replica (which serves it via
+        # sendfile). ?proxy=1 forces the relay (comparator/debug).
+        if req.method == "GET" and self.volume_redirect \
+                and req.query.get("proxy") != "1":
+            loc = self.volume_direct_url(entry)
+            if loc is not None:
+                self._m_req.inc("read_redirect")
+                return Response(b"", status=302,
+                                content_type="text/plain",
+                                headers={"Location": loc})
+        mime = entry.attr.mime or "application/octet-stream"
+        headers = {"Content-Disposition":
+                   f'inline; filename="{entry.name}"'}
         # edge deadline: honors an inbound X-Weed-Deadline (propagated
         # budget) or mints the default; every chunk fetch below inherits
         # the remaining time instead of its own full 30s
         with deadline_scope(Deadline.from_headers(req.headers,
                                                   default=READ_DEADLINE_S)):
+            if req.method == "GET" and req.headers.get("Range"):
+                total = entry.file_size()
+                try:
+                    rng = parse_byte_range(req.headers["Range"], total)
+                except RangeNotSatisfiable:
+                    headers["Content-Range"] = f"bytes */{total}"
+                    return Response(b"", status=416, content_type=mime,
+                                    headers=headers)
+                if rng is not None:
+                    lo, hi = rng
+                    piece = self._read_entry_range(entry, lo,
+                                                   hi - lo + 1)
+                    headers["Content-Range"] = \
+                        f"bytes {lo}-{hi}/{total}"
+                    return Response(piece, status=206,
+                                    content_type=mime, headers=headers)
             data = self._read_entry_bytes(entry)
-        return Response(data, content_type=entry.attr.mime
-                        or "application/octet-stream",
-                        headers={"Content-Disposition":
-                                 f'inline; filename="{entry.name}"'})
+        return Response(data, content_type=mime, headers=headers)
+
+    def volume_direct_url(self, entry: Entry) -> Optional[str]:
+        """The JWT-stamped volume URL an entry's payload can be GET
+        directly from, or None when the read must proxy. Eligibility —
+        the payload must be ONE plaintext stored chunk that IS the
+        whole file: no inline content, exactly one chunk covering
+        [0, file_size), no per-chunk cipher key, no manifest
+        indirection, no remote mount, and at least volume_redirect_min
+        bytes (smaller reads stay on the proxy where the reader cache
+        and deadline-bounded hedged fetches serve the hot tail). The
+        replica choice follows this filer's learned peer health, and a
+        failed lookup falls back to the proxy path rather than
+        redirecting into the void."""
+        if entry.content or entry.remote or not entry.chunks:
+            return None
+        if entry.file_size() < self.volume_redirect_min:
+            return None
+        if len(entry.chunks) != 1 or has_chunk_manifest(entry.chunks):
+            return None
+        c = entry.chunks[0]
+        if c.cipher_key or c.offset != 0 \
+                or c.size != entry.file_size():
+            return None
+        try:
+            vid = int(c.fid.split(",")[0])
+            peers = [l["url"] for l in self.mc.lookup_volume(vid)]
+        except Exception:
+            return None
+        if not peers:
+            return None
+        peer = self.peer_health.rank(peers)[0]
+        jwt = self._read_jwt_for(c.fid)
+        return f"http://{peer}/{c.fid}" + (f"?jwt={jwt}" if jwt else "")
 
     def _read_jwt_for(self, fid: str) -> str:
         """Sign a read token with the shared jwt.signing.read key when
@@ -1145,6 +1218,33 @@ class FilerServer:
             piece = blob[view.offset_in_chunk:
                          view.offset_in_chunk + view.size]
             out[view.logic_offset:view.logic_offset + view.size] = piece
+        return bytes(out)
+
+    def _read_entry_range(self, entry: Entry, lo: int,
+                          length: int) -> bytes:
+        """``entry`` bytes [lo, lo+length) fetching ONLY the chunks
+        that overlap the window — a Range GET of one 4MB chunk out of
+        a multi-GB file costs one chunk fetch, not an assembly of the
+        whole object."""
+        if length <= 0:
+            return b""
+        if not entry.content and not entry.chunks and entry.remote:
+            return self.remote_mounts.read_through(entry)[lo:lo + length]
+        if entry.content or not entry.chunks:
+            return entry.content[lo:lo + length]
+        chunks = entry.chunks
+        if has_chunk_manifest(chunks):
+            chunks = resolve_chunk_manifest(self._read_chunk, chunks)
+        visibles = non_overlapping_visible_intervals(chunks)
+        views = view_from_visibles(visibles, lo, length)
+        chunk_by_fid = {c.fid: c for c in chunks}
+        out = bytearray(length)
+        for view in views:
+            blob = self._read_chunk(chunk_by_fid[view.fid])
+            piece = blob[view.offset_in_chunk:
+                         view.offset_in_chunk + view.size]
+            out[view.logic_offset - lo:
+                view.logic_offset - lo + view.size] = piece
         return bytes(out)
 
     @staticmethod

@@ -89,6 +89,7 @@ class VolumeServer:
                  ec_batch_window_s: float = 0.005,
                  needle_cache_mb: int = 64,
                  hinted_handoff: bool = True,
+                 zero_copy: bool = True,
                  profile_hz: float = profiler.DEFAULT_HZ):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
@@ -138,6 +139,14 @@ class VolumeServer:
         (storage/needle_cache.py) fronting the healthy and degraded-EC
         read paths; admission follows this server's HotKeys sketch and
         0 disables the cache entirely.
+
+        zero_copy serves eligible whole-needle and Range GETs as
+        (fd, offset, length) descriptors that the HTTP core hands to
+        os.sendfile — the payload never enters Python. An explicit
+        fallback ladder (cached, EC, tiered, compressed-for-plain-
+        clients, resize, TTL, v1, sub-threshold payloads) keeps the
+        buffered path, which also stays available wholesale as the
+        bit-identity comparator (zero_copy=False).
 
         hinted_handoff turns replicated writes into a sloppy quorum:
         a write whose primary + majority of replica legs land is acked,
@@ -199,6 +208,12 @@ class VolumeServer:
         # vid -> (expires_monotonic, [peer urls]) for replica fan-out
         self._replica_cache: dict[int, tuple[float, list]] = {}
         self.advertise = advertise
+        # zero-copy read plane: descriptor GETs via sendfile. The
+        # minimum payload keeps tiny hot needles on the buffered path,
+        # where the needle cache (and its cache-aware routing) earns
+        # its keep; bulk payloads skip the cache and ride the kernel.
+        self.zero_copy = zero_copy
+        self.zero_copy_min = 64 * 1024
         self.resilient_reads = resilient_reads
         self.parallel_replication = parallel_replication
         self._fsync = fsync
@@ -1036,6 +1051,13 @@ class VolumeServer:
                 return resp
             # else: metadata says we can't serve the subrange (v1,
             # compressed, malformed range) — fall through to full read
+        if self.zero_copy:
+            resp = self._zero_copy_read(req, vid, key, cookie)
+            if resp is not None:
+                return resp
+            # else: some rung of the fallback ladder claimed the read —
+            # the buffered path below is the single error/repair
+            # authority and the bit-identity comparator
         try:
             if self.store.find_volume(vid) is not None:
                 try:
@@ -1117,6 +1139,83 @@ class VolumeServer:
         if req.headers.get("If-None-Match") == f'"{n.checksum:x}"':
             return Response(b"", status=304, content_type=mime)
         return Response(n.data, content_type=mime, headers=headers)
+
+    def _zero_copy_read(self, req: Request, vid: int, key: int,
+                        cookie) -> Optional[Response]:
+        """Descriptor fast path: answer a whole-needle or Range GET
+        with ``send_file(fd, offset, count)`` so the payload moves
+        page-cache -> socket inside the kernel. Returns None to fall
+        back to the buffered path — the explicit ladder:
+
+        - in-process dispatch (no socket to sendfile to)
+        - image resize (must materialize and transform)
+        - cached needle (memory beats disk; keeps cache-aware routing)
+        - EC / tiered / v1 volumes, expired volumes, malformed records
+        - any lookup error (buffered path owns read-repair + 404 shape)
+        - compressed payload for a client that doesn't accept gzip
+        - TTL-expired needle (buffered 404 shape kept)
+        - payloads under zero_copy_min (syscall setup beats the copy
+          only above a threshold; small hot needles feed the cache)
+
+        The ETag is the record's STORED crc — identical to the
+        buffered path's computed value for locally written records."""
+        if getattr(req, "handler", None) is None:
+            return None
+        if req.query.get("width") or req.query.get("height"):
+            return None
+        desc = self.store.read_volume_needle_descriptor(vid, key, cookie)
+        if desc is None:
+            return None
+        n, fd, payload_off, data_size = desc
+        try:
+            if data_size < self.zero_copy_min:
+                return None
+            if n.is_compressed and "gzip" not in \
+                    req.headers.get("Accept-Encoding", ""):
+                return None
+            if n.has_ttl and n.ttl and n.last_modified:
+                from seaweedfs_tpu.storage.super_block import TTL
+                ttl = TTL.from_bytes(n.ttl)
+                if ttl.minutes and clockctl.now() > \
+                        n.last_modified + ttl.minutes * 60:
+                    return None  # buffered path serves the 404 shape
+            h = req.handler
+            self.ledger.charge_disk(data_size,
+                                    tenant=h.client_address[0])
+            headers = {weed_headers.ZERO_COPY: "1"}
+            if n.is_compressed:
+                headers["Content-Encoding"] = "gzip"
+            if n.last_modified:
+                headers["X-Last-Modified"] = str(n.last_modified)
+            if n.name:
+                headers["X-File-Name"] = n.name.decode(errors="replace")
+            mime = (n.mime.decode(errors="replace")
+                    if n.mime else "application/octet-stream")
+            from seaweedfs_tpu.utils.httpd import (RangeNotSatisfiable,
+                                                   parse_byte_range,
+                                                   send_file)
+            try:
+                rng = parse_byte_range(req.headers.get("Range", ""),
+                                       data_size)
+            except RangeNotSatisfiable:
+                headers["Content-Range"] = f"bytes */{data_size}"
+                return Response(b"", status=416, content_type=mime,
+                                headers=headers)
+            self._m_req.inc("read_zero_copy")
+            if rng is not None:
+                lo, hi = rng
+                headers["Content-Range"] = f"bytes {lo}-{hi}/{data_size}"
+                return send_file(fd, payload_off + lo, hi - lo + 1,
+                                 status=206, content_type=mime,
+                                 headers=headers)
+            headers["ETag"] = f'"{n.checksum:x}"'
+            if req.headers.get("If-None-Match") == f'"{n.checksum:x}"':
+                return Response(b"", status=304, content_type=mime)
+            return send_file(fd, payload_off, data_size,
+                             content_type=mime, headers=headers)
+        finally:
+            # send_file dup'd its own handle; the descriptor's is ours
+            os.close(fd)
 
     def _ec_ranged_read(self, req: Request, vid: int, key: int,
                         cookie) -> Optional[Response]:

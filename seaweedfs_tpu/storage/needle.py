@@ -167,7 +167,11 @@ class Needle:
             n._parse_body_v2(buf[h:h + n.size])
         if size > 0 and check_crc:
             stored, = struct.unpack_from(">I", buf, h + size)
-            actual = crc32c(n.data)
+            # checksum over a memoryview WINDOW of the record, not a
+            # re-slice: verification adds zero copies on top of the
+            # parse (and callers that skip the parse entirely use
+            # verify_record_crc on the raw blob)
+            actual = crc32c(payload_window(buf, size, version))
             if stored != actual and stored != _legacy_crc_value(actual):
                 raise CrcError("CRC error! Data On Disk Corrupted")
             n.checksum = actual
@@ -222,6 +226,54 @@ class Needle:
 
     def stamp(self) -> None:
         self.append_at_ns = time.time_ns()
+
+
+def payload_window(buf, size: int,
+                   version: int = CURRENT_VERSION) -> memoryview:
+    """The data payload of a raw record blob as a zero-copy
+    ``memoryview`` window — the region the stored CRC covers. For v2/3
+    that is ``data_size`` bytes starting right after the 4-byte
+    data_size field; for v1 the whole body IS the payload."""
+    mv = memoryview(buf) if not isinstance(buf, memoryview) else buf
+    h = t.NEEDLE_HEADER_SIZE
+    if version == VERSION1 or size == 0:
+        return mv[h:h + size]
+    data_size, = struct.unpack_from(">I", buf, h)
+    if data_size + 4 > size:
+        raise ValueError("index out of range")
+    return mv[h + 4:h + 4 + data_size]
+
+
+def payload_crc_stored(buf, size: int) -> int:
+    """The CRC field as stored in a raw record blob (v1/2/3 all keep
+    it right after the body). For locally written records this equals
+    the computed payload checksum; cache hits that skip the re-walk
+    (verified at admission) take the ETag from here."""
+    if size <= 0:
+        return 0
+    return struct.unpack_from(">I", buf, t.NEEDLE_HEADER_SIZE + size)[0]
+
+
+def verify_record_crc(buf, size: int, version: int = CURRENT_VERSION,
+                      window: int = 1 << 20) -> int:
+    """Verify a raw record blob's stored CRC against its payload
+    without parsing the record or copying the payload: the checksum
+    runs over ``window``-sized memoryview slices chained through
+    ``crc32c(crc=...)``. Returns the (canonical) checksum; raises
+    CrcError on mismatch. This is the cache-admission check — once a
+    blob passes here, hits can re-parse with ``check_crc=False`` and
+    range reads can serve memoryview slices of it directly."""
+    if size <= 0:
+        return 0
+    payload = payload_window(buf, size, version)
+    c = 0
+    for off in range(0, len(payload), window):
+        c = crc32c(payload[off:off + window], c)
+    stored, = struct.unpack_from(">I", buf,
+                                 t.NEEDLE_HEADER_SIZE + size)
+    if stored != c and stored != _legacy_crc_value(c):
+        raise CrcError("CRC error! Data On Disk Corrupted")
+    return c
 
 
 def _legacy_crc_value(c: int) -> int:

@@ -1,11 +1,16 @@
 """Byte-budgeted LRU of hot needle records at the volume server.
 
 Caches RAW on-disk record blobs (the same bytes ``read_needle_blob``
-returns), never parsed Needle objects: every hit re-parses via
-``Needle.from_bytes`` with its CRC check, so a cached read is
-bit-identical to a disk read by construction, and handler-side
-mutation of ``n.data`` (gzip decompress, image resize) can never
-poison the cache. The zipf head in real traffic (sim/workload.py)
+returns), never parsed Needle objects.  CRC is verified ONCE, at
+admission: every loader runs ``needle.verify_record_crc`` over the
+blob (chained crc32c over memoryview windows — no payload copy)
+before the blob enters the cache, so a corrupt record can never be
+admitted.  Hits then parse with ``check_crc=False`` and restore the
+stored checksum via ``needle.payload_crc_stored`` — a cached read
+stays bit-identical to a disk read (the blob is immutable in the
+cache; handlers that mutate ``n.data`` after parse — gzip
+decompress, image resize — mutate their own parsed copy, never the
+cached bytes) without re-hashing the payload on every hit. The zipf head in real traffic (sim/workload.py)
 makes this the common-read fast path; per the degraded-read boosting
 line of arXiv 2306.10528, the biggest win is on degraded EC volumes,
 where a miss pays a k-column decode — reconstructed records are

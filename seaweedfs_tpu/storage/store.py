@@ -18,6 +18,7 @@ from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.disk_location import DiskLocation
 from seaweedfs_tpu.storage.erasure_coding import layout
 from seaweedfs_tpu.storage.erasure_coding.ec_volume import EcVolume
+from seaweedfs_tpu.storage import needle
 from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.super_block import ReplicaPlacement, TTL
 from seaweedfs_tpu.storage.volume import (CookieMismatchError, DeletedError,
@@ -275,16 +276,42 @@ class Store:
 
         def load():
             blob, size = v.read_needle_blob(needle_id)
+            # CRC verified ONCE at admission, over memoryview windows
+            # (no payload copy); hits below skip the re-check
+            needle.verify_record_crc(blob, size, v.version)
             return blob, size, v.version, False
 
         blob, size, version = cache.get_or_load(vid, needle_id, load)
-        # re-parse per hit: CRC re-checked, and handler-side mutation
-        # of n.data (gzip decompress, resize) can't touch the cache
-        n = Needle.from_bytes(blob, size, version)
+        # re-parse per hit (handler-side mutation of n.data — gzip
+        # decompress, resize — can't touch the cache) but WITHOUT the
+        # per-hit CRC walk: the blob was verified at admission
+        n = Needle.from_bytes(blob, size, version, check_crc=False)
+        n.checksum = needle.payload_crc_stored(blob, size)
         if cookie is not None and n.cookie != cookie:
             raise CookieMismatchError(
                 f"cookie mismatch for needle {needle_id:x}")
         return n
+
+    def read_volume_needle_descriptor(self, vid: int, needle_id: int,
+                                      cookie: Optional[int] = None):
+        """Zero-copy read plane: ``(needle_meta, fd, payload_offset,
+        data_size)`` for the volume server to sendfile, or None when
+        the read belongs on the buffered ladder — volume missing or
+        expired (caller re-drives the buffered path for its richer
+        repair/404 handling), needle cached (served from memory), or
+        the volume refuses (tiered/v1). NotFound/Deleted/Cookie errors
+        are NOT raised here: they return None so the buffered path
+        stays the single authority on read-repair and error shape."""
+        v = self.find_volume(vid)
+        if v is None or v.is_expired():
+            return None
+        cache = self.needle_cache
+        if cache is not None and cache.contains(vid, needle_id):
+            return None  # memory beats disk: cache path serves it
+        try:
+            return v.read_needle_descriptor(needle_id, cookie)
+        except (NotFoundError, DeletedError, CookieMismatchError):
+            return None
 
     def delete_volume_needle(self, vid: int, needle_id: int,
                              cookie: Optional[int] = None) -> int:
@@ -383,12 +410,14 @@ class Store:
                 raise DeletedError(f"needle {needle_id:x} deleted")
             blob = b"".join(
                 self._read_one_interval(ev, iv) for iv in intervals)
-            version = ev.version
+            n = Needle.from_bytes(blob, size, ev.version)
         else:
             blob, size, version = cache.get_or_load(
                 vid, needle_id,
                 lambda: self._load_ec_record(ev, needle_id))
-        n = Needle.from_bytes(blob, size, version)
+            # admission verified the blob's CRC; hits skip the re-walk
+            n = Needle.from_bytes(blob, size, version, check_crc=False)
+            n.checksum = needle.payload_crc_stored(blob, size)
         if cookie is not None and n.cookie != cookie:
             raise NotFoundError(f"cookie mismatch for needle {needle_id:x}")
         return n
@@ -404,6 +433,10 @@ class Store:
         meter = {"recovered": 0}
         blob = b"".join(
             self._read_one_interval(ev, iv, meter) for iv in intervals)
+        # the one CRC walk this blob ever pays: admission-time, over
+        # memoryview windows — hits re-parse with check_crc=False and
+        # range reads serve memoryview slices of the verified bytes
+        needle.verify_record_crc(blob, size, ev.version)
         return blob, size, ev.version, meter["recovered"] > 0
 
     def _read_record_range(self, ev: EcVolume, rec_offset: int,
@@ -490,7 +523,12 @@ class Store:
         if cache is not None:
             hit = cache.get(vid, needle_id)
             if hit is not None:
-                return hit[0][data_off + lo:data_off + lo + length]
+                # memoryview WINDOW of the cached record, not a bytes
+                # copy: CRC was verified at admission, and epoch
+                # invalidation guarantees the underlying blob is
+                # immutable for as long as this view can be reachable
+                return memoryview(hit[0])[data_off + lo:
+                                          data_off + lo + length]
             if (t.get_actual_size(size, ev.version)
                     <= cache.max_item_bytes()
                     and self._range_needs_recovery(
@@ -498,7 +536,8 @@ class Store:
                 blob, _, _ = cache.get_or_load(
                     vid, needle_id,
                     lambda: self._load_ec_record(ev, needle_id))
-                return blob[data_off + lo:data_off + lo + length]
+                return memoryview(blob)[data_off + lo:
+                                        data_off + lo + length]
         return self._read_record_range(
             ev, offset, data_off + lo, length)
 

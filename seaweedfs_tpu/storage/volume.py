@@ -12,13 +12,15 @@ compaction revision.
 from __future__ import annotations
 
 import os
+import struct
 import threading
 import time
 from typing import Optional
 
 from seaweedfs_tpu.utils import clockctl
 from seaweedfs_tpu.storage import types as t
-from seaweedfs_tpu.storage.needle import CURRENT_VERSION, Needle
+from seaweedfs_tpu.storage.needle import (CURRENT_VERSION, Needle,
+                                          SizeMismatchError)
 from seaweedfs_tpu.storage.needle_map import CompactMap
 from seaweedfs_tpu.storage import idx as idxmod
 from seaweedfs_tpu.storage.super_block import ReplicaPlacement, SuperBlock, TTL
@@ -277,6 +279,67 @@ class Volume:
             raise CookieMismatchError(
                 f"cookie mismatch for needle {needle_id:x}")
         return n
+
+    def read_needle_descriptor(self, needle_id: int,
+                               cookie: Optional[int] = None):
+        """Zero-copy read: locate the needle and hand back
+        ``(needle_meta, fd, payload_offset, data_size)`` instead of
+        materialized bytes — the payload stays on disk for the caller
+        to ``os.sendfile``. Only the record's head (header + data_size)
+        and tail (flags/metadata + crc [+ append_at_ns]) are read; the
+        needle_meta carries every field EXCEPT ``data``, with
+        ``checksum`` set to the STORED crc (identical to the computed
+        one for locally written records).
+
+        The fd is ``os.dup``'d from the volume's .dat under the volume
+        lock — the caller owns it and must close it (a compaction
+        that replaces the .dat mid-send leaves the dup pinned to the
+        pre-compaction inode: a consistent snapshot). Returns None when
+        this volume can't serve descriptors (tiered backend, v1
+        records) so callers fall back to the buffered path; raises the
+        same NotFound/Deleted/CookieMismatch errors as read_needle."""
+        with self._lock:
+            nv = self.nm.get(needle_id)
+            if nv is None:
+                raise NotFoundError(f"needle {needle_id:x} not found")
+            off_units, size = nv
+            if not t.size_is_valid(size):
+                raise DeletedError(f"needle {needle_id:x} deleted")
+            if self._backend is not None or self.version == 1 \
+                    or size <= 0:
+                return None
+            actual_off = t.offset_to_actual(off_units)
+            # pending buffered appends are invisible to the raw fd
+            # until flushed; reads through self._dat don't need this
+            # (seek flushes), sendfile does
+            self._dat.flush()
+            head = self._read_at(actual_off, t.NEEDLE_HEADER_SIZE + 4)
+            n = Needle.parse_header(head)
+            if n.size != size:
+                raise SizeMismatchError(
+                    f"found size {n.size}, expected {size} "
+                    f"(id {needle_id:x})")
+            data_size, = struct.unpack_from(">I", head,
+                                            t.NEEDLE_HEADER_SIZE)
+            if data_size + 4 > size:
+                return None  # malformed body: buffered path reports it
+            tail_rel = t.NEEDLE_HEADER_SIZE + 4 + data_size
+            body_tail_len = size - 4 - data_size
+            tail_len = body_tail_len + t.NEEDLE_CHECKSUM_SIZE + \
+                (8 if self.version == 3 else 0)
+            tail = self._read_at(actual_off + tail_rel, tail_len)
+            n.parse_body_tail(tail[:body_tail_len])
+            n.checksum, = struct.unpack_from(">I", tail, body_tail_len)
+            if self.version == 3:
+                n.append_at_ns, = struct.unpack_from(
+                    ">Q", tail, body_tail_len + t.NEEDLE_CHECKSUM_SIZE)
+            fd = os.dup(self._dat.fileno())
+        if cookie is not None and n.cookie != cookie:
+            os.close(fd)
+            raise CookieMismatchError(
+                f"cookie mismatch for needle {needle_id:x}")
+        payload_off = actual_off + t.NEEDLE_HEADER_SIZE + 4
+        return n, fd, payload_off, data_size
 
     def read_needle_blob(self, needle_id: int) -> tuple[bytes, int]:
         """Raw on-disk record bytes + stored size — the lossless transfer

@@ -2,7 +2,11 @@
 (reference weed/storage/needle/crc.go:13 uses Go hash/crc32 Castagnoli).
 
 Uses the native C++ kernel when available, else a numpy table-driven
-fallback.
+fallback. Both accept any byte-shaped buffer (bytes / bytearray /
+memoryview) WITHOUT copying it, and both chain through ``crc=``:
+``crc32c(b, crc32c(a))`` equals ``crc32c(a + b)``, which is what lets
+the read plane verify a payload window-by-window over memoryview
+slices of a cached record instead of materializing a contiguous copy.
 """
 
 from __future__ import annotations
@@ -25,9 +29,12 @@ def _make_table() -> np.ndarray:
 _TAB = _make_table()
 
 
-def _crc32c_py(data: bytes | np.ndarray, crc: int = 0) -> int:
-    buf = np.frombuffer(bytes(data) if not isinstance(data, np.ndarray)
-                        else data.tobytes(), dtype=np.uint8)
+def _crc32c_py(data: bytes | bytearray | memoryview | np.ndarray,
+               crc: int = 0) -> int:
+    if isinstance(data, np.ndarray):
+        buf = np.ascontiguousarray(data, dtype=np.uint8)
+    else:  # zero-copy view of the caller's buffer
+        buf = np.frombuffer(data, dtype=np.uint8)
     c = np.uint32(crc ^ 0xFFFFFFFF)
     tab = _TAB
     for b in buf.tolist():
@@ -36,7 +43,8 @@ def _crc32c_py(data: bytes | np.ndarray, crc: int = 0) -> int:
     return int(c) ^ 0xFFFFFFFF
 
 
-def crc32c(data: bytes | np.ndarray, crc: int = 0) -> int:
+def crc32c(data: bytes | bytearray | memoryview | np.ndarray,
+           crc: int = 0) -> int:
     try:
         from seaweedfs_tpu.native import rs_native
         if rs_native.available():

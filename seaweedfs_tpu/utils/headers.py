@@ -61,6 +61,12 @@ SHARD_FORWARDED = "X-Weed-Shard-Forwarded"
 # affinity can't starve the other replicas of cache warmth
 CACHE_HOT = "X-Weed-Cache-Hot"
 
+# set "1" on a volume GET whose payload was served by the zero-copy
+# descriptor path (sendfile off the .dat fd, server/volume_server.py);
+# tests and the read-plane bench use it to prove which path ran, and
+# operators can spot a fleet that silently fell back to buffered serving
+ZERO_COPY = "X-Weed-Zero-Copy"
+
 # ---- partial-parallel EC repair (storage/erasure_coding/partial.py) ----
 
 # shard ids folded into a chain hop's pre-reduced column

@@ -21,11 +21,13 @@ from __future__ import annotations
 
 import collections
 import json
+import os
 import queue
 import re
 import select
 import selectors
 import socket
+import stat
 import threading
 import urllib.error
 import urllib.parse
@@ -121,6 +123,69 @@ class LocalRequest:
         return json.loads(self.body) if self.body else None
 
 
+class FileSlice:
+    """A ``(fd, offset, count)`` window of a regular file standing in
+    for a response body — the zero-copy read-plane descriptor. The
+    payload never enters userspace on the common path: ``_send`` hands
+    the window to ``os.sendfile`` and the kernel moves pages straight
+    from the page cache to the socket. ``__len__`` is the window size,
+    so Content-Length, access-log byte counts, and the ledger all work
+    unchanged.
+
+    Owns its fd (``send_file`` dups the caller's): the transport closes
+    it after the send, win or lose, so a descriptor response stays
+    valid even if the producing volume is compacted or closed while the
+    bytes are in flight — the dup'd fd pins the old inode."""
+
+    __slots__ = ("fd", "offset", "count", "_closed")
+
+    def __init__(self, fd: int, offset: int, count: int):
+        self.fd = fd
+        self.offset = int(offset)
+        self.count = int(count)
+        self._closed = False
+
+    def __len__(self) -> int:
+        return self.count
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
+
+    def read_all(self) -> bytes:
+        """Materialize the window (in-process LocalRequest dispatch and
+        tests — NOT the wire path, which sendfiles it)."""
+        out = bytearray()
+        off, end = self.offset, self.offset + self.count
+        while off < end:
+            piece = os.pread(self.fd, min(1 << 20, end - off), off)
+            if not piece:
+                raise OSError(
+                    f"FileSlice: EOF at {off}, wanted {end - off} more")
+            out += piece
+            off += len(piece)
+        return bytes(out)
+
+
+def send_file(fd: int, offset: int, count: int, *, status: int = 200,
+              content_type: str = "application/octet-stream",
+              headers: Optional[dict] = None) -> Response:
+    """Descriptor response primitive: serve ``count`` bytes of the
+    regular file behind ``fd`` starting at ``offset`` without reading
+    them into Python. The fd is dup'd here (the response owns the dup;
+    the caller keeps its handle) and closed by the transport after the
+    payload is on the wire. Callers that may fail between building and
+    returning the Response must close ``resp.body`` on that error
+    path."""
+    return Response(FileSlice(os.dup(fd), offset, count), status=status,
+                    content_type=content_type, headers=headers)
+
+
 class Response:
     def __init__(self, body: Any = None, status: int = 200,
                  content_type: str = "application/json",
@@ -137,6 +202,11 @@ class Response:
             self.content_type = content_type
         elif body is None:
             self.body = b""
+            self.content_type = content_type
+        elif isinstance(body, (memoryview, FileSlice)):
+            # zero-copy bodies ride through uncoerced: a memoryview is
+            # written to the socket as-is, a FileSlice is sendfile'd
+            self.body = body
             self.content_type = content_type
         else:
             self.body = bytes(body)
@@ -396,6 +466,21 @@ def _fd_readable(sock) -> bool:
         return bool(p.poll(0))
     r, _, _ = select.select([sock], [], [], 0)
     return bool(r)
+
+
+def _fd_writable(sock, timeout: Optional[float]) -> bool:
+    """Block until the socket's send buffer drains (or timeout). The
+    sendfile loop lands here on EAGAIN: service() armed a socket
+    timeout, which puts the fd in non-blocking mode internally, so a
+    full send buffer surfaces as BlockingIOError instead of blocking
+    inside the syscall."""
+    if hasattr(select, "poll"):
+        p = select.poll()
+        p.register(sock.fileno(), select.POLLOUT)
+        return bool(p.poll(None if timeout is None else timeout * 1000))
+    _, w, _ = select.select([], [sock], [], timeout)
+    return bool(w)
+
 
 _BUSY_BODY = b'{"error": "server busy"}'
 
@@ -788,6 +873,7 @@ class _ConnHandler(BaseHTTPRequestHandler):
                 len(resp.body) if resp is not None else 0)
 
     def _send(self, resp):
+        body = resp.body
         try:
             self.send_response(resp.status)
             self.send_header("Content-Type", resp.content_type)
@@ -795,14 +881,88 @@ class _ConnHandler(BaseHTTPRequestHandler):
                 # HEAD handlers set it to the entity size; the
                 # wire body is still suppressed below
                 self.send_header("Content-Length",
-                                 str(len(resp.body)))
+                                 str(len(body)))
             for k, v in resp.headers.items():
                 self.send_header(k, v)
             self.end_headers()
-            if self.command != "HEAD":
-                self.wfile.write(resp.body)
+            if self.command == "HEAD":
+                return
+            if isinstance(body, FileSlice):
+                self._send_file_slice(body)
+            else:
+                self.wfile.write(body)
         except (BrokenPipeError, ConnectionResetError):
             pass
+        finally:
+            if isinstance(body, FileSlice):
+                body.close()
+
+    # pread granularity for the buffered descriptor fallback
+    _FILE_CHUNK = 1 << 20
+
+    def _send_file_slice(self, fs: FileSlice) -> None:
+        """Payload of a descriptor response. The headers are sitting in
+        wfile's buffer: flush them, then hand the file window to
+        ``os.sendfile`` so the kernel streams page-cache pages to the
+        socket with zero userspace copies. A short write (EAGAIN — the
+        fd is non-blocking under the service() socket timeout) parks on
+        writability for the same io_timeout budget and resumes at the
+        short-write offset; sendfile with an explicit offset never
+        moves the fd position, so concurrent descriptor sends off one
+        volume fd don't interfere. TLS connections (payload must cross
+        the record layer), non-regular files, and platforms without
+        os.sendfile take the buffered pread loop instead."""
+        if fs.count <= 0:
+            return
+        use_sendfile = (hasattr(os, "sendfile")
+                        and getattr(self.connection, "pending",
+                                    None) is None)
+        if use_sendfile:
+            try:
+                if not stat.S_ISREG(os.fstat(fs.fd).st_mode):
+                    use_sendfile = False
+            except OSError:
+                use_sendfile = False
+        if not use_sendfile:
+            self._send_file_buffered(fs)
+            return
+        self.wfile.flush()  # response head precedes the payload
+        off, end = fs.offset, fs.offset + fs.count
+        timeout = self.connection.gettimeout()
+        while off < end:
+            try:
+                sent = os.sendfile(self.connection.fileno(), fs.fd,
+                                   off, end - off)
+            except BlockingIOError:
+                if not _fd_writable(self.connection, timeout):
+                    raise socket.timeout(
+                        "sendfile: send buffer stayed full past "
+                        "io_timeout")
+                continue
+            except OSError:
+                if off == fs.offset:
+                    # first call refused (EINVAL/ENOTSOCK class):
+                    # this transport can't sendfile — buffered loop
+                    self._send_file_buffered(fs)
+                    return
+                raise  # mid-payload failure: framing is unrecoverable
+            if sent == 0:
+                raise ConnectionError("sendfile: peer gone mid-file")
+            off += sent
+
+    def _send_file_buffered(self, fs: FileSlice) -> None:
+        off, end = fs.offset, fs.offset + fs.count
+        while off < end:
+            piece = os.pread(fs.fd, min(self._FILE_CHUNK, end - off),
+                             off)
+            if not piece:
+                # under-delivering Content-Length corrupts framing —
+                # close the connection rather than serve a truncation
+                raise OSError(
+                    f"descriptor read hit EOF at {off}, "
+                    f"{end - off} bytes short")
+            self.wfile.write(piece)
+            off += len(piece)
 
     do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
     # WebDAV verbs
@@ -1603,23 +1763,25 @@ def _drop_conn(netloc: str) -> None:
 
 def http_call(method: str, url: str, body: Optional[bytes] = None,
               json_body: Any = None, timeout: float = 30.0,
-              headers: Optional[dict] = None,
-              deadline=None) -> tuple[int, bytes, dict]:
+              headers: Optional[dict] = None, deadline=None,
+              follow_redirects: bool = True) -> tuple[int, bytes, dict]:
     # Trace propagation: when a trace is ambient, this outbound RPC
     # becomes a client child span and its ids ride X-Weed-Trace so the
     # callee's server span nests under it. No ambient trace (or tracing
     # disabled) costs one ContextVar read — no span allocation.
     amb = tracing.current_span()
     if amb is None:
-        return _http_call_impl(method, url, body, json_body, timeout,
-                               headers, deadline)
+        return _http_call_following(method, url, body, json_body,
+                                    timeout, headers, deadline,
+                                    follow_redirects)
     span = amb.child(f"{method.upper()} {url.split('?', 1)[0]}")
     headers = dict(headers or {})
     headers.setdefault(tracing.TRACE_HEADER, span.header_value())
     status, err = 0, ""
     try:
-        out = _http_call_impl(method, url, body, json_body, timeout,
-                              headers, deadline)
+        out = _http_call_following(method, url, body, json_body,
+                                   timeout, headers, deadline,
+                                   follow_redirects)
         status = out[0]
         return out
     except BaseException as e:
@@ -1627,6 +1789,36 @@ def http_call(method: str, url: str, body: Optional[bytes] = None,
         raise
     finally:
         span.finish(status=status, error=err)
+
+
+# Data-plane redirects (the filer/S3 read path answers eligible GETs
+# with a 302 volume-direct URL) are followed transparently for safe
+# methods, re-sending the original headers (Range, class, deadline) at
+# the target. 307 is deliberately NOT in this set: that status is the
+# filer namespace-shard redirect protocol, consumed by
+# wdclient.filer_call with its own ring-epoch bookkeeping.
+_REDIRECT_STATUSES = (301, 302, 303)
+_MAX_REDIRECT_HOPS = 4
+
+
+def _http_call_following(method, url, body, json_body, timeout,
+                         headers, deadline,
+                         follow: bool) -> tuple[int, bytes, dict]:
+    out = _http_call_impl(method, url, body, json_body, timeout,
+                          headers, deadline)
+    if not follow or method.upper() not in ("GET", "HEAD"):
+        return out
+    hops = 0
+    while out[0] in _REDIRECT_STATUSES and hops < _MAX_REDIRECT_HOPS:
+        loc = next((v for k, v in out[2].items()
+                    if k.lower() == "location"), None)
+        if not loc:
+            break
+        url = urllib.parse.urljoin(url, loc)
+        out = _http_call_impl(method, url, None, None, timeout,
+                              headers, deadline)
+        hops += 1
+    return out
 
 
 def _http_call_impl(method: str, url: str, body: Optional[bytes] = None,

@@ -117,6 +117,13 @@ CASES = {
         "clean": ("def h(self, path):\n"
                   "    return self.filer.find_entry(path)\n"),
     },
+    "hot-path-bytes-copy": {
+        "path": "seaweedfs_tpu/storage/x.py",
+        "bad": ("def serve(blob):\n"
+                "    return bytes(blob)\n"),
+        "clean": ("def serve(blob):\n"
+                  "    return memoryview(blob)\n"),
+    },
     "ambient-scope-loss": {
         "bad": ("from seaweedfs_tpu.utils.tracing import current_span\n\n"
                 "def f(pool):\n"
@@ -268,6 +275,35 @@ def test_filer_cache_bypass_scoping():
         ("def h(self, path):\n"
          "    return self.filer.store.inner.find_entry(path)\n"),
         path="seaweedfs_tpu/server/filer_server.py")
+
+
+def test_hot_path_bytes_copy_scoping():
+    """The rule bites only under storage/ and server/, only on
+    payload-named buffers, and catches the slice spellings too —
+    bytes(x[a:b]) and the bare full-slice copy x[:]."""
+    bad = "def f(blob):\n    return bytes(blob)\n"
+    # outside the read data plane: legal
+    assert "hot-path-bytes-copy" not in rules_of(bad)
+    assert "hot-path-bytes-copy" not in rules_of(
+        bad, path="seaweedfs_tpu/filer/x.py")
+    # non-payload names: legal (bytes(n) preallocation, bytes(fid))
+    assert "hot-path-bytes-copy" not in rules_of(
+        "def f(fid):\n    return bytes(fid)\n",
+        path="seaweedfs_tpu/storage/x.py")
+    # bytes of a slice of a payload: flagged
+    assert "hot-path-bytes-copy" in rules_of(
+        "def f(blob, a, b):\n    return bytes(blob[a:b])\n",
+        path="seaweedfs_tpu/server/x.py")
+    # full-slice copy: flagged; a bounded slice is not a full copy
+    assert "hot-path-bytes-copy" in rules_of(
+        "def f(data):\n    return data[:]\n",
+        path="seaweedfs_tpu/storage/x.py")
+    assert "hot-path-bytes-copy" not in rules_of(
+        "def f(data, n):\n    return data[:n]\n",
+        path="seaweedfs_tpu/storage/x.py")
+    # the transport home keeps its sanctioned materializations
+    assert "hot-path-bytes-copy" not in rules_of(
+        bad, path="seaweedfs_tpu/utils/httpd.py")
 
 
 def test_syntax_error_reported_not_crashed():

@@ -1,0 +1,508 @@
+"""Zero-copy read plane: sendfile GETs, volume-direct redirects, and
+the fallback ladder.
+
+Every test here is a comparator at one of the plane's seams: the
+sendfile path must be BIT-IDENTICAL to the buffered path it replaces
+(`vs.zero_copy = False`), and the volume-direct redirect must be
+bit-identical to the filer/S3 proxy it bypasses (`?proxy=1`,
+`volume_redirect = False`).  The X-Weed-Zero-Copy response header is
+the witness for WHICH path served — asserting its presence/absence is
+how the fallback-ladder tests prove cached and EC-degraded reads
+stayed buffered."""
+
+import hashlib
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.gateway.s3_server import S3Server
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils import headers as weed_headers
+from seaweedfs_tpu.utils.httpd import (FileSlice, HttpServer, Response,
+                                       http_call, http_json, send_file)
+
+ZC = weed_headers.ZERO_COPY
+
+
+def _hdr(headers, name, default=None):
+    return next((v for k, v in headers.items() if k.lower() == name.lower()),
+                default)
+
+
+def _payload(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+# --------------------------------------------------- volume server
+
+
+@pytest.fixture
+def vstack(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url)
+    vs.start()
+    time.sleep(0.1)
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def _upload(master, data):
+    a = http_json("GET", f"http://{master.url}/dir/assign")
+    status, _, _ = http_call("POST", f"http://{a['url']}/{a['fid']}",
+                             body=data)
+    assert status < 300
+    return a["url"], a["fid"]
+
+
+def test_sendfile_vs_buffered_bit_identity(vstack):
+    """Whole-needle GET: same status, body, and ETag on both paths —
+    and the header witnesses which path actually ran."""
+    master, vs = vstack
+    data = _payload(1 << 20)
+    url, fid = _upload(master, data)
+
+    status, body, h = http_call("GET", f"http://{url}/{fid}")
+    assert status == 200 and body == data
+    assert _hdr(h, ZC) == "1", "1MB needle should take the sendfile path"
+    etag_zc = _hdr(h, "ETag")
+
+    vs.zero_copy = False
+    status, body2, h2 = http_call("GET", f"http://{url}/{fid}")
+    assert status == 200 and body2 == data
+    assert _hdr(h2, ZC) is None
+    assert _hdr(h2, "ETag") == etag_zc
+
+
+@pytest.mark.parametrize("spec,lo,hi", [
+    ("bytes=0-65535", 0, 65535),              # aligned head window
+    ("bytes=100000-299999", 100000, 299999),  # interior window
+    ("bytes=0-0", 0, 0),                      # single byte
+    ("bytes=-1234", (1 << 20) - 1234, (1 << 20) - 1),   # suffix form
+    ("bytes=1048570-", 1048570, (1 << 20) - 1),          # open-ended tail
+    ("bytes=-9999999", 0, (1 << 20) - 1),     # over-long suffix clamps
+])
+def test_range_bit_identity(vstack, spec, lo, hi):
+    master, vs = vstack
+    data = _payload(1 << 20, seed=1)
+    url, fid = _upload(master, data)
+
+    status, body, h = http_call("GET", f"http://{url}/{fid}",
+                                headers={"Range": spec})
+    assert status == 206 and body == data[lo:hi + 1]
+    assert _hdr(h, ZC) == "1"
+    assert _hdr(h, "Content-Range") == f"bytes {lo}-{hi}/{len(data)}"
+
+    vs.zero_copy = False
+    status, body2, h2 = http_call("GET", f"http://{url}/{fid}",
+                                  headers={"Range": spec})
+    assert status == 206 and body2 == body
+    assert _hdr(h2, ZC) is None
+    assert _hdr(h2, "Content-Range") == _hdr(h, "Content-Range")
+
+
+def test_range_unsatisfiable_416_both_paths(vstack):
+    master, vs = vstack
+    data = _payload(1 << 20, seed=2)
+    url, fid = _upload(master, data)
+    for zero_copy in (True, False):
+        vs.zero_copy = zero_copy
+        status, _, h = http_call("GET", f"http://{url}/{fid}",
+                                 headers={"Range": "bytes=9999999-"})
+        assert status == 416, f"zero_copy={zero_copy}"
+        assert _hdr(h, "Content-Range") == f"bytes */{len(data)}"
+
+
+def test_malformed_range_serves_whole_body_both_paths(vstack):
+    # RFC 7233: an unparseable Range header is ignored, not an error
+    master, vs = vstack
+    data = _payload(256 * 1024, seed=3)
+    url, fid = _upload(master, data)
+    for zero_copy in (True, False):
+        vs.zero_copy = zero_copy
+        status, body, _ = http_call("GET", f"http://{url}/{fid}",
+                                    headers={"Range": "bytes=x-y"})
+        assert status == 200 and body == data
+
+
+def test_threshold_keeps_small_needles_buffered(vstack):
+    """Payloads under zero_copy_min stay on the buffered path (they
+    feed the needle cache); dropping the threshold flips the SAME
+    needle to sendfile with an identical body."""
+    master, vs = vstack
+    data = _payload(4096, seed=4)
+    url, fid = _upload(master, data)
+
+    status, body, h = http_call("GET", f"http://{url}/{fid}")
+    assert status == 200 and body == data and _hdr(h, ZC) is None
+
+    vs.zero_copy_min = 0
+    if vs.store.needle_cache is not None:
+        vs.store.needle_cache.invalidate_volume(int(fid.split(",")[0]))
+    status, body2, h2 = http_call("GET", f"http://{url}/{fid}")
+    assert status == 200 and body2 == data
+    assert _hdr(h2, ZC) == "1"
+
+
+def test_fallback_ladder_cached_read(vstack):
+    """A needle admitted to the record cache is served from memory —
+    the descriptor path must defer to it (no ZC header), and the body
+    must stay bit-identical."""
+    master, vs = vstack
+    data = _payload(128 * 1024, seed=5)
+    url, fid = _upload(master, data)
+
+    vs.zero_copy = False           # buffered read admits to the cache
+    status, body, _ = http_call("GET", f"http://{url}/{fid}")
+    assert status == 200 and body == data
+
+    vs.zero_copy = True
+    status, body2, h2 = http_call("GET", f"http://{url}/{fid}")
+    assert status == 200 and body2 == data
+    assert _hdr(h2, ZC) is None, \
+        "cache hit must win over the descriptor path"
+
+
+def test_fallback_ladder_ec_degraded(vstack, tmp_path):
+    """After EC conversion (and shard loss) the read survives via the
+    reconstruction path — buffered, never sendfile."""
+    from seaweedfs_tpu.storage.erasure_coding import layout
+
+    master, vs = vstack
+    data = _payload(96 * 1024, seed=6)
+    url, fid = _upload(master, data)
+    vid = int(fid.split(",")[0])
+
+    base = vs.store.generate_ec_shards(vid)
+    vs.store.delete_volume(vid)
+    vs.store.mount_ec_shards("", vid, list(range(14)))
+
+    status, body, h = http_call("GET", f"http://{url}/{fid}")
+    assert status == 200 and body == data
+    assert _hdr(h, ZC) is None, "EC reads have no contiguous fd window"
+
+    # degrade: drop 4 shards entirely -> k-column reconstruction
+    victims = [0, 3, 7, 11]
+    vs.store.unmount_ec_shards(vid, victims)
+    for sid in victims:
+        os.remove(base + layout.shard_ext(sid))
+    status, body, h = http_call("GET", f"http://{url}/{fid}")
+    assert status == 200 and body == data
+    assert _hdr(h, ZC) is None
+
+
+def test_mid_transfer_disconnect_leaves_server_healthy(vstack):
+    """A client that vanishes mid-sendfile must cost exactly its own
+    connection: the next requests on fresh connections still serve the
+    full, correct body."""
+    master, vs = vstack
+    data = _payload(4 << 20, seed=7)
+    url, fid = _upload(master, data)
+    host, port = url.split(":")
+
+    for _ in range(3):
+        sock = socket.create_connection((host, int(port)), timeout=5)
+        sock.sendall(f"GET /{fid} HTTP/1.1\r\nHost: x\r\n\r\n"
+                     .encode())
+        sock.recv(65536)           # headers + first payload bytes
+        sock.close()               # vanish mid-body
+
+    status, body, h = http_call("GET", f"http://{url}/{fid}")
+    assert status == 200 and body == data
+    assert _hdr(h, ZC) == "1"
+
+
+# ------------------------------------------- transport edge windows
+
+
+def test_send_file_primitive_edges(tmp_path):
+    """send_file at the transport layer: 0-byte windows, windows that
+    end exactly at EOF, and interior windows all frame correctly on a
+    keep-alive connection (a framing bug would corrupt request 2)."""
+    blob = _payload(100_000, seed=8)
+    p = tmp_path / "w.dat"
+    p.write_bytes(blob)
+    fd = os.open(p, os.O_RDONLY)
+
+    srv = HttpServer()
+
+    def serve(req):
+        off = int(req.query.get("off", "0"))
+        cnt = int(req.query.get("cnt", "0"))
+        return send_file(fd, off, cnt)
+
+    srv.add("GET", "/w", serve)
+    srv.start()
+    try:
+        base = f"http://{srv.host}:{srv.port}/w"
+        windows = [(0, 0), (0, 100_000), (99_999, 1), (50_000, 0),
+                   (12_345, 67_890), (100_000, 0)]
+        for off, cnt in windows:
+            status, body, _ = http_call("GET",
+                                        f"{base}?off={off}&cnt={cnt}")
+            assert status == 200, (off, cnt)
+            assert body == blob[off:off + cnt], (off, cnt)
+    finally:
+        srv.stop()
+        os.close(fd)
+
+
+def test_file_slice_owns_its_fd():
+    r, w = os.pipe()
+    os.close(w)
+    fs = FileSlice(r, 0, 0)
+    assert len(fs) == 0
+    fs.close()
+    fs.close()                     # idempotent
+    with pytest.raises(OSError):
+        os.fstat(r)                # really closed
+
+
+def test_response_keeps_memoryview_uncopied():
+    blob = bytearray(b"x" * 64)
+    mv = memoryview(blob)[8:16]
+    resp = Response(mv)
+    assert resp.body is mv         # no bytes() rematerialization
+
+
+# ------------------------------------------------ filer redirects
+
+
+@pytest.fixture
+def fstack(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    time.sleep(0.2)
+    yield master, vs, fs
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_filer_redirects_single_chunk_gets(fstack):
+    master, vs, fs = fstack
+    base = f"http://{fs.url}"
+    data = _payload(3 << 20, seed=9)         # 1 chunk (< 4MB)
+    status, _, _ = http_call("POST", f"{base}/d/one.bin", body=data)
+    assert status == 201
+
+    # raw 302: Location points at a volume server, NOTHING is proxied
+    status, body, h = http_call("GET", f"{base}/d/one.bin",
+                                follow_redirects=False)
+    assert status == 302 and body == b""
+    loc = _hdr(h, "Location")
+    assert loc and vs.url in loc
+
+    # followed redirect == proxied comparator, bit for bit
+    status, direct, h = http_call("GET", f"{base}/d/one.bin")
+    assert status == 200 and direct == data
+    assert _hdr(h, ZC) == "1", "volume-direct GET should sendfile"
+    status, proxied, h = http_call("GET", f"{base}/d/one.bin?proxy=1")
+    assert status == 200 and proxied == data
+    assert _hdr(h, ZC) is None
+
+
+def test_filer_redirect_honors_range(fstack):
+    master, vs, fs = fstack
+    base = f"http://{fs.url}"
+    data = _payload(3 << 20, seed=10)
+    http_call("POST", f"{base}/d/r.bin", body=data)
+
+    for spec, lo, hi in [("bytes=100-999", 100, 999),
+                        ("bytes=-4096", len(data) - 4096, len(data) - 1),
+                        ("bytes=3145000-", 3145000, len(data) - 1)]:
+        status, body, h = http_call("GET", f"{base}/d/r.bin",
+                                    headers={"Range": spec})
+        assert status == 206 and body == data[lo:hi + 1], spec
+        status, body2, h2 = http_call("GET", f"{base}/d/r.bin?proxy=1",
+                                      headers={"Range": spec})
+        assert status == 206 and body2 == body, spec
+        assert _hdr(h2, "Content-Range") == _hdr(h, "Content-Range")
+
+
+def test_filer_proxy_range_conformance(fstack):
+    """The proxied (multi-chunk) path assembles ranges across chunk
+    boundaries and 416s with the total length."""
+    master, vs, fs = fstack
+    base = f"http://{fs.url}"
+    data = _payload(9_000_000, seed=11)      # 3 chunks
+    http_call("POST", f"{base}/d/big.bin", body=data)
+
+    # multi-chunk entries are NOT redirect-eligible
+    status, _, _ = http_call("GET", f"{base}/d/big.bin",
+                             follow_redirects=False)
+    assert status == 200
+
+    lo, hi = 4_000_000, 8_500_000            # spans all 3 chunks
+    status, body, h = http_call(
+        "GET", f"{base}/d/big.bin",
+        headers={"Range": f"bytes={lo}-{hi}"})
+    assert status == 206 and body == data[lo:hi + 1]
+    assert _hdr(h, "Content-Range") == f"bytes {lo}-{hi}/{len(data)}"
+
+    status, _, h = http_call("GET", f"{base}/d/big.bin",
+                             headers={"Range": "bytes=99999999-"})
+    assert status == 416
+    assert _hdr(h, "Content-Range") == f"bytes */{len(data)}"
+
+
+def test_filer_redirect_disabled_comparator(fstack):
+    master, vs, fs = fstack
+    base = f"http://{fs.url}"
+    data = _payload(2 << 20, seed=12)
+    http_call("POST", f"{base}/d/c.bin", body=data)
+
+    fs.volume_redirect = False
+    status, body, _ = http_call("GET", f"{base}/d/c.bin",
+                                follow_redirects=False)
+    assert status == 200 and body == data    # proxied, no 302
+
+
+def test_inline_entries_never_redirect(fstack):
+    master, vs, fs = fstack
+    base = f"http://{fs.url}"
+    http_call("POST", f"{base}/d/tiny.txt", body=b"inline me")
+    status, body, _ = http_call("GET", f"{base}/d/tiny.txt",
+                                follow_redirects=False)
+    assert status == 200 and body == b"inline me"
+
+
+def test_small_files_stay_proxied(fstack):
+    """Single-chunk entries under volume_redirect_min keep the proxy
+    path: the filer's reader cache and deadline-bounded fetches own
+    the hot small tail; only bulk reads skip the hop."""
+    master, vs, fs = fstack
+    base = f"http://{fs.url}"
+    data = _payload(64 * 1024, seed=16)      # chunked, but small
+    http_call("POST", f"{base}/d/small.bin", body=data)
+    status, body, _ = http_call("GET", f"{base}/d/small.bin",
+                                follow_redirects=False)
+    assert status == 200 and body == data    # proxied, no 302
+
+    fs.volume_redirect_min = 0
+    status, body, _ = http_call("GET", f"{base}/d/small.bin",
+                                follow_redirects=False)
+    assert status == 302 and body == b""
+
+
+def test_jwt_stamped_on_volume_direct_urls(tmp_path):
+    """With jwt.signing.read in force the 302 Location must carry a
+    fid-scoped token — and the volume server must reject the same URL
+    with the token stripped."""
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url,
+                      jwt_read_key="read-secret")
+    vs.start()
+    fs = FilerServer(master.url)
+    fs._jwt_read_key = "read-secret"         # same shared key
+    fs.start()
+    time.sleep(0.2)
+    try:
+        base = f"http://{fs.url}"
+        data = _payload(1 << 20, seed=13)
+        status, _, _ = http_call("POST", f"{base}/d/s.bin", body=data)
+        assert status == 201
+
+        status, _, h = http_call("GET", f"{base}/d/s.bin",
+                                 follow_redirects=False)
+        assert status == 302
+        loc = _hdr(h, "Location")
+        assert "?jwt=" in loc
+
+        status, body, _ = http_call("GET", loc)
+        assert status == 200 and body == data
+
+        stripped = loc.split("?jwt=")[0]
+        status, _, _ = http_call("GET", stripped)
+        assert status == 401
+
+        # end-to-end with auto-follow
+        status, body, _ = http_call("GET", f"{base}/d/s.bin")
+        assert status == 200 and body == data
+    finally:
+        fs.stop()
+        vs.stop()
+        master.stop()
+
+
+# --------------------------------------------------- S3 gateway
+
+
+@pytest.fixture
+def s3stack(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url)
+    vs.start()
+    fs = FilerServer(master.url)
+    fs.start()
+    s3 = S3Server(fs)
+    s3.start()
+    time.sleep(0.2)
+    yield vs, fs, s3
+    s3.stop()
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_s3_redirect_vs_proxy_bit_identity(s3stack):
+    vs, fs, s3 = s3stack
+    base = f"http://{s3.url}"
+    http_call("PUT", f"{base}/zc")
+    data = _payload(3 << 20, seed=14)
+    status, _, _ = http_call("PUT", f"{base}/zc/obj.bin", body=data)
+    assert status == 200
+
+    status, body, h = http_call("GET", f"{base}/zc/obj.bin",
+                                follow_redirects=False)
+    assert status == 302 and body == b""
+    assert vs.url in _hdr(h, "Location")
+
+    status, direct, _ = http_call("GET", f"{base}/zc/obj.bin")
+    assert status == 200 and direct == data
+    status, proxied, _ = http_call("GET", f"{base}/zc/obj.bin?proxy=1")
+    assert status == 200 and proxied == data
+    assert hashlib.sha256(direct).digest() == \
+        hashlib.sha256(proxied).digest()
+
+    # S3-side kill switch falls back to proxying without a client change
+    s3.volume_redirect = False
+    status, body, _ = http_call("GET", f"{base}/zc/obj.bin",
+                                follow_redirects=False)
+    assert status == 200 and body == data
+    s3.volume_redirect = True
+
+
+def test_s3_range_conformance(s3stack):
+    vs, fs, s3 = s3stack
+    base = f"http://{s3.url}"
+    http_call("PUT", f"{base}/rg")
+    data = _payload(3 << 20, seed=15)
+    http_call("PUT", f"{base}/rg/o.bin", body=data)
+
+    for spec, lo, hi in [("bytes=0-1023", 0, 1023),
+                        ("bytes=-512", len(data) - 512, len(data) - 1)]:
+        status, body, h = http_call("GET", f"{base}/rg/o.bin",
+                                    headers={"Range": spec})
+        assert status == 206 and body == data[lo:hi + 1]
+        status, body2, _ = http_call("GET", f"{base}/rg/o.bin?proxy=1",
+                                     headers={"Range": spec})
+        assert status == 206 and body2 == body
+
+    status, _, h = http_call("GET", f"{base}/rg/o.bin",
+                             headers={"Range": "bytes=99999999-"})
+    assert status == 416
+    assert _hdr(h, "Content-Range") == f"bytes */{len(data)}"

@@ -1,4 +1,4 @@
-"""The weedlint rule set: one AST pass, eleven invariants.
+"""The weedlint rule set: one AST pass, fourteen invariants.
 
 Every rule encodes a contract the cluster depends on ambiently — the
 kind that breaks silently at a single call site and only surfaces as a
@@ -109,6 +109,20 @@ filer-cache-bypass
     fact the cache already invalidated.  The row-level escape hatch
     ``.store.inner.find_entry`` stays legal: it is the explicit "raw
     store row, no resolution" API that meta-import and sync sinks use.
+
+hot-path-bytes-copy
+    ``bytes(<payload>)`` or a full ``<payload>[:]`` slice inside
+    ``seaweedfs_tpu/storage/`` or ``seaweedfs_tpu/server/``.  The
+    zero-copy read plane moves payloads as memoryview windows and
+    ``(fd, offset, count)`` descriptors — ``utils/httpd.py`` owns the
+    only sanctioned materialization points (FileSlice.read_all, the
+    buffered sendfile fallback) — so a ``bytes()`` rematerialization
+    of a data/blob/payload-named buffer on the read path silently
+    reinstates the copy-per-GET the plane exists to remove.
+    Deliberate copies (cache-admission snapshots that must outlive a
+    mutable buffer, wire framing that needs an owned ``bytes``) are
+    baselined or suppressed with a justification; new code passes
+    views through to the transport.
 """
 
 from __future__ import annotations
@@ -143,6 +157,9 @@ RULES: dict[str, str] = {
         ".store.find_entry in server/filer_server.py bypasses the "
         "entry cache — call filer.find_entry (or .inner.find_entry "
         "for raw rows)",
+    "hot-path-bytes-copy":
+        "bytes(<payload>)/full-slice copy in storage/ or server/ — "
+        "pass memoryview windows on the read hot path",
 }
 
 # files that ARE the sanctioned implementation of a contract
@@ -153,6 +170,7 @@ _RULE_HOME = {
     "header-literal": "utils/headers.py",
     "raw-device-discovery": "parallel/mesh.py",
     "unbounded-body-read": "utils/httpd.py",
+    "hot-path-bytes-copy": "utils/httpd.py",
 }
 
 _HEADER_PREFIX = "X-Weed-"
@@ -175,6 +193,14 @@ _STREAMISH = re.compile(r"(?:^_*|_)(?:sock(?:et)?|rfile|wfile|stream|"
                         r"conn(?:ection)?|resp(?:onse)?|body)s?$",
                         re.IGNORECASE)
 _AMBIENT_READERS = {"current_span", "current_deadline", "current_class"}
+# names that hold needle/chunk payload bytes on the read path; a
+# bytes()/full-slice copy of one re-buys the copy-per-GET the
+# zero-copy plane removed
+_PAYLOADISH = re.compile(r"(?:^_*|_)(?:data|blob|body|payload|"
+                         r"buf(?:fer)?|chunk|piece|record)s?$",
+                         re.IGNORECASE)
+# subtrees where the hot-path-bytes-copy rule applies (read data plane)
+_HOT_PATH_PREFIXES = ("seaweedfs_tpu/storage/", "seaweedfs_tpu/server/")
 _SCOPE_ENTRIES = {"span_scope", "deadline_scope", "class_scope",
                   "attach", "child_scope"}
 
@@ -462,6 +488,37 @@ class Checker(ast.NodeVisitor):
                 and node.args:
             self._check_submit(node)
 
+        if canonical == "bytes" and len(node.args) == 1 \
+                and not node.keywords \
+                and self.rel.startswith(_HOT_PATH_PREFIXES):
+            arg = node.args[0]
+            if isinstance(arg, ast.Subscript):
+                arg = arg.value
+            recv = _terminal(arg)
+            if recv is not None and _PAYLOADISH.search(recv):
+                self.report(
+                    node, "hot-path-bytes-copy",
+                    f"bytes({recv}…) rematerializes a payload buffer — "
+                    "the read plane moves memoryview windows and fd "
+                    "descriptors; pass the view through (copy only at "
+                    "a sanctioned materialization point, with a "
+                    "justified suppression)")
+
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # <payload>[:] — a whole-buffer copy spelled as a slice
+        sl = node.slice
+        if isinstance(sl, ast.Slice) and sl.lower is None \
+                and sl.upper is None and sl.step is None \
+                and self.rel.startswith(_HOT_PATH_PREFIXES):
+            recv = _terminal(node.value)
+            if recv is not None and _PAYLOADISH.search(recv):
+                self.report(
+                    node, "hot-path-bytes-copy",
+                    f"{recv}[:] copies the whole payload buffer — "
+                    "slice a memoryview (or pass the buffer itself) "
+                    "instead of duplicating it on the read path")
         self.generic_visit(node)
 
     def _check_submit(self, node: ast.Call) -> None:
