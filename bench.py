@@ -586,6 +586,103 @@ def bench_conn_hold(n_conns: int = 10000, n_probe: int = 200,
     }
 
 
+def bench_lrc_repair(size_mb: int = 32, iters: int = 3) -> dict:
+    """Single-shard repair cost, LRC(10,2,2) vs RS(10,4), on the same
+    payload: bytes read from surviving shards per rebuilt MB, and
+    repair wall time.  The LRC plan reads the 5 surviving group
+    members where RS reads k=10 columns, so the headline ratios are
+    ~0.5x bytes-read-per-rebuilt-MB and ~2x wall.
+
+    Bit-identity is asserted IN-RUN twice: the rebuilt shard against
+    the originally encoded one (both families), and the LRC encode
+    against a pure-Python GF(256) double-loop reference on a sample —
+    a fast-but-wrong coder cannot post a number.
+
+    SEAWEEDFS_TPU_BENCH_LRC_MB overrides the volume size."""
+    import tempfile
+
+    from seaweedfs_tpu.models.coder import make_coder
+    from seaweedfs_tpu.ops import gf256
+    from seaweedfs_tpu.ops.lrc import LrcCoder
+    from seaweedfs_tpu.storage.erasure_coding import encoder as ecenc
+    from seaweedfs_tpu.storage.erasure_coding import layout
+
+    size_mb = int(os.environ.get("SEAWEEDFS_TPU_BENCH_LRC_MB", size_mb))
+    size = size_mb * 1024 * 1024
+    lost_sid = 2  # a group-0 data shard: the LRC headline case
+
+    # in-run reference check: LrcCoder's batched GF matmul encode must
+    # match the O(m*k*n) scalar double loop on a random sample
+    lrc = LrcCoder()
+    k = lrc.scheme.data_shards
+    rng = np.random.default_rng(13)
+    sample = rng.integers(0, 256, size=(k, 256), dtype=np.uint8)
+    fast = lrc.encode_array(sample)
+    gen = lrc._parity
+    for r in range(gen.shape[0]):
+        row = bytearray(sample.shape[1])
+        for c in range(k):
+            coef = int(gen[r, c])
+            for j in range(sample.shape[1]):
+                row[j] ^= gf256.gf_mul(coef, int(sample[c, j]))
+        if bytes(fast[r]) != bytes(row):
+            raise RuntimeError(
+                f"LRC encode diverges from the scalar GF reference "
+                f"at parity row {r}")
+
+    rows = {}
+    with tempfile.TemporaryDirectory() as d:
+        for fam, name in (("rs", "cpu-mt"), ("lrc", "lrc-mt")):
+            coder = make_coder(name)
+            base = os.path.join(d, fam)
+            rng2 = np.random.default_rng(7)
+            with open(base + ".dat", "wb") as f:
+                left = size
+                while left:
+                    n = min(1 << 24, left)
+                    f.write(rng2.integers(0, 256, n,
+                                          dtype=np.uint8).tobytes())
+                    left -= n
+            ecenc.write_ec_files(base, coder)
+            shard_path = base + layout.shard_ext(lost_sid)
+            with open(shard_path, "rb") as f:
+                golden = f.read()
+            walls = []
+            stats: dict = {}
+            for _ in range(iters):
+                os.remove(shard_path)
+                stats = {}
+                t0 = time.perf_counter()
+                ecenc.rebuild_ec_files(base, coder, stats=stats)
+                walls.append(time.perf_counter() - t0)
+                with open(shard_path, "rb") as f:
+                    if f.read() != golden:
+                        raise RuntimeError(
+                            f"{fam} rebuild of shard {lost_sid} is not "
+                            "bit-identical to the encoded shard")
+            read_b = stats.get("read_bytes", 0)
+            rebuilt_b = stats.get("rebuilt_bytes", 0)
+            rows[fam] = {
+                "sources": len(stats.get("sources") or []),
+                "read_mb": round(read_b / 1e6, 2),
+                "read_per_rebuilt_mb": round(read_b / max(1, rebuilt_b),
+                                             3),
+                "wall_s": round(sorted(walls)[len(walls) // 2], 4),
+            }
+    return {
+        "lrc_repair_mb": size_mb,
+        "lrc_repair_lost_sid": lost_sid,
+        "lrc_repair_rs": rows["rs"],
+        "lrc_repair_lrc": rows["lrc"],
+        "lrc_repair_read_ratio": round(
+            rows["lrc"]["read_per_rebuilt_mb"]
+            / rows["rs"]["read_per_rebuilt_mb"], 3),
+        "lrc_repair_wall_speedup": round(
+            rows["rs"]["wall_s"] / max(1e-9, rows["lrc"]["wall_s"]), 2),
+        "lrc_repair_bit_identical": True,  # raises above otherwise
+    }
+
+
 def bench_repair_network(n_files: int = 6) -> dict:
     """Rebuilder network ingress per MiB rebuilt: partial-column chain
     vs legacy copy+rebuild, same spread layout.
@@ -1985,6 +2082,7 @@ def main(argv=None):
     e2e.update(bench_profiler_overhead())  # wall-stack sampler cost
     e2e.update(bench_tenant_flood())  # per-tenant class-rate isolation
     e2e.update(bench_repair_network())  # partial-column repair ingress
+    e2e.update(bench_lrc_repair())  # LRC vs RS single-shard repair cost
     e2e.update(bench_filer_streaming_rss())  # bounded-memory ingest
     e2e.update(bench_read_plane())  # sendfile GETs + volume redirects
     e2e.update(bench_replica_divergence_repair())  # hinted-handoff drill

@@ -435,6 +435,35 @@ class Topology:
     def lookup_ec_shards(self, vid: int) -> Optional[list[list[DataNode]]]:
         return self.ec_shard_map.get(vid)
 
+    def nodes_by_rack(self) -> dict[str, list[DataNode]]:
+        """{'dc/rack': [nodes]} — the failure-domain view that
+        group-aligned EC placement plans against."""
+        out: dict[str, list[DataNode]] = {}
+        for dc in self.data_centers.values():
+            for rack in dc.racks.values():
+                out[f"{dc.id}/{rack.id}"] = list(rack.nodes.values())
+        return out
+
+    def ec_group_alignment(self, vid: int, scheme) -> dict:
+        """Per-local-group rack footprint of an EC volume:
+        {group: sorted racks holding any member shard}. A group whose
+        footprint is ONE rack repairs single-shard losses without
+        crossing rack boundaries."""
+        owners = self.lookup_ec_shards(vid)
+        if owners is None:
+            return {}
+        rack_of: dict[str, str] = {}
+        for rk, nodes in self.nodes_by_rack().items():
+            for n in nodes:
+                rack_of[n.id] = rk
+        out: dict[int, list[str]] = {}
+        for g in range(getattr(scheme, "local_groups", 0)):
+            racks = {rack_of.get(n.id, "") for sid in
+                     scheme.group_members(g) if sid < len(owners)
+                     for n in owners[sid]}
+            out[g] = sorted(r for r in racks if r)
+        return out
+
     def next_volume_id(self) -> int:
         with self.lock:
             self.max_volume_id += 1

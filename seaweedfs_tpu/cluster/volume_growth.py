@@ -111,6 +111,58 @@ def find_empty_slots(topo: Topology, rp: ReplicaPlacement,
     return chosen
 
 
+def find_ec_group_slots(topo: Topology, scheme,
+                        disk: str = "") -> list[DataNode]:
+    """Choose a target node per EC shard 0..total-1 with LRC group
+    alignment: every member of a local group (its data shards + the
+    group's local parity) lands in one rack, each group on a different
+    rack when the topology has enough, and the global parities on racks
+    outside every group's. A group-local repair then never crosses rack
+    boundaries. Raises NoFreeSpaceError when fewer than two racks have
+    free space or a group does not fit its rack — callers fall back to
+    the balanced spread (shell/ec_plan.balanced_ec_distribution)."""
+    def fs(n) -> float:
+        if getattr(n, "draining", False):
+            return 0.0
+        return n.free_space(disk or "")
+
+    by_rack = {rk: [n for n in ns if fs(n) >= 1]
+               for rk, ns in topo.nodes_by_rack().items()}
+    by_rack = {rk: ns for rk, ns in by_rack.items() if ns}
+    racks = sorted(by_rack,
+                   key=lambda rk: -sum(fs(n) for n in by_rack[rk]))
+    if len(racks) < 2:
+        raise NoFreeSpaceError(
+            "group-aligned EC placement needs >= 2 racks with free space")
+    targets: list[Optional[DataNode]] = [None] * scheme.total_shards
+    budget = {n.id: int(fs(n)) for ns in by_rack.values() for n in ns}
+
+    def place(sids: list[int], rack_names: list[str]) -> None:
+        pool = sorted((n for rk in rack_names for n in by_rack[rk]),
+                      key=lambda n: -budget[n.id])
+        i = 0
+        for sid in sids:
+            for _ in range(len(pool)):
+                n = pool[i % len(pool)]
+                i += 1
+                if budget[n.id] > 0:
+                    budget[n.id] -= 1
+                    targets[sid] = n
+                    break
+            else:
+                raise NoFreeSpaceError(
+                    f"no free slot for shard {sid} in racks {rack_names}")
+
+    group_racks: list[str] = []
+    for g in range(scheme.local_groups):
+        rk = racks[g % len(racks)]
+        group_racks.append(rk)
+        place(scheme.group_members(g), [rk])
+    others = [rk for rk in racks if rk not in group_racks] or racks
+    place(scheme.global_parity_ids(), others)
+    return targets
+
+
 # (node, vid, collection, rp, ttl, disk) -> success
 AllocateFn = Callable[[DataNode, int, str, str, str, str], bool]
 

@@ -43,7 +43,9 @@ class RSScheme:
         return f"RS({self.data_shards},{self.parity_shards})"
 
     def __eq__(self, other):
-        return (isinstance(other, RSScheme)
+        # type identity, not isinstance: an LrcScheme with the same
+        # (data, parity) counts is a DIFFERENT code family
+        return (type(other) is type(self)
                 and other.data_shards == self.data_shards
                 and other.parity_shards == self.parity_shards)
 
@@ -52,6 +54,98 @@ class RSScheme:
 
 
 DEFAULT_SCHEME = RSScheme(10, 4)
+
+
+class LrcScheme(RSScheme):
+    """LRC(k, l, g): k data shards split into l local groups, one local
+    (XOR) parity per group, g global RS parities. Shard ids are laid out
+    data-first so the RS plumbing (layout constants, .ecNN extensions,
+    ecx indexes) carries over: [0..k) data, [k..k+l) local parities
+    (group i's parity is shard k+i), [k+l..k+l+g) global parities.
+    Default LRC(10,2,2) keeps total_shards == 14 == RS(10,4)'s."""
+
+    __slots__ = ("local_groups", "global_parities")
+
+    def __init__(self, data_shards: int = 10, local_groups: int = 2,
+                 global_parities: int = 2):
+        if local_groups <= 0 or data_shards % local_groups:
+            raise ValueError(
+                f"LRC: {local_groups} groups must evenly divide "
+                f"{data_shards} data shards")
+        super().__init__(data_shards, local_groups + global_parities)
+        self.local_groups = local_groups
+        self.global_parities = global_parities
+
+    @property
+    def group_size(self) -> int:
+        return self.data_shards // self.local_groups
+
+    def group_of(self, sid: int) -> Optional[int]:
+        """Local group index of a shard id, or None for global parities."""
+        if sid < self.data_shards:
+            return sid // self.group_size
+        if sid < self.data_shards + self.local_groups:
+            return sid - self.data_shards
+        return None
+
+    def group_members(self, g: int) -> list[int]:
+        """Data shard ids + the local parity id of group g."""
+        lo = g * self.group_size
+        return list(range(lo, lo + self.group_size)) + [self.data_shards + g]
+
+    def local_parity_ids(self) -> list[int]:
+        return list(range(self.data_shards,
+                          self.data_shards + self.local_groups))
+
+    def global_parity_ids(self) -> list[int]:
+        return list(range(self.data_shards + self.local_groups,
+                          self.total_shards))
+
+    def __repr__(self):
+        return (f"LRC({self.data_shards},{self.local_groups},"
+                f"{self.global_parities})")
+
+    def __eq__(self, other):
+        return (type(other) is type(self)
+                and other.data_shards == self.data_shards
+                and other.local_groups == self.local_groups
+                and other.global_parities == self.global_parities)
+
+    def __hash__(self):
+        return hash((self.data_shards, self.local_groups,
+                     self.global_parities, "lrc"))
+
+
+def scheme_to_dict(scheme: RSScheme) -> dict:
+    """Serializable CodeSpec for volume metadata (.vif) — lets mixed-code
+    clusters pick the right coder per volume at load time."""
+    if isinstance(scheme, LrcScheme):
+        return {"family": "lrc", "data_shards": scheme.data_shards,
+                "local_groups": scheme.local_groups,
+                "global_parities": scheme.global_parities}
+    return {"family": "rs", "data_shards": scheme.data_shards,
+            "parity_shards": scheme.parity_shards}
+
+
+def scheme_from_dict(d: Optional[dict]) -> RSScheme:
+    """Inverse of scheme_to_dict; None / empty -> the RS default (volumes
+    encoded before CodeSpec persistence are RS(10,4))."""
+    if not d:
+        return DEFAULT_SCHEME
+    if d.get("family") == "lrc":
+        return LrcScheme(int(d.get("data_shards", 10)),
+                         int(d.get("local_groups", 2)),
+                         int(d.get("global_parities", 2)))
+    return RSScheme(int(d.get("data_shards", 10)),
+                    int(d.get("parity_shards", 4)))
+
+
+def coder_name_for_scheme(scheme: RSScheme, fallback: str = "cpu-mt") -> str:
+    """The registry name that matches a scheme's code family; `fallback`
+    names the RS coder to use (its -mt suffix carries over to LRC)."""
+    if isinstance(scheme, LrcScheme):
+        return "lrc-mt" if fallback.endswith("-mt") else "lrc"
+    return fallback
 
 
 class ErasureCoder(abc.ABC):
@@ -119,9 +213,14 @@ def register_coder(name: str):
 def make_coder(name: str = "cpu", scheme: RSScheme = DEFAULT_SCHEME) -> ErasureCoder:
     """Factory: 'cpu' (default, like the reference), 'jax', 'pallas',
     'mxu' (measurement kernel — see ops/rs_mxu.py), 'mesh' (batched
-    multi-device dispatch — see ops/rs_mesh.py)."""
+    multi-device dispatch — see ops/rs_mesh.py), 'lrc' (locally
+    repairable code — see ops/lrc.py)."""
     # import for registration side effects
     from seaweedfs_tpu.ops import rs_cpu  # noqa: F401
+    if name in ("lrc", "lrc-mt"):
+        from seaweedfs_tpu.ops import lrc  # noqa: F401
+        if not isinstance(scheme, LrcScheme):
+            scheme = LrcScheme()
     if name in ("jax", "tpu", "pallas", "mxu"):
         from seaweedfs_tpu.ops import rs_jax  # noqa: F401
     if name == "pallas":

@@ -154,8 +154,18 @@ class EcBatchScheduler:
         return job.future
 
     def submit_encode(self, data: np.ndarray,
-                      cls: Optional[str] = None) -> Future:
-        """(k, n) uint8 -> Future of (m, n) uint8 parity."""
+                      cls: Optional[str] = None,
+                      mat: Optional[np.ndarray] = None) -> Future:
+        """(k, n) uint8 -> Future of (m, n) uint8 parity.  RS parity by
+        default; pass ``mat`` — an (m, k) GF(256) parity matrix, e.g. an
+        LrcCoder's — to encode under another code family.  Matrix-
+        carrying encodes ride the same per-job-matrix path as rebuilds
+        (parity IS mat @ data over GF(256)), so one drain can mix RS and
+        LRC volumes and every future demuxes exactly its own rows."""
+        if mat is not None:
+            return self._submit("rebuild", data,
+                                np.ascontiguousarray(mat, dtype=np.uint8),
+                                cls)
         return self._submit("encode", data, None, cls)
 
     def submit_rebuild(self, srcdata: np.ndarray, rebuild_mat: np.ndarray,
@@ -166,9 +176,9 @@ class EcBatchScheduler:
                             np.ascontiguousarray(rebuild_mat,
                                                  dtype=np.uint8), cls)
 
-    def encode(self, data: np.ndarray, cls: Optional[str] = None
-               ) -> np.ndarray:
-        return self.submit_encode(data, cls).result()
+    def encode(self, data: np.ndarray, cls: Optional[str] = None,
+               mat: Optional[np.ndarray] = None) -> np.ndarray:
+        return self.submit_encode(data, cls, mat).result()
 
     def rebuild(self, srcdata: np.ndarray, rebuild_mat: np.ndarray,
                 cls: Optional[str] = None) -> np.ndarray:
@@ -225,8 +235,20 @@ class EcBatchScheduler:
             for jobs in groups.values():
                 self._run_group(jobs)
 
+    def _mesh_compatible(self, jobs: list) -> bool:
+        # the mesh kernel is traced for (k, <=m)-shaped work; an LRC
+        # group-local rebuild reads fewer than k sources, and that is a
+        # routing decision, not a mesh failure — send it to the CPU
+        # coder without benching the mesh
+        j = jobs[0]  # groups share data.shape by construction
+        if j.data.shape[0] != self.scheme.data_shards:
+            return False
+        return all(jj.mat is None
+                   or jj.mat.shape[0] <= self.scheme.parity_shards
+                   for jj in jobs)
+
     def _run_group(self, jobs: list) -> None:
-        if self._mesh_healthy():
+        if self._mesh_healthy() and self._mesh_compatible(jobs):
             try:
                 self._run_mesh(jobs)
                 self.mesh_batches += 1
@@ -324,26 +346,42 @@ class BatchCoder(ErasureCoder):
     encode_into/reconstruct_rows per block-group exactly as before; the
     facade turns those calls into scheduler submissions, so N concurrent
     volume pipelines coalesce into device-sized mesh batches without
-    knowing about each other."""
+    knowing about each other.
 
-    def __init__(self, scheduler: EcBatchScheduler):
-        super().__init__(scheduler.scheme)
+    Pass a ``scheme`` from a different code family (LrcScheme) to get a
+    facade for that family sharing the SAME scheduler: its encodes and
+    rebuilds carry their own GF matrices, so RS and LRC volumes coalesce
+    into one drain and each future demuxes bit-identical per-job rows."""
+
+    def __init__(self, scheduler: EcBatchScheduler,
+                 scheme: Optional[RSScheme] = None):
+        if scheme is None:
+            scheme = scheduler.scheme
+        super().__init__(scheme)
         self.scheduler = scheduler
-        from seaweedfs_tpu.ops.rs_cpu import CpuCoder
-        self._host = CpuCoder(scheduler.scheme)  # matrix derivation only
+        if scheme == scheduler.scheme:
+            from seaweedfs_tpu.ops.rs_cpu import CpuCoder
+            self._host = CpuCoder(scheme)  # matrix derivation only
+            self._encode_mat = None  # scheduler's native RS parity path
+        else:
+            from seaweedfs_tpu.models.coder import (coder_name_for_scheme,
+                                                    make_coder)
+            self._host = make_coder(coder_name_for_scheme(scheme, "cpu"),
+                                    scheme)
+            self._encode_mat = np.ascontiguousarray(self._host._parity)
 
     def encode_array(self, data: np.ndarray) -> np.ndarray:
-        return self.scheduler.encode(data)
+        return self.scheduler.encode(data, mat=self._encode_mat)
 
     def encode_into(self, data: np.ndarray, out: np.ndarray) -> np.ndarray:
-        out[:] = self.scheduler.encode(data)
+        out[:] = self.scheduler.encode(data, mat=self._encode_mat)
         return out
 
     def encode(self, shards: Sequence[bytes]) -> list[bytes]:
         k = self.scheme.data_shards
         data = np.stack([np.frombuffer(bytes(shards[i]), dtype=np.uint8)
                          for i in range(k)])
-        parity = self.scheduler.encode(data)
+        parity = self.scheduler.encode(data, mat=self._encode_mat)
         return [bytes(shards[i]) for i in range(k)] + \
             [parity[i].tobytes() for i in range(self.scheme.parity_shards)]
 
@@ -360,18 +398,27 @@ class BatchCoder(ErasureCoder):
             return out
         return rec
 
+    def _rebuild_plan(self, present: Sequence[int], missing: Sequence[int]
+                      ) -> tuple[list[int], np.ndarray]:
+        # a plan-capable host (LRC) chooses its own source subset — the
+        # first k of sorted(present) can be rank-deficient for it
+        if hasattr(self._host, "plan_rebuild"):
+            return self._host.plan_rebuild(present, missing)
+        return (sorted(present)[:self.scheme.data_shards],
+                self.rebuild_matrix(present, missing))
+
     def reconstruct(self, shards: Sequence[Optional[bytes]]) -> list[bytes]:
         k, total = self.scheme.data_shards, self.scheme.total_shards
         present = [i for i in range(total) if shards[i] is not None]
-        if len(present) < k:
+        if len(present) < k and not hasattr(self._host, "plan_rebuild"):
             raise ValueError(f"too few shards: {len(present)} < {k}")
         missing = [i for i in range(total) if shards[i] is None]
         if not missing:
             return [bytes(s) for s in shards]
+        src_sids, mat = self._rebuild_plan(present, missing)
         src = np.stack([np.frombuffer(bytes(shards[i]), dtype=np.uint8)
-                        for i in sorted(present)[:k]])
-        rec = self.scheduler.rebuild(
-            src, self.rebuild_matrix(present, missing))
+                        for i in src_sids])
+        rec = self.scheduler.rebuild(src, mat)
         out = [bytes(s) if s is not None else None for s in shards]
         for r, i in enumerate(missing):
             out[i] = rec[r].tobytes()
@@ -381,16 +428,16 @@ class BatchCoder(ErasureCoder):
                          ) -> list[Optional[bytes]]:
         k, total = self.scheme.data_shards, self.scheme.total_shards
         present = [i for i in range(total) if shards[i] is not None]
-        if len(present) < k:
+        if len(present) < k and not hasattr(self._host, "plan_rebuild"):
             raise ValueError(f"too few shards: {len(present)} < {k}")
         missing_data = [i for i in range(k) if shards[i] is None]
         out = [bytes(s) if s is not None else None for s in shards]
         if not missing_data:
             return out
+        src_sids, mat = self._rebuild_plan(present, missing_data)
         src = np.stack([np.frombuffer(bytes(shards[i]), dtype=np.uint8)
-                        for i in sorted(present)[:k]])
-        rec = self.scheduler.rebuild(
-            src, self.rebuild_matrix(present, missing_data))
+                        for i in src_sids])
+        rec = self.scheduler.rebuild(src, mat)
         for r, i in enumerate(missing_data):
             out[i] = rec[r].tobytes()
         return out

@@ -334,14 +334,16 @@ def pipelined_rebuild_files(base_file_name: str,
     missing = [i for i in range(total) if i not in present]
     if not missing:
         return []
-    if len(present) < k:
+    if len(present) < k and not hasattr(coder, "plan_rebuild"):
         raise ValueError(f"need {k} shards, have {len(present)}")
 
     if not hasattr(coder, "rebuild_matrix"):
         from seaweedfs_tpu.ops.rs_cpu import CpuCoder
         coder = CpuCoder(coder.scheme, workers="auto")
-    rmat = coder.rebuild_matrix(present, missing)
-    src = sorted(present)[:k]
+    from seaweedfs_tpu.storage.erasure_coding.encoder import \
+        plan_rebuild_sources
+    src, rmat = plan_rebuild_sources(coder, present, missing)
+    n_src = len(src)
 
     shard_size = os.path.getsize(base_file_name + layout.shard_ext(src[0]))
     offs = list(range(0, shard_size, batch_size))
@@ -361,7 +363,7 @@ def pipelined_rebuild_files(base_file_name: str,
             for off in offs:
                 n = min(batch_size, shard_size - off)
                 t0 = clockctl.monotonic()
-                buf = data_pool.get((k, n))
+                buf = data_pool.get((n_src, n))
                 for r, f in enumerate(ins):
                     f.seek(off)
                     got = f.readinto(memoryview(buf[r]))
@@ -411,7 +413,11 @@ def pipelined_rebuild_files(base_file_name: str,
         pl.join()
         _merge_stats(stats, slock, encode_s=busy,
                      wall_s=clockctl.monotonic() - wall0,
-                     bytes_in=shard_size * k, batches=len(offs))
+                     bytes_in=shard_size * n_src, batches=len(offs),
+                     rebuilt_bytes=shard_size * len(missing))
+        if stats is not None:
+            with slock:
+                stats["sources"] = list(src)
         outs.commit()
     except _Aborted:
         _unwind(pl, outs)

@@ -130,6 +130,12 @@ class RepairQueue:
         # rebuilt, for the most recent repair (partial: ~1 shard-width
         # per lost shard ≈ 1.0; legacy copy+rebuild: ≈ k/missing)
         self.last_repair_network_bytes_per_mb = 0.0
+        # repair-strategy planner bookkeeping: the planner consults the
+        # rebuilder's CodeSpec and, for plan-capable families (LRC),
+        # narrows the source fan-out to the cheapest repair ("local" =
+        # surviving group members only, "global" = full-width decode)
+        self.last_strategy = ""
+        self.strategy_counts: dict[str, int] = {}
         self.last_lag_s = 0.0
         self.scrub_reports = 0
         self.recent_needle_reports: list[dict] = []
@@ -409,7 +415,10 @@ class RepairQueue:
         missing = sorted(set(range(layout.TOTAL_SHARDS_COUNT)) - present)
         if not missing:
             return 0  # healed while queued (e.g. by an operator)
-        if len(present) < layout.DATA_SHARDS_COUNT:
+        if len(present) < layout.DATA_SHARDS_COUNT \
+                and not self.partial_repair:
+            # the partial path may still repair an LRC group loss from
+            # fewer than k survivors; legacy copy+rebuild cannot
             raise RuntimeError(
                 f"vol {vid}: only {len(present)} shards survive, "
                 f"need {layout.DATA_SHARDS_COUNT}")
@@ -446,6 +455,10 @@ class RepairQueue:
 
         # 4b. legacy choreography: stage every needed shard, then
         # rebuild locally
+        if len(present) < layout.DATA_SHARDS_COUNT:
+            raise RuntimeError(
+                f"vol {vid}: only {len(present)} shards survive, "
+                f"need {layout.DATA_SHARDS_COUNT}")
         moved = 0
         for sid in need:
             src = self._pick_source(shard_owners[sid])
@@ -474,10 +487,51 @@ class RepairQueue:
         self._node_post(rebuilder_url, "/admin/ec/mount",
                         {"volume_id": vid, "collection": collection,
                          "shard_ids": rebuilt})
+        self._note_strategy(resp.get("strategy", "global"))
         self._note_network_cost(moved, shard_size, len(rebuilt))
         moved += shard_size * len(rebuilt)
         self.bandwidth.consume(shard_size * len(rebuilt), self._stop)
         return moved
+
+    def _shard_stat(self, vid: int, collection: str, url: str) -> dict:
+        with class_scope(BACKGROUND):
+            resp = http_json(
+                "GET",
+                f"http://{url}/admin/ec/shard_stat?volumeId={vid}"
+                f"&collection={collection}", timeout=10)
+        return resp if isinstance(resp, dict) else {}
+
+    def _plan_sources(self, vid: int, collection: str, present: set,
+                      missing: list, rebuilder_url: str):
+        """Pick the cheapest repair for this failure pattern. Reads the
+        volume's CodeSpec off the rebuilder's shard_stat; plan-capable
+        families (LRC) narrow the source set — a single lost group
+        shard repairs from its ~k/l surviving group members instead of
+        fanning the reduction chain across k holders. Returns
+        (source_sids | None, strategy); None = use every survivor."""
+        try:
+            from seaweedfs_tpu.models.coder import (coder_name_for_scheme,
+                                                    make_coder,
+                                                    scheme_from_dict)
+            spec = self._shard_stat(vid, collection, rebuilder_url)
+            scheme = scheme_from_dict(spec.get("code"))
+            coder = make_coder(coder_name_for_scheme(scheme), scheme)
+            if not hasattr(coder, "plan_rebuild"):
+                return None, "global"
+            src, _mat = coder.plan_rebuild(sorted(present), sorted(missing))
+            strategy = "local" if len(src) < scheme.data_shards \
+                else "global"
+            return set(src), strategy
+        except Exception as e:
+            glog.vlog(1, "ec repair vol %d: source planning skipped (%s)",
+                      vid, e)
+            return None, "global"
+
+    def _note_strategy(self, strategy: str) -> None:
+        with self._lock:
+            self.last_strategy = strategy
+            self.strategy_counts[strategy] = \
+                self.strategy_counts.get(strategy, 0) + 1
 
     def _repair_partial(self, vid: int, collection: str,
                         shard_owners: dict, present: set,
@@ -486,8 +540,12 @@ class RepairQueue:
         mount. Returns bytes accounted (network received + rebuilt
         shard bytes, mirroring the legacy accounting). Raises on any
         failure — the caller falls back to copy+rebuild."""
+        plan_sids, planned = self._plan_sources(
+            vid, collection, present, missing, rebuilder_url)
         sources = {}
         for sid in sorted(present):
+            if plan_sids is not None and sid not in plan_sids:
+                continue
             urls = [n.url for n in shard_owners[sid]
                     if n.url != rebuilder_url]
             if urls:
@@ -510,6 +568,7 @@ class RepairQueue:
                          "shard_ids": rebuilt})
         with self._lock:
             self.partial_repairs += 1
+        self._note_strategy(resp.get("strategy") or planned)
         if resp.get("fallbacks"):
             glog.info("ec repair vol %d: partial rebuild degraded "
                       "mid-chain (%s)", vid, resp["fallbacks"])
@@ -598,6 +657,8 @@ class RepairQueue:
                 "partial_enabled": self.partial_repair,
                 "partial_repairs": self.partial_repairs,
                 "partial_fallbacks": self.partial_fallbacks,
+                "last_strategy": self.last_strategy,
+                "strategy_counts": dict(self.strategy_counts),
                 "last_repair_network_bytes_per_mb":
                     self.last_repair_network_bytes_per_mb,
                 "last_lag_s": round(self.last_lag_s, 3),

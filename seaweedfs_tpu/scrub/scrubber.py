@@ -231,13 +231,17 @@ class Scrubber:
 
     # ---- EC shard parity re-check ----
     def scrub_ec_volume(self, ev, directory: str, cursors: dict) -> dict:
-        coder = self.store.coder
+        # per-volume coder: an LRC volume's parity rows (group-masked
+        # locals + globals) come from its own generator, so RS and LRC
+        # volumes on one store each scrub against the right code
+        coder = self.store.coder_for(ev)
         k = coder.scheme.data_shards
         total = coder.scheme.total_shards
         shard_size = ev.shard_size()
         vid = ev.volume_id
         rep = {"volume_id": vid, "collection": ev.collection, "ec": True,
-               "bytes": 0, "corruptions": [], "size": shard_size * total}
+               "bytes": 0, "corruptions": [], "size": shard_size * total,
+               "code": type(coder.scheme).__name__}
         present = sorted(ev.shards)
         missing_data = [i for i in range(k) if i not in ev.shards]
         remote_reader = getattr(self.store, "remote_partial_reader", None)
@@ -399,10 +403,18 @@ class Scrubber:
             gf_partial_product(mat_local, data, out=expected)
         coeff_by_sid = {i: [int(pmat[j - k][i]) for j in parity_present]
                         for i in missing_data}
-        remote = remote_reader(vid, coeff_by_sid, offset, length, n_rows)
-        if remote is None:
-            raise RuntimeError("remote partial unavailable")
-        expected ^= remote
+        # group-local verification: an LRC local parity's coefficient
+        # row is zero outside its own group, so absent columns that
+        # contribute nothing to every checked parity are dropped — and
+        # when none remain (only this group's parity is being checked)
+        # the scrub completes with NO remote pull at all
+        coeff_by_sid = {i: c for i, c in coeff_by_sid.items() if any(c)}
+        if coeff_by_sid:
+            remote = remote_reader(vid, coeff_by_sid, offset, length,
+                                   n_rows)
+            if remote is None:
+                raise RuntimeError("remote partial unavailable")
+            expected ^= remote
         mism = [j for idx, j in enumerate(parity_present)
                 if expected[idx].tobytes() != rows[j]]
         return None if not mism else []

@@ -23,7 +23,8 @@ from typing import Optional
 
 import numpy as np
 
-from seaweedfs_tpu.models.coder import ErasureCoder
+from seaweedfs_tpu.models.coder import (ErasureCoder, scheme_from_dict,
+                                        scheme_to_dict)
 from seaweedfs_tpu.ops.rs_cpu import gf_partial_product
 from seaweedfs_tpu.qos import (BACKGROUND, WRITE, QosGovernor, class_scope,
                                classify, current_class, from_headers)
@@ -267,6 +268,10 @@ class VolumeServer:
             "selector-core connection counters", ("stat",))
         self.metrics.on_expose(self._refresh_gauges)
         self.peer_health = PeerHealth(metrics=self.metrics)
+        # per-volume record of the last repair strategy this server
+        # executed ({vid: {"strategy", "sources", "mode"}}), surfaced
+        # via /admin/ec/shard_stat for the shell's ec.scheme.status
+        self._ec_last_strategy: dict[int, dict] = {}
         # admission control: class-weighted slots under an adaptive
         # concurrency limit; shed requests get 503 + Retry-After at the
         # socket edge, before their body is buffered
@@ -1920,15 +1925,27 @@ class VolumeServer:
     def _ec_generate(self, req: Request) -> Response:
         b = req.json()
         base = self.store.generate_ec_shards(
-            b["volume_id"], pipelined=b.get("pipelined", True))
+            b["volume_id"], pipelined=b.get("pipelined", True),
+            code=b.get("code", ""))
         return Response({"base": os.path.basename(base)})
+
+    def _ec_volume_coder(self, base: str) -> ErasureCoder:
+        """The coder for the volume at `base`, per its .vif CodeSpec
+        (store default when absent — legacy volumes are RS(10,4))."""
+        from seaweedfs_tpu.storage.erasure_coding.ec_volume import \
+            read_volume_info
+        return self.store.coder_for_scheme(
+            scheme_from_dict(read_volume_info(base).get("code")))
 
     def _ec_rebuild(self, req: Request) -> Response:
         b = req.json()
         vid = b["volume_id"]
         base = self._ec_base_name(vid, b.get("collection", ""))
-        rebuilt = ecenc.rebuild_ec_files(base, self.store.coder,
-                                         pipelined=b.get("pipelined", True))
+        coder = self._ec_volume_coder(base)
+        stats: dict = {}
+        rebuilt = ecenc.rebuild_ec_files(base, coder,
+                                         pipelined=b.get("pipelined", True),
+                                         stats=stats)
         ecenc.rebuild_ecx_file(base)
         # shard_size lets the caller (the master's repair queue) account
         # the bytes this repair moved over the wire
@@ -1938,8 +1955,27 @@ class VolumeServer:
             if os.path.exists(p):
                 shard_size = os.path.getsize(p)
                 break
+        sources = stats.get("sources") or []
+        strategy = self._record_strategy(vid, coder, sources, "full")
         return Response({"rebuilt_shard_ids": rebuilt,
-                         "shard_size": shard_size})
+                         "shard_size": shard_size,
+                         "read_bytes": stats.get(
+                             "read_bytes", stats.get("bytes_in", 0)),
+                         "sources": list(sources),
+                         "strategy": strategy})
+
+    def _record_strategy(self, vid: int, coder: ErasureCoder,
+                         sources: list, mode: str) -> str:
+        """Classify + remember the repair strategy a rebuild used:
+        'local' when the planned source set is narrower than k (an LRC
+        group repair), 'global' otherwise."""
+        k = coder.scheme.data_shards
+        plan_capable = hasattr(coder, "plan_rebuild")
+        strategy = "local" if plan_capable and sources \
+            and len(sources) < k else "global"
+        self._ec_last_strategy[vid] = {
+            "strategy": strategy, "sources": list(sources), "mode": mode}
+        return strategy
 
     def _ec_base_name(self, vid: int, collection: str = "") -> str:
         name = f"{collection}_{vid}" if collection else str(vid)
@@ -2085,8 +2121,17 @@ class VolumeServer:
                 sizes[i] = os.path.getsize(p)
         if not sizes:
             return Response({"error": "no shards"}, status=404)
-        return Response({"volume_id": vid, "shards": sorted(sizes),
-                         "shard_size": max(sizes.values())})
+        from seaweedfs_tpu.storage.erasure_coding.ec_volume import \
+            read_volume_info
+        out = {"volume_id": vid, "shards": sorted(sizes),
+               "shard_size": max(sizes.values()),
+               "code": scheme_to_dict(scheme_from_dict(
+                   read_volume_info(base).get("code"))),
+               "recover_stats": dict(self.store.ec_recover_stats)}
+        last = self._ec_last_strategy.get(vid)
+        if last:
+            out["last_repair"] = last
+        return Response(out)
 
     # ---- partial-column repair (storage/erasure_coding/partial.py) ----
     def _ec_partial_read(self, req: Request) -> Response:
@@ -2325,19 +2370,38 @@ class VolumeServer:
         batch = int(b.get("batch_size", 0)) or ecenc.DEFAULT_BATCH_SIZE
         if not missing:
             return Response({"error": "nothing to rebuild"}, status=400)
-        coder = self.store.coder
-        k = coder.scheme.data_shards
-        total = coder.scheme.total_shards
         base = self._ec_base_name(vid, collection)
-        local = [i for i in range(total)
+        local = [i for i in range(layout.TOTAL_SHARDS_COUNT)
                  if os.path.exists(base + layout.shard_ext(i))]
         present = sorted((set(local) | set(sources)) - set(missing))
-        if len(present) < k:
+        received = 0
+        # aux files first: the .vif names the volume's code family, and
+        # the per-volume coder below plans the source set from it
+        try:
+            received += self._ensure_ec_aux_files(
+                vid, collection, base, sources)
+        except RuntimeError as e:
+            return Response({"error": str(e)}, status=502)
+        coder = self._ec_volume_coder(base)
+        k = coder.scheme.data_shards
+        plan_capable = hasattr(coder, "plan_rebuild")
+        # a plan-capable (LRC) coder can repair a group loss from fewer
+        # than k survivors; only the generic path needs the k floor
+        if not plan_capable and len(present) < k:
             return Response(
                 {"error": f"only {len(present)} shards known, need {k}"},
                 status=409)
-        src_sids = present[:k]
-        received = 0
+        if not (plan_capable or hasattr(coder, "rebuild_matrix")):
+            from seaweedfs_tpu.ops.rs_cpu import CpuCoder
+            coder = CpuCoder(coder.scheme)
+        try:
+            src_sids, mat = ecenc.plan_rebuild_sources(
+                coder, present, missing)
+        except (ValueError, np.linalg.LinAlgError) as e:
+            return Response(
+                {"error": f"unrecoverable from {present}: {e}"},
+                status=409)
+        src_sids = list(src_sids)
         shard_size = 0
         for s in src_sids:
             if s in local:
@@ -2348,15 +2412,6 @@ class VolumeServer:
         if not shard_size:
             return Response({"error": "cannot determine shard size"},
                             status=409)
-        try:
-            received += self._ensure_ec_aux_files(
-                vid, collection, base, sources)
-        except RuntimeError as e:
-            return Response({"error": str(e)}, status=502)
-        if not hasattr(coder, "rebuild_matrix"):
-            from seaweedfs_tpu.ops.rs_cpu import CpuCoder
-            coder = CpuCoder(coder.scheme)
-        mat = coder.rebuild_matrix(present, missing)
         workers = int(getattr(self.store.coder, "workers", 1) or 1)
         miss_n = len(missing)
         fallbacks: list[str] = []
@@ -2437,13 +2492,18 @@ class VolumeServer:
         ecenc.rebuild_ecx_file(base)
         self._m_req.inc("ec_rebuild_partial")
         mb = shard_size * miss_n / (1024.0 * 1024.0)
+        mode = "partial+fallback" if fallbacks else "partial"
+        strategy = self._record_strategy(vid, coder, src_sids, mode)
         return Response({
             "rebuilt_shard_ids": missing, "shard_size": shard_size,
             "network_bytes": received,
             "repair_network_bytes_per_mb":
                 round(received / mb, 1) if mb else 0.0,
             "fallbacks": fallbacks,
-            "mode": "partial+fallback" if fallbacks else "partial"})
+            "strategy": strategy,
+            "sources": src_sids,
+            "code": scheme_to_dict(coder.scheme).get("family", "rs"),
+            "mode": mode})
 
     def _remote_shard_stat(self, vid: int, collection: str,
                            sources: dict) -> int:

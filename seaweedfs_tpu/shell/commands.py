@@ -608,20 +608,24 @@ class ShellContext:
     # ---- ec.encode (reference command_ec_encode.go doEcEncode) ----
     def ec_encode(self, vid: Optional[int] = None, collection: str = "",
                   delete_source: bool = True,
-                  pipelined: bool = True) -> list[dict]:
+                  pipelined: bool = True, code: str = "") -> list[dict]:
         topo = self.topology()
         vids = [vid] if vid is not None else \
             ec_plan.collect_volume_ids_for_ec_encode(topo, collection)
         results = []
         for v in vids:
             results.append(self._ec_encode_one(topo, v, delete_source,
-                                               pipelined))
+                                               pipelined, code))
             topo = self.topology()  # refresh between volumes
         return results
 
     def _ec_encode_one(self, topo: dict, vid: int, delete_source: bool,
-                       pipelined: bool = True) -> dict:
-        plan = ec_plan.plan_ec_encode(topo, vid)
+                       pipelined: bool = True, code: str = "") -> dict:
+        scheme = None
+        if code.startswith("lrc"):
+            from seaweedfs_tpu.models.coder import LrcScheme
+            scheme = LrcScheme()
+        plan = ec_plan.plan_ec_encode(topo, vid, scheme=scheme)
         source = plan["source"]
         collection = ""
         for dc in topo.get("data_centers", []):
@@ -640,7 +644,7 @@ class ShellContext:
         # comparator / minimal path); default overlaps I/O with compute
         self._vs(source, "/admin/ec/generate",
                  {"volume_id": vid, "collection": collection,
-                  "pipelined": pipelined})
+                  "pipelined": pipelined, "code": code})
         # 3. spread: copy to targets, mount
         by_target: dict[str, list[int]] = defaultdict(list)
         for mv in plan["moves"]:
@@ -668,6 +672,8 @@ class ShellContext:
                 self._vs(replica, "/admin/delete_volume",
                          {"volume_id": vid})
         return {"vid": vid, "source": source,
+                "code": code or "rs",
+                "rack_aligned": plan.get("rack_aligned", False),
                 "placement": {t: sorted(s) for t, s in by_target.items()}}
 
     # ---- ec.rebuild (reference command_ec_rebuild.go) ----
@@ -722,6 +728,64 @@ class ShellContext:
                 res = {"error": str(e)}
             out.append({"node": nd, **res})
         return out
+
+    def ec_scheme_status(self, vid: Optional[int] = None) -> dict:
+        """Per-EC-volume code-family report: the CodeSpec each holder
+        persisted in its .vif, shard spread, LRC group rack alignment,
+        the last repair strategy the rebuilder executed, and the
+        master planner's strategy tallies."""
+        topo = self.topology()
+        owners: dict[int, dict[int, list[str]]] = defaultdict(
+            lambda: defaultdict(list))
+        rack_of: dict[str, str] = {}
+        for dc in topo.get("data_centers", []):
+            for rack in dc.get("racks", []):
+                for n in rack.get("nodes", []):
+                    rack_of[n["id"]] = \
+                        f"{dc.get('id', '')}/{rack.get('id', '')}"
+                    for e in n.get("ec_shards", []):
+                        bits = e["ec_index_bits"]
+                        for sid in range(layout.TOTAL_SHARDS_COUNT):
+                            if bits & (1 << sid):
+                                owners[e["id"]][sid].append(n["id"])
+        try:
+            repair = self.ec_repair_status()
+        except Exception:
+            repair = {}
+        volumes = []
+        for v, shard_map in sorted(owners.items()):
+            if vid is not None and v != vid:
+                continue
+            holder = next(iter(sorted(shard_map.values())))[0]
+            try:
+                stat = http_json(
+                    "GET",
+                    f"http://{holder}/admin/ec/shard_stat?volumeId={v}")
+            except Exception as e:
+                stat = {"error": str(e)}
+            code = stat.get("code") or {}
+            entry = {"vid": v, "code": code,
+                     "shards_present": sorted(shard_map),
+                     "last_repair": stat.get("last_repair"),
+                     "recover_stats": stat.get("recover_stats")}
+            if code.get("family") == "lrc":
+                from seaweedfs_tpu.models.coder import scheme_from_dict
+                scheme = scheme_from_dict(code)
+                groups = {}
+                for g in range(scheme.local_groups):
+                    racks = sorted(
+                        {rack_of.get(u, "")
+                         for sid in scheme.group_members(g)
+                         for u in shard_map.get(sid, [])} - {""})
+                    groups[g] = {"racks": racks,
+                                 "aligned": len(racks) <= 1}
+                entry["groups"] = groups
+            volumes.append(entry)
+        return {"volumes": volumes,
+                "planner": {
+                    "last_strategy": repair.get("last_strategy", ""),
+                    "strategy_counts": repair.get("strategy_counts", {}),
+                    "partial_repairs": repair.get("partial_repairs", 0)}}
 
     def ec_repair_status(self) -> dict:
         return http_json(

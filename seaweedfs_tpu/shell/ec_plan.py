@@ -127,9 +127,65 @@ def balanced_ec_distribution(nodes: list[EcNode],
     return picked
 
 
+def grouped_ec_distribution(nodes: list[EcNode],
+                            scheme) -> Optional[list[str]]:
+    """Rack-aligned placement for LRC: every member of a local group
+    (its data shards + the group's local parity) lands in ONE rack, so
+    a single-shard repair — which reads only surviving group members —
+    never crosses rack boundaries; each group takes its own rack and
+    the global parities go to racks outside every group (independent
+    failure domains) when the topology has them. Returns the target
+    node id per shard 0..total-1, or None when the topology cannot
+    align (fewer than two racks with slots, or a group does not fit) —
+    callers fall back to balanced_ec_distribution."""
+    by_rack: dict[str, list[EcNode]] = defaultdict(list)
+    for n in nodes:
+        # a rack-less node is its own failure domain
+        by_rack[n.rack or n.node_id].append(n)
+    free = {n.node_id: max(0, n.free_ec_slots) for n in nodes}
+    racks = sorted(by_rack, key=lambda r: -sum(free[n.node_id]
+                                               for n in by_rack[r]))
+    if len(racks) < 2:
+        return None
+    targets: list[Optional[str]] = [None] * scheme.total_shards
+
+    def place(sids: list[int], rack_names: list[str]) -> bool:
+        pool = sorted((n for r in rack_names for n in by_rack[r]),
+                      key=lambda n: -free[n.node_id])
+        i = 0
+        for sid in sids:
+            for _ in range(len(pool) or 1):
+                if not pool:
+                    return False
+                n = pool[i % len(pool)]
+                i += 1
+                if free[n.node_id] > 0:
+                    free[n.node_id] -= 1
+                    targets[sid] = n.node_id
+                    break
+            else:
+                return False
+        return True
+
+    group_racks: list[str] = []
+    for g in range(scheme.local_groups):
+        rack = racks[g % len(racks)]
+        group_racks.append(rack)
+        if not place(scheme.group_members(g), [rack]):
+            return None
+    others = [r for r in racks if r not in group_racks] or racks
+    if not place(scheme.global_parity_ids(), others):
+        return None
+    return targets
+
+
 def plan_ec_encode(topology: dict, vid: int,
-                   source_node: Optional[str] = None) -> dict:
-    """Plan: where the volume lives, and where each generated shard goes."""
+                   source_node: Optional[str] = None,
+                   scheme=None) -> dict:
+    """Plan: where the volume lives, and where each generated shard
+    goes. An LRC `scheme` asks for rack-aligned local groups first
+    (grouped_ec_distribution), falling back to the balanced round-robin
+    when the topology cannot align."""
     replicas = []
     for dc in topology.get("data_centers", []):
         for rack in dc.get("racks", []):
@@ -141,11 +197,16 @@ def plan_ec_encode(topology: dict, vid: int,
         raise LookupError(f"volume {vid} not found in topology")
     source = source_node or replicas[0]
     nodes = collect_ec_nodes(topology)
-    targets = balanced_ec_distribution(nodes)
+    targets = None
+    if scheme is not None and getattr(scheme, "local_groups", 0):
+        targets = grouped_ec_distribution(nodes, scheme)
+    rack_aligned = targets is not None
+    if targets is None:
+        targets = balanced_ec_distribution(nodes)
     moves = [ShardMove(vid, sid, source, target)
              for sid, target in enumerate(targets)]
     return {"vid": vid, "source": source, "replicas": replicas,
-            "moves": moves}
+            "moves": moves, "rack_aligned": rack_aligned}
 
 
 def plan_ec_rebuild(topology: dict) -> list[dict]:

@@ -49,10 +49,12 @@ HELP = """commands:
   s3.circuitbreaker [-bucket B] [-read N] [-write N] [-disable]
   mount.configure -collectionCapacity BYTES   statfs quota on live mounts
   fs.meta.cat <path>                one entry's raw metadata
-  ec.encode [-volumeId N] [-collection C]
+  ec.encode [-volumeId N] [-collection C] [-code rs|lrc]
   ec.rebuild [-n]
   ec.balance [-n]
   ec.decode -volumeId N
+  ec.scheme.status [-volumeId N]    per-volume code family (RS/LRC), group
+                                    rack alignment, last repair strategy
   ec.repair.status                  master repair queue depth/lag/backoffs
   ec.repair.kick                    clear backoffs, dispatch queued repairs
   cluster.health                    per-peer circuit breakers, scrub state,
@@ -606,7 +608,11 @@ def run_command(sh: ShellContext, line: str):
         return sh.volume_vacuum(thr)
     if cmd == "ec.encode":
         vid = int(flags["volumeId"]) if "volumeId" in flags else None
-        return sh.ec_encode(vid=vid, collection=flags.get("collection", ""))
+        return sh.ec_encode(vid=vid, collection=flags.get("collection", ""),
+                            code=flags.get("code", ""))
+    if cmd == "ec.scheme.status":
+        vid = int(flags["volumeId"]) if "volumeId" in flags else None
+        return sh.ec_scheme_status(vid=vid)
     if cmd == "ec.rebuild":
         return sh.ec_rebuild(apply=apply)
     if cmd == "ec.balance":

@@ -1,6 +1,6 @@
 """Scripted incident library + machine-checked invariants.
 
-Five incidents, each a pure function of (seed, n_actors):
+Six incidents, each a pure function of (seed, n_actors):
 
   az_loss          grey-failure prelude (scripted latency band on every
                    link), then correlated crash of one whole AZ; the
@@ -28,6 +28,15 @@ Five incidents, each a pure function of (seed, n_actors):
                    the victims, settle the half-finished wave, lose no
                    acked write, and re-close every breaker — the sim
                    rehearsal of the hinted-handoff divergence drill.
+  ec_single_shard_loss
+                   ONE shard holder dies under live traffic — the LRC
+                   repair drill.  Hybrid incident: the sim cluster must
+                   repair the lost holder with ZERO failed client ops
+                   (degraded reads fail over, never fail), while the
+                   code-level checks drive the real LrcCoder over every
+                   single-shard erasure — group shards must plan
+                   group-LOCAL repairs and the read cost must stay
+                   <= 0.6x the RS(10,4) baseline of k=10 columns.
 
 ``run_incident`` returns a JSON-able report: per-invariant verdicts,
 client/repair metrics, the event-log hash (bit-reproducibility), and
@@ -377,12 +386,97 @@ def _partition_heal_mid_repair(cluster: SimCluster, n_actors: int,
     return checks
 
 
+def _ec_single_shard_loss(cluster: SimCluster, n_actors: int,
+                          rate: float) -> list:
+    """Single-shard-loss repair drill, the LRC headline case.  The
+    macro sim models whole volume holders (not individual EC shard
+    files), so the incident is a hybrid: the cluster loses ONE holder
+    under live traffic — the single-shard-loss analogue — and must
+    repair it with zero failed client operations, degraded reads
+    failing over rather than failing.  The code-level invariants then
+    run the REAL LrcCoder over every single-shard erasure pattern: the
+    planner must choose the group-local strategy for every shard that
+    lives in a local group (data 0-9 + local parities 10-11), the mean
+    plan read cost across all 14 losses must stay <= 0.6x the RS(10,4)
+    baseline of k=10 columns, and a plan-driven rebuild must be
+    bit-identical to the lost shard."""
+    import numpy as np
+
+    from seaweedfs_tpu.ops.lrc import LrcCoder
+
+    duration, t_fault = 35.0, 10.0
+    wl = ZipfWorkload(default_tenants(4, rate), seed=cluster.kernel.seed)
+    cluster.load(wl.generate(duration))
+    victim = f"vol-{5 % n_actors}"
+    cluster.at(t_fault, cluster.crash, victim)
+    cluster.run(duration)
+    degraded = sum(1 for vid, holders in cluster.master.layout.items()
+                   if any(cluster.actor(h).crashed for h in holders))
+    _settle(cluster, wl, duration, 30.0)
+    cluster.run_until_converged(duration + 90.0)
+    cluster.run(cluster.kernel.now + 8.0)
+    checks: list = []
+    _common_invariants(cluster, checks)
+    checks.append(_check(
+        "zero_failed_degraded_reads", cluster.metrics.fail_total == 0,
+        f"{cluster.metrics.fail_total} failed ops mid-repair "
+        f"(samples: {cluster.metrics.fail_samples[:3]})"
+        if cluster.metrics.fail_total else
+        f"all {cluster.metrics.ops_total()} ops succeeded while "
+        f"{victim} was down"))
+    _tenant_invariant(cluster, checks)
+    _convergence_invariant(cluster, checks, t_fault, degraded)
+    _breaker_invariant(cluster, checks)
+
+    # ---- code-level repair-plan invariants (real LrcCoder) ----
+    coder = LrcCoder()
+    spec = coder.scheme
+    total, k = spec.total_shards, spec.data_shards
+    group_sids: set = set()
+    for g in range(spec.local_groups):
+        group_sids.update(spec.group_members(g))
+    strategies, reads = {}, []
+    for sid in range(total):
+        st = coder.repair_strategy(
+            [s for s in range(total) if s != sid], [sid])
+        strategies[sid] = st["strategy"]
+        reads.append(st["reads"])
+    bad = [s for s in sorted(group_sids) if strategies[s] != "local"]
+    checks.append(_check(
+        "lrc_local_strategy_for_group_shards", not bad,
+        f"group shards planned globally: {bad}" if bad else
+        f"all {len(group_sids)} group shards plan group-local repairs "
+        f"({spec.group_size} reads each)"))
+    mean_reads = sum(reads) / len(reads)
+    ratio = mean_reads / k
+    checks.append(_check(
+        "lrc_read_cost_vs_rs", ratio <= 0.6,
+        f"mean plan reads {mean_reads:.2f} cols vs RS baseline {k} "
+        f"-> ratio {ratio:.3f} (ceiling 0.6)"))
+    rng = np.random.default_rng(cluster.kernel.seed)
+    data = rng.integers(0, 256, size=(k, 512), dtype=np.uint8)
+    shards = coder.encode([data[i].tobytes() for i in range(k)])
+    sid = 5 % total
+    src, mat = coder.plan_rebuild(
+        [s for s in range(total) if s != sid], [sid])
+    rec = coder.reconstruct_rows(
+        np.stack([np.frombuffer(shards[s], dtype=np.uint8)
+                  for s in src]), mat)
+    checks.append(_check(
+        "lrc_repair_bit_identical",
+        rec[0].tobytes() == bytes(shards[sid]),
+        f"shard {sid} rebuilt bit-identically from "
+        f"{len(src)} group columns"))
+    return checks
+
+
 INCIDENTS = {
     "az_loss": _az_loss,
     "rolling_restart": _rolling_restart,
     "herd_repair": _herd_repair,
     "tenant_flood": _tenant_flood,
     "partition_heal_mid_repair": _partition_heal_mid_repair,
+    "ec_single_shard_loss": _ec_single_shard_loss,
 }
 
 

@@ -74,16 +74,25 @@ def _iter_dat_pieces(dat_file_size: int, large_block: int,
 def write_dat_file(base_file_name: str, dat_file_size: int,
                    large_block: int = layout.LARGE_BLOCK_SIZE,
                    small_block: int = layout.SMALL_BLOCK_SIZE,
-                   pipelined: bool = True) -> None:
-    """Reassemble .dat from data shards .ec00-.ec09 by walking rows
+                   pipelined: bool = True,
+                   data_shards: int = 0) -> None:
+    """Reassemble .dat from the data shards by walking rows
     (reference ec_decoder.go:154-195). Note the reference reads shards
     sequentially, so the per-shard read cursor advances across rows.
+    The data-shard count comes from the volume's .vif CodeSpec unless
+    overridden, so mixed-code stores decode each volume correctly.
 
     The output goes to .dat.tmp and is renamed into place on success, so
     an interrupted decode never leaves a truncated .dat. With
     pipelined=True a reader thread prefetches shard chunks through a
     bounded queue while the main thread writes (overlapped I/O)."""
-    k = layout.DATA_SHARDS_COUNT
+    if data_shards <= 0:
+        from seaweedfs_tpu.models.coder import scheme_from_dict
+        from seaweedfs_tpu.storage.erasure_coding.ec_volume import \
+            read_volume_info
+        data_shards = scheme_from_dict(
+            read_volume_info(base_file_name).get("code")).data_shards
+    k = data_shards
     ins = [open(base_file_name + layout.shard_ext(i), "rb") for i in range(k)]
     tmp = base_file_name + ".dat.tmp"
     try:

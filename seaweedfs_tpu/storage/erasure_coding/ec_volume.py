@@ -6,12 +6,34 @@ ec_shard.go, ec_volume_delete.go, ec_volume_info.go.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 from typing import Callable, Iterator, Optional
 
+from seaweedfs_tpu.models.coder import scheme_from_dict, scheme_to_dict
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.erasure_coding import layout
+
+
+def read_volume_info(base_file_name: str) -> dict:
+    """Parse the .vif sidecar ({"version": ..., "code": CodeSpec dict}).
+    Empty dict when absent/corrupt — pre-CodeSpec volumes default to
+    version 3 / RS(10,4) exactly as before."""
+    try:
+        with open(base_file_name + ".vif", "r", encoding="utf-8") as f:
+            info = json.load(f)
+        return info if isinstance(info, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def write_volume_info(base_file_name: str, version: int, scheme) -> None:
+    """Persist the .vif sidecar: version + the volume's CodeSpec, so a
+    mixed-code cluster can pick the right coder per volume at load."""
+    with open(base_file_name + ".vif", "w", encoding="utf-8") as f:
+        json.dump({"version": version,
+                   "code": scheme_to_dict(scheme)}, f)
 
 
 class NotFoundError(Exception):
@@ -142,8 +164,12 @@ class EcVolume:
         self.directory = directory
         self.collection = collection
         self.volume_id = volume_id
-        self.version = version
         self.base_file_name = os.path.join(directory, str(volume_id))
+        info = read_volume_info(self.base_file_name)
+        self.version = int(info.get("version", version))
+        # the volume's CodeSpec (RS(10,4) when the .vif predates CodeSpec
+        # persistence) — every shard-count consumer below derives from it
+        self.scheme = scheme_from_dict(info.get("code"))
         self.shards: dict[int, EcVolumeShard] = {}
         self._ecx_lock = threading.Lock()
         self._ecj_lock = threading.Lock()
@@ -155,6 +181,14 @@ class EcVolume:
         self.shard_locations: dict[int, list[str]] = {}
         self.shard_locations_refreshed_at = 0.0
         self.shard_locations_lock = threading.Lock()
+
+    @property
+    def data_shards(self) -> int:
+        return self.scheme.data_shards
+
+    @property
+    def total_shards(self) -> int:
+        return self.scheme.total_shards
 
     def add_shard(self, shard: EcVolumeShard) -> bool:
         if shard.shard_id in self.shards:
@@ -199,7 +233,8 @@ class EcVolume:
         record = t.get_actual_size(size, self.version)
         intervals = layout.locate_data(
             large_block, small_block,
-            layout.DATA_SHARDS_COUNT * shard_size, offset, record)
+            self.data_shards * shard_size, offset, record,
+            data_shards=self.data_shards)
         return intervals, offset, size
 
     def delete_needle(self, needle_id: int) -> None:

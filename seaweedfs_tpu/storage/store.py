@@ -13,7 +13,8 @@ import os
 import threading
 from typing import Callable, Optional
 
-from seaweedfs_tpu.models.coder import DEFAULT_SCHEME, ErasureCoder, make_coder
+from seaweedfs_tpu.models.coder import (DEFAULT_SCHEME, ErasureCoder,
+                                        coder_name_for_scheme, make_coder)
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.disk_location import DiskLocation
 from seaweedfs_tpu.storage.erasure_coding import layout
@@ -58,6 +59,9 @@ class Store:
         # multi-core CPU coder by default: bit-identical to "cpu",
         # shards each encode batch across the visible cores
         self.coder = coder or make_coder("cpu-mt")
+        # per-CodeSpec coder cache for mixed-code stores: RS and LRC
+        # volumes on the same disks each decode with their own family
+        self._coder_cache: dict = {self.coder.scheme: self.coder}
         self.remote_shard_reader: Optional[RemoteShardReader] = None
         # Injected by the volume server (optional): per-peer breaker
         # registry, a vid -> {shard_id: [urls]} locator, and the switch
@@ -86,6 +90,10 @@ class Store:
         self.deleted_volumes: list[dict] = []
         self.new_ec_shards: list[dict] = []
         self.deleted_ec_shards: list[dict] = []
+        # degraded-read repair-strategy tallies (exposed via shard_stat):
+        # "local" = planned group-local recovery, "global" = planned
+        # full-width recovery, "generic" = unplanned collect-k fallback
+        self.ec_recover_stats = {"local": 0, "global": 0, "generic": 0}
 
     def load_existing_volumes(self) -> None:
         for loc in self.locations:
@@ -347,26 +355,49 @@ class Store:
                 except FileNotFoundError:
                     continue
 
+    def coder_for(self, ev: EcVolume) -> ErasureCoder:
+        """The coder matching a volume's persisted CodeSpec — self.coder
+        for plain RS volumes, a cached family-specific coder otherwise.
+        This is the per-volume dispatch that lets RS and LRC volumes
+        coexist on one store."""
+        return self.coder_for_scheme(getattr(ev, "scheme", None))
+
+    def coder_for_scheme(self, scheme) -> ErasureCoder:
+        if scheme is None or scheme == self.coder.scheme:
+            return self.coder
+        c = self._coder_cache.get(scheme)
+        if c is None:
+            c = make_coder(coder_name_for_scheme(scheme), scheme)
+            self._coder_cache[scheme] = c
+        return c
+
     def generate_ec_shards(self, vid: int, pipelined: bool = True,
-                           stats: Optional[dict] = None) -> str:
+                           stats: Optional[dict] = None,
+                           code: str = "") -> str:
         """VolumeEcShardsGenerate equivalent: write .ec00-.ec13 + .ecx +
         .vif next to the volume's files (reference
         server/volume_grpc_erasure_coding.go:38-81). Returns the base file
-        name. The volume must exist locally; it is marked readonly first."""
-        import json
-
+        name. The volume must exist locally; it is marked readonly first.
+        `code` picks the family ('' / 'rs' -> the store coder, 'lrc' ->
+        LRC(10,2,2)); the chosen CodeSpec is persisted in the .vif."""
         from seaweedfs_tpu.storage.erasure_coding import encoder as ecenc
+        from seaweedfs_tpu.storage.erasure_coding.ec_volume import \
+            write_volume_info
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
+        if code and code != "rs":
+            coder = make_coder(code)
+            coder = self._coder_cache.setdefault(coder.scheme, coder)
+        else:
+            coder = self.coder
         v.read_only = True
         v.sync()
         base = v.file_name()
         ecenc.write_sorted_ecx(base)
-        ecenc.write_ec_files(base, self.coder, pipelined=pipelined,
+        ecenc.write_ec_files(base, coder, pipelined=pipelined,
                              stats=stats)
-        with open(base + ".vif", "w") as f:
-            json.dump({"version": v.version}, f)
+        write_volume_info(base, v.version, coder.scheme)
         return base
 
     def unmount_ec_shards(self, vid: int, shard_ids: list[int]) -> None:
@@ -450,7 +481,7 @@ class Store:
             return b""
         intervals = layout.locate_data(
             layout.LARGE_BLOCK_SIZE, layout.SMALL_BLOCK_SIZE,
-            layout.DATA_SHARDS_COUNT * ev.shard_size(),
+            ev.data_shards * ev.shard_size(),
             rec_offset + rel_off, length)
         return b"".join(
             self._read_one_interval(ev, iv) for iv in intervals)
@@ -551,7 +582,7 @@ class Store:
             return False
         intervals = layout.locate_data(
             layout.LARGE_BLOCK_SIZE, layout.SMALL_BLOCK_SIZE,
-            layout.DATA_SHARDS_COUNT * ev.shard_size(),
+            ev.data_shards * ev.shard_size(),
             rec_offset + rel_off, length)
         locs = None
         for iv in intervals:
@@ -612,14 +643,23 @@ class Store:
 
     def _recover_one_interval(self, ev: EcVolume, iv: layout.Interval,
                               wanted_shard: int) -> bytes:
-        """Degraded read: collect >= k sibling-shard ranges and
-        reconstruct. Local shards read inline; remote peers are fetched
-        CONCURRENTLY with first-k-wins — one slow peer must not
-        serialize recovery (reference store_ec.go:328-382 fans out a
-        goroutine per source shard the same way)."""
-        k = self.coder.scheme.data_shards
-        total = self.coder.scheme.total_shards
+        """Degraded read: collect sibling-shard ranges and reconstruct.
+        Local shards read inline; remote peers are fetched CONCURRENTLY
+        with first-k-wins — one slow peer must not serialize recovery
+        (reference store_ec.go:328-382 fans out a goroutine per source
+        shard the same way). Coders that plan their sources (LRC) get a
+        plan-first pass: a lost group member reads only its surviving
+        local group (~k/l columns) instead of k."""
+        coder = self.coder_for(ev)
+        k = coder.scheme.data_shards
+        total = coder.scheme.total_shards
         shard_off = iv.to_shard_id_and_offset()[1]
+        plan_capable = hasattr(coder, "plan_rebuild")
+        if plan_capable:
+            got = self._recover_via_plan(ev, iv, shard_off, coder,
+                                         wanted_shard)
+            if got is not None:
+                return got
         bufs: dict[int, bytes] = {}
         remote_sids: list[int] = []
         for sid in range(total):
@@ -628,13 +668,19 @@ class Store:
             local = ev.shards.get(sid)
             if local is not None:
                 bufs[sid] = local.read_at(shard_off, iv.size)
-                if len(bufs) >= k:
+                # a plan-capable coder may find an arbitrary k-subset
+                # rank-deficient, so keep every local column for it
+                if len(bufs) >= k and not plan_capable:
                     break
             elif self.remote_shard_reader is not None:
                 remote_sids.append(sid)
-        if len(bufs) < k and remote_sids:
+        # same reasoning remotely: the fallback is rare (a planned
+        # source was unreachable), so over-collect for plan coders
+        need = k if not plan_capable \
+            else min(total - 1, len(bufs) + len(remote_sids))
+        if len(bufs) < need and remote_sids:
             self._fetch_remote_shards(ev, iv, shard_off, bufs,
-                                      remote_sids, k)
+                                      remote_sids, need)
         if len(bufs) < k:
             raise NotFoundError(
                 f"ec volume {ev.volume_id}: only {len(bufs)} shards "
@@ -642,8 +688,53 @@ class Store:
         shards: list[Optional[bytes]] = [None] * total
         for sid, b in bufs.items():
             shards[sid] = b
-        full = self.coder.reconstruct(shards)
+        try:
+            full = coder.reconstruct(shards)
+        except ValueError as e:
+            raise NotFoundError(
+                f"ec volume {ev.volume_id}: {len(bufs)} shards reachable "
+                f"but pattern unrecoverable: {e}")
+        self.ec_recover_stats["generic"] += 1
         return full[wanted_shard]
+
+    def _recover_via_plan(self, ev: EcVolume, iv: layout.Interval,
+                          shard_off: int, coder: ErasureCoder,
+                          wanted_shard: int) -> Optional[bytes]:
+        """Try the coder's cheapest-source repair plan. Returns the
+        recovered range, or None when a planned source is unreachable
+        (the caller then falls back to the generic collect-k ladder)."""
+        import numpy as np
+        total = coder.scheme.total_shards
+        try:
+            src, mat = coder.plan_rebuild(
+                [s for s in range(total) if s != wanted_shard],
+                [wanted_shard])
+        except ValueError:
+            return None
+        if src is None:
+            return None
+        bufs: dict[int, bytes] = {}
+        remote: list[int] = []
+        for sid in src:
+            local = ev.shards.get(sid)
+            if local is not None:
+                bufs[sid] = local.read_at(shard_off, iv.size)
+            elif self.remote_shard_reader is not None:
+                remote.append(sid)
+            else:
+                return None
+        if remote:
+            self._fetch_remote_shards(ev, iv, shard_off, bufs, remote,
+                                      len(src))
+        if len(bufs) != len(src):
+            return None
+        rows = np.empty((len(src), iv.size), dtype=np.uint8)
+        for r, sid in enumerate(src):
+            rows[r] = np.frombuffer(bufs[sid], dtype=np.uint8)
+        strat = "local" if len(src) < coder.scheme.data_shards \
+            else "global"
+        self.ec_recover_stats[strat] += 1
+        return coder.reconstruct_rows(rows, mat)[0].tobytes()
 
     def _rank_remote_sids(self, vid: int,
                           sids: list[int]) -> tuple[list[int], int]:

@@ -710,7 +710,14 @@ class Volume:
     def destroy(self) -> None:
         self.close()
         base = self.file_name()
-        for ext in (".dat", ".idx", ".vif", ".note", ".sdx"):
+        exts = [".dat", ".idx", ".vif", ".note", ".sdx"]
+        if os.path.exists(base + ".ecx"):
+            # the volume was EC-encoded: the .vif now belongs to the EC
+            # volume — it persists the CodeSpec that picks the coder for
+            # a mixed-code store, so deleting the source .dat must not
+            # take it along
+            exts.remove(".vif")
+        for ext in exts:
             if os.path.exists(base + ext):
                 os.remove(base + ext)
         if os.path.isdir(base + ".ldb"):

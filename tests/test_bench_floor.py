@@ -249,6 +249,27 @@ def test_repair_network_floor():
     assert out["repair_network_bytes_per_mb_legacy"] >= 2 * per_mb, out
 
 
+def test_lrc_repair_floor():
+    """LRC repair-cost acceptance (PR 17 tentpole): a single lost
+    group shard must rebuild from the local group — <= 0.6x the RS
+    bytes-read-per-rebuilt-MB (the plan reads 5 columns, RS reads
+    k=10, so the honest ratio is 0.5) — and >= 1.5x faster wall, both
+    measured against the in-run RS comparator on the same payload so
+    CI variance stays out of the verdict.  Encode and rebuild
+    bit-identity (vs the scalar GF reference and the originally
+    encoded shard) are asserted inside the bench; a fast-but-wrong
+    coder raises before posting a number."""
+    import bench
+
+    out = bench.bench_lrc_repair(size_mb=24)
+    assert out["lrc_repair_bit_identical"] is True, out
+    assert out["lrc_repair_read_ratio"] <= 0.6, out
+    assert out["lrc_repair_wall_speedup"] >= 1.5, out
+    # the plan itself is the mechanism: 5 group columns, not k=10
+    assert out["lrc_repair_lrc"]["sources"] == 5, out
+    assert out["lrc_repair_rs"]["sources"] == 10, out
+
+
 def test_filer_streaming_rss_floor(monkeypatch):
     """Bounded-memory ingest acceptance: the filer child's peak RSS
     delta while streaming a body 16x the chunk size must stay within

@@ -126,6 +126,18 @@ def test_partition_heal_mid_repair_at_16_actors():
     assert by_check["breakers_reclosed"]["ok"]
 
 
+def test_ec_single_shard_loss_at_16_actors():
+    r = run_incident("ec_single_shard_loss", seed=0, n_actors=16)
+    assert r["passed"], [c for c in r["invariants"] if not c["ok"]]
+    # degraded reads fail over mid-repair, never fail outright
+    assert r["client"]["failed"] == 0
+    assert r["repair"]["done"] > 0
+    by_check = {c["name"]: c for c in r["invariants"]}
+    assert by_check["lrc_local_strategy_for_group_shards"]["ok"]
+    assert by_check["lrc_read_cost_vs_rs"]["ok"]
+    assert by_check["lrc_repair_bit_identical"]["ok"]
+
+
 def test_unknown_incident_raises():
     with pytest.raises(KeyError):
         run_incident("kraken", n_actors=16)

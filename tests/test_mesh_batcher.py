@@ -264,6 +264,95 @@ def test_batch_coder_facade_is_a_drop_in_coder():
         sched.stop()
 
 
+# ------------------------------------------- mixed-code batch drain
+
+def test_mixed_rs_lrc_batch_drain_bit_identical():
+    """THE satellite: RS and LRC jobs submitted into ONE scheduler in
+    the same coalescing window, every future demuxing bit-identical
+    per-job rows — RS encodes ride the native parity path, LRC encodes
+    the matrix-carrying path, and an LRC group-local rebuild (5 source
+    rows, not k) routes to the CPU coder WITHOUT benching the mesh."""
+    from seaweedfs_tpu.ops.lrc import LrcCoder
+
+    lrc = LrcCoder()
+    sched = EcBatchScheduler(window_s=0.1)
+    try:
+        rs_data = [_batch(1, 1024, seed=30 + i)[0] for i in range(3)]
+        lrc_data = [_batch(1, 1024, seed=40 + i)[0] for i in range(3)]
+        futs = []
+        for rd, ld in zip(rs_data, lrc_data):
+            futs.append(("rs", rd, sched.submit_encode(rd)))
+            futs.append(("lrc", ld,
+                         sched.submit_encode(ld, mat=lrc._parity)))
+        # an LRC single-shard local repair rides the same drain
+        shards = lrc.encode([lrc_data[0][i].tobytes() for i in range(K)])
+        full = [np.frombuffer(s, dtype=np.uint8) for s in shards]
+        src_sids, mat = lrc.plan_rebuild(
+            [s for s in range(TOTAL) if s != 2], [2])
+        assert len(src_sids) == 5  # group-local: 5 reads, not k=10
+        rf = sched.submit_rebuild(
+            np.stack([full[s] for s in src_sids]), mat)
+        for fam, d, f in futs:
+            want = CPU.encode_array(d) if fam == "rs" \
+                else lrc.encode_array(d)
+            assert np.array_equal(f.result(timeout=30), want), fam
+        assert np.array_equal(rf.result(timeout=30)[0], full[2])
+        st = sched.stats()
+        assert st["jobs_total"] == 7
+        assert st["coder_fallbacks"] == 0  # narrow rebuild != mesh fault
+        assert st["mesh_healthy"] is True
+    finally:
+        sched.stop()
+
+
+def test_mixed_drain_survives_mesh_loss_via_cpu():
+    """Mixed batch + mesh failure: both families drain through the CPU
+    fallback bit-identically."""
+    from seaweedfs_tpu.ops.lrc import LrcCoder
+
+    lrc = LrcCoder()
+    sched = EcBatchScheduler(mesh_coder=_Boom(), window_s=0.02)
+    try:
+        rd = _batch(1, 776, seed=50)[0]
+        ld = _batch(1, 776, seed=51)[0]
+        f1 = sched.submit_encode(rd)
+        f2 = sched.submit_encode(ld, mat=lrc._parity)
+        assert np.array_equal(f1.result(timeout=30), CPU.encode_array(rd))
+        assert np.array_equal(f2.result(timeout=30), lrc.encode_array(ld))
+        assert sched.coder_fallbacks >= 1
+    finally:
+        sched.stop()
+
+
+def test_lrc_batch_coder_facade_shares_scheduler():
+    """One scheduler serves two BatchCoder facades — RS and LRC — each
+    encoding under its own family and reconstructing via its own plan."""
+    from seaweedfs_tpu.models.coder import LrcScheme
+    from seaweedfs_tpu.ops.lrc import LrcCoder
+
+    lrc = LrcCoder()
+    sched = EcBatchScheduler(window_s=0.005)
+    try:
+        rs_bc = BatchCoder(sched)
+        lrc_bc = BatchCoder(sched, LrcScheme())
+        assert lrc_bc.scheme.total_shards == TOTAL
+        rng = np.random.default_rng(52)
+        shards = [rng.integers(0, 256, 600, dtype=np.uint8).tobytes()
+                  for _ in range(K)]
+        assert [bytes(s) for s in rs_bc.encode(shards)] == \
+            [bytes(s) for s in CPU.encode(shards)]
+        full = lrc_bc.encode(shards)
+        assert [bytes(s) for s in full] == \
+            [bytes(s) for s in lrc.encode(shards)]
+        # a single-shard hole reconstructs through the shared scheduler
+        # (plan-driven sources, not first-k-of-present)
+        holes = [s if i != 7 else None for i, s in enumerate(full)]
+        assert [bytes(s) for s in lrc_bc.reconstruct(holes)] == \
+            [bytes(s) for s in full]
+    finally:
+        sched.stop()
+
+
 # ------------------------------------- repair-queue wave coalescing
 
 def test_repair_queue_coalesces_dispatch_waves():

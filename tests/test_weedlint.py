@@ -124,6 +124,17 @@ CASES = {
         "clean": ("def serve(blob):\n"
                   "    return memoryview(blob)\n"),
     },
+    "hardcoded-shard-count": {
+        "path": "seaweedfs_tpu/storage/erasure_coding/x.py",
+        "bad": ("def shard_files(base):\n"
+                "    return [base + str(i) for i in range(14)]\n"),
+        "clean": ("from seaweedfs_tpu.storage.erasure_coding import "
+                  "layout\n\n"
+                  "def shard_files(base):\n"
+                  "    return [base + str(i)\n"
+                  "            for i in range(layout.TOTAL_SHARDS_COUNT)]"
+                  "\n"),
+    },
     "ambient-scope-loss": {
         "bad": ("from seaweedfs_tpu.utils.tracing import current_span\n\n"
                 "def f(pool):\n"

@@ -8,6 +8,15 @@ past the wall time — that's the overlap working.
 Usage:
   PYTHONPATH=. JAX_PLATFORMS=cpu python tools/ec_profile.py [size_mb]
   PYTHONPATH=. ... python tools/ec_profile.py --dat /path/to/base  # existing .dat
+  PYTHONPATH=. ... python tools/ec_profile.py --coder lrc [size_mb]
+  PYTHONPATH=. ... python tools/ec_profile.py --repair-table [size_mb]
+
+--coder picks the code family for the encode/rebuild profile (cpu =
+RS(10,4), lrc = LRC(10,2,2); an -mt suffix is applied for the pipelined
+leg either way).  --repair-table runs the repair-cost comparison: for
+each canonical failure pattern, bytes read from survivors, bytes moved
+(rebuilt), wall seconds and the plan's source count, RS vs LRC on the
+same payload — the bytes-read-per-rebuilt-MB headline.
 
 Prints a table plus one JSON line for scripts.
 """
@@ -33,12 +42,15 @@ def build_volume(base: str, size: int) -> None:
             left -= n
 
 
-def profile(base: str, keep_shards: bool = False) -> dict:
+def profile(base: str, keep_shards: bool = False,
+            coder_name: str = "cpu") -> dict:
     from seaweedfs_tpu.models.coder import make_coder
     from seaweedfs_tpu.storage.erasure_coding import encoder as ecenc
     from seaweedfs_tpu.storage.erasure_coding import layout
 
     size = os.path.getsize(base + ".dat")
+    serial_name = coder_name.removesuffix("-mt")
+    mt_name = serial_name + "-mt"
 
     def clean():
         if keep_shards:
@@ -49,11 +61,11 @@ def profile(base: str, keep_shards: bool = False) -> dict:
                 os.remove(p)
 
     t0 = time.perf_counter()
-    ecenc.write_ec_files(base, make_coder("cpu"))
+    ecenc.write_ec_files(base, make_coder(serial_name))
     serial_s = time.perf_counter() - t0
     clean()
 
-    coder = make_coder("cpu-mt")
+    coder = make_coder(mt_name)
     stats: dict = {}
     t0 = time.perf_counter()
     ecenc.write_ec_files(base, coder, pipelined=True, stats=stats)
@@ -70,6 +82,7 @@ def profile(base: str, keep_shards: bool = False) -> dict:
 
     return {
         "size_mb": round(size / 1e6, 1),
+        "coder": mt_name,
         "workers": coder.workers,
         "serial_s": round(serial_s, 3),
         "pipelined_s": round(pipe_s, 3),
@@ -84,18 +97,110 @@ def profile(base: str, keep_shards: bool = False) -> dict:
     }
 
 
+# canonical failure patterns, all recoverable under both RS(10,4) and
+# LRC(10,2,2) — missing shard ids per pattern
+REPAIR_PATTERNS = [
+    ("single-data", [2]),
+    ("single-local-parity", [10]),
+    ("single-global-parity", [12]),
+    ("two-in-one-group", [1, 3]),
+    ("one-per-group", [2, 7]),
+    ("group+global", [4, 13]),
+]
+
+
+def repair_cost_table(size_mb: float = 8.0) -> dict:
+    """Repair cost per failure pattern, RS vs LRC on the same payload:
+    bytes read from surviving shards, bytes moved (rebuilt), wall
+    seconds, plan source count, and rebuilt-bit identity against the
+    originally encoded shards."""
+    from seaweedfs_tpu.models.coder import make_coder
+    from seaweedfs_tpu.storage.erasure_coding import encoder as ecenc
+    from seaweedfs_tpu.storage.erasure_coding import layout
+
+    rows = []
+    with tempfile.TemporaryDirectory() as d:
+        for fam, name in (("rs", "cpu-mt"), ("lrc", "lrc-mt")):
+            coder = make_coder(name)
+            base = os.path.join(d, fam)
+            build_volume(base, int(size_mb * 1024 * 1024))
+            ecenc.write_ec_files(base, coder)
+            golden = {}
+            for sid in range(layout.TOTAL_SHARDS_COUNT):
+                with open(base + layout.shard_ext(sid), "rb") as f:
+                    golden[sid] = f.read()
+            for pname, missing in REPAIR_PATTERNS:
+                for sid in missing:
+                    os.remove(base + layout.shard_ext(sid))
+                stats: dict = {}
+                t0 = time.perf_counter()
+                ecenc.rebuild_ec_files(base, coder, stats=stats)
+                wall = time.perf_counter() - t0
+                identical = True
+                for sid in missing:
+                    with open(base + layout.shard_ext(sid), "rb") as f:
+                        identical &= f.read() == golden[sid]
+                read_b = stats.get("read_bytes", 0)
+                moved_b = stats.get("rebuilt_bytes", 0)
+                rows.append({
+                    "code": fam, "pattern": pname, "missing": missing,
+                    "sources": len(stats.get("sources") or []),
+                    "read_mb": round(read_b / 1e6, 2),
+                    "moved_mb": round(moved_b / 1e6, 2),
+                    "read_per_rebuilt_mb": round(read_b / max(1, moved_b),
+                                                 2),
+                    "wall_s": round(wall, 3),
+                    "identical": identical,
+                })
+    ratios = {}
+    by_key = {(r["code"], r["pattern"]): r for r in rows}
+    for pname, _ in REPAIR_PATTERNS:
+        rs, lrc = by_key[("rs", pname)], by_key[("lrc", pname)]
+        ratios[pname] = round(
+            lrc["read_mb"] / max(1e-9, rs["read_mb"]), 3)
+    return {"size_mb": size_mb, "rows": rows, "lrc_read_ratio": ratios}
+
+
+def print_repair_table(out: dict) -> None:
+    print(f"repair cost per failure pattern "
+          f"({out['size_mb']} MB volume):")
+    hdr = (f"  {'pattern':22s} {'code':4s} {'srcs':>4s} {'read MB':>8s} "
+           f"{'moved MB':>9s} {'rd/MB':>6s} {'wall s':>7s} ok")
+    print(hdr)
+    for r in out["rows"]:
+        print(f"  {r['pattern']:22s} {r['code']:4s} {r['sources']:4d} "
+              f"{r['read_mb']:8.2f} {r['moved_mb']:9.2f} "
+              f"{r['read_per_rebuilt_mb']:6.2f} {r['wall_s']:7.3f} "
+              f"{'Y' if r['identical'] else 'N'}")
+    for pname, ratio in out["lrc_read_ratio"].items():
+        print(f"  lrc/rs bytes-read ratio [{pname}]: {ratio}")
+
+
 def main(argv: list[str]) -> int:
+    coder_name = "cpu"
+    if "--coder" in argv:
+        i = argv.index("--coder")
+        coder_name = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    if "--repair-table" in argv:
+        argv.remove("--repair-table")
+        size_mb = float(argv[0]) if argv else 8.0
+        out = repair_cost_table(size_mb)
+        print_repair_table(out)
+        print(json.dumps(out))
+        return 0
     if argv and argv[0] == "--dat":
-        out = profile(argv[1], keep_shards=False)
+        out = profile(argv[1], keep_shards=False, coder_name=coder_name)
     else:
         size_mb = int(argv[0]) if argv else 256
         with tempfile.TemporaryDirectory() as d:
             base = os.path.join(d, "prof")
             build_volume(base, size_mb * 1024 * 1024)
-            out = profile(base)
+            out = profile(base, coder_name=coder_name)
 
     st, rst = out["stages_s"], out["rebuild_stages_s"]
-    print(f"volume: {out['size_mb']} MB   coder workers: {out['workers']}")
+    print(f"volume: {out['size_mb']} MB   coder: {out['coder']}   "
+          f"workers: {out['workers']}")
     print(f"serial encode    : {out['serial_s']:8.3f}s")
     print(f"pipelined encode : {out['pipelined_s']:8.3f}s "
           f"({out['speedup']}x, {out['encode_mbps']} MB/s)")

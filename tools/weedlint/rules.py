@@ -1,4 +1,4 @@
-"""The weedlint rule set: one AST pass, fourteen invariants.
+"""The weedlint rule set: one AST pass, fifteen invariants.
 
 Every rule encodes a contract the cluster depends on ambiently — the
 kind that breaks silently at a single call site and only surfaces as a
@@ -123,6 +123,19 @@ hot-path-bytes-copy
     mutable buffer, wire framing that needs an owned ``bytes``) are
     baselined or suppressed with a justification; new code passes
     views through to the transport.
+
+hardcoded-shard-count
+    a shard-count literal (4/10/14) used as a ``range()`` bound or a
+    comparison operand inside ``storage/erasure_coding/``.  Shard
+    counts are code-family parameters now — RS(10,4) and LRC(10,2,2)
+    volumes coexist on one store, each carrying its CodeSpec in the
+    .vif — so iteration and guards must read
+    ``layout.DATA_SHARDS_COUNT/TOTAL_SHARDS_COUNT`` or the volume's
+    own ``scheme``/``data_shards``.  A literal ``range(14)`` silently
+    pins one family's geometry onto every volume it touches.  Sizes
+    that merely happen to be 4 (prefetch depth, 4-byte lanes) don't
+    match the flagged forms and stay legal; ``layout.py`` is the home
+    where the counts are defined.
 """
 
 from __future__ import annotations
@@ -160,6 +173,9 @@ RULES: dict[str, str] = {
     "hot-path-bytes-copy":
         "bytes(<payload>)/full-slice copy in storage/ or server/ — "
         "pass memoryview windows on the read hot path",
+    "hardcoded-shard-count":
+        "shard-count literal (4/10/14) in storage/erasure_coding/ — "
+        "read layout constants or the volume's CodeSpec",
 }
 
 # files that ARE the sanctioned implementation of a contract
@@ -171,6 +187,7 @@ _RULE_HOME = {
     "raw-device-discovery": "parallel/mesh.py",
     "unbounded-body-read": "utils/httpd.py",
     "hot-path-bytes-copy": "utils/httpd.py",
+    "hardcoded-shard-count": "storage/erasure_coding/layout.py",
 }
 
 _HEADER_PREFIX = "X-Weed-"
@@ -201,6 +218,11 @@ _PAYLOADISH = re.compile(r"(?:^_*|_)(?:data|blob|body|payload|"
                          re.IGNORECASE)
 # subtrees where the hot-path-bytes-copy rule applies (read data plane)
 _HOT_PATH_PREFIXES = ("seaweedfs_tpu/storage/", "seaweedfs_tpu/server/")
+# the code-family geometry values of RS(10,4)/LRC(10,2,2): data, parity,
+# total — a literal one of these in a range() bound or comparison inside
+# the EC subtree pins one family's geometry onto every volume
+_SHARD_COUNT_LITERALS = {4, 10, 14}
+_EC_SUBTREE = "seaweedfs_tpu/storage/erasure_coding/"
 _SCOPE_ENTRIES = {"span_scope", "deadline_scope", "class_scope",
                   "attach", "child_scope"}
 
@@ -488,6 +510,18 @@ class Checker(ast.NodeVisitor):
                 and node.args:
             self._check_submit(node)
 
+        if canonical == "range" and self.rel.startswith(_EC_SUBTREE):
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) \
+                        and type(arg.value) is int \
+                        and arg.value in _SHARD_COUNT_LITERALS:
+                    self.report(
+                        arg, "hardcoded-shard-count",
+                        f"range({arg.value}) pins one code family's "
+                        "shard geometry — iterate layout.DATA_SHARDS_"
+                        "COUNT/TOTAL_SHARDS_COUNT or the volume's own "
+                        "scheme counts")
+
         if canonical == "bytes" and len(node.args) == 1 \
                 and not node.keywords \
                 and self.rel.startswith(_HOT_PATH_PREFIXES):
@@ -504,6 +538,24 @@ class Checker(ast.NodeVisitor):
                     "a sanctioned materialization point, with a "
                     "justified suppression)")
 
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        if self.rel.startswith(_EC_SUBTREE):
+            for operand in [node.left] + node.comparators:
+                if isinstance(operand, ast.Constant) \
+                        and type(operand.value) is int \
+                        and operand.value in _SHARD_COUNT_LITERALS \
+                        and operand.value != 4:
+                    # 4 as a bare comparison operand is usually a size
+                    # (lanes, prefetch) — only 10/14 read as shard
+                    # counts outside a range()
+                    self.report(
+                        operand, "hardcoded-shard-count",
+                        f"comparison against literal {operand.value} "
+                        "hardcodes one code family's shard count — "
+                        "compare against layout constants or the "
+                        "volume's scheme")
         self.generic_visit(node)
 
     def visit_Subscript(self, node: ast.Subscript) -> None:
