@@ -2026,6 +2026,187 @@ def bench_tenant_flood(duration_s: float = 1.0,
     }
 
 
+def bench_assign_flood(n_clients: int = 32, dark_s: float = 5.0,
+                       edge_s: float = 1.0) -> dict:
+    """Master-outage-tolerant writes: a concurrent PUT flood through
+    the assign-lease lane vs the master-routed comparator across a
+    master-dark window.
+
+    `n_clients` writer threads flood 1KB PUTs for edge + dark + edge
+    seconds while a netchaos proxy fronting the master blackholes it
+    for the middle `dark_s`. The volume server keeps its direct
+    heartbeat lane (grants/renewals continue), so the window models
+    the client-visible master outage; true leader death is the chaos
+    drill's beat (tests/test_chaos_drill.py). The leased lane mints
+    fids from the holder's epoch-stamped range: zero failed writes and
+    zero master dials inside the window. The assign_leases=False
+    comparator pays a master round trip per write and craters for the
+    duration — which is also where the master's assign CPU goes: on a
+    live cluster, `tools/prof_collect.py --diff` before/after enabling
+    leases shows the /dir/assign route frames draining out of the
+    master's flamegraph (the grant path amortizes one Raft commit per
+    LEASE_RANGE=4096 fids). Floors (tests/test_bench_floor.py):
+    leased >= 2x comparator writes/s, zero leased dark-window
+    failures, zero leased dark-window master calls, bit-identical
+    stored bytes through both lanes.
+    SEAWEEDFS_TPU_BENCH_FLOOD_{CLIENTS,DARK_S,EDGE_S} override
+    sizing."""
+    import tempfile
+    import threading
+
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.utils.httpd import HttpError, http_call
+    from seaweedfs_tpu.utils.resilience import Deadline, deadline_scope
+    from tools.netchaos import ChaosProxy
+
+    n_clients = int(os.environ.get("SEAWEEDFS_TPU_BENCH_FLOOD_CLIENTS",
+                                   n_clients))
+    dark_s = float(os.environ.get("SEAWEEDFS_TPU_BENCH_FLOOD_DARK_S",
+                                  dark_s))
+    edge_s = float(os.environ.get("SEAWEEDFS_TPU_BENCH_FLOOD_EDGE_S",
+                                  edge_s))
+    payload = b"\x5a\xa5" * 512  # 1KB
+    duration = edge_s + dark_s + edge_s
+
+    with tempfile.TemporaryDirectory() as d:
+        master = MasterServer(volume_size_limit_mb=64)
+        master.start()
+        vs = VolumeServer([os.path.join(d, "v")], master.url)
+        vs.start()
+        proxy = ChaosProxy(master.http.host, master.http.port).start()
+        vs_direct = f"{vs.http.host}:{vs.http.port}"
+
+        def flood(mc) -> dict:
+            """One lane's run: flood for `duration`, blackhole the
+            proxy for the middle `dark_s`, count completions (stamped
+            so the dark window is separable) and failures."""
+            done: list[tuple[float, str]] = []
+            failed = {"total": 0, "dark": 0}
+            lock = threading.Lock()
+            stop_at = time.monotonic() + duration
+            window = {}
+
+            def in_dark(t: float) -> bool:
+                return window.get("t0", 1e18) <= t <= \
+                    window.get("t1", 1e18)
+
+            def worker():
+                while time.monotonic() < stop_at:
+                    try:
+                        # per-op deadline: a dark-window master dial
+                        # fails fast instead of eating the whole run
+                        with deadline_scope(Deadline.after(1.0)):
+                            a = mc.assign()
+                            if not a.get("fid") or a.get("error"):
+                                raise ConnectionError(str(a))
+                            operation.upload_to(a["fid"], a["url"],
+                                                payload)
+                    except (ConnectionError, HttpError, OSError):
+                        t = time.monotonic()
+                        with lock:
+                            failed["total"] += 1
+                            failed["dark"] += in_dark(t)
+                        continue
+                    t = time.monotonic()
+                    with lock:
+                        done.append((t, a["fid"]))
+
+            threads = [threading.Thread(target=worker,
+                                        name=f"flood-writer-{i}")
+                       for i in range(n_clients)]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            time.sleep(edge_s)
+            window["t0"] = time.monotonic()
+            calls0 = mc.master_calls
+            proxy.set_fault(mode="blackhole")
+            time.sleep(dark_s)
+            window["t1"] = time.monotonic()
+            calls1 = mc.master_calls
+            proxy.set_fault(mode="pass")
+            for t in threads:
+                t.join(timeout=duration + 30)
+            wall = time.monotonic() - t0
+            dark_writes = sum(1 for t, _ in done if in_dark(t))
+            return {"wps": round(len(done) / wall, 1),
+                    "writes": len(done),
+                    "dark_writes": dark_writes,
+                    "failed": failed["total"],
+                    "failed_dark": failed["dark"],
+                    "master_calls_dark": calls1 - calls0,
+                    "fids": [fid for _, fid in done]}
+
+        leased = MasterClient(proxy.url, cache_ttl=0.0)
+        legacy = MasterClient(proxy.url, cache_ttl=0.0,
+                              assign_leases=False)
+        try:
+            # warm: grow the volume, let the heartbeat grant land, and
+            # prime the client's lease directory so the first dark-
+            # window assign already knows its holders
+            a = leased.assign()
+            if a.get("error"):
+                raise RuntimeError(f"warm assign failed: {a['error']}")
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                with vs._lease_lock:
+                    if vs._leases:
+                        break
+                time.sleep(0.05)
+            else:
+                raise RuntimeError("holder never received a lease")
+            if not leased.assign().get("lease_epoch"):
+                raise RuntimeError("lease lane never engaged")
+
+            leased_run = flood(leased)
+            legacy_run = flood(legacy)
+
+            # bit identity across the lanes: the same payload through a
+            # holder-minted fid and a master-minted fid reads back
+            # identical (and a sample of the dark-window writes is
+            # durable on disk, not just acked)
+            la, ma = leased.assign(), legacy.assign()
+            operation.upload_to(la["fid"], la["url"], payload)
+            operation.upload_to(ma["fid"], ma["url"], payload)
+            identical = True
+            for fid in (la["fid"], ma["fid"],
+                        *leased_run["fids"][-20:]):
+                status, body, _ = http_call(
+                    "GET", f"http://{vs_direct}/{fid}", timeout=10)
+                identical = identical and status == 200 \
+                    and body == payload
+            lease_assigns = leased.lease_assigns
+            lease_fallbacks = leased.lease_fallbacks
+        finally:
+            leased.stop()
+            legacy.stop()
+            vs.stop()
+            proxy.stop()
+            master.stop()
+
+    return {
+        "assign_flood_clients": n_clients,
+        "assign_flood_dark_s": dark_s,
+        "assign_flood_leased_wps": leased_run["wps"],
+        "assign_flood_legacy_wps": legacy_run["wps"],
+        "assign_flood_speedup": round(
+            leased_run["wps"] / max(legacy_run["wps"], 0.1), 2),
+        "assign_flood_leased_failed": leased_run["failed"],
+        "assign_flood_leased_failed_dark": leased_run["failed_dark"],
+        "assign_flood_leased_dark_writes": leased_run["dark_writes"],
+        "assign_flood_leased_master_calls_dark":
+            leased_run["master_calls_dark"],
+        "assign_flood_legacy_failed": legacy_run["failed"],
+        "assign_flood_legacy_dark_writes": legacy_run["dark_writes"],
+        "assign_flood_lease_assigns": lease_assigns,
+        "assign_flood_lease_fallbacks": lease_fallbacks,
+        "assign_flood_bit_identical": identical,
+    }
+
+
 def classify_tpu_failure(err):
     """Map a probe failure string onto a stable fallback reason for
     the BENCH json. Delegates to parallel/mesh.classify_failure so the
@@ -2087,6 +2268,7 @@ def main(argv=None):
     e2e.update(bench_read_plane())  # sendfile GETs + volume redirects
     e2e.update(bench_replica_divergence_repair())  # hinted-handoff drill
     e2e.update(bench_filer_ops())  # sharded namespace scale-out
+    e2e.update(bench_assign_flood())  # master-dark leased PUT flood
     tpu, attempts, err = tpu_probe_with_retries()
     if tpu is not None:
         print(json.dumps({

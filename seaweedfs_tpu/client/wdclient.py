@@ -20,13 +20,21 @@ from typing import Optional
 
 from seaweedfs_tpu.utils import clockctl
 from seaweedfs_tpu.utils.httpd import HttpError, http_json
-from seaweedfs_tpu.utils.resilience import RetryPolicy
+from seaweedfs_tpu.utils.resilience import (Deadline, RetryPolicy,
+                                            current_deadline)
 
 
 class MasterClient:
     def __init__(self, master_urls: list[str] | str, cache_ttl: float = 10.0,
                  grpc_address: Optional[str] = None,
-                 client_type: str = "client", client_address: str = ""):
+                 client_type: str = "client", client_address: str = "",
+                 assign_leases: bool = True):
+        """assign_leases routes assigns through the direct-to-volume
+        lease lane first (volume servers mint fids locally from
+        master-granted fid-range leases; see /admin/lease_assign),
+        falling back to the master's /dir/assign when no leased holder
+        answers. Off = every assign is a master round trip, kept as
+        the bench comparator (assign_leases=False)."""
         if isinstance(master_urls, str):
             master_urls = [master_urls]
         self.master_urls = master_urls
@@ -51,6 +59,14 @@ class MasterClient:
         # (collection, replication, ttl, disk) -> (expires, [fid dicts])
         self._assign_pools: dict[tuple, tuple[float, list[dict]]] = {}
         self._assign_jwt_mode = False  # JWT replies disable pooling
+        # assign-lease lane: cached /cluster/leases directory
+        # (fetched_at_monotonic, [lease dicts]) + outcome counters.
+        # Followers serve the directory too, so it refreshes even
+        # while the leader is dark.
+        self.assign_leases = assign_leases
+        self._lease_dir: tuple[float, dict] = (0.0, {})
+        self.lease_assigns = 0
+        self.lease_fallbacks = 0
         self._peer_health = None  # lazy; see peer_health
         # cache-aware routing: (vid, key) -> [replica url, use count]
         # for needles some replica advertised as cache-hot (bounded LRU)
@@ -159,31 +175,61 @@ class MasterClient:
     def leader(self) -> str:
         return self._leader
 
+    def _resolve_leader(self) -> Optional[str]:
+        """Probe every known master's /cluster/status and adopt the
+        leader it reports. Used after a 503 without a usable hint: the
+        node we asked is alive but mid-election or not-yet-ready, and
+        some peer usually already knows who won."""
+        for url in list(self.master_urls):
+            try:
+                st = http_json("GET", f"http://{url}/cluster/status",
+                               deadline=Deadline.after(1.0))
+            except (ConnectionError, HttpError):
+                continue
+            leader = st.get("Leader") or st.get("leader")
+            if leader:
+                with self._lock:
+                    self._leader = leader
+                    if leader not in self.master_urls:
+                        self.master_urls.append(leader)
+                return leader
+        return None
+
     def _call(self, method: str, path: str, body=None, rounds: int = 3):
         """Try the believed leader, then every master, following 409
         leader hints; several rounds with backoff ride out an election
         in progress (reference wdclient retries until a leader answers,
-        masterclient.go:135-146)."""
+        masterclient.go:135-146). A 503 (not-ready fresh leader, or a
+        shedding master) re-resolves the leader from the peer list and
+        keeps retrying; an ambient deadline (resilience.current_deadline)
+        bounds the whole dance instead of the fixed round count."""
         with self._lock:
             self.master_calls += 1
+        dl = current_deadline()
         last_err: Exception = RuntimeError("no masters")
         for attempt in range(rounds):
             candidates = [self._leader] + [u for u in self.master_urls
                                            if u != self._leader]
             for url in candidates:
+                if dl is not None and dl.expired():
+                    raise last_err
                 try:
                     self.retry.record_call(url)
-                    out = http_json(method, f"http://{url}{path}", body)
+                    out = http_json(method, f"http://{url}{path}", body,
+                                    deadline=dl)
                     self._leader = url
                     return out
                 except HttpError as e:
-                    # follower redirect: {"error": "not leader", "leader": u}
-                    if e.status == 409:
+                    # follower redirect {"error": "not leader",
+                    # "leader": u} or a 503 carrying the same hint
+                    if e.status in (409, 503):
                         import json as _json
                         try:
                             hint = _json.loads(e.body).get("leader")
                         except Exception:
                             hint = None
+                        if e.status == 503 and (not hint or hint == url):
+                            hint = self._resolve_leader()
                         if hint and hint not in candidates:
                             candidates.append(hint)
                         if hint:
@@ -201,7 +247,12 @@ class MasterClient:
                 # per-destination tokens and stops the retry storm early
                 if not self.retry.allow_retry(self._leader):
                     break
-                clockctl.sleep(self.retry.backoff(attempt))
+                pause = self.retry.backoff(attempt)
+                if dl is not None:
+                    if dl.remaining() <= 0:
+                        break
+                    pause = min(pause, dl.remaining())
+                clockctl.sleep(pause)
         raise last_err
 
     @property
@@ -402,9 +453,109 @@ class MasterClient:
         with self._lock:
             self._affinity.pop((vid, key), None)
 
+    # assign-lease lane: how long a pulled /cluster/leases directory
+    # serves before re-pull. Holders renew every heartbeat (2s pulse,
+    # 30s TTL), so a directory this stale still names live leases.
+    LEASE_DIR_TTL = 15.0
+
+    def _lease_directory(self, refresh: bool = False) -> dict:
+        """The master's /cluster/leases reply, TTL-cached. Any master
+        answers (followers serve the replicated table), so the
+        directory keeps refreshing while the leader is dark. Never
+        raises: on total master darkness the stale directory keeps
+        serving — its holders' own expiry checks are the real gate."""
+        now = clockctl.monotonic()
+        with self._lock:
+            ts, cached = self._lease_dir
+            # an empty table re-polls at heartbeat cadence: right after
+            # growth the first grants land within one pulse, and a
+            # 15s-stale "no leases" copy would pin every assign to the
+            # master for that long
+            ttl = self.LEASE_DIR_TTL if cached.get("leases") else 2.0
+            if cached and not refresh and now - ts < ttl:
+                return cached
+            self.master_calls += 1
+        for url in [self._leader] + [u for u in self.master_urls
+                                     if u != self._leader]:
+            try:
+                out = http_json("GET", f"http://{url}/cluster/leases",
+                                deadline=Deadline.after(2.0))
+            except (ConnectionError, HttpError):
+                continue
+            with self._lock:
+                self._lease_dir = (now, out)
+            return out
+        with self._lock:
+            # re-arm the TTL on the stale copy so a dark cluster isn't
+            # re-probed on every single assign
+            self._lease_dir = (now, cached)
+        return cached
+
+    def assign_from_lease(self, count: int = 1, collection: str = "",
+                          replication: str = "") -> Optional[dict]:
+        """One assign minted DIRECTLY by a leased volume server —
+        zero master involvement on the warm path. Holders are tried
+        health-ranked and breaker-gated; a 503 refusal (lease lapsed
+        or exhausted) moves to the next holder. None = no leased
+        holder could mint; the caller falls back to /dir/assign."""
+        if not self.assign_leases:
+            return None
+        directory = self._lease_directory()
+        want_rp = (replication or directory.get("default_replication")
+                   or "000").zfill(3)
+        now = clockctl.now()
+        holders: list[str] = []
+        for l in directory.get("leases", []):
+            if l.get("collection", "") != collection:
+                continue
+            if (l.get("replication") or "000") != want_rp:
+                continue
+            if l.get("expires_at", 0) <= now:
+                continue
+            h = l.get("holder")
+            if h and h not in holders:
+                holders.append(h)
+        ranked = self.peer_health.rank(holders)
+        for url in ranked:
+            if not self.peer_health.allow(url) and url != ranked[-1]:
+                continue
+            t0 = clockctl.monotonic()
+            try:
+                out = http_json(
+                    "POST",
+                    f"http://{url}/admin/lease_assign?count={count}"
+                    f"&collection={collection}",
+                    deadline=Deadline.after(2.0))
+            except HttpError:
+                # a refusal is still a healthy transport answer
+                self.peer_health.record(url, True,
+                                        clockctl.monotonic() - t0)
+                continue
+            except ConnectionError:
+                self.peer_health.record(url, False)
+                continue
+            self.peer_health.record(url, True, clockctl.monotonic() - t0)
+            with self._lock:
+                self.lease_assigns += 1
+            return out
+        return None
+
     def assign(self, count: int = 1, collection: str = "",
                replication: str = "", ttl: str = "",
                data_center: str = "", disk: str = "") -> dict:
+        # direct-to-volume lane first: leases carry the leased volume's
+        # own placement, so only constraint-free assigns (no ttl/disk/
+        # dc pin) are eligible; anything else goes straight to the
+        # master, as does any assign the lane couldn't serve
+        if self.assign_leases and not ttl and not disk \
+                and not data_center:
+            out = self.assign_from_lease(count=count,
+                                         collection=collection,
+                                         replication=replication)
+            if out is not None:
+                return out
+            with self._lock:
+                self.lease_fallbacks += 1
         qs = (f"count={count}&collection={collection}"
               f"&replication={replication}&ttl={ttl}&dataCenter={data_center}"
               f"&disk={disk}")

@@ -352,7 +352,7 @@ class S3Server:
             amz_date = req.query.get("X-Amz-Date", "")
             expires = int(req.query.get("X-Amz-Expires", "900"))
             t = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
-            if time.time() - t > expires:  # weedlint: disable=raw-clock — X-Amz-Date is an absolute epoch
+            if time.time() - t > expires:  # weedlint: disable=raw-clock,lease-wall-clock — X-Amz-Date is an absolute epoch, not a clockctl TTL
                 return _err("AccessDenied", "request has expired", 403)
             signed_headers = req.query["X-Amz-SignedHeaders"].split(";")
             query = {k: v for k, v in req.query.items()

@@ -113,12 +113,19 @@ class FilerServer:
                  trace_sample: float = 0.01,
                  profile_hz: float = profiler.DEFAULT_HZ,
                  sharding: bool = False,
-                 entry_cache: bool = True):
+                 entry_cache: bool = True,
+                 assign_leases: bool = True):
         # qos=False disables admission control entirely (the
         # bit-for-bit comparator, same convention as parallel_uploads)
         # cipher=True encrypts every chunk (AES-256-GCM, per-chunk key in
         # the chunk metadata) so volume servers hold only ciphertext
         # (reference `weed filer -encryptVolumeData`)
+        # assign_leases routes _upload_chunks/_stream_chunks fid
+        # assigns through the direct-to-volume lease lane inside
+        # MasterClient.assign (fallback: master /dir/assign) — writes
+        # keep flowing through a master leader outage while volume
+        # servers hold valid leases. False = every assign round-trips
+        # the master, the bench comparator.
         self.cipher = cipher
         # announce=False: gateway mode (remote metadata store) — don't
         # register as a filer or aggregate peers
@@ -127,7 +134,8 @@ class FilerServer:
         self._grpc_server = None
         self.grpc_port: Optional[int] = None
         self.master_url = master_url
-        self.mc = MasterClient(master_url)
+        self.assign_leases = assign_leases
+        self.mc = MasterClient(master_url, assign_leases=assign_leases)
         kwargs = {}
         if store == "sqlite":
             kwargs["path"] = (store_dir or ".") + "/filer.db"

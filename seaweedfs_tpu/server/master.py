@@ -11,7 +11,16 @@ master_grpc_server*.go over HTTP/JSON:
   POST /vol/grow         explicit growth
   POST /vol/vacuum       trigger vacuum check on all nodes
   GET  /cluster/status   leader info
+  GET  /cluster/leases   assign-lease table (holder/epoch/range/expiry)
   POST /admin/lock, /admin/unlock   exclusive shell lock
+
+Assign leases: the master grants volume servers epoch-stamped
+fid-range leases ({vid, key_lo, key_hi, epoch, expires_at}) riding the
+heartbeat reply, Raft-proposed before they are handed out so a grant
+survives leader failover and a fresh leader resumes the sequence past
+the high-water mark instead of double-granting. Holders mint fids
+locally from their range; the master only re-enters the per-PUT path
+when no leased holder is reachable.
 """
 
 from __future__ import annotations
@@ -33,6 +42,23 @@ from seaweedfs_tpu.utils.httpd import (HttpServer, Request, Response,
                                        http_json)
 from seaweedfs_tpu.utils.resilience import Deadline, PeerHealth
 import random
+
+# ---- assign-lease protocol knobs ----
+# How long a fid-range lease stays valid. Long relative to the
+# heartbeat pulse (2s) so a leader election (sub-second to a few
+# seconds) never outlives the leases already in holders' hands.
+LEASE_TTL_S = 30.0
+# Keys per grant. 4096 fids per (vid, holder) per grant keeps renewal
+# traffic to ~1 raft proposal per volume per TTL under realistic
+# floods; abandoned remainders just burn cheap sequence ids.
+LEASE_RANGE = 4096
+# Renew when remaining lifetime falls below this fraction of the TTL
+# or remaining range below this fraction of LEASE_RANGE.
+LEASE_RENEW_FRACTION = 0.5
+LEASE_RANGE_REFILL_FRACTION = 0.25
+# Cap raft proposals per heartbeat so one node with many volumes
+# can't stall the heartbeat handler; the rest renew next pulse.
+LEASE_GRANTS_PER_PULSE = 8
 
 
 class MasterServer:
@@ -132,6 +158,22 @@ class MasterServer:
         self.raft = None
         self._seq_ckpt = 0  # highest committed sequence checkpoint
         self._seq_synced_term = -1  # raft term our sequencer is synced to
+        # ---- assign-lease table (replicated) ----
+        # vid -> {vid, holder, key_lo, key_hi, epoch, expires_at, ...};
+        # every entry was a committed raft "lease" command (or arrived
+        # in a snapshot), so the table survives leader failover and a
+        # fresh leader can honor — and avoid double-granting over —
+        # ranges its predecessor handed out.
+        self.leases: dict[int, dict] = {}
+        self._lease_epoch = 0           # replicated grant counter
+        self._lease_lock = threading.Lock()   # table/epoch mutations
+        self._grant_lock = threading.Lock()   # serializes grant checks
+        # leader-local: holder-reported mint cursor per vid, for the
+        # /cluster/leases "remaining range" view (not replicated)
+        self._lease_progress: dict[int, int] = {}
+        self.lease_counters = {"grant": 0, "renew": 0, "expire": 0}
+        self._m_lease = self.metrics.counter(
+            "master", "lease_total", "assign-lease operations", ("op",))
         # ---- durable state (reference checkpoints MaxVolumeId + sequence
         # through raft snapshots, topology/cluster_commands.go) ----
         self.meta_dir = meta_dir
@@ -186,6 +228,7 @@ class MasterServer:
         while not self._stop.wait(self.topo.pulse_seconds):
             ticks += 1
             self.topo.prune_dead_nodes()
+            self._expire_leases()
             self._save_state()
             self._feed_slo()
             if self.is_leader():
@@ -241,6 +284,9 @@ class MasterServer:
                 st = json.load(f)
             self.topo.max_volume_id = st.get("max_volume_id", 0)
             self.sequencer.set_max(st.get("sequence", 0))
+            for vid_s, l in (st.get("leases") or {}).items():
+                self.leases[int(vid_s)] = l
+            self._lease_epoch = st.get("lease_epoch", 0)
         except (OSError, ValueError):
             pass
 
@@ -250,9 +296,13 @@ class MasterServer:
         import json, os
         tmp = self._state_path() + ".tmp"
         try:
+            with self._lease_lock:
+                leases = {str(vid): l for vid, l in self.leases.items()}
+                epoch = self._lease_epoch
             with open(tmp, "w") as f:
                 json.dump({"max_volume_id": self.topo.max_volume_id,
-                           "sequence": self.sequencer.peek()}, f)
+                           "sequence": self.sequencer.peek(),
+                           "leases": leases, "lease_epoch": epoch}, f)
             os.replace(tmp, self._state_path())
         except OSError:
             pass
@@ -270,15 +320,21 @@ class MasterServer:
         self.raft = RaftNode(
             self.url, self.peers,
             apply_fn=self._apply_raft_command,
-            snapshot_fn=lambda: {"max_volume_id": self.topo.max_volume_id,
-                                 # followers never mint ids, so their live
-                                 # counter is stale — the committed
-                                 # checkpoint is the durable floor
-                                 "sequence": max(self._seq_ckpt,
-                                                 self.sequencer.peek())},
+            snapshot_fn=self._raft_snapshot_state,
             restore_fn=self._restore_raft_snapshot,
             state_path=state_path)
         self.raft.start()
+
+    def _raft_snapshot_state(self) -> dict:
+        with self._lease_lock:
+            leases = {str(vid): dict(l) for vid, l in self.leases.items()}
+            epoch = self._lease_epoch
+        return {"max_volume_id": self.topo.max_volume_id,
+                # followers never mint ids, so their live counter is
+                # stale — the committed checkpoint is the durable floor
+                "sequence": max(self._seq_ckpt, self.sequencer.peek()),
+                "leases": leases,
+                "lease_epoch": epoch}
 
     def _apply_raft_command(self, cmd: dict) -> None:
         """State machine: committed log entries (every master applies)."""
@@ -291,6 +347,8 @@ class MasterServer:
             # checkpoint once per leadership change (assign_fid) so a
             # continuing leader doesn't burn a batch per checkpoint
             self._seq_ckpt = max(self._seq_ckpt, cmd["value"])
+        elif cmd.get("type") == "lease":
+            self._apply_lease(cmd["lease"])
         elif cmd.get("type") == "raft_config":
             # membership change committed through the log, so every
             # master (and a restarted one replaying it) converges on
@@ -306,6 +364,27 @@ class MasterServer:
             self.topo.max_volume_id = max(self.topo.max_volume_id,
                                           state.get("max_volume_id", 0))
         self._seq_ckpt = max(self._seq_ckpt, state.get("sequence", 0))
+        with self._lease_lock:
+            for vid_s, l in (state.get("leases") or {}).items():
+                vid = int(vid_s)
+                cur = self.leases.get(vid)
+                if cur is None or l["epoch"] >= cur["epoch"]:
+                    self.leases[vid] = dict(l)
+            self._lease_epoch = max(self._lease_epoch,
+                                    state.get("lease_epoch", 0))
+
+    def _apply_lease(self, lease: dict) -> None:
+        """State-machine apply of a committed lease grant: install the
+        entry (newest epoch wins per vid) and floor the sequence
+        checkpoint past its range, so a failed-over leader resumes
+        minting beyond every key any predecessor leased out."""
+        vid = int(lease["vid"])
+        with self._lease_lock:
+            cur = self.leases.get(vid)
+            if cur is None or lease["epoch"] >= cur["epoch"]:
+                self.leases[vid] = dict(lease)
+            self._lease_epoch = max(self._lease_epoch, lease["epoch"])
+            self._seq_ckpt = max(self._seq_ckpt, lease["key_hi"] + 1)
 
     def _raft_propose(self, cmd: dict) -> bool:
         """Replicate a command; returns True once committed. Callers
@@ -385,6 +464,7 @@ class MasterServer:
         r("GET", "/dir/status", self._handle_dir_status)
         r("POST", "/vol/grow", self._handle_grow)
         r("GET", "/cluster/status", self._handle_cluster_status)
+        r("GET", "/cluster/leases", self._handle_cluster_leases)
         r("GET", "/cluster/health", self._handle_cluster_health)
         r("GET", "/cluster/qos", self._handle_cluster_qos)
         r("GET", "/cluster/telemetry", self._handle_cluster_telemetry)
@@ -634,11 +714,160 @@ class MasterServer:
             if vids:
                 self.repair_queue.note_drain(vids)
         # mirror reference reply: volume size limit + leader
-        return Response({
+        reply = {
             "volume_size_limit": self.topo.volume_size_limit,
             "leader": self.url,
             "metrics_address": "",
             "jwt_signing_key": self.jwt_signing_key,
+        }
+        # assign-lease piggyback: grants/renewals owed to this holder
+        # ride the reply (a draining node gets none — its leases lapse
+        # and writes fall back to healthy holders or the master)
+        if node is not None and not node.draining:
+            grants = self._lease_grants_for(node, hb.get("lease_req"))
+            if grants:
+                reply["leases"] = grants
+        return Response(reply)
+
+    def _sync_sequence(self, timeout: float = 2.0) -> Optional[dict]:
+        """Fast-forward the live sequencer past the committed
+        checkpoint once per leadership term (a fresh leader must never
+        re-mint ids its predecessor handed out or leased away).
+        Returns an assign-shaped error dict when raft leadership isn't
+        ready, else None. timeout<=0 makes the check non-blocking for
+        callers that must not stall (heartbeat grant path)."""
+        if self.raft is None:
+            return None
+        if not self.raft.is_ready():
+            # a fresh leader must commit its no-op barrier first so
+            # inherited checkpoints are applied before minting ids
+            if timeout <= 0 or not self.raft.wait_ready(timeout=timeout):
+                return {"error": "raft leader not ready",
+                        "leader": self.leader}
+        term = self.raft.current_term
+        if self._seq_synced_term != term:
+            # once per leadership change: jump past every id any
+            # previous leader may have handed out
+            self.sequencer.set_max(self._seq_ckpt)
+            self._seq_synced_term = term
+        return None
+
+    # ---- assign leases (grant/renew ride the heartbeat reply) ----
+    def _commit_lease(self, lease: dict) -> bool:
+        """Replicate a grant before handing it out; a lease the log
+        didn't commit must never reach a holder (it would vanish on
+        failover and the new leader could re-grant the same range)."""
+        if not self._raft_propose({"type": "lease", "lease": lease}):
+            return False
+        if self.raft is None:
+            # single-master mode: no log to apply from, install directly
+            self._apply_lease(lease)
+        return True
+
+    def _lease_grants_for(self, node, lease_req) -> list:
+        """Grants/renewals owed to one heartbeating holder. lease_req
+        is the holder's per-vid lease view ({vid: {"next_key": n,
+        "epoch": e}}, {} when it holds none) — None means the node
+        doesn't speak leases and gets nothing."""
+        if lease_req is None or not isinstance(lease_req, dict):
+            return []
+        if self._sync_sequence(timeout=0.0) is not None:
+            return []  # mid-election: grant on a later pulse
+        out = []
+        now = clockctl.now()
+        with self._grant_lock:
+            for vid_s, want in lease_req.items():
+                if len(out) >= LEASE_GRANTS_PER_PULSE:
+                    break
+                vid = int(vid_s)
+                want = want if isinstance(want, dict) else {}
+                vinfo = node.volumes.get(vid)
+                if vinfo is None or vinfo.get("read_only"):
+                    continue
+                if vinfo.get("ttl"):
+                    continue  # TTL volumes keep master-routed assigns
+                if vinfo.get("size", 0) >= self.topo.volume_size_limit:
+                    continue
+                cur = self.leases.get(vid)
+                if cur is not None and cur["expires_at"] > now \
+                        and cur["holder"] != node.url:
+                    continue  # another holder's live lease on this vid
+                renewing = (cur is not None and cur["holder"] == node.url
+                            and cur["expires_at"] > now)
+                if renewing:
+                    next_key = int(want.get("next_key", cur["key_lo"]))
+                    self._lease_progress[vid] = next_key
+                    left = cur["key_hi"] - next_key + 1
+                    if (cur["expires_at"] - now
+                            > LEASE_TTL_S * LEASE_RENEW_FRACTION
+                            and left > LEASE_RANGE
+                            * LEASE_RANGE_REFILL_FRACTION):
+                        continue  # healthy lease: nothing owed
+                key_lo = self.sequencer.next_file_id(LEASE_RANGE)
+                with self.topo.lock:
+                    replicas = [
+                        {"url": n.url, "publicUrl": n.public_url}
+                        for n in self.topo.lookup(
+                            vinfo.get("collection", ""), vid)
+                        if n.url != node.url]
+                from seaweedfs_tpu.storage.super_block import \
+                    ReplicaPlacement
+                lease = {"vid": vid, "holder": node.url,
+                         "holder_public": node.public_url,
+                         "key_lo": key_lo,
+                         "key_hi": key_lo + LEASE_RANGE - 1,
+                         "epoch": self._lease_epoch + 1,
+                         "expires_at": now + LEASE_TTL_S,
+                         "collection": vinfo.get("collection", ""),
+                         "replication": str(ReplicaPlacement.from_byte(
+                             vinfo.get("replica_placement", 0))),
+                         "replicas": replicas}
+                if not self._commit_lease(lease):
+                    break  # raft can't commit: no grants this pulse
+                self._lease_progress[vid] = key_lo
+                op = "renew" if renewing else "grant"
+                self.lease_counters[op] += 1
+                self._m_lease.inc(op)
+                out.append(lease)
+        return out
+
+    def _expire_leases(self) -> None:
+        """Drop lapsed entries (pulse cadence). Expiry is the only
+        revocation: the master never claws a live range back, it just
+        stops renewing, and the holder's own clockctl check refuses to
+        mint past expires_at."""
+        now = clockctl.now()
+        with self._lease_lock:
+            dead = [vid for vid, l in self.leases.items()
+                    if l["expires_at"] <= now]
+            for vid in dead:
+                del self.leases[vid]
+        for vid in dead:
+            self._lease_progress.pop(vid, None)
+            self.lease_counters["expire"] += 1
+            self._m_lease.inc("expire")
+
+    def _handle_cluster_leases(self, req: Request) -> Response:
+        """The assign-lease table: per-vid holder, epoch, remaining
+        range (from the holder's last-reported mint cursor) and expiry.
+        Served from the replicated table, so followers answer too —
+        clients refresh their lease directory from here even while the
+        leader is dark."""
+        now = clockctl.now()
+        with self._lease_lock:
+            leases = [dict(l) for _, l in sorted(self.leases.items())]
+        for l in leases:
+            nxt = self._lease_progress.get(l["vid"], l["key_lo"])
+            l["remaining_keys"] = max(0, l["key_hi"] - nxt + 1)
+            l["remaining_s"] = round(l["expires_at"] - now, 3)
+        return Response({
+            "master": self.url,
+            "leader": self.leader,
+            "is_leader": self.is_leader(),
+            "lease_ttl_s": LEASE_TTL_S,
+            "default_replication": self.default_replication,
+            "counters": dict(self.lease_counters),
+            "leases": leases,
         })
 
     def assign_fid(self, count: int = 1, collection: str = "",
@@ -647,19 +876,9 @@ class MasterServer:
         """Core assignment: pick/grow a writable volume, mint a fid.
         Returns the reply dict or {"error": ...} (used by both the HTTP
         and gRPC planes)."""
-        if self.raft is not None:
-            if not self.raft.is_ready():
-                # a fresh leader must commit its no-op barrier first so
-                # inherited checkpoints are applied before minting ids
-                if not self.raft.wait_ready(timeout=2.0):
-                    return {"error": "raft leader not ready",
-                            "leader": self.leader}
-            term = self.raft.current_term
-            if self._seq_synced_term != term:
-                # once per leadership change: jump past every id any
-                # previous leader may have handed out
-                self.sequencer.set_max(self._seq_ckpt)
-                self._seq_synced_term = term
+        err = self._sync_sequence()
+        if err is not None:
+            return err
         replication = replication or self.default_replication
         layout = self.topo.get_layout(collection, replication, ttl,
                                       disk_type)
@@ -729,7 +948,11 @@ class MasterServer:
             data_center=req.query.get("dataCenter", ""),
             disk_type=req.query.get("disk", ""))
         if "error" in reply:
-            return Response(reply, status=500)
+            # a not-ready fresh leader answers 503 + its leader hint so
+            # clients re-resolve and retry instead of treating it as a
+            # hard failure (wdclient._call follows the hint)
+            return Response(reply,
+                            status=503 if "leader" in reply else 500)
         return Response(reply)
 
     def _allocate_rpc(self, node, vid, collection, rp, ttl,

@@ -47,6 +47,17 @@ from seaweedfs_tpu.utils.resilience import (Deadline, PeerHealth,
                                             deadline_scope, hedged)
 
 PULSE_SECONDS = 2.0
+# Refuse to mint fids from a lease this close to its expiry: covers
+# clock skew between master and holder plus the in-flight upload time,
+# so an acked fid never rides a range the master already re-granted.
+LEASE_MINT_SAFETY_S = 3.0
+# Wake the heartbeat (renewal piggyback) once a mint leaves this
+# fraction or less of the granted range: a write flood can burn
+# LEASE_RANGE keys in under one pulse, and waiting out PULSE_SECONDS
+# would strand the holder range-exhausted — falling back to a master
+# that may be dark. Mirrors the master's LEASE_RANGE_REFILL_FRACTION
+# (the threshold at which it stops skipping healthy renewals).
+LEASE_REFILL_FRACTION = 0.25
 # Default edge budget for a public read that arrives without a
 # propagated X-Weed-Deadline: bounds the whole local -> remote ->
 # degraded-reconstruction chain (was: unbounded handler + timeout=30
@@ -91,6 +102,7 @@ class VolumeServer:
                  needle_cache_mb: int = 64,
                  hinted_handoff: bool = True,
                  zero_copy: bool = True,
+                 assign_leases: bool = True,
                  profile_hz: float = profiler.DEFAULT_HZ):
         """tcp_port >= 0 enables the raw TCP data path (0 = ephemeral;
         reference volume_server_tcp_handlers_write.go). grpc_port starts
@@ -156,6 +168,15 @@ class VolumeServer:
         through the raw needle-blob transfer once the peer heals. Off =
         the legacy any-leg-fails-the-write contract, kept as the
         comparator for the divergence drill.
+
+        assign_leases requests epoch-stamped fid-range leases from the
+        master via heartbeat piggyback and serves /admin/lease_assign:
+        clients mint fids here, off the master's per-PUT critical path,
+        and writes survive a master leader outage while a lease is
+        valid. Expiry discipline runs on clockctl so the sim can
+        rehearse lease lapses on the virtual clock. Off = this server
+        never requests leases and lease_assign answers 503, kept as
+        the bench comparator (assign_leases=False).
 
         profile_hz sets the always-on wall-stack sampler's rate
         (utils/profiler.py; 19Hz default, prime so it can't phase-lock
@@ -223,6 +244,19 @@ class VolumeServer:
         self.hinted_handoff = hinted_handoff
         self.hint_journal = None  # HintJournal, attached in start()
         self._hint_thread: Optional[threading.Thread] = None
+        # assign leases: vid -> lease dict from the master's grant,
+        # plus a local "next_key" mint cursor. Renewal wants ride every
+        # full heartbeat; expiry is checked against clockctl at mint.
+        self.assign_leases = assign_leases
+        self._leases: dict[int, dict] = {}
+        self._lease_lock = threading.Lock()
+        self.lease_stats = {"installed": 0, "minted": 0, "refused": 0}
+        # demand-triggered renewal: set when a mint drains a lease past
+        # its refill threshold, waking the heartbeat loop early so a
+        # fresh range lands before the active one exhausts (a flood can
+        # burn LEASE_RANGE keys in under one pulse). Also set by stop()
+        # to keep shutdown prompt.
+        self._lease_hungry = threading.Event()
         # lazily-built shared pool for the concurrent replica fan-out
         self._replicate_pool: Optional[object] = None
         self._replicate_pool_lock = threading.Lock()
@@ -385,6 +419,7 @@ class VolumeServer:
         the group commit, then send a final draining heartbeat so the
         grace clock restarts from the actual departure."""
         self._stop.set()
+        self._lease_hungry.set()  # wake the heartbeat loop's wait
         self.sampler.stop()
         if self.scrubber is not None:
             self.scrubber.stop()
@@ -482,6 +517,9 @@ class VolumeServer:
         hb["telemetry"] = self.telemetry_snapshot()
         if self.grpc_port:
             hb["grpc_port"] = self.grpc_port
+        lease_req = self._lease_req(hb)
+        if lease_req is not None:
+            hb["lease_req"] = lease_req
         for _attempt in range(2):  # second try after a leader redirect
             try:
                 reply = self._master_json(
@@ -493,6 +531,7 @@ class VolumeServer:
                     if reply.get("jwt_signing_key") \
                             and not self.jwt_signing_key:
                         self.jwt_signing_key = reply["jwt_signing_key"]
+                    self._install_leases(reply)
                 return
             except HttpError as e:
                 old = self.master_url
@@ -505,29 +544,140 @@ class VolumeServer:
     def _follow_leader_hint(self, e: "HttpError") -> None:
         """A follower replied 409 {"leader": url}: re-aim at the leader
         (the reference restarts doHeartbeat at the new leader,
-        volume_grpc_client_to_master.go newLeader handling)."""
+        volume_grpc_client_to_master.go newLeader handling). A 409
+        WITHOUT a hint — a deposed leader cut off from the election —
+        falls through to _fail_over, else the node would hammer the
+        ex-leader forever and never re-register with the winner."""
         import json as _json
         try:
             body = _json.loads(e.body)
         except Exception:
-            return
+            body = {}
         leader = body.get("leader")
         if leader and leader != self.master_url:
             self.master_url = leader
+        else:
+            self._fail_over()
 
     def _fail_over(self) -> None:
         for url in self.master_urls:
             if url == self.master_url:
                 continue
             try:
-                http_json("GET", f"http://{url}/cluster/status",
-                          deadline=Deadline.after(2.0))
+                out = http_json("GET", f"http://{url}/cluster/status",
+                                deadline=Deadline.after(2.0))
                 self.peer_health.record(url, True)
-                self.master_url = url
+                # adopt the peer's leader view when it has one; a live
+                # follower is still a fine next hop (its 409 will carry
+                # the hint once the election settles)
+                leader = (out or {}).get("Leader")
+                self.master_url = leader or url
                 return
             except (ConnectionError, HttpError):
                 self.peer_health.record(url, False)
                 continue
+
+    # ---- assign leases (local fid minting off the master's path) ----
+    def _lease_req(self, hb: dict) -> Optional[dict]:
+        """Renewal wants for the heartbeat piggyback: one entry per
+        writable local volume, carrying the mint cursor + epoch of any
+        lease already held so the master can skip still-healthy ones.
+        Also GCs lapsed leases — expiry is the only revocation."""
+        if not self.assign_leases:
+            return None
+        req: dict[str, dict] = {}
+        now = clockctl.now()
+        with self._lease_lock:
+            for vid in [vid for vid, l in self._leases.items()
+                        if l["expires_at"] <= now]:
+                del self._leases[vid]
+            for v in hb.get("volumes", []):
+                if v.get("read_only"):
+                    continue
+                if self.volume_size_limit \
+                        and v.get("size", 0) >= self.volume_size_limit:
+                    continue
+                held = self._leases.get(v["id"])
+                req[str(v["id"])] = (
+                    {"next_key": held["next_key"], "epoch": held["epoch"]}
+                    if held else {})
+        return req
+
+    def _install_leases(self, reply: dict) -> None:
+        """Adopt granted/renewed leases from a heartbeat reply. A grant
+        from an older epoch (a stale leader's last gasp) never replaces
+        a newer one; every accepted grant is a fresh range, so the mint
+        cursor resets to its key_lo."""
+        for l in reply.get("leases") or []:
+            vid = int(l["vid"])
+            with self._lease_lock:
+                cur = self._leases.get(vid)
+                if cur is not None and l["epoch"] < cur["epoch"]:
+                    continue
+                self._leases[vid] = dict(l, next_key=l["key_lo"])
+                self.lease_stats["installed"] += 1
+            # the grant names this vid's replica peers: prime the
+            # fan-out cache so a leased write replicates even while
+            # the master (this cache's only other source) is dark
+            peers = [r["url"] for r in l.get("replicas", [])
+                     if not self._is_self(r["url"])]
+            if peers:
+                self._replica_cache[vid] = (
+                    clockctl.monotonic() + self.REPLICA_CACHE_TTL, peers)
+
+    def _admin_lease_assign(self, req: Request) -> Response:
+        """Mint fids locally from an active lease (the direct-to-volume
+        assign lane; shape mirrors the master's /dir/assign reply).
+        Refuses — 503, so clients fall back to the master — when no
+        matching lease is valid: none held, wrong collection, range
+        exhausted, or within LEASE_MINT_SAFETY_S of expiry."""
+        count = max(1, int(req.query.get("count", "1") or "1"))
+        collection = req.query.get("collection", "")
+        if self.draining or not self.assign_leases:
+            return Response({"error": "no active lease"}, status=503)
+        chosen = None
+        now = clockctl.now()
+        with self._lease_lock:
+            for vid, l in self._leases.items():
+                if l["expires_at"] - now <= LEASE_MINT_SAFETY_S:
+                    continue
+                if l.get("collection", "") != collection:
+                    continue
+                if l["next_key"] + count > l["key_hi"] + 1:
+                    continue
+                v = self.store.find_volume(vid)
+                if v is None or v.read_only:
+                    continue
+                if self.volume_size_limit \
+                        and v.content_size() >= self.volume_size_limit:
+                    continue
+                key = l["next_key"]
+                l["next_key"] += count
+                chosen = (vid, dict(l), key)
+                break
+            if chosen is None:
+                self.lease_stats["refused"] += 1
+            else:
+                self.lease_stats["minted"] += count
+                span = chosen[1]["key_hi"] - chosen[1]["key_lo"] + 1
+                left = chosen[1]["key_hi"] - chosen[1]["next_key"] + 1
+                if left <= span * LEASE_REFILL_FRACTION:
+                    # running dry: pulse now, don't wait out the tick
+                    self._lease_hungry.set()
+        if chosen is None:
+            return Response({"error": "no active lease"}, status=503)
+        vid, lease, key = chosen
+        import random
+        from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+        cookie = random.getrandbits(32)
+        out = {"fid": f"{vid},{format_needle_id_cookie(key, cookie)}",
+               "url": self.url, "publicUrl": self.store.public_url,
+               "count": count, "lease_epoch": lease["epoch"],
+               "replicas": lease.get("replicas", [])}
+        if self.jwt_signing_key:
+            from seaweedfs_tpu.utils.security import gen_jwt
+            out["auth"] = gen_jwt(self.jwt_signing_key, out["fid"])
+        return Response(out)
 
     def _push_deltas(self) -> None:
         """Send pending volume/EC-shard deltas to the master immediately
@@ -554,7 +704,13 @@ class VolumeServer:
 
     def _heartbeat_loop(self) -> None:
         ticks = 0
-        while not self._stop.wait(PULSE_SECONDS):
+        while True:
+            # pulse cadence, cut short when a mint drains a lease past
+            # its refill threshold (or stop() wakes us for shutdown)
+            self._lease_hungry.wait(PULSE_SECONDS)
+            self._lease_hungry.clear()
+            if self._stop.is_set():
+                return
             ticks += 1
             if ticks % 12 == 0:
                 # TTL volume reaping (reference master vacuum loop
@@ -580,6 +736,7 @@ class VolumeServer:
                     reply = self._master_json(
                         "POST", "/heartbeat", body,
                         deadline=Deadline.after(2 * PULSE_SECONDS))
+                    self._install_leases(reply or {})
                 else:
                     self.heartbeat_once()
             except HttpError as e:
@@ -656,6 +813,8 @@ class VolumeServer:
         # integrity scrub
         r("POST", "/admin/scrub", self._admin_scrub)
         r("GET", "/admin/scrub/status", self._admin_scrub_status)
+        # direct-to-volume fid minting from the master's assign lease
+        r("POST", "/admin/lease_assign", self._admin_lease_assign)
         # per-peer breaker/health table (cluster.health shell command)
         r("GET", "/admin/health", self._admin_health)
         # admission-control snapshot + runtime tuning (cluster.qos)
@@ -1616,6 +1775,9 @@ class VolumeServer:
         extra = {}
         if self.tcp_server is not None:
             extra["TcpPort"] = self.tcp_server.port
+        with self._lease_lock:
+            extra["Leases"] = {"held": len(self._leases),
+                               **self.lease_stats}
         return Response({"Version": "seaweedfs-tpu 0.1", **extra, **hb})
 
     # ---- admin ----

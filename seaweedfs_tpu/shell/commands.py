@@ -811,6 +811,30 @@ class ShellContext:
                 node["health"] = {"error": type(e).__name__}
         return out
 
+    def cluster_leases(self) -> dict:
+        """Assign-lease view: the master's grant table (holder, range,
+        epoch, remaining keys/seconds) + grant/renew/expire counters,
+        enriched with each holder's own mint/refuse stats from /status.
+        Served by followers too — the table is Raft-replicated — so it
+        keeps answering through a leader outage, which is exactly when
+        an operator wants it. An unreachable holder is reported, not
+        fatal."""
+        out = http_json("GET",
+                        f"http://{self.master_url}/cluster/leases")
+        holders: dict[str, dict] = {}
+        for lease in out.get("leases", []):
+            url = lease.get("holder", "")
+            if not url or url in holders:
+                continue
+            try:
+                status = http_json("GET", f"http://{url}/status")
+                holders[url] = status.get("Leases",
+                                          {"error": "no lease stats"})
+            except Exception as e:
+                holders[url] = {"error": type(e).__name__}
+        out["holders"] = holders
+        return out
+
     def cluster_shards(self) -> dict:
         """Namespace-sharding view: the master's filer ring (members +
         epoch) enriched with each filer's /__api/shard/status — routing

@@ -59,6 +59,8 @@ HELP = """commands:
   ec.repair.kick                    clear backoffs, dispatch queued repairs
   cluster.health                    per-peer circuit breakers, scrub state,
                                     repair bandwidth budget
+  cluster.leases                    assign-lease grant table (holder, range,
+                                    epoch, remaining) + mint/refuse stats
   cluster.qos [-node HOST:PORT] [-limit N] [-minLimit N] [-maxLimit N]
               [-tenantRate R] [-tenantBurst B] [-enable|-disable]
                                     per-node admission-control view; with
@@ -623,6 +625,8 @@ def run_command(sh: ShellContext, line: str):
         return sh.ec_repair_status()
     if cmd == "cluster.health":
         return sh.cluster_health()
+    if cmd == "cluster.leases":
+        return sh.cluster_leases()
     if cmd == "cluster.shards":
         return sh.cluster_shards()
     if cmd == "cluster.qos":

@@ -37,6 +37,8 @@ from seaweedfs_tpu.utils.resilience import PeerHealth
 
 PULSE = 2.0                 # heartbeat period, matches server.PULSE_SECONDS
 DEAD_AFTER = PULSE * 5      # liveness timeout, matches topology prune
+SIM_LEASE_TTL = 30.0        # matches server.master LEASE_TTL_S
+SIM_LEASE_SAFETY = 3.0      # matches volume_server LEASE_MINT_SAFETY_S
 
 
 class SimResource:
@@ -136,6 +138,10 @@ class VolumeActor:
         self.crashed = False
         self.draining = False
         self.epoch = 0
+        # assign lease from the master's heartbeat-reply grant
+        # ({"epoch": term, "expires_at": t}); in-memory only, so a
+        # restart loses it until the next heartbeat re-grants
+        self.lease: Optional[dict] = None
         self.active = 0               # in-flight client/replica requests
         self.base_volume_bytes = base_volume_bytes
         self.volumes: dict[int, dict] = {}   # vid -> {key: version}
@@ -158,6 +164,7 @@ class VolumeActor:
         self.crashed = False
         self.draining = False
         self.epoch += 1
+        self.lease = None  # leases are process memory, not disk
         self.kernel.note(self.name, "restore")
         self.start()
 
@@ -188,7 +195,14 @@ class VolumeActor:
         epoch = self.epoch
         while not self.crashed and self.epoch == epoch:
             try:
-                yield self._hb()
+                r = yield self._hb()
+                lease = (r or {}).get("lease")
+                if lease is not None and (
+                        self.lease is None
+                        or lease["epoch"] >= self.lease["epoch"]):
+                    # grant/renewal piggybacked on the reply; a stale
+                    # leader's lower-epoch grant never wins
+                    self.lease = lease
             except (SimError, SimShed):
                 pass  # missed pulse; the master's timeout does the rest
             yield PULSE
@@ -212,6 +226,18 @@ class VolumeActor:
                 return {"data": data, "bytes": nbytes}
             finally:
                 grant.release()
+        if op == "lease_assign":
+            # local fid mint from the heartbeat-granted lease — the
+            # sim twin of /admin/lease_assign. Expiry discipline uses
+            # the same safety margin as the real holder; a refusal
+            # sends the filer to the next holder or the master.
+            yield 0.0002
+            l = self.lease
+            if (self.draining or l is None
+                    or l["expires_at"] - self.kernel.now
+                    <= SIM_LEASE_SAFETY):
+                raise SimError(f"no lease {self.name}")
+            return {"ok": True, "epoch": l["epoch"]}
         if op == "repair_install":
             vid = body["vid"]
             merged = self.volumes.setdefault(vid, {})
@@ -327,11 +353,43 @@ class FilerActor:
         if (vid in self._layout
                 and k.now - self._layout_at.get(vid, -1e9) < self.LOOKUP_TTL):
             return self._layout[vid]
-        r = yield self.sim.transport.call(
-            self.name, "master", "lookup", {"vid": vid}, timeout=0.5)
+        try:
+            r = yield self.sim.transport.call(
+                self.name, "master", "lookup", {"vid": vid}, timeout=0.5)
+        except (SimError, SimShed):
+            if vid in self._layout:
+                # stale-while-revalidate, the real wdclient's cache
+                # contract: a dark master must not fail ops whose
+                # layout we already know. Re-arm the clock so the
+                # outage isn't re-probed on every single op.
+                self._layout_at[vid] = k.now
+                return self._layout[vid]
+            raise
         self._layout[vid] = r["holders"]
         self._layout_at[vid] = k.now
         return r["holders"]
+
+    def _assign(self, vid: int, holders: list):
+        """The fid mint for one write: any leased holder mints locally
+        (the sim twin of wdclient.assign_from_lease), the master's
+        /dir/assign is the fallback. With leases off every write pays
+        — and during a leader outage loses — the master round trip."""
+        if self.sim.assign_leases:
+            ranked = self.peers.rank(holders)
+            for i, h in enumerate(ranked):
+                if not self.peers.allow(h) and i < len(ranked) - 1:
+                    continue
+                try:
+                    yield self.sim.transport.call(
+                        self.name, h, "lease_assign", {"vid": vid},
+                        timeout=0.5)
+                except (SimError, SimShed):
+                    continue
+                self.sim.metrics.lease_mints += 1
+                return
+        yield self.sim.transport.call(
+            self.name, "master", "assign_fid", {"vid": vid}, timeout=0.5)
+        self.sim.metrics.master_assigns += 1
 
     def _read(self, op):
         vid = op.key % self.sim.n_vids
@@ -390,8 +448,9 @@ class FilerActor:
 
     def _write(self, op):
         vid = op.key % self.sim.n_vids
-        version = self.sim.metrics.next_version()
         holders = yield from self._holders(vid)
+        yield from self._assign(vid, holders)
+        version = self.sim.metrics.next_version()
         ranked = self.peers.rank(holders)
         last: Optional[BaseException] = None
         for i, h in enumerate(ranked):
@@ -434,6 +493,15 @@ class MasterActor:
         self.crashed = False
         self.draining = False
         self.epoch = 0
+        # Raft modeling: the actor is "the master service", not one
+        # process. leaderless=True is the election window after a
+        # leader crash — leader-only ops (heartbeats, assign_fid,
+        # repair control) refuse, while lookups keep flowing because
+        # any follower serves them from replicated topology. term is
+        # bumped on takeover(); lease grants are stamped with it, so
+        # a stale leader's grants lose to the new term's.
+        self.leaderless = False
+        self.term = 1
         self.replication = replication
         self.repair_grace_s = repair_grace_s
         self.drain_grace_s = drain_grace_s
@@ -450,10 +518,21 @@ class MasterActor:
         self.repair_active_max = 0
         self.repairs_done = 0
         self.repair_enqueued_for: dict[str, int] = {}
+        # vid -> completed-rebuild count: the mid-repair failover
+        # invariant reads this (no vid rebuilt twice across terms)
+        self.repair_log: dict[int, int] = {}
         self.converged_at: Optional[float] = None
 
     def start(self) -> None:
         self.kernel.spawn(self._control_loop())
+
+    def fail_leader(self) -> None:
+        """The leader process dies. Its in-flight repair streams die
+        with it (epoch bump aborts them at their next yield); every
+        leader-only RPC refuses until takeover()."""
+        self.leaderless = True
+        self.epoch += 1
+        self.kernel.note("master", "leader_fail", f"term={self.term}")
 
     def register(self, node: str, az: int) -> None:
         self.nodes[node] = {"last_seen": 0.0, "draining": False,
@@ -462,6 +541,11 @@ class MasterActor:
     # -- rpc --
     def handle(self, op, body, src):
         yield 0.0002  # request parse/dispatch cost
+        if self.leaderless and op != "lookup":
+            # election window: no leader to process heartbeats or
+            # mint fids — but any follower serves lookups from the
+            # replicated topology, so reads never notice
+            raise SimError("no raft leader")
         if op == "heartbeat":
             st = self.nodes.get(src)
             if st is None:
@@ -479,7 +563,17 @@ class MasterActor:
                 self.dead.discard(src)
                 self.drain_grace_until.pop(src, None)
                 self.kernel.note("master", "rejoin", src)
-            return {"ok": True}
+            reply = {"ok": True}
+            if self.sim.assign_leases and not st["draining"] \
+                    and not body.get("final"):
+                # grant/renew the assign lease on the reply piggyback,
+                # epoch-stamped with the current term; the 15x
+                # TTL/pulse ratio means a leader outage shorter than
+                # the TTL never interrupts local minting
+                reply["lease"] = {"epoch": self.term,
+                                  "expires_at": (self.kernel.now
+                                                 + SIM_LEASE_TTL)}
+            return reply
         if op == "lookup":
             holders = self.layout.get(body["vid"])
             if holders is None:
@@ -493,7 +587,34 @@ class MasterActor:
             if not live:
                 raise SimError("no writable nodes")
             return {"nodes": live}
+        if op == "assign_fid":
+            # the /dir/assign fallback lane: a plain leader round trip
+            # (refused outright while the leader is down — exactly the
+            # outage the lease lane exists to ride out)
+            return {"ok": True, "term": self.term}
         raise SimError(f"bad master op {op}")
+
+    # -- leader failover --
+    def takeover(self) -> None:
+        """A follower wins the election. Raft-replicated state (node
+        registry, volume layout, lease grants — all ride the log)
+        survives into the new term; leader-local repair bookkeeping
+        (queue, active wave, degraded-scan clocks) does not — the new
+        leader re-derives it from its own degraded scan, which is how
+        the real RepairQueue refills after failover. Liveness clocks
+        restart so the outage itself can't declare the fleet dead."""
+        self.leaderless = False
+        self.epoch += 1
+        self.term += 1
+        now = self.kernel.now
+        for st in self.nodes.values():
+            st["last_seen"] = now
+        self._queue.clear()
+        self._queued.clear()
+        self._active.clear()
+        self._degraded_since.clear()
+        self.converged_at = None
+        self.kernel.note("master", "takeover", f"term={self.term}")
 
     # -- liveness helpers --
     def _fresh(self, node: str) -> bool:
@@ -513,6 +634,8 @@ class MasterActor:
     def _control_loop(self):
         while True:
             yield PULSE
+            if self.crashed or self.leaderless:
+                continue  # no leader: no scans, no dispatch
             now = self.kernel.now
             for node in sorted(self.nodes):
                 if node in self.dead or self._counts_as_present(node):
@@ -564,6 +687,11 @@ class MasterActor:
             self.kernel.spawn(self._repair_task(vid))
 
     def _repair_task(self, vid: int):
+        # A repair stream belongs to the leader incarnation that
+        # dispatched it: after a takeover the new leader rebuilds its
+        # own wave, so a stale task finishing would double-rebuild the
+        # vid. Check the epoch after every yield and abort silently.
+        epoch0 = self.epoch
         try:
             holders = self.layout[vid]
             sources = sorted((h for h in holders if self._fresh(h)),
@@ -579,11 +707,17 @@ class MasterActor:
             source, target = sources[0], targets[0]
             r = yield self.sim.transport.call(
                 "master", source, "repair_pull", {"vid": vid}, timeout=5.0)
+            if self.crashed or self.epoch != epoch0:
+                return
             # paced stream: bytes over the per-stream bandwidth share
             yield r["bytes"] / self.repair_stream_bw
+            if self.crashed or self.epoch != epoch0:
+                return
             yield self.sim.transport.call(
                 "master", target, "repair_install",
                 {"vid": vid, "data": r["data"]}, timeout=5.0)
+            if self.crashed or self.epoch != epoch0:
+                return
             dead_holders = [h for h in holders
                             if not self._counts_as_present(h)]
             new = [h for h in holders if h != dead_holders[0]] \
@@ -591,14 +725,17 @@ class MasterActor:
             new.append(target)
             self.layout[vid] = new
             self.repairs_done += 1
+            self.repair_log[vid] = self.repair_log.get(vid, 0) + 1
             self.kernel.note("master", "repair_done", f"{vid}->{target}")
         except SimShed as e:
             # source shed us (foreground pressure): back off politely
             yield min(2.0, e.retry_after) + self.kernel.rng.random() * 0.2
-            self._requeue(vid)
+            if not self.crashed and self.epoch == epoch0:
+                self._requeue(vid)
         except SimError:
             yield 0.5 + self.kernel.rng.random() * 0.5
-            self._requeue(vid)
+            if not self.crashed and self.epoch == epoch0:
+                self._requeue(vid)
         finally:
             self._active.discard(vid)
 

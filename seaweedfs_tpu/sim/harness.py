@@ -54,6 +54,10 @@ class SimMetrics:
         self.sheds: dict[str, int] = {}      # tenant -> shed retries seen
         self.fail_total = 0
         self.fail_samples: list[str] = []
+        # assign-lane split: fids minted from a holder's lease vs
+        # round trips to the master's assign_fid fallback
+        self.lease_mints = 0
+        self.master_assigns = 0
         self.acked: dict[int, tuple] = {}    # key -> (version, vid)
         self._ver = 0
         # cumulative per-class [total, bad] for the SLO burn evaluator
@@ -104,6 +108,8 @@ class SimMetrics:
             "tenants": {t: {"ok": v[0], "fail": v[1]}
                         for t, v in sorted(self.tenants.items())},
             "sheds": dict(sorted(self.sheds.items())),
+            "assign": {"leased": self.lease_mints,
+                       "master": self.master_assigns},
             "fail_samples": list(self.fail_samples),
         }
 
@@ -114,7 +120,8 @@ class SimCluster:
                  replication: int = 3, schedule=None,
                  repair_grace_s: float = 5.0, drain_grace_s: float = 45.0,
                  max_repair_streams: int = 6,
-                 repair_stream_bw: float = 16e6):
+                 repair_stream_bw: float = 16e6,
+                 assign_leases: bool = True):
         if n_az < replication:
             raise ValueError("need n_az >= replication for AZ-disjoint "
                              "placement")
@@ -126,6 +133,9 @@ class SimCluster:
         self.n_az = n_az
         self.n_vids = n_volume_actors * vids_per_node
         self.replication = replication
+        # comparator toggle: False routes every write's fid assignment
+        # through the master (the pre-lease protocol)
+        self.assign_leases = assign_leases
 
         self.master = MasterActor(
             self, replication=replication, repair_grace_s=repair_grace_s,
@@ -209,6 +219,15 @@ class SimCluster:
 
     def drain(self, name: str) -> None:
         self.kernel.spawn(self.actor(name).drain())
+
+    def fail_master_leader(self, outage_s: float) -> None:
+        """Raft leader loss: leader-only master functions go dark for
+        ``outage_s`` (the election window), then a follower takes over
+        with the replicated state and a bumped term. Holders keep
+        minting from their epoch-stamped leases the whole time."""
+        self.kernel.note("incident", "master_leader_fail", f"{outage_s}")
+        self.master.fail_leader()
+        self.kernel.schedule(outage_s, self.master.takeover)
 
     # -- workload --
     def load(self, ops) -> None:

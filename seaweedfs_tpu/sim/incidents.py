@@ -1,6 +1,6 @@
 """Scripted incident library + machine-checked invariants.
 
-Six incidents, each a pure function of (seed, n_actors):
+Eight incidents, each a pure function of (seed, n_actors):
 
   az_loss          grey-failure prelude (scripted latency band on every
                    link), then correlated crash of one whole AZ; the
@@ -28,6 +28,21 @@ Six incidents, each a pure function of (seed, n_actors):
                    the victims, settle the half-finished wave, lose no
                    acked write, and re-close every breaker — the sim
                    rehearsal of the hinted-handoff divergence drill.
+  master_failover_mid_write
+                   the Raft leader dies mid-write-flood for a 6s
+                   election window; the fid-range assign leases must
+                   carry every write (ZERO failed client requests,
+                   lease mints observed during the dark window), the
+                   new leader takes over with a bumped term, and the
+                   outage alone must trigger no repairs and declare
+                   no node dead.
+  master_failover_mid_repair
+                   the leader dies while a crash-triggered repair wave
+                   is mid-flight; the dead leader's streams abort, the
+                   new leader re-derives the wave from its own scan and
+                   finishes it — no vid rebuilt twice, no repair entry
+                   lost, zero acked-write loss, convergence within the
+                   budget stretched only by the election + re-detect.
   ec_single_shard_loss
                    ONE shard holder dies under live traffic — the LRC
                    repair drill.  Hybrid incident: the sim cluster must
@@ -470,6 +485,135 @@ def _ec_single_shard_loss(cluster: SimCluster, n_actors: int,
     return checks
 
 
+def _master_failover_mid_write(cluster: SimCluster, n_actors: int,
+                               rate: float) -> list:
+    """The headline lease drill: the Raft leader dies under a full
+    write flood. Holders hold epoch-stamped fid-range leases renewed
+    every heartbeat (TTL 15x the pulse), so local minting rides out
+    any election window shorter than the TTL — the dark master must
+    cost ZERO failed client requests. Reads survive on follower-served
+    lookups; the new leader's bumped term proves the failover actually
+    happened rather than the window being too gentle to notice."""
+    duration, t_fail, outage = 40.0, 12.0, 6.0
+    wl = ZipfWorkload(default_tenants(4, rate), seed=cluster.kernel.seed)
+    cluster.load(wl.generate(duration))
+    cluster.run(t_fail)
+    mints_before = cluster.metrics.lease_mints
+    cluster.fail_master_leader(outage)
+    cluster.run(t_fail + outage)
+    mints_during = cluster.metrics.lease_mints - mints_before
+    cluster.run(duration)
+    _settle(cluster, wl, duration, 10.0)
+    cluster.run(duration + 12.0)
+    m = cluster.master
+    checks: list = []
+    _common_invariants(cluster, checks)
+    checks.append(_check(
+        "zero_failed_client_requests", cluster.metrics.fail_total == 0,
+        f"{cluster.metrics.fail_total} failed ops "
+        f"(samples: {cluster.metrics.fail_samples[:3]})"
+        if cluster.metrics.fail_total else
+        f"all {cluster.metrics.ops_total()} ops succeeded across the "
+        f"{outage:.0f}s election window"))
+    checks.append(_check(
+        "writes_minted_during_outage", mints_during > 0,
+        f"{mints_during} fids minted from leases while the "
+        f"leader was dark"))
+    checks.append(_check(
+        "leader_took_over", m.term == 2,
+        f"term={m.term} (takeover {'happened' if m.term == 2 else 'MISSING'})"))
+    checks.append(_check(
+        "no_spurious_repairs", m.repairs_done == 0 and not m.dead,
+        f"repairs={m.repairs_done} dead={sorted(m.dead)}"
+        if m.repairs_done or m.dead else
+        "election window triggered no repair and declared nobody dead"))
+    _tenant_invariant(cluster, checks)
+    _breaker_invariant(cluster, checks)
+    return checks
+
+
+def _master_failover_mid_repair(cluster: SimCluster, n_actors: int,
+                                rate: float) -> list:
+    """Cascading failover: a herd crash puts a repair wave in flight,
+    then the leader coordinating that wave dies. The dead leader's
+    streams abort at their next yield (they belong to the old
+    incarnation); the new leader starts with an empty queue and must
+    re-derive the remaining work from its own degraded scan — repairs
+    already committed to the replicated layout are not redone (no vid
+    rebuilt twice), repairs not yet committed are not forgotten (the
+    fleet still converges)."""
+    duration, t_crash, t_leader, outage = 45.0, 10.0, 27.0, 6.0
+    victims = [f"vol-{i}" for i in range(0, n_actors, 7)]
+    wl = ZipfWorkload(default_tenants(4, rate), seed=cluster.kernel.seed)
+    cluster.load(wl.generate(duration))
+
+    def herd():
+        yield t_crash
+        cluster.kernel.note("incident", "herd_crash", str(len(victims)))
+        for v in victims:
+            cluster.crash(v)
+
+    cluster.kernel.spawn(herd())
+    # run exactly to the leader failure and snapshot the repair plane:
+    # the wave must already be engaged when the leader dies, or the
+    # incident degenerates into plain herd_repair
+    cluster.run(t_leader)
+    m = cluster.master
+    wave_at_fail = (len(m._queue), len(m._active), m.repairs_done)
+    cluster.fail_master_leader(outage)
+    cluster.run(duration)
+    degraded = sum(1 for vid, holders in cluster.master.layout.items()
+                   if any(cluster.actor(h).crashed for h in holders))
+    _settle(cluster, wl, duration, 30.0)
+    cluster.run_until_converged(duration + 120.0)
+    cluster.run(cluster.kernel.now + 8.0)
+    checks: list = []
+    _common_invariants(cluster, checks)
+    checks.append(_check(
+        "repair_wave_engaged_before_failover", any(wave_at_fail),
+        f"at leader death: queued={wave_at_fail[0]} "
+        f"active={wave_at_fail[1]} done={wave_at_fail[2]}"))
+    checks.append(_check(
+        "leader_took_over", m.term == 2,
+        f"term={m.term}"))
+    dup = {v: n for v, n in m.repair_log.items() if n > 1}
+    checks.append(_check(
+        "no_duplicate_rebuilds", not dup,
+        f"vids rebuilt more than once: {dup}" if dup else
+        f"{len(m.repair_log)} vids rebuilt exactly once across terms"))
+    checks.append(_check(
+        "repair_wave_settled", not m._queue and not m._active
+        and not cluster.degraded_vids(),
+        f"queue={len(m._queue)} active={len(m._active)} "
+        f"degraded={len(cluster.degraded_vids())}"))
+    # standard pacing budget from the crash instant, stretched by the
+    # election window plus one liveness re-detection cycle (takeover
+    # resets every node's clock, so the dead are re-declared ~10s +
+    # scan grace later)
+    copy_s = (cluster.volumes[0].base_volume_bytes
+              / m.repair_stream_bw + 0.1)
+    budget = (12.0 + m.repair_grace_s
+              + 3.5 * degraded * copy_s / m.max_repair_streams
+              + 15.0 + outage + 12.0 + m.repair_grace_s)
+    took = (m.converged_at - t_crash) if m.converged_at else None
+    checks.append(_check(
+        "repair_converged_in_budget",
+        took is not None and took <= budget,
+        f"converged in {took:.1f}s (budget {budget:.1f}s, "
+        f"{m.repairs_done} repairs across terms)" if took is not None
+        else f"NOT converged (queue={len(m._queue)} "
+             f"active={len(m._active)} "
+             f"degraded={len(cluster.degraded_vids())})"))
+    checks.append(_check(
+        "repair_pacing_held",
+        m.repair_active_max <= m.max_repair_streams,
+        f"max active streams {m.repair_active_max} "
+        f"<= budget {m.max_repair_streams}"))
+    _breaker_invariant(cluster, checks)
+    _tenant_invariant(cluster, checks)
+    return checks
+
+
 INCIDENTS = {
     "az_loss": _az_loss,
     "rolling_restart": _rolling_restart,
@@ -477,6 +621,8 @@ INCIDENTS = {
     "tenant_flood": _tenant_flood,
     "partition_heal_mid_repair": _partition_heal_mid_repair,
     "ec_single_shard_loss": _ec_single_shard_loss,
+    "master_failover_mid_write": _master_failover_mid_write,
+    "master_failover_mid_repair": _master_failover_mid_repair,
 }
 
 

@@ -1,0 +1,226 @@
+"""Assign-lease lane: master-outage-tolerant fid minting.
+
+The master grants volume servers epoch-stamped fid-range leases on the
+heartbeat reply; holders mint fids locally via /admin/lease_assign and
+clients (wdclient) prefer that lane over /dir/assign. These tests pin
+the grant/install/mint/refuse ladder against real in-process servers,
+the wdclient leader re-resolution on 503, and the assign_leases=False
+comparator (bit-identical stored bytes either way).
+"""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.client import operation
+from seaweedfs_tpu.client.wdclient import MasterClient
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils.httpd import (HttpError, HttpServer, Response,
+                                       http_json)
+from seaweedfs_tpu.utils.resilience import Deadline, deadline_scope
+
+
+@pytest.fixture
+def duo(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url)
+    vs.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not master.topo.all_nodes():
+        time.sleep(0.05)
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def _wait_lease(vs, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        with vs._lease_lock:
+            if vs._leases:
+                return dict(next(iter(vs._leases.values())))
+        time.sleep(0.1)
+    raise AssertionError("holder never received a lease")
+
+
+def test_heartbeat_grants_lease_and_holder_mints_locally(duo):
+    master, vs = duo
+    mc = MasterClient(master.url)
+    # first assign grows the volume (master path); the next heartbeat
+    # asks for a lease on it and the grant rides the reply back
+    first = mc.assign()
+    assert first.get("fid"), first
+    lease = _wait_lease(vs)
+    assert lease["epoch"] >= 1
+    assert lease["key_hi"] > lease["key_lo"]
+    assert master.lease_counters["grant"] >= 1
+
+    # now the lane mints without the master: upload + readback through
+    # a lease-minted fid is bit-identical
+    out = mc.assign()
+    assert out.get("lease_epoch") == lease["epoch"], out
+    assert mc.lease_assigns == 1
+    data = b"leased needle payload" * 64
+    operation.upload_to(out["fid"], out["url"], data)
+    assert operation.read_data(mc, out["fid"]) == data
+    assert vs.lease_stats["minted"] >= 1
+
+    # the lease table is visible to operators and clients
+    reply = http_json("GET", f"http://{master.url}/cluster/leases")
+    assert reply["is_leader"]
+    assert reply["counters"]["grant"] >= 1
+    vids = [l["vid"] for l in reply["leases"]]
+    assert lease["vid"] in vids
+
+
+def test_leased_writes_survive_master_outage(duo):
+    """The tentpole proof at unit scale: with a warm lease, the master
+    process can die and every write still completes."""
+    master, vs = duo
+    mc = MasterClient(master.url)
+    assert mc.assign().get("fid")
+    _wait_lease(vs)
+    mc.assign()  # warm the client's lease directory too
+    master.stop()
+    try:
+        blobs = {}
+        t0 = time.time()
+        for i in range(10):
+            out = mc.assign()
+            assert out.get("fid") and "error" not in out, out
+            data = f"dark-window write {i}".encode() * 32
+            operation.upload_to(out["fid"], out["url"], data)
+            blobs[out["fid"]] = data
+        assert time.time() - t0 < 5.0, "writes stalled on the dead master"
+        assert mc.lease_assigns >= 11
+        # readback straight from the holder (lookup would need a master)
+        from seaweedfs_tpu.utils.httpd import http_call
+        for fid, data in blobs.items():
+            status, body, _ = http_call("GET",
+                                        f"http://{vs.url}/{fid}",
+                                        timeout=5)
+            assert status == 200 and body == data
+    finally:
+        vs.stop()
+
+
+def test_unleased_holder_refuses_503_and_client_falls_back(duo):
+    master, vs = duo
+    mc = MasterClient(master.url)
+    # no volume yet -> no lease -> the holder must refuse, not mint
+    with pytest.raises(HttpError) as ei:
+        http_json("POST", f"http://{vs.url}/admin/lease_assign",
+                  timeout=3)
+    assert ei.value.status == 503
+    assert vs.lease_stats["refused"] >= 1
+    # the client's assign still succeeds via the master fallback
+    out = mc.assign()
+    assert out.get("fid"), out
+    assert mc.lease_fallbacks >= 1
+
+
+def test_draining_holder_refuses_lease_mints(duo):
+    master, vs = duo
+    mc = MasterClient(master.url)
+    assert mc.assign().get("fid")
+    _wait_lease(vs)
+    vs.draining = True
+    try:
+        with pytest.raises(HttpError) as ei:
+            http_json("POST", f"http://{vs.url}/admin/lease_assign",
+                      timeout=3)
+        assert ei.value.status == 503
+    finally:
+        vs.draining = False
+
+
+def test_shell_cluster_leases_command(duo):
+    """weed-tpu shell `cluster.leases`: the master's grant table plus
+    each holder's own mint/refuse stats, through the same dispatch the
+    operator types at."""
+    from seaweedfs_tpu.shell.commands import ShellContext
+    from seaweedfs_tpu.shell.repl import run_command
+
+    master, vs = duo
+    mc = MasterClient(master.url)
+    assert mc.assign().get("fid")
+    _wait_lease(vs)
+    mc.assign()  # one holder-minted fid so the stats are non-zero
+    out = run_command(ShellContext(master.url, use_grpc=False),
+                      "cluster.leases")
+    assert out["is_leader"] is True
+    assert out["counters"]["grant"] >= 1
+    leases = out["leases"]
+    assert leases and all(l["key_hi"] >= l["key_lo"] for l in leases)
+    assert all(l["remaining_s"] > 0 for l in leases)
+    holder = leases[0]["holder"]
+    assert out["holders"][holder]["installed"] >= 1
+    assert out["holders"][holder]["minted"] >= 1
+
+
+def test_call_503_reresolves_leader_from_peer_status():
+    """wdclient._call on a 503 without a usable hint probes the peer
+    list's /cluster/status and retries at whoever it names leader."""
+    confused = HttpServer()
+    confused.add("POST", "/dir/assign",
+                 lambda req: Response({"error": "shedding"}, status=503))
+    confused.add("GET", "/cluster/status",
+                 lambda req: Response({"IsLeader": False,
+                                       "Leader": leader_url[0]}))
+    confused.start()
+    leader = HttpServer()
+    leader.add("POST", "/dir/assign",
+               lambda req: Response({"fid": "1,00000001deadbeef",
+                                     "url": "x", "count": 1}))
+    leader.add("GET", "/cluster/status",
+               lambda req: Response({"IsLeader": True,
+                                     "Leader": leader_url[0]}))
+    leader.start()
+    leader_url = [f"127.0.0.1:{leader.port}"]
+    try:
+        mc = MasterClient([f"127.0.0.1:{confused.port}"],
+                          assign_leases=False)
+        out = mc.assign()
+        assert out.get("fid") == "1,00000001deadbeef"
+        assert mc.leader == leader_url[0]
+    finally:
+        confused.stop()
+        leader.stop()
+
+
+def test_ambient_deadline_bounds_the_master_dance():
+    """An expiring ambient deadline cuts the leader-hunt short instead
+    of grinding through every round x candidate x backoff."""
+    mc = MasterClient(["127.0.0.1:1", "127.0.0.1:2"],
+                      assign_leases=False)
+    t0 = time.time()
+    with deadline_scope(Deadline.after(0.5)):
+        with pytest.raises((ConnectionError, HttpError)):
+            mc._call("POST", "/dir/assign?count=1")
+    assert time.time() - t0 < 3.0
+
+
+def test_comparator_lane_off_same_bytes(duo):
+    """assign_leases=False is the pre-lease protocol; stored bytes are
+    bit-identical through either lane."""
+    master, vs = duo
+    leased = MasterClient(master.url)
+    legacy = MasterClient(master.url, assign_leases=False)
+    assert legacy.assign().get("fid")
+    _wait_lease(vs)
+
+    data = b"\x00comparator payload\xff" * 128
+    a = leased.assign()
+    assert a.get("lease_epoch"), a  # minted by the holder
+    b = legacy.assign()
+    assert "lease_epoch" not in b   # minted by the master
+    assert legacy.lease_assigns == 0
+    operation.upload_to(a["fid"], a["url"], data)
+    operation.upload_to(b["fid"], b["url"], data)
+    assert operation.read_data(leased, a["fid"]) \
+        == operation.read_data(legacy, b["fid"]) == data
+    # and the two lanes never minted overlapping keys: the leased range
+    # was carved from the same replicated sequence the master mints from
+    assert a["fid"] != b["fid"]

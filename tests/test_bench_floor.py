@@ -362,6 +362,30 @@ def test_read_plane_floor(monkeypatch):
         out["read_plane_agg_buffered_mbps"], out
 
 
+def test_assign_flood_floor(monkeypatch):
+    """Assign-lease acceptance (PR 18 tentpole): with the master
+    blackholed mid-flood, the leased lane must not fail a single
+    write nor dial the master once inside the dark window, keep
+    actually completing writes while dark, and beat the master-routed
+    comparator >= 2x on writes/s over the identical window (the
+    comparator flatlines for the dark stretch — ideal ratio here is
+    ~3x, so 2x leaves CI slack). Bit identity of stored bytes through
+    both lanes, plus a durability readback of the tail of the
+    dark-window writes, is asserted inside the bench. Sized down from
+    the nightly 32-client/5s-dark run to stay tier-1-fast."""
+    import bench
+
+    monkeypatch.setenv("SEAWEEDFS_TPU_BENCH_FLOOD_CLIENTS", "12")
+    monkeypatch.setenv("SEAWEEDFS_TPU_BENCH_FLOOD_DARK_S", "2.5")
+    monkeypatch.setenv("SEAWEEDFS_TPU_BENCH_FLOOD_EDGE_S", "0.6")
+    out = bench.bench_assign_flood()
+    assert out["assign_flood_leased_failed_dark"] == 0, out
+    assert out["assign_flood_leased_master_calls_dark"] == 0, out
+    assert out["assign_flood_leased_dark_writes"] > 0, out
+    assert out["assign_flood_bit_identical"] is True, out
+    assert out["assign_flood_speedup"] >= 2.0, out
+
+
 def test_telemetry_overhead_floor():
     """The always-on telemetry plane (RED histogram observe + hot-key
     sketch offer per request) must stay within noise of the

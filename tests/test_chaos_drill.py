@@ -253,3 +253,126 @@ def test_partition_drill_replays_blackhole_window_on_quorum_writes(
         vs1.stop()
         proxy.stop()
         master.stop()
+
+
+# the master-outage drill: the leader leg goes fully dark on the wire
+# for a window — the wall-clock twin of the sim incident
+# master_failover_mid_write's election window
+MASTER_DARK_SCHEDULE = {"events": [
+    {"link": "*->*", "fault": "blackhole", "start": 0.2,
+     "duration": 1.5},
+]}
+
+
+@pytest.mark.slow
+def test_master_failover_drill_writes_ride_leases(tmp_path):
+    """The assign-lease drill against a REAL 3-master cluster, two
+    phases. Phase 1 replays MASTER_DARK_SCHEDULE through a ChaosProxy
+    interposed on the client's leader leg: every write issued through
+    the blackhole window must succeed, minted from volume-server
+    leases with zero master round trips landing. Phase 2 escalates to
+    a true cascading failover — the leader process is stopped for
+    good, the survivors elect, grants resume under the new leader with
+    an advanced epoch, and every blob written across both windows
+    reads back bit-identical."""
+    masters = [MasterServer(volume_size_limit_mb=64) for _ in range(3)]
+    for m in masters:
+        m.start()
+    urls = [m.url for m in masters]
+    for m in masters:
+        m.set_peers(urls)
+    deadline = time.time() + 30
+    leader = None
+    while time.time() < deadline and leader is None:
+        leaders = [m for m in masters if m.is_leader()]
+        leader = leaders[0] if len(leaders) == 1 else None
+        time.sleep(0.05)
+    assert leader is not None, "trio never elected"
+    proxy = ChaosProxy(leader.http.host, leader.http.port).start()
+    followers = [m for m in masters if m is not leader]
+    vs = VolumeServer([str(tmp_path / "v")], urls, scrub_interval_s=0)
+    vs.start()
+    driver = None
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not leader.topo.all_nodes():
+            time.sleep(0.05)
+        # the client believes the proxied leg IS the leader
+        mc = MasterClient([proxy.url] + [f.url for f in followers])
+        assert mc.assign().get("fid")  # grows the volume (master path)
+        deadline = time.time() + 15
+        while time.time() < deadline and not vs._leases:
+            time.sleep(0.1)
+        assert vs._leases, "heartbeat never granted a lease"
+        warm = mc.assign()  # warms the client's lease directory
+        assert warm.get("lease_epoch"), warm
+        epoch0 = warm["lease_epoch"]
+
+        # ---- phase 1: leader leg blackholed on the schedule ----
+        blobs: dict = {}
+        mints0 = mc.lease_assigns
+        calls0 = mc.master_calls
+        driver = ScheduleDriver(proxy, MASTER_DARK_SCHEDULE).start()
+        deadline = time.time() + 6
+        while time.time() < deadline and not driver.done():
+            a = mc.assign()
+            assert a.get("fid") and not a.get("error"), a
+            body = f"dark-{len(blobs)}".encode() * 16
+            operation.upload_to(a["fid"], a["url"], body)
+            blobs[a["fid"]] = body
+            time.sleep(0.02)
+        assert driver.done(), "schedule never exhausted"
+        assert [ap["mode"] for ap in driver.applied][-1] == "pass"
+        assert len(blobs) >= 10, "write flood too thin to prove anything"
+        assert mc.lease_assigns - mints0 == len(blobs), \
+            "some dark-window write left the lease lane"
+        assert mc.master_calls == calls0, \
+            "a dark-window assign dialed the master"
+
+        # ---- phase 2: the leader process dies for good ----
+        leader.stop()
+        proxy.stop()
+        survivors = followers
+        deadline = time.time() + 30
+        new_leader = None
+        while time.time() < deadline and new_leader is None:
+            leaders = [m for m in survivors if m.is_leader()]
+            new_leader = leaders[0] if len(leaders) == 1 else None
+            time.sleep(0.05)
+        assert new_leader is not None, "survivors never elected"
+        # writes keep flowing while the holder re-registers
+        for i in range(5):
+            a = mc.assign()
+            assert a.get("fid") and not a.get("error"), a
+            body = f"failover-{i}".encode() * 16
+            operation.upload_to(a["fid"], a["url"], body)
+            blobs[a["fid"]] = body
+        # the replicated lease table survived into the new term and
+        # renewal grants resume with an advanced epoch
+        from seaweedfs_tpu.utils.httpd import http_json
+        reply = http_json("GET",
+                          f"http://{new_leader.url}/cluster/leases",
+                          timeout=5)
+        assert reply["leases"], "lease table lost in failover"
+        deadline = time.time() + 30
+        renewed = None
+        while time.time() < deadline and renewed is None:
+            with vs._lease_lock:
+                for l in vs._leases.values():
+                    if l["epoch"] > epoch0:
+                        renewed = dict(l)
+            time.sleep(0.2)
+        assert renewed is not None, "new leader never renewed the lease"
+
+        # every blob from both phases reads back bit-identical
+        for fid, body in blobs.items():
+            status, got, _ = http_call("GET", f"http://{vs.url}/{fid}",
+                                       timeout=5)
+            assert status == 200 and got == body
+    finally:
+        if driver is not None:
+            driver.stop()
+        vs.stop()
+        proxy.stop()
+        for m in masters:
+            m.stop()

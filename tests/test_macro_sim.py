@@ -138,6 +138,49 @@ def test_ec_single_shard_loss_at_16_actors():
     assert by_check["lrc_repair_bit_identical"]["ok"]
 
 
+def test_master_failover_mid_write_at_16_actors():
+    r = run_incident("master_failover_mid_write", seed=0, n_actors=16)
+    assert r["passed"], [c for c in r["invariants"] if not c["ok"]]
+    # the headline: a 6s leader outage under a write flood costs
+    # nothing — every fid minted from a holder's lease
+    assert r["client"]["failed"] == 0
+    assert r["client"]["assign"]["leased"] > 0
+    by_check = {c["name"]: c for c in r["invariants"]}
+    assert by_check["writes_minted_during_outage"]["ok"]
+    assert by_check["leader_took_over"]["ok"]
+    assert by_check["no_spurious_repairs"]["ok"]
+
+
+def test_master_failover_mid_repair_at_16_actors():
+    r = run_incident("master_failover_mid_repair", seed=0, n_actors=16)
+    assert r["passed"], [c for c in r["invariants"] if not c["ok"]]
+    assert r["repair"]["done"] > 0
+    by_check = {c["name"]: c for c in r["invariants"]}
+    assert by_check["repair_wave_engaged_before_failover"]["ok"]
+    assert by_check["no_duplicate_rebuilds"]["ok"]
+    assert by_check["repair_wave_settled"]["ok"]
+
+
+def test_comparator_lane_off_routes_assigns_to_master():
+    """assign_leases=False is the pre-lease protocol: every write pays
+    the master round trip, and a leader outage would stall them."""
+    cluster = SimCluster(n_volume_actors=8, n_az=4, seed=3,
+                         assign_leases=False)
+    wl = ZipfWorkload(default_tenants(2, 40.0), seed=3)
+    cluster.load(wl.generate(8.0))
+    cluster.run(10.0)
+    assert cluster.metrics.master_assigns > 0
+    assert cluster.metrics.lease_mints == 0
+    assert cluster.metrics.fail_total == 0
+    # and with the lane on (default), the same fleet mints locally
+    cluster2 = SimCluster(n_volume_actors=8, n_az=4, seed=3)
+    wl2 = ZipfWorkload(default_tenants(2, 40.0), seed=3)
+    cluster2.load(wl2.generate(8.0))
+    cluster2.run(10.0)
+    assert cluster2.metrics.lease_mints > 0
+    assert cluster2.metrics.master_assigns == 0
+
+
 def test_unknown_incident_raises():
     with pytest.raises(KeyError):
         run_incident("kraken", n_actors=16)

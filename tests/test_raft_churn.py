@@ -184,3 +184,119 @@ def test_lagging_follower_converges_after_heal(trio):
     # future failover to it cannot re-mint ids the old leader issued
     assert follower._seq_ckpt >= leader.sequencer.peek() or \
         follower._seq_ckpt >= leader._seq_ckpt
+
+
+def _leases(url: str) -> dict:
+    return http_json("GET", f"http://{url}/cluster/leases", timeout=3)
+
+
+def _wait_leases(url: str, timeout: float = 25.0,
+                 pred=lambda reply: reply["leases"]) -> dict:
+    deadline = time.time() + timeout
+    reply: dict = {}
+    while time.time() < deadline:
+        try:
+            reply = _leases(url)
+            if pred(reply):
+                return reply
+        except (ConnectionError, HttpError):
+            pass
+        time.sleep(0.2)
+    raise AssertionError(f"lease predicate never held: {reply}")
+
+
+def test_lease_grants_survive_failover_without_overlap(trio):
+    """The assign-lease tentpole at the raft layer: a term-N grant is
+    Raft-committed before it reaches the holder, so (a) the term-N+1
+    leader still sees it after failover and (b) the new leader's
+    sequence floor sits past the leased range — a fresh grant can
+    never overlap a predecessor's."""
+    masters, vs = trio
+    old_leader = _wait_unique_leader(masters)
+
+    # grow a volume, then the heartbeat piggyback grants its lease
+    out = _assign(old_leader.url)
+    assert out.get("fid"), out
+    before = _wait_leases(old_leader.url)
+    old = {l["vid"]: l for l in before["leases"]}
+    assert old and before["counters"]["grant"] >= 1
+    deadline = time.time() + 15
+    while time.time() < deadline and not vs._leases:
+        time.sleep(0.1)
+    assert vs._leases, "holder never installed the granted lease"
+    max_epoch = max(l["epoch"] for l in old.values())
+    high_water = max(l["key_hi"] for l in old.values())
+
+    heal = _partition(old_leader)
+    try:
+        survivors = [m for m in masters if m is not old_leader]
+        new_leader = _wait_unique_leader(survivors, timeout=30)
+        assert new_leader is not old_leader
+
+        # (a) the replicated table survived into term N+1: the new
+        # leader serves the exact term-N grants (vid, range, epoch).
+        # The entries ride its log; they apply once the new term's
+        # no-op barrier commits, so poll rather than check instantly.
+        after = {l["vid"]: l for l in _wait_leases(
+            new_leader.url,
+            pred=lambda r: {l["vid"] for l in r["leases"]}
+            >= set(old))["leases"]}
+        for vid, l in old.items():
+            assert vid in after, f"grant for vid {vid} lost on failover"
+            assert (after[vid]["key_lo"], after[vid]["key_hi"],
+                    after[vid]["epoch"]) == \
+                (l["key_lo"], l["key_hi"], l["epoch"])
+
+        # the holder chases the 409s to the new leader (the deposed
+        # one can't name a winner, so the VS probes the peer list)
+        deadline = time.time() + 20
+        while time.time() < deadline and not new_leader.topo.all_nodes():
+            time.sleep(0.1)
+        assert new_leader.topo.all_nodes(), \
+            "holder never re-registered with the new leader"
+
+        # (b) provoke a fresh grant under the new leader: grow a new
+        # volume (new collection) so the next heartbeat asks for it
+        out = http_json("GET", f"http://{new_leader.url}/dir/assign"
+                               f"?collection=leasechurn", timeout=5)
+        assert out.get("fid"), out
+        fresh = _wait_leases(
+            new_leader.url,
+            pred=lambda r: any(l["epoch"] > max_epoch
+                               for l in r["leases"]))
+        for l in fresh["leases"]:
+            if l["epoch"] <= max_epoch:
+                continue  # term-N grant, checked above
+            # non-overlap: every new range starts past every key any
+            # previous leader handed out or leased away
+            assert l["key_lo"] > high_water, (l, high_water)
+    finally:
+        heal()
+
+
+def test_lease_snapshot_roundtrip_floors_sequence():
+    """The InstallSnapshot path for leases: a compacted follower
+    restoring from snapshot ends with the full grant table and a
+    sequence floor past every leased range (epoch>= wins on merge)."""
+    a = MasterServer()
+    lease = {"vid": 7, "holder": "h:1", "holder_public": "h:1",
+             "key_lo": 5000, "key_hi": 9095, "epoch": 3,
+             "expires_at": time.time() + 30, "collection": "",
+             "replication": "000", "replicas": []}
+    a._apply_lease(lease)
+    snap = a._raft_snapshot_state()
+    assert snap["leases"]["7"]["epoch"] == 3
+    assert snap["sequence"] >= 9096
+
+    b = MasterServer()
+    # pre-existing newer grant on the restoring master must survive
+    b._apply_lease(dict(lease, vid=9, epoch=5, key_lo=20000,
+                        key_hi=24095))
+    b._restore_raft_snapshot(snap)
+    assert b.leases[7]["key_lo"] == 5000
+    assert b.leases[9]["epoch"] == 5
+    assert b._seq_ckpt >= 9096
+    assert b._lease_epoch >= 3
+    # an OLDER epoch arriving later (stale leader's log entry) loses
+    b._apply_lease(dict(lease, epoch=2, key_lo=1, key_hi=4096))
+    assert b.leases[7]["epoch"] == 3 and b.leases[7]["key_lo"] == 5000

@@ -135,6 +135,16 @@ CASES = {
                   "            for i in range(layout.TOTAL_SHARDS_COUNT)]"
                   "\n"),
     },
+    "lease-wall-clock": {
+        "bad": ("import time\n\ndef grant(vid, ttl):\n"
+                "    lease_expires_at = time.time() + ttl\n"
+                "    return {'vid': vid, 'expires_at': lease_expires_at}"
+                "\n"),
+        "clean": ("from seaweedfs_tpu.utils import clockctl\n\n"
+                  "def grant(vid, ttl):\n"
+                  "    return {'vid': vid,\n"
+                  "            'expires_at': clockctl.now() + ttl}\n"),
+    },
     "ambient-scope-loss": {
         "bad": ("from seaweedfs_tpu.utils.tracing import current_span\n\n"
                 "def f(pool):\n"
@@ -315,6 +325,45 @@ def test_hot_path_bytes_copy_scoping():
     # the transport home keeps its sanctioned materializations
     assert "hot-path-bytes-copy" not in rules_of(
         bad, path="seaweedfs_tpu/utils/httpd.py")
+
+
+def test_lease_wall_clock_shapes_and_scoping():
+    """The rule hunts every spelling of lease math on a raw clock —
+    dict entry, comparison, keyword argument, aliased datetime — but
+    only inside seaweedfs_tpu/, never in clockctl.py (the home), and
+    never when the expression reads clockctl or carries no clock call
+    at all (comparing expires_at against a prefetched `now` is THE
+    sanctioned idiom)."""
+    dict_entry = ("import time\n\ndef g(vid):\n"
+                  "    return {'vid': vid, 'expires_at': "
+                  "time.time() + 30}\n")
+    assert "lease-wall-clock" in rules_of(dict_entry)
+    # bench drivers and tests stamp wall-clock expiries legitimately
+    assert "lease-wall-clock" not in rules_of(
+        dict_entry, path="tools/bench_thing.py")
+    assert "lease-wall-clock" not in rules_of(
+        dict_entry, path="seaweedfs_tpu/utils/clockctl.py")
+    # comparison: lease operand vs a raw clock read
+    assert "lease-wall-clock" in rules_of(
+        "import time\n\ndef f(l):\n"
+        "    return l['expires_at'] <= time.monotonic()\n")
+    # keyword-argument spelling
+    assert "lease-wall-clock" in rules_of(
+        "import time\n\ndef f(mk):\n"
+        "    return mk(expires_at=time.time() + 30)\n")
+    # aliased datetime still resolves to the canonical wall clock
+    assert "lease-wall-clock" in rules_of(
+        "from datetime import datetime as dt\n\ndef f(lease):\n"
+        "    lease['expires_at'] = dt.utcnow().timestamp() + 30\n")
+    # the sanctioned idiom: clock read once through clockctl, lease
+    # arithmetic against the local snapshot
+    assert "lease-wall-clock" not in rules_of(
+        "from seaweedfs_tpu.utils import clockctl\n\ndef f(l):\n"
+        "    now = clockctl.now()\n"
+        "    return l['expires_at'] <= now\n")
+    # non-lease wall-clock math is raw-clock's beat, not this rule's
+    assert "lease-wall-clock" not in rules_of(
+        "import time\n\ndef f():\n    t0 = time.time()\n    return t0\n")
 
 
 def test_syntax_error_reported_not_crashed():

@@ -124,6 +124,19 @@ hot-path-bytes-copy
     baselined or suppressed with a justification; new code passes
     views through to the transport.
 
+lease-wall-clock
+    lease/expiry math reading a raw wall clock inside ``seaweedfs_tpu/``:
+    an assignment, comparison, dict entry or keyword argument whose
+    identifiers mention lease/expiry and whose value calls
+    ``time.time()/monotonic()/perf_counter()`` or
+    ``datetime.now()/utcnow()`` directly.  Lease TTLs are a correctness
+    boundary — the holder refuses to mint past ``expires_at`` and the
+    master grants on the same arithmetic — so both sides must read
+    ``clockctl.now()``; a raw site puts the grant and the refusal on
+    different clocks (and is invisible to the macro-sim's virtual
+    time), which is exactly how a holder keeps minting from a range
+    the master already re-granted.
+
 hardcoded-shard-count
     a shard-count literal (4/10/14) used as a ``range()`` bound or a
     comparison operand inside ``storage/erasure_coding/``.  Shard
@@ -176,6 +189,9 @@ RULES: dict[str, str] = {
     "hardcoded-shard-count":
         "shard-count literal (4/10/14) in storage/erasure_coding/ — "
         "read layout constants or the volume's CodeSpec",
+    "lease-wall-clock":
+        "lease/expiry math on a raw wall clock (time.time/datetime.now) "
+        "— grant and refusal must share clockctl.now()",
 }
 
 # files that ARE the sanctioned implementation of a contract
@@ -188,6 +204,7 @@ _RULE_HOME = {
     "unbounded-body-read": "utils/httpd.py",
     "hot-path-bytes-copy": "utils/httpd.py",
     "hardcoded-shard-count": "storage/erasure_coding/layout.py",
+    "lease-wall-clock": "utils/clockctl.py",
 }
 
 _HEADER_PREFIX = "X-Weed-"
@@ -200,7 +217,7 @@ _HTTP_CALLS = {
 # modules whose aliases we track for canonical-name resolution
 _TRACKED_MODULES = ("time", "urllib.request", "urllib", "http.client",
                     "http", "socket", "queue", "concurrent.futures",
-                    "concurrent", "jax", "threading")
+                    "concurrent", "jax", "threading", "datetime")
 _DEVICE_CALLS = {"jax.devices", "jax.local_devices",
                  "jax.device_count", "jax.local_device_count"}
 _BLOCKING_TERMINALS = {"http_call", "http_json", "urlopen"}
@@ -225,6 +242,14 @@ _SHARD_COUNT_LITERALS = {4, 10, 14}
 _EC_SUBTREE = "seaweedfs_tpu/storage/erasure_coding/"
 _SCOPE_ENTRIES = {"span_scope", "deadline_scope", "class_scope",
                   "attach", "child_scope"}
+# the raw wall clocks lease math must never read directly: lease TTLs
+# are grant/refuse arithmetic shared by master and holder, so both
+# sides go through clockctl.now() (one indirection, one clock)
+_WALL_CLOCK_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+                     "datetime.datetime.now", "datetime.datetime.utcnow",
+                     "datetime.datetime.today"}
+# identifiers/keys that mark an expression as lease-expiry arithmetic
+_LEASEISH = re.compile(r"lease|expir", re.IGNORECASE)
 
 
 @dataclass(frozen=True)
@@ -282,6 +307,20 @@ def _walk_same_scope(node: ast.AST, *, skip_root_check: bool = True):
         first = False
         yield cur
         stack.extend(ast.iter_child_nodes(cur))
+
+
+def _mentions_lease(node: ast.AST) -> bool:
+    """Does the expression name a lease/expiry — an identifier,
+    attribute or string key matching lease/expir?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and _LEASEISH.search(n.id):
+            return True
+        if isinstance(n, ast.Attribute) and _LEASEISH.search(n.attr):
+            return True
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and _LEASEISH.search(n.value):
+            return True
+    return False
 
 
 def _contains_yield(node: ast.AST) -> bool:
@@ -522,6 +561,12 @@ class Checker(ast.NodeVisitor):
                         "COUNT/TOTAL_SHARDS_COUNT or the volume's own "
                         "scheme counts")
 
+        for kw in node.keywords:
+            # expires_at=time.time()+ttl spelled as a keyword argument
+            if kw.arg and _LEASEISH.search(kw.arg):
+                self._check_lease_clock(kw.value, ast.Name(id=kw.arg),
+                                        kw.value)
+
         if canonical == "bytes" and len(node.args) == 1 \
                 and not node.keywords \
                 and self.rel.startswith(_HOT_PATH_PREFIXES):
@@ -540,7 +585,56 @@ class Checker(ast.NodeVisitor):
 
         self.generic_visit(node)
 
+    def _wall_clock_in(self, node: ast.AST) -> Optional[str]:
+        """Canonical name of the first raw wall-clock call inside the
+        expression, resolved through import aliases, else None."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                canonical = self._canonical(n.func)
+                if canonical in _WALL_CLOCK_CALLS:
+                    return canonical
+        return None
+
+    def _check_lease_clock(self, node: ast.AST, lease_src: ast.AST,
+                           clock_src: ast.AST) -> None:
+        """lease-wall-clock: lease/expiry math (named by lease_src)
+        whose value expression (clock_src) reads a raw wall clock."""
+        if not self.rel.startswith("seaweedfs_tpu/"):
+            return
+        if not _mentions_lease(lease_src):
+            return
+        what = self._wall_clock_in(clock_src)
+        if what:
+            self.report(
+                node, "lease-wall-clock",
+                f"lease/expiry math reads raw {what}() — grant and "
+                "refusal must share one clock: route through "
+                "clockctl.now() so holders, the master and the "
+                "macro-sim's virtual time agree on when a lease lapses")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._check_lease_clock(node, target, node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_lease_clock(node, node.target, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check_lease_clock(node, node.target, node.value)
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        for key, value in zip(node.keys, node.values):
+            if key is not None:
+                self._check_lease_clock(node, key, value)
+        self.generic_visit(node)
+
     def visit_Compare(self, node: ast.Compare) -> None:
+        # a lease/expiry operand compared against a raw wall clock read
+        self._check_lease_clock(node, node, node)
         if self.rel.startswith(_EC_SUBTREE):
             for operand in [node.left] + node.comparators:
                 if isinstance(operand, ast.Constant) \
