@@ -1114,6 +1114,283 @@ def bench_filer_ops(n_shards: int = 3, n_identity_ops: int = 240,
     }
 
 
+def bench_shard_rebalance(n_shards: int = 3, n_hot_dirs: int = 9,
+                          files_per_dir: int = 10,
+                          ops_per_phase: int = 360,
+                          store_ms: float = 4.0,
+                          concurrency: int = 24,
+                          converge_timeout_s: float = 45.0) -> dict:
+    """Live shard rebalancing vs a frozen ring, on the pathological
+    hash layout: N hot directories that all land on ONE shard.
+
+    Both clusters are identical 3-shard rings behind the single-writer
+    latency shim (entry caches OFF so every namespace op pays the
+    store lock — the per-shard bottleneck migration redistributes).
+    The frozen comparator's planner is disarmed; the live cluster's
+    planner runs the real closed loop — announce piggybacks feed the
+    master, plans dispatch move orders, movers copy and the ring flips
+    at commit — on a fast announce cadence.
+
+    Three measured phases on each cluster: BEFORE (all hot dirs on one
+    shard), DURING (live cluster migrating under load), AFTER (live
+    cluster converged).  Reported: aggregate ops/s and interactive
+    (read) p99 per phase, failed client ops on the live cluster across
+    ALL phases (must be 0 — the dual-serve window guarantee), and a
+    full routed-namespace walk compared across clusters (bit
+    identity: migration moves rows, never mutates them)."""
+    import hashlib
+    import random
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.client.wdclient import MasterClient
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.utils import clockctl
+    from seaweedfs_tpu.utils.httpd import http_json
+
+    class LatencyStore:
+        """Single-writer DB stand-in (see bench_filer_ops)."""
+
+        def __init__(self, inner, delay_s: float):
+            self.inner = inner
+            self.delay_s = delay_s
+            self.name = inner.name
+            self.op_lock = threading.Lock()
+
+        def _op(self, fn, *a, **kw):
+            with self.op_lock:
+                clockctl.sleep(self.delay_s)
+                return fn(*a, **kw)
+
+        def find_entry(self, p):
+            return self._op(self.inner.find_entry, p)
+
+        def insert_entry(self, e):
+            return self._op(self.inner.insert_entry, e)
+
+        def update_entry(self, e):
+            return self._op(self.inner.update_entry, e)
+
+        def delete_entry(self, p):
+            return self._op(self.inner.delete_entry, p)
+
+        def delete_folder_children(self, p):
+            return self._op(self.inner.delete_folder_children, p)
+
+        def list_directory_entries(self, *a, **kw):
+            return self._op(self.inner.list_directory_entries, *a, **kw)
+
+        def __getattr__(self, name):  # kv_*, close, ...
+            return getattr(self.inner, name)
+
+    def build_cluster(live: bool):
+        master = MasterServer()
+        # both start disarmed: the live cluster's planner is armed
+        # (min_rate lowered) only after the BEFORE phase is measured
+        master.rebalance.min_rate = float("inf")
+        if live:
+            # fast loop for bench timescales.  Cooldown short enough
+            # for SECOND-hop moves (dirs pile onto the intermediate
+            # coldest shard and must be movable again to reach even);
+            # equilibrium itself stops the loop — at even spread the
+            # imbalance sits under threshold and no plan fires
+            master.rebalance.window_s = 2.0
+            master.rebalance.threshold = 1.35
+            master.rebalance.cooldown_s = 6.0
+        master.start()
+        filers = []
+        for _ in range(n_shards):
+            f = FilerServer(master.url, sharding=True,
+                            entry_cache=False, qos=False,
+                            tracing_enabled=False)
+            f.announce_interval_s = 0.5
+            f.filer.store.inner = LatencyStore(f.filer.store.inner,
+                                               store_ms / 1000.0)
+            f.start()
+            filers.append(f)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ring = http_json("GET",
+                             f"http://{master.url}/cluster/filers")
+            if len(ring.get("filers", [])) == n_shards:
+                break
+            clockctl.sleep(0.05)
+        for f in filers:
+            f._adopt_ring()
+        return master, filers, MasterClient(master.url)
+
+    def payload(path: str) -> bytes:
+        return (f"{path}:" * 40).encode()[:512]  # inline, per-path
+
+    ma, fa, mca = build_cluster(live=True)
+    mb, fb, mcb = build_cluster(live=False)
+    failed = [0]
+    try:
+        # the adversarial layout: hot directories that ALL hash onto
+        # one shard — on BOTH rings.  The two clusters' members are
+        # distinct host:port strings, so their hash layouts differ;
+        # picking by one ring alone would hand the frozen comparator
+        # an accidentally-even (non-adversarial) spread
+        ring_a = fa[0].shard_ring
+        ring_b = fb[0].shard_ring
+        buckets: dict = {}
+        hot_dirs = []
+        for i in range(8000):
+            d = f"/hot/d{i:04d}"
+            k = (ring_a.owner(d), ring_b.owner(d))
+            buckets.setdefault(k, []).append(d)
+            if len(buckets[k]) >= n_hot_dirs:
+                hot_dirs = buckets[k]
+                break
+        assert len(hot_dirs) == n_hot_dirs, "no co-owned dir set found"
+
+        seeded = []
+        for d in hot_dirs:
+            for j in range(files_per_dir):
+                seeded.append(f"{d}/k{j:02d}")
+        for mc in (mca, mcb):
+            for p in seeded:
+                st, _, _ = mc.filer_call("PUT", p, body=payload(p))
+                assert st in (200, 201), (p, st)
+
+        rng = random.Random(1009)
+        wseq = [0]
+
+        def gen_ops(n: int) -> list:
+            """85/15 read/write over the hot dirs; writes create new
+            deterministic paths so migration deltas see fresh rows."""
+            ops = []
+            for _ in range(n):
+                d = rng.choice(hot_dirs)
+                if rng.random() < 0.15:
+                    wseq[0] += 1
+                    ops.append(("w", f"{d}/n{wseq[0]:05d}"))
+                else:
+                    ops.append(("r", f"{d}/k{rng.randrange(files_per_dir):02d}"))
+            return ops
+
+        def replay(mc, ops, count_failures: bool) -> tuple:
+            lats = []
+
+            def one(op):
+                kind, p = op
+                t0 = time.perf_counter()
+                if kind == "w":
+                    st, _, _ = mc.filer_call("PUT", p, body=payload(p))
+                    ok = st in (200, 201)
+                else:
+                    st, _, _ = mc.filer_call("GET", p)
+                    ok = st == 200
+                    lats.append(time.perf_counter() - t0)
+                if count_failures and not ok:
+                    failed[0] += 1
+
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=concurrency) as pool:
+                list(pool.map(one, ops))
+            dt = time.perf_counter() - t0
+            lats.sort()
+            p99 = lats[int(0.99 * (len(lats) - 1))] if lats else 0.0
+            return len(ops) / dt, p99 * 1000.0
+
+        def run_phase(ops):
+            """The SAME op list hits both clusters (namespace identity
+            holds); live is measured with failure counting on."""
+            ops_live, p99_live = replay(mca, ops, True)
+            ops_frz, p99_frz = replay(mcb, ops, False)
+            return (ops_live, p99_live), (ops_frz, p99_frz)
+
+        before_live, before_frz = run_phase(gen_ops(ops_per_phase))
+
+        # arm the planner: announce piggybacks (0.5s cadence) now feed
+        # real plans.  Load stays CONTINUOUS on the live cluster —
+        # alternating clusters would leave idle gaps that turn the
+        # planner's windowed rates into noise and invite spurious
+        # moves — until the override table stops growing and no move
+        # is in flight, i.e. the ring has converged.  The frozen
+        # cluster replays the same batches afterwards (its performance
+        # is stationary; namespace identity still holds).
+        ma.rebalance.min_rate = 10.0
+        during = {"live": [], "frz": []}
+        during_batches = []
+        t_during0 = time.monotonic()
+        seen, stable, converged = -1, 0, False
+        while time.monotonic() - t_during0 < converge_timeout_s:
+            batch = gen_ops(ops_per_phase)
+            during_batches.append(batch)
+            during["live"].append(replay(mca, batch, True))
+            reb = http_json("GET",
+                            f"http://{ma.url}/cluster/rebalance")
+            n_over = len(reb["overrides"])
+            moving = reb["planner"]["moving"]
+            stable = stable + 1 if (n_over == seen and not moving
+                                    and n_over > 0) else 0
+            seen = n_over
+            if stable >= 3:
+                converged = True
+                break
+        t_during = time.monotonic() - t_during0
+        for batch in during_batches:
+            during["frz"].append(replay(mcb, batch, False))
+
+        after_live, after_frz = run_phase(gen_ops(ops_per_phase))
+
+        # bit identity: full namespace through the routed listing path
+        def walk(mc) -> list:
+            out, stack = [], ["/"]
+            while stack:
+                dpath = stack.pop()
+                status, body, _ = mc.filer_call("GET", dpath)
+                if status != 200:
+                    continue
+                for r in json.loads(body).get("Entries", []):
+                    if r["IsDirectory"]:
+                        stack.append(r["FullPath"])
+                    else:
+                        s, b, _ = mc.filer_call("GET", r["FullPath"])
+                        out.append((r["FullPath"], s,
+                                    hashlib.sha256(b).hexdigest()))
+            return sorted(out)
+
+        walk_identical = walk(mca) == walk(mcb)
+        reb = http_json("GET", f"http://{ma.url}/cluster/rebalance")
+        moves = reb["planner"]["commits"]
+        spread_after = fa[0].shard_ring.spread(hot_dirs)
+    finally:
+        for f in fa + fb:
+            f.stop()
+        ma.stop()
+        mb.stop()
+
+    d_live = during["live"] or [before_live]
+    d_frz = during["frz"] or [before_frz]
+    mean = lambda xs: sum(xs) / len(xs)  # noqa: E731
+    return {
+        "shard_rebalance_shards": n_shards,
+        "shard_rebalance_hot_dirs": n_hot_dirs,
+        "shard_rebalance_moves_committed": moves,
+        "shard_rebalance_converged": bool(converged),
+        "shard_rebalance_converge_s": round(t_during, 1),
+        "shard_rebalance_ops_before": round(before_live[0], 1),
+        "shard_rebalance_ops_during": round(
+            mean([x[0] for x in d_live]), 1),
+        "shard_rebalance_ops_after": round(after_live[0], 1),
+        "shard_rebalance_ops_frozen": round(after_frz[0], 1),
+        "shard_rebalance_speedup": round(
+            after_live[0] / after_frz[0], 2),
+        "shard_rebalance_p99_ms_before": round(before_live[1], 1),
+        "shard_rebalance_p99_ms_during": round(
+            max([x[1] for x in d_live]), 1),
+        "shard_rebalance_p99_ms_after": round(after_live[1], 1),
+        "shard_rebalance_p99_ms_frozen": round(after_frz[1], 1),
+        "shard_rebalance_failed_ops": failed[0],
+        "shard_rebalance_bit_identical": bool(walk_identical),
+        "shard_rebalance_dir_spread_after": spread_after,
+        "shard_rebalance_store_ms": store_ms,
+    }
+
+
 def bench_replicated_write(n_writes: int = 20,
                            slow_ms: float = 40.0) -> dict:
     """Replicated-write tail latency: concurrent replica fan-out vs
@@ -2268,6 +2545,7 @@ def main(argv=None):
     e2e.update(bench_read_plane())  # sendfile GETs + volume redirects
     e2e.update(bench_replica_divergence_repair())  # hinted-handoff drill
     e2e.update(bench_filer_ops())  # sharded namespace scale-out
+    e2e.update(bench_shard_rebalance())  # live hot-dir migration
     e2e.update(bench_assign_flood())  # master-dark leased PUT flood
     tpu, attempts, err = tpu_probe_with_retries()
     if tpu is not None:

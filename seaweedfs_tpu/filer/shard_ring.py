@@ -53,20 +53,33 @@ def format_shard_header(epoch: int, owner: str) -> str:
 
 
 def parse_shard_header(value: str) -> tuple[int, str]:
-    """-> (epoch, owner_url); epoch 0 on garbage (treated as stale)."""
+    """-> (epoch, owner_url); epoch 0 on garbage (treated as stale).
+    Negative epochs clamp to 0 too — epochs are forward-only, so a
+    negative value is garbage with a sign bit, and letting it through
+    would poison every `held >= seen` comparison downstream."""
     try:
         epoch_s, _, owner = value.partition(":")
-        return int(epoch_s), owner
+        return max(0, int(epoch_s)), owner
     except (ValueError, AttributeError):
         return 0, ""
 
 
 class ShardRing:
     def __init__(self, members: list[str], epoch: int = 1,
-                 vnodes: int = VNODES):
+                 vnodes: int = VNODES,
+                 overrides: Optional[dict] = None):
         self.members: list[str] = sorted(set(members))
         self.epoch = int(epoch)
         self.vnodes = vnodes
+        # rebalancer override table layered over the hash ring: an
+        # exact-directory entry {dir: owner} wins over the consistent
+        # hash (filer/rebalance.py emits these; the master bumps the
+        # epoch per applied plan).  Overrides naming a departed member
+        # are dropped — routing to a dead shard is worse than routing
+        # to the hash owner.
+        self.overrides: dict = {
+            _norm_dir(d): o for d, o in (overrides or {}).items()
+            if o in self.members}
         pts = sorted((_point(f"{m}#{i}"), m)
                      for m in self.members for i in range(vnodes))
         self._keys = [p[0] for p in pts]
@@ -77,12 +90,43 @@ class ShardRing:
         rows and serves its listings). "" when the ring is empty."""
         if not self._keys:
             return ""
+        d = _norm_dir(directory)
+        if self.overrides:
+            o = self.overrides.get(d)
+            if o is not None:
+                return o
+        if len(self.members) == 1:
+            return self.members[0]
+        i = bisect.bisect(self._keys, _point(d))
+        if i == len(self._keys):
+            i = 0
+        return self._owners[i]
+
+    def hash_owner(self, directory: str) -> str:
+        """The consistent-hash owner, ignoring the override table —
+        what `owner()` falls back to when an override is retired."""
+        if not self._keys:
+            return ""
         if len(self.members) == 1:
             return self.members[0]
         i = bisect.bisect(self._keys, _point(_norm_dir(directory)))
         if i == len(self._keys):
             i = 0
         return self._owners[i]
+
+    def with_overrides(self, overrides: dict) -> "ShardRing":
+        """A new ring at epoch+1 with `overrides` merged over the
+        current table (None values retire entries).  Same members —
+        this is the rebalancer's epoch bump, not a membership change."""
+        merged = dict(self.overrides)
+        for d, o in overrides.items():
+            d = _norm_dir(d)
+            if o is None:
+                merged.pop(d, None)
+            else:
+                merged[d] = o
+        return ShardRing(self.members, epoch=self.epoch + 1,
+                         vnodes=self.vnodes, overrides=merged)
 
     def owner_for_path(self, path: str) -> str:
         """The shard holding the entry ROW at `path` = the owner of
@@ -96,13 +140,17 @@ class ShardRing:
         return url in self.members
 
     def to_dict(self) -> dict:
-        return {"epoch": self.epoch, "filers": list(self.members),
-                "vnodes": self.vnodes}
+        out = {"epoch": self.epoch, "filers": list(self.members),
+               "vnodes": self.vnodes}
+        if self.overrides:
+            out["overrides"] = dict(self.overrides)
+        return out
 
     @classmethod
     def from_dict(cls, d: dict) -> "ShardRing":
         return cls(d.get("filers", []), epoch=d.get("epoch", 1),
-                   vnodes=d.get("vnodes", VNODES))
+                   vnodes=d.get("vnodes", VNODES),
+                   overrides=d.get("overrides"))
 
     def spread(self, directories: list[str]) -> dict:
         """member -> owned count over a directory sample (shard_profile
@@ -118,8 +166,12 @@ class ShardRing:
 def ring_if_changed(ring: Optional[ShardRing],
                     members: list[str]) -> Optional[ShardRing]:
     """A new ring at epoch+1 when `members` differs from `ring`'s,
-    else None — the master's epoch-bump helper."""
+    else None — the master's epoch-bump helper.  Overrides survive a
+    membership change (the rebalanced placement outlives a restart of
+    an unrelated shard); entries pointing at a departed member are
+    dropped by the ShardRing constructor."""
     new = sorted(set(members))
     if ring is not None and ring.members == new:
         return None
-    return ShardRing(new, epoch=(ring.epoch + 1 if ring else 1))
+    return ShardRing(new, epoch=(ring.epoch + 1 if ring else 1),
+                     overrides=(ring.overrides if ring else None))

@@ -130,6 +130,9 @@ class FilerServer:
         # announce=False: gateway mode (remote metadata store) — don't
         # register as a filer or aggregate peers
         self.announce = announce
+        # announce cadence doubles as the rebalance-telemetry cadence;
+        # benches/tests shorten it to speed planner convergence
+        self.announce_interval_s = 15.0
         self._grpc_port_arg = grpc_port
         self._grpc_server = None
         self.grpc_port: Optional[int] = None
@@ -178,6 +181,11 @@ class FilerServer:
         # already ensured on their owners; invalidated by peer meta
         # events so a remote delete re-triggers the ensure walk
         self._remote_parents = EntryCache(capacity=4096, neg_capacity=0)
+        # live rebalancing executor: streams one directory's rows to a
+        # new owner in the background on master move orders, then the
+        # ring flips at commit (filer/rebalance.py)
+        from seaweedfs_tpu.filer.rebalance import DirectoryMover
+        self.mover = DirectoryMover(self)
         self.filer_conf = FilerConf.load(self.filer.store)
         self._filer_conf_loaded = clockctl.now()
         self._filer_conf_write_lock = threading.Lock()
@@ -249,7 +257,9 @@ class FilerServer:
         from seaweedfs_tpu.utils.metrics import RedRecorder
         self.red = RedRecorder(self.metrics, "filer")
         self.http.red = self.red
-        self.hotkeys = HotKeys(dims=("path", "tenant"))
+        # "dir" feeds the master's RebalancePlanner: per-directory
+        # temperature rides the announce piggyback (filer/rebalance.py)
+        self.hotkeys = HotKeys(dims=("path", "tenant", "dir"))
         self.metrics_http.add("GET", "/admin/hotkeys",
                               self.hotkeys.handler(self.url))
         self.metrics_http.add("GET", "/admin/telemetry",
@@ -320,18 +330,26 @@ class FilerServer:
         from seaweedfs_tpu.utils.httpd import http_json
 
         def announce():
+            body = {"type": "filer", "url": self.url,
+                    "metrics_url": self.metrics_url}
+            if self.sharding:
+                # temperature piggyback for the master's rebalance
+                # planner: cumulative op count (the planner diffs
+                # successive reports into a rate) + hottest directories
+                body["shard_load"] = {
+                    "ops": self.hotkeys.sketches["dir"].total,
+                    "dirs": self.hotkeys.top(8).get("dir", [])}
             try:
                 http_json("POST",
                           f"http://{self.master_url}/cluster/register",
-                          {"type": "filer", "url": self.url,
-                           "metrics_url": self.metrics_url}, timeout=5)
+                          body, timeout=5)
             except Exception as e:
                 glog.vlog(1, "filer announce to master %s failed: %s",
                           self.master_url, e)
 
         announce()
         self._adopt_ring()
-        while not self._announce_stop.wait(15.0):
+        while not self._announce_stop.wait(self.announce_interval_s):
             announce()
             self._adopt_ring()
             self.autocap.maybe_tick()
@@ -585,6 +603,7 @@ class FilerServer:
                         for k, v in self._m_shard._values.items()},
             "remote_parents": self._remote_parents.snapshot(),
             "autocap": self.autocap.snapshot(),
+            "mover": self.mover.status(),
         }
         if self.filer.entry_cache is not None:
             out["entry_cache"] = self.filer.entry_cache.snapshot()
@@ -598,6 +617,25 @@ class FilerServer:
         ring = ShardRing.from_dict(b)
         self.set_shard_ring(ring, pin=bool(b.get("pin")))
         return Response({"epoch": ring.epoch, "members": len(ring)})
+
+    def _api_shard_migrate(self, req: Request) -> Response:
+        """Master move order: migrate `dir`'s child rows to filer `to`
+        in the background (filer/rebalance.py DirectoryMover).  Only
+        the current owner may execute — rows move FROM here."""
+        b = req.json() or {}
+        directory, dest = b.get("dir", ""), b.get("to", "")
+        if not directory or not dest:
+            return Response({"error": "dir and to required"}, status=400)
+        ring = self.shard_ring
+        if not self._shard_active() or dest not in ring:
+            return Response({"error": "not an active shard member"},
+                            status=409)
+        if ring.owner(directory) != self.url:
+            return Response({"error": "not the owner",
+                             "owner": ring.owner(directory)}, status=409)
+        started = self.mover.start(directory, dest)
+        return Response({"started": started,
+                         "status": self.mover.status()})
 
     def stop(self) -> None:
         self.sampler.stop()
@@ -660,6 +698,7 @@ class FilerServer:
         r("GET", "/__api/meta_events", self._api_meta_events)
         r("GET", "/__api/shard/status", self._api_shard_status)
         r("POST", "/__api/shard/ring", self._api_shard_ring_set)
+        r("POST", "/__api/shard/migrate", self._api_shard_migrate)
         r("GET", r"/__api/chunk/(\S+)", self._api_chunk_blob)
         r("GET", "/__api/remote/status", self._api_remote_status)
         r("POST", "/__api/remote/configure", self._api_remote_configure)
@@ -735,6 +774,15 @@ class FilerServer:
             # hot-key sketches: which paths are hammered and by whom
             # (tenant = client IP, the same key the QoS buckets use)
             self.hotkeys.record("path", req.path.rstrip("/") or "/")
+            # the dir sketch is CLIENT temperature — the rebalance
+            # planner's input.  Forwarded requests are internal
+            # plumbing (peer parent-ensures, mover pushes); counting
+            # them would mark namespace-interior directories hot and
+            # invite the planner to migrate them
+            if not req.headers.get(weed_headers.SHARD_FORWARDED):
+                self.hotkeys.record("dir",
+                                    parent_dir(req.path.rstrip("/")
+                                               or "/"))
             h = getattr(req, "handler", None)
             if h is not None:
                 self.hotkeys.record("tenant", h.client_address[0])

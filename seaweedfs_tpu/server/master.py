@@ -189,6 +189,13 @@ class MasterServer:
         # clients can detect drift from one integer compare
         self._filer_ring = None
         self._filer_ring_lock = threading.Lock()
+        # live rebalancing (filer/rebalance.py): announce piggybacks
+        # feed the planner; a plan dispatches move orders to the
+        # source filer, and the ring only flips at commit time — after
+        # the mover has the rows at the destination
+        from seaweedfs_tpu.filer.rebalance import RebalancePlanner
+        self.rebalance = RebalancePlanner()
+        self.rebalance_dispatched: list[dict] = []
         self._grpc_server = None
         self.grpc_port: Optional[int] = None
 
@@ -480,6 +487,10 @@ class MasterServer:
         r("POST", "/dir/leave", self._handle_dir_leave)
         r("GET", "/cluster/nodes", self._handle_cluster_nodes)
         r("GET", "/cluster/filers", self._handle_cluster_filers)
+        r("GET", "/cluster/rebalance", self._handle_rebalance_status)
+        r("POST", "/cluster/rebalance/kick", self._handle_rebalance_kick)
+        r("POST", "/cluster/rebalance/commit",
+          self._handle_rebalance_commit)
         r("POST", "/col/delete", self._handle_col_delete)
         r("GET", "/ui", self._handle_ui)
         r("GET", "/", self._handle_ui)
@@ -605,7 +616,88 @@ class MasterServer:
             # so a client pulling right after a membership change can't
             # observe new members under the old epoch
             self._current_filer_ring()
+            if b.get("shard_load"):
+                self.rebalance.observe(url, b["shard_load"])
+                self._maybe_rebalance()
         return Response({})
+
+    # ---- live shard rebalancing (filer/rebalance.py) ----
+    def _maybe_rebalance(self, force: bool = False) -> Optional[dict]:
+        """Ask the planner for a plan against the current ring; when
+        one emits, dispatch move orders to each source filer in a
+        short-lived thread (the mover runs there; announce handling
+        must not block on a migration).  Leader-only, like repair."""
+        if not self.is_leader():
+            return None
+        with self._filer_ring_lock:
+            ring = self._filer_ring
+        plan = self.rebalance.plan(ring, force=force)
+        if plan is None:
+            return None
+        glog.info("rebalance plan: hot=%s (%.1fx mean) -> %s: %s",
+                  plan["hot"], plan["imbalance"], plan["cold"],
+                  [m["dir"] for m in plan["moves"]])
+        threading.Thread(target=self._dispatch_moves,
+                         args=(plan["moves"],),
+                         name="rebalance-dispatch", daemon=True).start()
+        return plan
+
+    def _dispatch_moves(self, moves: list[dict]) -> None:
+        from seaweedfs_tpu.utils.httpd import http_json
+        for mv in moves:
+            try:
+                out = http_json(
+                    "POST",
+                    f"http://{mv['from']}/__api/shard/migrate",
+                    {"dir": mv["dir"], "to": mv["to"]}, timeout=10)
+                self.rebalance_dispatched.append(
+                    {**mv, "accepted": bool(out.get("started"))})
+                if not out.get("started"):
+                    # mover busy: let the next planner round retry
+                    self.rebalance.note_failed(mv["dir"])
+            except Exception as e:
+                glog.warning("rebalance dispatch %s -> %s failed: %s",
+                             mv["dir"], mv["to"], e)
+                self.rebalance.note_failed(mv["dir"])
+
+    def _handle_rebalance_status(self, req: Request) -> Response:
+        with self._filer_ring_lock:
+            ring = self._filer_ring
+        return Response({
+            "planner": self.rebalance.status(),
+            "dispatched": self.rebalance_dispatched[-16:],
+            "overrides": dict(ring.overrides) if ring else {},
+            "ring_epoch": ring.epoch if ring else 0,
+        })
+
+    def _handle_rebalance_kick(self, req: Request) -> Response:
+        if not self.is_leader():
+            return self._not_leader()
+        plan = self._maybe_rebalance(force=True)
+        return Response({"plan": plan})
+
+    def _handle_rebalance_commit(self, req: Request) -> Response:
+        """The mover finished copying: flip ownership.  Layer the
+        {dir: dest} override over the ring under the ring lock — a
+        forward-only epoch bump — and return the new ring so the
+        caller can adopt it without a second round-trip."""
+        if not self.is_leader():
+            return self._not_leader()
+        b = req.json() or {}
+        directory, dest = b.get("dir", ""), b.get("to", "")
+        if not directory or not dest:
+            return Response({"error": "dir and to required"}, status=400)
+        with self._filer_ring_lock:
+            ring = self._filer_ring
+            if ring is None or dest not in ring:
+                return Response(
+                    {"error": f"{dest} not a ring member"}, status=409)
+            self._filer_ring = ring.with_overrides({directory: dest})
+            out = self._filer_ring.to_dict()
+        self.rebalance.note_committed(directory)
+        glog.info("rebalance commit: %s -> %s (ring epoch %d)",
+                  directory, dest, out["epoch"])
+        return Response(out)
 
     def _handle_cluster_nodes(self, req: Request) -> Response:
         ntype = req.query.get("type", "")

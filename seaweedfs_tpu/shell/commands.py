@@ -839,8 +839,12 @@ class ShellContext:
         """Namespace-sharding view: the master's filer ring (members +
         epoch) enriched with each filer's /__api/shard/status — routing
         outcome counters (local/redirect/forward/forced_local), entry
-        cache + negative-lookup hit rates, autocap state.  Unreachable
-        filers are reported, not fatal."""
+        cache + negative-lookup hit rates, autocap state — plus the
+        rebalancer's placement view: the override table, spread() of
+        the overridden directories across members, and the planner's
+        windowed per-shard rates with the resulting max/mean imbalance.
+        Unreachable filers (and a master without the rebalance
+        endpoint) are reported, not fatal."""
         try:
             ring = http_json("GET",
                              f"http://{self.master_url}/cluster/filers")
@@ -853,7 +857,31 @@ class ShellContext:
                     "GET", f"http://{url}/__api/shard/status"))
             except Exception as e:
                 shards.append({"url": url, "error": type(e).__name__})
-        return {"ring": ring, "shards": shards}
+        out = {"ring": ring, "shards": shards}
+        try:
+            reb = http_json(
+                "GET", f"http://{self.master_url}/cluster/rebalance")
+        except Exception as e:
+            reb = {"error": type(e).__name__}
+        out["rebalance"] = reb
+        if ring.get("filers"):
+            from seaweedfs_tpu.filer.shard_ring import ShardRing
+
+            r = ShardRing.from_dict(ring)
+            rates = {u: v for u, v in
+                     ((reb.get("planner") or {}).get("rates")
+                      or {}).items() if v is not None}
+            mean = (sum(rates.values()) / len(rates)) if rates else 0.0
+            out["placement"] = {
+                "overrides": dict(r.overrides),
+                # where the moved directories landed, per member — the
+                # "did the hot set actually spread" answer
+                "override_spread": r.spread(list(r.overrides)),
+                "rates": rates,
+                "imbalance": round(max(rates.values()) / mean, 3)
+                if mean > 0 else None,
+            }
+        return out
 
     def cluster_qos(self, configure: Optional[dict] = None,
                     node: str = "") -> dict:

@@ -1,6 +1,6 @@
 """Scripted incident library + machine-checked invariants.
 
-Eight incidents, each a pure function of (seed, n_actors):
+Nine incidents, each a pure function of (seed, n_actors):
 
   az_loss          grey-failure prelude (scripted latency band on every
                    link), then correlated crash of one whole AZ; the
@@ -43,6 +43,16 @@ Eight incidents, each a pure function of (seed, n_actors):
                    finishes it — no vid rebuilt twice, no repair entry
                    lost, zero acked-write loss, convergence within the
                    budget stretched only by the election + re-detect.
+  hot_shard_migration
+                   one namespace directory melts its owning filer shard
+                   (80% of all ops); the REAL RebalancePlanner must
+                   detect the imbalance from announce-shaped telemetry,
+                   emit exactly one converged move plan, and flip a
+                   REAL ShardRing via the override table mid-traffic —
+                   rolling_restart shape: ZERO failed client requests,
+                   the hot shard's routed share collapses after the
+                   flip, and the cooldown/min-share gates prevent
+                   ping-pong (no second flip).
   ec_single_shard_loss
                    ONE shard holder dies under live traffic — the LRC
                    repair drill.  Hybrid incident: the sim cluster must
@@ -614,12 +624,144 @@ def _master_failover_mid_repair(cluster: SimCluster, n_actors: int,
     return checks
 
 
+def _hot_shard_migration(cluster: SimCluster, n_actors: int,
+                         rate: float) -> list:
+    """Temperature-driven directory migration, closed loop.  The sim's
+    filers are client-side drivers (no namespace service plane), so the
+    namespace layer is modeled HERE with the real production pieces:
+    ops route to the filer owning their directory per a real ShardRing,
+    per-shard counters feed a real RebalancePlanner at announce
+    cadence, and a modeled mover (copy delay, then commit) flips the
+    ring with a real ``with_overrides`` epoch bump.  One directory
+    carries 80% of the load, melting its hash-owner; the planner must
+    move it to the coolest shard with zero failed client ops
+    (rolling_restart shape) and then STOP — the cooldown and min-share
+    gates must prevent the destination (now hottest by construction)
+    from shedding crumbs forever."""
+    from seaweedfs_tpu.filer.rebalance import RebalancePlanner
+    from seaweedfs_tpu.filer.shard_ring import ShardRing
+
+    duration = 40.0
+    hot_dir = "/zipf/hot"
+    names = [f.name for f in cluster.filers]
+    by_name = {f.name: f for f in cluster.filers}
+    ring = [ShardRing(names)]  # one-slot holder: the flip swaps it
+    hot_owner = ring[0].owner(hot_dir)
+    planner = RebalancePlanner(window_s=8.0, threshold=1.5,
+                               min_rate=2.0, cooldown_s=60.0)
+    ops_cum = {n: 0 for n in names}
+    dirs_cum: dict = {n: {} for n in names}
+    routed = {"pre": {n: 0 for n in names},
+              "post": {n: 0 for n in names}}
+    flips: list = []
+
+    def dir_of(op) -> str:
+        # 80% of ops hammer one directory; the rest spread over 97
+        # buckets so every shard has a pulse (the planner refuses to
+        # plan over members it has no rate for)
+        if op.key % 10 < 8:
+            return hot_dir
+        return "/zipf/b%03d" % (op.key % 97)
+
+    def dispatch(op) -> None:
+        owner = ring[0].owner(dir_of(op))
+        ops_cum[owner] += 1
+        dc = dirs_cum[owner]
+        d = dir_of(op)
+        dc[d] = dc.get(d, 0) + 1
+        routed["post" if flips else "pre"][owner] += 1
+        cluster._start_op(by_name[owner], op)
+
+    wl = ZipfWorkload(default_tenants(4, rate), seed=cluster.kernel.seed)
+    for op in wl.generate(duration):
+        cluster.kernel.schedule(op.t, dispatch, op)
+
+    def control_loop():
+        # the master's announce-ingest cadence: every 2s each shard
+        # reports cumulative ops + top directories, then the planner
+        # gets one shot at the current ring
+        while cluster.kernel.now < duration:
+            yield 2.0
+            now = cluster.kernel.now
+            for n in names:
+                top = sorted(dirs_cum[n].items(),
+                             key=lambda kv: (-kv[1], kv[0]))[:8]
+                planner.observe(
+                    n, {"ops": ops_cum[n],
+                        "dirs": [{"key": d, "count": c}
+                                 for d, c in top]}, now=now)
+            plan = planner.plan(ring[0], now=now)
+            if plan is None:
+                continue
+
+            def mover(moves=plan["moves"]):
+                yield 1.5  # modeled copy + delta drain before commit
+                new = ring[0].with_overrides(
+                    {m["dir"]: m["to"] for m in moves})
+                assert new.epoch > ring[0].epoch
+                ring[0] = new
+                for m in moves:
+                    planner.note_committed(m["dir"],
+                                           now=cluster.kernel.now)
+                flips.append((cluster.kernel.now, list(moves)))
+                cluster.kernel.note("incident", "ring_flip",
+                                    f"epoch={new.epoch}")
+
+            cluster.kernel.spawn(mover())
+
+    cluster.kernel.spawn(control_loop())
+    cluster.run(duration)
+    _settle(cluster, wl, duration, 10.0)
+    cluster.run(duration + 12.0)
+    checks: list = []
+    _common_invariants(cluster, checks)
+    checks.append(_check(
+        "zero_failed_client_requests", cluster.metrics.fail_total == 0,
+        f"{cluster.metrics.fail_total} failed ops "
+        f"(samples: {cluster.metrics.fail_samples[:3]})"
+        if cluster.metrics.fail_total else
+        f"all {cluster.metrics.ops_total()} ops succeeded across "
+        f"{len(flips)} ring flip(s)"))
+    moved = flips and any(m["dir"] == hot_dir
+                          for _, mv in flips for m in mv)
+    checks.append(_check(
+        "planner_moved_hot_directory",
+        bool(moved) and ring[0].overrides.get(hot_dir) not in (
+            None, hot_owner),
+        f"hot dir {hot_dir}: {hot_owner} -> "
+        f"{ring[0].overrides.get(hot_dir)} at "
+        f"t={flips[0][0]:.1f}s (ring epoch {ring[0].epoch})"
+        if flips else "planner never flipped the ring"))
+    pre_n, post_n = sum(routed["pre"].values()), sum(routed["post"].values())
+    pre_share = routed["pre"][hot_owner] / pre_n if pre_n else 0.0
+    post_share = routed["post"][hot_owner] / post_n if post_n else 1.0
+    checks.append(_check(
+        "hot_shard_share_collapsed",
+        pre_share >= 0.5 and post_share <= 0.35,
+        f"{hot_owner} routed share {pre_share:.2f} pre-flip -> "
+        f"{post_share:.2f} post-flip "
+        f"({pre_n} pre / {post_n} post ops)"))
+    # under zipf a couple of second-tier directories are individually
+    # warm, so follow-up spread moves are legitimate — thrash is a
+    # directory moving TWICE (ping-pong) or the planner never settling
+    moved_dirs = [m["dir"] for _, mv in flips for m in mv]
+    checks.append(_check(
+        "no_ping_pong",
+        len(set(moved_dirs)) == len(moved_dirs) and len(flips) <= 3,
+        f"{len(flips)} flips, moved {moved_dirs} "
+        f"(each dir at most once, <=3 plans)"))
+    _tenant_invariant(cluster, checks)
+    _breaker_invariant(cluster, checks)
+    return checks
+
+
 INCIDENTS = {
     "az_loss": _az_loss,
     "rolling_restart": _rolling_restart,
     "herd_repair": _herd_repair,
     "tenant_flood": _tenant_flood,
     "partition_heal_mid_repair": _partition_heal_mid_repair,
+    "hot_shard_migration": _hot_shard_migration,
     "ec_single_shard_loss": _ec_single_shard_loss,
     "master_failover_mid_write": _master_failover_mid_write,
     "master_failover_mid_repair": _master_failover_mid_repair,

@@ -138,6 +138,17 @@ def test_ec_single_shard_loss_at_16_actors():
     assert by_check["lrc_repair_bit_identical"]["ok"]
 
 
+def test_hot_shard_migration_at_16_actors():
+    r = run_incident("hot_shard_migration", seed=0, n_actors=16)
+    assert r["passed"], [c for c in r["invariants"] if not c["ok"]]
+    # rolling_restart shape: the migration is invisible to clients
+    assert r["client"]["failed"] == 0
+    by_check = {c["name"]: c for c in r["invariants"]}
+    assert by_check["planner_moved_hot_directory"]["ok"]
+    assert by_check["hot_shard_share_collapsed"]["ok"]
+    assert by_check["no_ping_pong"]["ok"]
+
+
 def test_master_failover_mid_write_at_16_actors():
     r = run_incident("master_failover_mid_write", seed=0, n_actors=16)
     assert r["passed"], [c for c in r["invariants"] if not c["ok"]]

@@ -159,6 +159,16 @@ CASES = {
                   "            return span\n"
                   "    pool.submit(work)\n"),
     },
+    "ring-epoch-forward": {
+        "bad": ("def adopt(self, ring):\n"
+                "    cur = self.shard_ring\n"
+                "    if cur is None or ring.epoch == cur.epoch:\n"
+                "        self.shard_ring = ring\n"),
+        "clean": ("def adopt(self, ring):\n"
+                  "    cur = self.shard_ring\n"
+                  "    if cur is None or ring.epoch > cur.epoch:\n"
+                  "        self.shard_ring = ring\n"),
+    },
 }
 
 

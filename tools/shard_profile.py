@@ -15,9 +15,14 @@ This is the operator's "is the namespace actually spreading" view: a
 healthy N-shard cluster shows ops/s on every member and a mis-route
 rate near zero once clients have pulled the current ring epoch.
 
+With `--watch` the tool runs until interrupted and each row gains a
+moves/s column — rows the shard's DirectoryMover migrated since the
+previous sample (plus the mover's state and the directory in flight),
+so an operator can watch a live rebalance drain in real time.
+
 Usage:
   PYTHONPATH=. python tools/shard_profile.py --master 127.0.0.1:9333 \
-      [--interval 2] [--duration 10] [--json]
+      [--interval 2] [--duration 10] [--json] [--watch]
   PYTHONPATH=. python tools/shard_profile.py --filer 127.0.0.1:8888 --once
 """
 
@@ -55,10 +60,21 @@ def _served(snap: dict) -> float:
             + cache.get("misses", 0))
 
 
+def _moves_per_s(prev: dict, cur: dict, dt: float) -> float:
+    """Mover throughput from successive rows_moved samples.  The
+    counter resets when a new migration starts, so a negative delta
+    means "new move began" — clamp to the absolute count instead of
+    reporting a negative rate."""
+    c = cur.get("mover", {}).get("rows_moved", 0)
+    p = (prev or {}).get("mover", {}).get("rows_moved", 0)
+    return round(max(c - p, c if c < p else 0) / dt, 1)
+
+
 def _row(filer: str, prev: dict, cur: dict, dt: float) -> dict:
     routing = cur.get("routing", {})
     p_routing = (prev or {}).get("routing", {})
     cache = cur.get("entry_cache", {})
+    mover = cur.get("mover", {})
     looked = (cache.get("hits", 0) + cache.get("neg_hits", 0)
               + cache.get("misses", 0))
     return {
@@ -77,22 +93,34 @@ def _row(filer: str, prev: dict, cur: dict, dt: float) -> dict:
         if looked else 0.0,
         "hot_size": cache.get("entries", 0),
         "neg_size": cache.get("negatives", 0),
+        "moves_per_s": _moves_per_s(prev, cur, dt),
+        "mover_state": mover.get("state", "idle"),
+        "mover_dir": mover.get("dir"),
     }
 
 
-def _print_rows(ts: float, ring: dict, rows: list) -> None:
+def _print_rows(ts: float, ring: dict, rows: list,
+                watch: bool = False) -> None:
     print(f"[{time.strftime('%H:%M:%S', time.localtime(ts))}] "
           f"ring epoch={ring.get('epoch')} members={len(ring.get('filers', []))}")
     for r in rows:
-        print(f"    {r['shard']:<22} active={str(r['active']):<5} "
-              f"ops/s={r['ops_per_s']:<8} redir/s={r['redirect_per_s']:<6} "
-              f"fwd/s={r['forward_per_s']:<6} hit={r['hit_rate']:<6} "
-              f"neg={r['neg_hit_rate']:<6} "
-              f"cached={r['hot_size']}+{r['neg_size']}")
+        if "error" in r:
+            print(f"    {r['shard']:<22} error={r['error']}")
+            continue
+        line = (f"    {r['shard']:<22} active={str(r['active']):<5} "
+                f"ops/s={r['ops_per_s']:<8} redir/s={r['redirect_per_s']:<6} "
+                f"fwd/s={r['forward_per_s']:<6} hit={r['hit_rate']:<6} "
+                f"neg={r['neg_hit_rate']:<6} "
+                f"cached={r['hot_size']}+{r['neg_size']}")
+        if watch:
+            line += f" moves/s={r['moves_per_s']:<6}"
+            if r["mover_state"] not in ("idle", "done"):
+                line += f" mover={r['mover_state']}:{r['mover_dir']}"
+        print(line)
 
 
 def run(master: str, filers: list, interval: float, duration: float,
-        as_json: bool, once: bool) -> int:
+        as_json: bool, once: bool, watch: bool = False) -> int:
     ring: dict = {"filers": filers}
     if master:
         try:
@@ -123,9 +151,9 @@ def run(master: str, filers: list, interval: float, duration: float,
         if as_json:
             print(json.dumps({"ts": ts, "ring": ring, "shards": rows}))
         else:
-            _print_rows(ts, ring, rows)
+            _print_rows(ts, ring, rows, watch=watch)
         prev = cur
-        if once or clockctl.monotonic() >= deadline:
+        if once or (not watch and clockctl.monotonic() >= deadline):
             return 0
         clockctl.sleep(interval)
 
@@ -141,11 +169,17 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--once", action="store_true",
                     help="one sample and exit")
+    ap.add_argument("--watch", action="store_true",
+                    help="run until interrupted; adds a moves/s column "
+                         "(DirectoryMover rows migrated per second)")
     args = ap.parse_args(argv)
     args.master = args.master.removeprefix("http://")
     args.filer = [f.removeprefix("http://") for f in args.filer]
-    return run(args.master, args.filer, args.interval, args.duration,
-               args.as_json, args.once)
+    try:
+        return run(args.master, args.filer, args.interval,
+                   args.duration, args.as_json, args.once, args.watch)
+    except KeyboardInterrupt:
+        return 0
 
 
 if __name__ == "__main__":

@@ -1,4 +1,4 @@
-"""The weedlint rule set: one AST pass, fifteen invariants.
+"""The weedlint rule set: one AST pass, seventeen invariants.
 
 Every rule encodes a contract the cluster depends on ambiently — the
 kind that breaks silently at a single call site and only surfaces as a
@@ -149,6 +149,18 @@ hardcoded-shard-count
     that merely happen to be 4 (prefetch depth, 4-byte lanes) don't
     match the flagged forms and stay legal; ``layout.py`` is the home
     where the counts are defined.
+
+ring-epoch-forward
+    a bare ``==`` between two shard-ring epoch expressions.  Ring
+    epochs are forward-only: adoption sites must compare ``>``/``>=``
+    so a replayed or stale announcement can never re-install an old
+    ring (filer ``_adopt_ring``, wdclient ``note_shard_epoch``, the
+    mover's commit adopt).  An ``==`` gate looks equivalent on the
+    happy path and silently rejects every LEGITIMATE newer epoch —
+    the ring then never converges after a rebalance.  Epoch equality
+    that has nothing to do with rings (sim actor incarnations, volume
+    cache generations) doesn't name a ring/shard and stays legal;
+    ``filer/shard_ring.py`` is the home where epoch semantics live.
 """
 
 from __future__ import annotations
@@ -192,6 +204,9 @@ RULES: dict[str, str] = {
     "lease-wall-clock":
         "lease/expiry math on a raw wall clock (time.time/datetime.now) "
         "— grant and refusal must share clockctl.now()",
+    "ring-epoch-forward":
+        "shard-ring epoch compared with == — adoption must be >/>= "
+        "(forward-only) or a stale ring can re-install",
 }
 
 # files that ARE the sanctioned implementation of a contract
@@ -205,6 +220,7 @@ _RULE_HOME = {
     "hot-path-bytes-copy": "utils/httpd.py",
     "hardcoded-shard-count": "storage/erasure_coding/layout.py",
     "lease-wall-clock": "utils/clockctl.py",
+    "ring-epoch-forward": "filer/shard_ring.py",
 }
 
 _HEADER_PREFIX = "X-Weed-"
@@ -250,6 +266,22 @@ _WALL_CLOCK_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
                      "datetime.datetime.today"}
 # identifiers/keys that mark an expression as lease-expiry arithmetic
 _LEASEISH = re.compile(r"lease|expir", re.IGNORECASE)
+# ring-epoch-forward: both operands name an epoch, and at least one
+# names the ring/shard machinery — sim actor incarnations and other
+# unrelated "epoch"s stay legal
+_EPOCHISH = re.compile(r"epoch", re.IGNORECASE)
+_RINGISH = re.compile(r"ring|shard", re.IGNORECASE)
+
+
+def _ident_strings(expr: ast.AST) -> list[str]:
+    """Every Name/Attribute identifier inside `expr`."""
+    out = []
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name):
+            out.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.append(n.attr)
+    return out
 
 
 @dataclass(frozen=True)
@@ -635,6 +667,18 @@ class Checker(ast.NodeVisitor):
     def visit_Compare(self, node: ast.Compare) -> None:
         # a lease/expiry operand compared against a raw wall clock read
         self._check_lease_clock(node, node, node)
+        if len(node.ops) == 1 and isinstance(node.ops[0], ast.Eq):
+            left = _ident_strings(node.left)
+            right = _ident_strings(node.comparators[0])
+            if (any(_EPOCHISH.search(s) for s in left)
+                    and any(_EPOCHISH.search(s) for s in right)
+                    and any(_RINGISH.search(s)
+                            for s in left + right)):
+                self.report(
+                    node, "ring-epoch-forward",
+                    "ring epoch compared with == — epochs are "
+                    "forward-only; adopt with > / >= so a stale ring "
+                    "can never re-install")
         if self.rel.startswith(_EC_SUBTREE):
             for operand in [node.left] + node.comparators:
                 if isinstance(operand, ast.Constant) \
