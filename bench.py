@@ -1391,6 +1391,347 @@ def bench_shard_rebalance(n_shards: int = 3, n_hot_dirs: int = 9,
     }
 
 
+def bench_tiering(n_vols: int = 6, files_per_vol: int = 12,
+                  file_kb: int = 32, ops_per_phase: int = 240,
+                  concurrency: int = 4,
+                  converge_timeout_s: float = 75.0,
+                  reheat_timeout_s: float = 30.0) -> dict:
+    """Temperature-driven tiering autopilot vs a tiering-off comparator.
+
+    Two identical single-node clusters, each with n_vols sealed data
+    volumes seeded with the same payloads.  The live cluster's planner
+    is armed (fast bands) after the BEFORE phase and the workload gives
+    each volume a distinct temperature: one volume is hammered (hot),
+    one gets a ~0.8/s trickle (cooling), the rest go silent (cold).
+    The autopilot must move cooling->EC and cold->cloud (our own S3
+    gateway) purely from heartbeat-piggybacked read counters, then
+    promote one cloud volume back to hot when the bench re-heats it.
+
+    Reported: hot-read p99 per phase (BEFORE / DURING migration /
+    AFTER, plus the frozen comparator), failed client ops across ALL
+    live-lane reads (must be 0 — demote/promote hold the volume lock,
+    so concurrent reads wait instead of failing), bit-identical
+    readback of every needle at every rung transition, and the
+    $/GB-weighted effective-capacity ratio vs tiering-off under a
+    declared price model (hot replicated NVMe 1.0, EC parity HDD 0.5,
+    cloud object store 0.1 $/GB)."""
+    import hashlib
+    import random
+    import shutil
+    import tempfile
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.client.operation import upload_to
+    from seaweedfs_tpu.gateway.s3_server import S3Server
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+    from seaweedfs_tpu.utils import clockctl
+    from seaweedfs_tpu.utils.httpd import http_call, http_json
+
+    PRICE = {"hot": 1.0, "ec": 0.5, "cloud": 0.1}  # $/GB weights
+
+    def build_lane(live: bool) -> dict:
+        d = tempfile.mkdtemp(prefix="bench-tier-")
+        master = MasterServer(volume_size_limit_mb=64)
+        if not live:
+            # tiering-off comparator: same planner object, permanently
+            # below the age gate so no plan can ever fire
+            master.tiering.min_age_s = float("inf")
+        master.start()
+        vs = VolumeServer([os.path.join(d, "v")], master.url)
+        vs.start()
+        lane = {"dir": d, "master": master, "vs": vs,
+                "filer": None, "s3": None}
+        if live:
+            fs = FilerServer(master.url)
+            fs.start()
+            s3 = S3Server(fs)
+            s3.start()
+            http_call("PUT", f"http://{s3.url}/tier")
+            lane["filer"], lane["s3"] = fs, s3
+        # explicit growth needs the node registered; retry across the
+        # first heartbeat
+        deadline = time.monotonic() + 10
+        vids: list = []
+        while time.monotonic() < deadline and len(vids) < n_vols:
+            try:
+                out = http_json(
+                    "POST",
+                    f"http://{master.url}/vol/grow?count={n_vols}")
+                vids = sorted(out.get("volume_ids", []))
+            except (ConnectionError, ValueError):
+                pass
+            if len(vids) < n_vols:
+                clockctl.sleep(0.1)
+        assert len(vids) == n_vols, f"volume growth failed: {vids}"
+        lane["vids"] = vids
+        return lane
+
+    # identical payloads on both lanes, addressed by (vol index, file
+    # index) so the lanes' vid numbering need not match
+    rng = random.Random(7)
+    payloads = {(i, j): rng.randbytes(file_kb * 1024)
+                for i in range(n_vols) for j in range(files_per_vol)}
+    digests = {k: hashlib.sha256(v).hexdigest()
+               for k, v in payloads.items()}
+
+    def seed(lane: dict) -> None:
+        """Self-assigned fids (master assign scatters randomly; the
+        bench needs an exact files-per-volume layout), then seal every
+        data volume — demotion only considers read-only volumes."""
+        key = 1
+        lane["fids"] = {}
+        for i, vid in enumerate(lane["vids"]):
+            for j in range(files_per_vol):
+                fid = f"{vid},{format_needle_id_cookie(key, 0x1234)}"
+                key += 1
+                upload_to(fid, lane["vs"].url, payloads[(i, j)],
+                          name=f"f{i}_{j}")
+                lane["fids"][(i, j)] = fid
+        for vid in lane["vids"]:
+            http_json("POST",
+                      f"http://{lane['vs'].url}/admin/mark_readonly",
+                      {"volume_id": vid, "read_only": True})
+
+    la = build_lane(live=True)
+    lb = build_lane(live=False)
+    failed = [0]
+    stop_evt = threading.Event()
+    threads: list = []
+    try:
+        seed(la)
+        seed(lb)
+        # roles by volume index: 0 hot, 1 cooling, 2.. cold
+        hot_fids = [la["fids"][(0, j)] for j in range(files_per_vol)]
+        cool_fids = [la["fids"][(1, j)] for j in range(files_per_vol)]
+        hot_fids_b = [lb["fids"][(0, j)] for j in range(files_per_vol)]
+        cold_idx = list(range(2, n_vols))
+
+        def get(lane: dict, fid: str, count_failures: bool) -> bytes:
+            try:
+                st, body, _ = http_call(
+                    "GET", f"http://{lane['vs'].url}/{fid}")
+                ok = st == 200
+            except (ConnectionError, OSError):
+                ok, body = False, b""
+            if not ok and count_failures:
+                failed[0] += 1
+            return body if ok else b""
+
+        def replay(lane: dict, fids: list, n: int,
+                   count_failures: bool) -> float:
+            """n hot reads, cycled over fids; returns p99 in ms."""
+            lats: list = []
+
+            def one(k):
+                t0 = time.perf_counter()
+                get(lane, fids[k % len(fids)], count_failures)
+                lats.append(time.perf_counter() - t0)
+
+            with ThreadPoolExecutor(max_workers=concurrency) as pool:
+                list(pool.map(one, range(n)))
+            lats.sort()
+            return lats[int(0.99 * (len(lats) - 1))] * 1000.0
+
+        def walk(lane: dict, count_failures: bool) -> bool:
+            """Read back EVERY needle and compare against the seeded
+            digest — the bit-identity probe run at each rung state."""
+            ok = True
+            for k, fid in sorted(lane["fids"].items()):
+                body = get(lane, fid, count_failures)
+                if hashlib.sha256(body).hexdigest() != digests[k]:
+                    ok = False
+            return ok
+
+        def best_p99(lane: dict, fids: list, reps: int,
+                     count_failures: bool) -> float:
+            """Best-of-reps p99: scheduler noise only ever ADDS
+            latency, so the minimum is the closest estimate of the
+            lane's intrinsic tail (the benches share one small box)."""
+            return min(replay(lane, fids, ops_per_phase,
+                              count_failures) for _ in range(reps))
+
+        # warm connections + page cache, then the BEFORE phase
+        replay(la, hot_fids, 64, False)
+        replay(lb, hot_fids_b, 64, False)
+        p99_before = best_p99(la, hot_fids, 2, True)
+        identical_before = walk(la, True)
+
+        # arm the autopilot: fast bands scaled to the bench workload
+        # (hammer >> heat_min, trickle inside (cold_max, cool_max],
+        # silence -> 0), cloud rung pointed at our own S3 gateway.
+        # Heartbeats are already flowing, so plans fire on the next
+        # pulse.
+        tp = la["master"].tiering
+        tp.window_s = 3.0
+        tp.cool_max = 1.5
+        tp.cold_max = 0.2
+        tp.heat_min = 6.0
+        tp.min_age_s = 2.0
+        tp.cooldown_s = 3.0
+        tp.max_moves_per_plan = 4
+        tp.cloud_enabled = True
+        la["master"].tier_mover.endpoint = f"http://{la['s3'].url}"
+        la["master"].tier_mover.bucket = "tier"
+
+        # identical background workload on BOTH lanes (only the
+        # autopilot differs): a hammer keeps the hot volume hot, a
+        # ~0.8/s trickle holds the cooling volume in the EC band
+        def driver(lane: dict, fids: list, pause: float,
+                   count_failures: bool):
+            k = 0
+            while not stop_evt.is_set():
+                get(lane, fids[k % len(fids)], count_failures)
+                k += 1
+                stop_evt.wait(pause)
+
+        cool_fids_b = [lb["fids"][(1, j)] for j in range(files_per_vol)]
+        for lane, fids, pause, count in (
+                (la, hot_fids, 0.1, True),
+                (la, cool_fids, 1.2, True),
+                (lb, hot_fids_b, 0.1, False),
+                (lb, cool_fids_b, 1.2, False)):
+            t = threading.Thread(
+                target=driver, args=(lane, fids, pause, count),
+                daemon=True, name="bench-tier-driver")
+            t.start()
+            threads.append(t)
+
+        def tier_status() -> dict:
+            return http_json(
+                "GET", f"http://{la['master'].url}/cluster/tiering")
+
+        def rung_of(st: dict, vid: int) -> str:
+            vols = st["planner"]["volumes"]
+            meta = vols.get(str(vid), vols.get(vid, {}))
+            return meta.get("rung", "hot")
+
+        want = {la["vids"][i]: "cloud" for i in cold_idx}
+        want[la["vids"][1]] = "ec"
+        p99_during: list = []
+        t0 = time.monotonic()
+        stable, converged = 0, False
+        st_conv = tier_status()
+        while time.monotonic() - t0 < converge_timeout_s:
+            p99_during.append(replay(la, hot_fids, 60, True))
+            st = tier_status()
+            settled = (not st["mover"]["busy"] and all(
+                rung_of(st, vid) == rung for vid, rung in want.items()))
+            stable = stable + 1 if settled else 0
+            if stable >= 2:
+                converged, st_conv = True, st
+                break
+            clockctl.sleep(0.4)
+        t_converge = time.monotonic() - t0
+        identical_tiered = walk(la, True)
+
+        # steady-state economics at the converged rung layout: the
+        # same bytes, weighted by what their rung costs per GB
+        def lane_cost(st: dict, flat: bool) -> float:
+            cost = 0.0
+            for vid in la["vids"]:
+                vols = st["planner"]["volumes"]
+                meta = vols.get(str(vid), vols.get(vid, {}))
+                rung = "hot" if flat else meta.get("rung", "hot")
+                cost += meta.get("size", 0) * PRICE[rung]
+            return cost
+
+        tiered_cost = lane_cost(st_conv, flat=False)
+        flat_cost = lane_cost(st_conv, flat=True)
+        capacity_ratio = flat_cost / tiered_cost if tiered_cost else 0.0
+
+        # re-heat: hammer one cloud volume until the autopilot promotes
+        # it home (cloud -> hot; it never had EC shards)
+        reheat_vid = la["vids"][cold_idx[0]]
+        reheat_fids = [la["fids"][(cold_idx[0], j)]
+                       for j in range(files_per_vol)]
+        t0 = time.monotonic()
+        promoted, k = False, 0
+        next_poll = 0.0
+        while time.monotonic() - t0 < reheat_timeout_s:
+            get(la, reheat_fids[k % len(reheat_fids)], True)
+            k += 1
+            if time.monotonic() - t0 >= next_poll:
+                next_poll += 0.5
+                if rung_of(tier_status(), reheat_vid) == "hot":
+                    promoted = True
+                    break
+        t_reheat = time.monotonic() - t0
+
+        # snapshot the final rung layout while the steering load is
+        # still live, then retire the drivers: BEFORE was measured
+        # without them, so the steady-state AFTER/frozen comparison
+        # must be too (the drivers exist only to steer temperature
+        # through the migration and re-heat phases).  The planner is
+        # age-gated off for the epilogue so the now-silent volumes
+        # can't start a fresh demotion mid-measurement.
+        st_final = tier_status()
+        rungs_final = {vid: rung_of(st_final, vid)
+                       for vid in la["vids"]}
+        stats = http_json(
+            "GET", f"http://{la['vs'].url}/admin/tier")["stats"]
+        tp.min_age_s = float("inf")
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=5)
+
+        # interleaved best-of-3 so slow drift on the shared box hits
+        # both lanes alike
+        after_samples, frozen_samples = [], []
+        for _ in range(3):
+            after_samples.append(
+                replay(la, hot_fids, ops_per_phase, True))
+            frozen_samples.append(
+                replay(lb, hot_fids_b, ops_per_phase, False))
+        p99_after = min(after_samples)
+        p99_frozen = min(frozen_samples)
+        identical_after = walk(la, True)
+        identical_frozen = walk(lb, False)
+    finally:
+        stop_evt.set()
+        for lane in (la, lb):
+            if lane.get("s3"):
+                lane["s3"].stop()
+            if lane.get("filer"):
+                lane["filer"].stop()
+            lane["vs"].stop()
+            lane["master"].stop()
+            shutil.rmtree(lane["dir"], ignore_errors=True)
+
+    return {
+        "tiering_vols": n_vols,
+        "tiering_files": n_vols * files_per_vol,
+        "tiering_converged": bool(converged),
+        "tiering_converge_s": round(t_converge, 1),
+        "tiering_rungs_converged": {
+            str(vid): rung_of(st_conv, vid) for vid in la["vids"]},
+        "tiering_rungs_final": {
+            str(k): v for k, v in rungs_final.items()},
+        "tiering_capacity_ratio": round(capacity_ratio, 2),
+        "tiering_price_model": "hot=1.0 ec=0.5 cloud=0.1 $/GB",
+        "tiering_p99_ms_before": round(p99_before, 1),
+        "tiering_p99_ms_during": round(max(p99_during), 1)
+        if p99_during else 0.0,
+        "tiering_p99_ms_after": round(p99_after, 1),
+        "tiering_p99_ms_frozen": round(p99_frozen, 1),
+        "tiering_p99_degradation": round(
+            p99_after / p99_frozen, 2) if p99_frozen else 0.0,
+        "tiering_failed_ops": failed[0],
+        "tiering_bit_identical": bool(
+            identical_before and identical_tiered and identical_after
+            and identical_frozen),
+        "tiering_reheat_promoted": bool(promoted),
+        "tiering_reheat_s": round(t_reheat, 1),
+        "tiering_demotes": stats.get("demotes", 0),
+        "tiering_promotes": stats.get("promotes", 0),
+        "tiering_bytes_demoted": stats.get("bytes_demoted", 0),
+        "tiering_bytes_promoted": stats.get("bytes_promoted", 0),
+    }
+
+
 def bench_replicated_write(n_writes: int = 20,
                            slow_ms: float = 40.0) -> dict:
     """Replicated-write tail latency: concurrent replica fan-out vs
@@ -2546,6 +2887,7 @@ def main(argv=None):
     e2e.update(bench_replica_divergence_repair())  # hinted-handoff drill
     e2e.update(bench_filer_ops())  # sharded namespace scale-out
     e2e.update(bench_shard_rebalance())  # live hot-dir migration
+    e2e.update(bench_tiering())  # temperature-driven tier autopilot
     e2e.update(bench_assign_flood())  # master-dark leased PUT flood
     tpu, attempts, err = tpu_probe_with_retries()
     if tpu is not None:

@@ -82,7 +82,9 @@ def cmd_master(args):
                       default_replication=args.defaultReplication,
                       meta_dir=args.mdir,
                       grpc_port=args.port + 10000 if args.grpc else None,
-                      repair_rate_mbps=args.repairRateMBps)
+                      repair_rate_mbps=args.repairRateMBps,
+                      tier_endpoint=args.tierEndpoint,
+                      tier_bucket=args.tierBucket)
     ms.start()
     _start_push(args, ("master", ms))
     if args.peers:
@@ -882,6 +884,12 @@ def main(argv=None):
     m.add_argument("-repairRateMBps", type=float, default=0.0,
                    help="cluster-wide EC repair bandwidth budget shared "
                         "across concurrent rebuilds (0 = unlimited)")
+    m.add_argument("-tierEndpoint", default="",
+                   help="S3 endpoint URL for the tiering autopilot's "
+                        "cloud rung (empty keeps cloud demotion off; "
+                        "hot<->ec transitions still run)")
+    m.add_argument("-tierBucket", default="tier",
+                   help="bucket on -tierEndpoint holding demoted volumes")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")

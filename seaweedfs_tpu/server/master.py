@@ -75,7 +75,8 @@ class MasterServer:
                  qos: bool = True,
                  tracing_enabled: bool = True,
                  trace_sample: float = 0.01,
-                 profile_hz: float = profiler.DEFAULT_HZ):
+                 profile_hz: float = profiler.DEFAULT_HZ,
+                 tier_endpoint: str = "", tier_bucket: str = "tier"):
         self.topo = Topology(volume_size_limit=volume_size_limit_mb * 1024 * 1024)
         self.jwt_signing_key = jwt_signing_key
         from seaweedfs_tpu.utils.metrics import Registry
@@ -196,6 +197,15 @@ class MasterServer:
         from seaweedfs_tpu.filer.rebalance import RebalancePlanner
         self.rebalance = RebalancePlanner()
         self.rebalance_dispatched: list[dict] = []
+        # tiering autopilot (storage/tiering.py): heartbeat-piggybacked
+        # read counters feed the planner; the mover executes rung
+        # transitions as BACKGROUND token-bucketed jobs. Without a
+        # tier_endpoint the cloud rung stays disabled (hot<->ec only).
+        from seaweedfs_tpu.storage.tiering import TieringPlanner, TierMover
+        self.tiering = TieringPlanner(cloud_enabled=bool(tier_endpoint))
+        self.tier_mover = TierMover(self.tiering, endpoint=tier_endpoint,
+                                    bucket=tier_bucket)
+        self.tiering_dispatched: list[dict] = []
         self._grpc_server = None
         self.grpc_port: Optional[int] = None
 
@@ -491,6 +501,8 @@ class MasterServer:
         r("POST", "/cluster/rebalance/kick", self._handle_rebalance_kick)
         r("POST", "/cluster/rebalance/commit",
           self._handle_rebalance_commit)
+        r("GET", "/cluster/tiering", self._handle_tiering_status)
+        r("POST", "/cluster/tiering/kick", self._handle_tiering_kick)
         r("POST", "/col/delete", self._handle_col_delete)
         r("GET", "/ui", self._handle_ui)
         r("GET", "/", self._handle_ui)
@@ -699,6 +711,39 @@ class MasterServer:
                   directory, dest, out["epoch"])
         return Response(out)
 
+    # ---- tiering autopilot (storage/tiering.py) ----
+    def _maybe_tier(self, force: bool = False) -> Optional[dict]:
+        """Leader-gated: ask the planner for rung transitions and hand
+        them to the mover. One plan in flight at a time — the mover
+        refuses a start while busy, and un-dispatched moves just wait
+        for the next heartbeat round."""
+        if not self.is_leader() or self.tier_mover.busy:
+            return None
+        plan = self.tiering.plan()
+        if plan is None:
+            return None
+        glog.info("tiering plan: %s",
+                  [(m["vid"], m["from"], m["to"]) for m in plan["moves"]])
+        self.tiering_dispatched.extend(plan["moves"])
+        if not self.tier_mover.start(plan):
+            for mv in plan["moves"]:
+                self.tiering.note_failed(mv["vid"])
+            return None
+        return plan
+
+    def _handle_tiering_status(self, req: Request) -> Response:
+        return Response({
+            "planner": self.tiering.status(),
+            "mover": self.tier_mover.status(),
+            "dispatched": self.tiering_dispatched[-16:],
+        })
+
+    def _handle_tiering_kick(self, req: Request) -> Response:
+        if not self.is_leader():
+            return self._not_leader()
+        plan = self._maybe_tier(force=True)
+        return Response({"plan": plan})
+
     def _handle_cluster_nodes(self, req: Request) -> Response:
         ntype = req.query.get("type", "")
         now = clockctl.now()
@@ -798,6 +843,12 @@ class MasterServer:
             self.topo.incremental_sync(node, hb)
         else:
             node = self.topo.sync_data_node_registration(hb)
+        # tiering telemetry piggyback: per-volume read counters + rung
+        # state feed the planner; a plan (if any) dispatches off-thread
+        tiering = (hb.get("telemetry") or {}).get("tiering")
+        if tiering and node is not None:
+            self.tiering.observe(f"{hb['ip']}:{hb['port']}", tiering)
+            self._maybe_tier()
         if node is not None and node.draining:
             # graceful drain announced: exempt the node's volumes from
             # the degraded repair scan so a rolling restart never looks

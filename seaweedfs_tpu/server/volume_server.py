@@ -15,6 +15,7 @@ reference weed/server/volume_grpc_erasure_coding.go:24-35.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import threading
@@ -327,6 +328,16 @@ class VolumeServer:
         self.red = RedRecorder(self.metrics, "volume")
         self.http.red = self.red
         self.hotkeys = HotKeys(dims=("needle",))
+        # per-volume cumulative read counters — the tiering autopilot's
+        # temperature signal, piggybacked on heartbeats via
+        # telemetry_snapshot(). Cumulative on purpose: the master diffs
+        # successive reports, so a lost heartbeat costs nothing and a
+        # restart clamps to zero instead of going negative.
+        self.vol_reads: dict[int, int] = collections.defaultdict(int)
+        # rung-transition counters for /admin/tier + tier_profile
+        self.tier_stats = {"demotes": 0, "promotes": 0,
+                           "bytes_demoted": 0, "bytes_promoted": 0,
+                           "failed": 0}
         # continuous profiling + per-(class, tenant) resource ledger;
         # both ride the telemetry piggyback to the master
         from seaweedfs_tpu.stats.ledger import ResourceLedger
@@ -784,6 +795,10 @@ class VolumeServer:
         r("GET", "/admin/volume_file", self._admin_volume_file)
         r("POST", "/admin/tier_upload", self._admin_tier_upload)
         r("POST", "/admin/tier_download", self._admin_tier_download)
+        # tiering autopilot: rung state + BACKGROUND-classed moves
+        r("GET", "/admin/tier", self._admin_tier_status)
+        r("POST", "/admin/tier/demote", self._admin_tier_demote)
+        r("POST", "/admin/tier/promote", self._admin_tier_promote)
         r("GET", "/admin/volume_digest", self._admin_volume_digest)
         r("GET", "/admin/needle", self._admin_needle)
         r("GET", "/admin/needle_blob", self._admin_needle_blob)
@@ -904,7 +919,8 @@ class VolumeServer:
         snap = {"node": self.url, "server": "volume",
                 "red": self.red.snapshot(),
                 "hotkeys": self.hotkeys.snapshot(),
-                "ledger": self.ledger.snapshot()}
+                "ledger": self.ledger.snapshot(),
+                "tiering": self.tiering_report()}
         if self.hint_journal is not None:
             # journal size/age ride the heartbeat so the master can
             # fire hints_stale when a drain wedges
@@ -912,6 +928,31 @@ class VolumeServer:
             snap["hints"] = {"pending_rows": st["pending_rows"],
                              "oldest_debt_age_s": st["oldest_debt_age_s"]}
         return snap
+
+    def tiering_report(self) -> dict:
+        """Per-volume tier state + cumulative read counters for the
+        master's TieringPlanner (rides every heartbeat's telemetry
+        piggyback). A tiered volume's size comes from the backend's
+        cached stat — one HEAD against the gateway on the first report
+        after demotion, free afterwards."""
+        vols = {}
+        for loc in self.store.locations:
+            for vid, v in list(loc.volumes.items()):
+                has_ec = vid in loc.ec_volumes \
+                    or self.store.has_ec_volume(vid)
+                if v.is_tiered:
+                    rung = "cloud"
+                else:
+                    rung = "ec" if has_ec else "hot"
+                try:
+                    size = v.content_size()
+                except (IOError, OSError, ValueError):
+                    size = 0  # tier endpoint blip: report, don't crash
+                vols[vid] = {"reads": self.vol_reads.get(vid, 0),
+                             "rung": rung, "size": size,
+                             "read_only": v.read_only,
+                             "has_ec_shards": has_ec}
+        return {"volumes": vols, "stats": dict(self.tier_stats)}
 
     def _admin_telemetry(self, req: Request) -> Response:
         return Response(self.telemetry_snapshot())
@@ -1206,6 +1247,10 @@ class VolumeServer:
         self._m_req.inc("read")
         vid, key, cookie = self._parse_fid(req)
         self.hotkeys.record("needle", "%d,%x" % (vid, key))
+        # temperature signal for the tiering planner: demand against
+        # the volume, wherever the bytes end up coming from (local,
+        # EC-degraded, or the cloud tier). GIL-atomic int bump.
+        self.vol_reads[vid] += 1
         if req.headers.get("Range") and \
                 self.store.find_volume(vid) is None and \
                 self.store.has_ec_volume(vid) and \
@@ -2004,6 +2049,59 @@ class VolumeServer:
         except (ValueError, IOError) as e:
             return Response({"error": str(e)}, status=409)
         return Response({"downloaded": v.id})
+
+    def _admin_tier_status(self, req: Request) -> Response:
+        """Per-rung census + move counters for tier_profile and
+        volume.tier.status."""
+        report = self.tiering_report()
+        rungs = collections.Counter(
+            v["rung"] for v in report["volumes"].values())
+        return Response({"url": self.url, "rungs": dict(rungs),
+                         **report})
+
+    def _admin_tier_demote(self, req: Request) -> Response:
+        """One rung down, BACKGROUND-classed: the S3 upload + readback
+        verify inside tier_to must never ride the interactive QoS lane
+        (this scope also stamps X-Weed-Class on the outbound PUTs)."""
+        b = req.json()
+        vid = b["volume_id"]
+        v = self.store.find_volume(vid)
+        if v is None:
+            return Response({"error": "volume not found"}, status=404)
+        size = 0
+        try:
+            with class_scope(BACKGROUND):
+                size = v.content_size() if not v.is_tiered else 0
+                info = v.tier_to(b["endpoint"], b["bucket"],
+                                 keep_local=b.get("keep_local", False))
+        except (ValueError, RuntimeError, IOError) as e:
+            self.tier_stats["failed"] += 1
+            return Response({"error": str(e)}, status=409)
+        self.tier_stats["demotes"] += 1
+        self.tier_stats["bytes_demoted"] += size
+        self._push_deltas()
+        return Response({"demoted": vid, "rung": "cloud",
+                         "remote": info.get("remote")})
+
+    def _admin_tier_promote(self, req: Request) -> Response:
+        """One rung up, BACKGROUND-classed: fetch from the tier,
+        verify size + chained crc32c against the .vif record, reopen
+        local (the re-heat path)."""
+        b = req.json()
+        vid = b["volume_id"]
+        v = self.store.find_volume(vid)
+        if v is None:
+            return Response({"error": "volume not found"}, status=404)
+        try:
+            with class_scope(BACKGROUND):
+                v.untier()
+        except (ValueError, IOError) as e:
+            self.tier_stats["failed"] += 1
+            return Response({"error": str(e)}, status=409)
+        self.tier_stats["promotes"] += 1
+        self.tier_stats["bytes_promoted"] += v.content_size()
+        self._push_deltas()
+        return Response({"promoted": vid, "rung": "hot"})
 
     def _admin_volume_digest(self, req: Request) -> Response:
         """Live (key,size) inventory + digest of one volume replica, for

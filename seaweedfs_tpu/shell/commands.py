@@ -236,6 +236,79 @@ class ShellContext:
                                {"volume_id": vid})
                 for node in replicas[vid]}
 
+    def volume_tier_status(self, vid: Optional[int] = None) -> dict:
+        """Tiering-autopilot view: the master planner's per-volume
+        temperatures/rungs/bands + mover state, enriched with each
+        volume server's own /admin/tier census (rung counts, move
+        counters). An unreachable server is reported, not fatal."""
+        out = http_json("GET",
+                        f"http://{self.master_url}/cluster/tiering")
+        if vid is not None:
+            vols = out.get("planner", {}).get("volumes", {})
+            out["volume"] = vols.get(str(vid), vols.get(vid))
+        servers: dict[str, dict] = {}
+        for vol in out.get("planner", {}).get("volumes", {}).values():
+            for url in vol.get("urls", []):
+                if url in servers:
+                    continue
+                try:
+                    st = http_json("GET", f"http://{url}/admin/tier")
+                    servers[url] = {"rungs": st.get("rungs", {}),
+                                    "stats": st.get("stats", {})}
+                except Exception as e:
+                    servers[url] = {"error": type(e).__name__}
+        out["servers"] = servers
+        return out
+
+    def volume_tier_rung_move(self, vid: int, to_rung: str,
+                              endpoint: str = "",
+                              bucket: str = "tier") -> dict:
+        """Operator-forced rung transition on every replica, through
+        the same BACKGROUND-classed endpoints the autopilot's mover
+        uses (the volume server enters the scope; weedlint's
+        tier-move-background rule guards in-process callers)."""
+        replicas, _ = self._volume_locations()
+        if vid not in replicas:
+            raise LookupError(f"volume {vid} not found")
+        from seaweedfs_tpu.storage.erasure_coding import layout
+        out = {}
+        for node in replicas[vid]:
+            if to_rung == "cloud":
+                out[node] = self._vs(node, "/admin/tier/demote",
+                                     {"volume_id": vid,
+                                      "endpoint": endpoint,
+                                      "bucket": bucket}, timeout=600)
+            elif to_rung == "ec":
+                out[node] = self._vs(node, "/admin/ec/generate",
+                                     {"volume_id": vid}, timeout=600)
+                # the rung census reads MOUNTED shards: an unmounted
+                # encode still reports "hot" (and the autopilot would
+                # plan the demotion again)
+                self._vs(node, "/admin/ec/mount",
+                         {"volume_id": vid,
+                          "shard_ids":
+                          list(range(layout.TOTAL_SHARDS_COUNT))},
+                         timeout=600)
+            elif to_rung in ("hot", "local"):
+                # the way up depends on where the volume is now:
+                # cloud -> untier the .dat, ec -> decode the shards
+                try:
+                    cur = http_json(
+                        "GET", f"http://{node}/admin/tier"
+                    ).get("volumes", {}).get(str(vid), {}).get("rung")
+                except Exception:
+                    cur = None
+                if cur == "ec":
+                    out[node] = self._vs(node, "/admin/ec/to_volume",
+                                         {"volume_id": vid}, timeout=600)
+                else:
+                    out[node] = self._vs(node, "/admin/tier/promote",
+                                         {"volume_id": vid}, timeout=600)
+            else:
+                raise ValueError(f"unknown rung {to_rung!r} "
+                                 "(hot|ec|cloud)")
+        return out
+
     def volume_move(self, vid: int, source: str, target: str,
                     collection: str = "", disk_type: str = "") -> None:
         """Move a volume: copy to target then delete on source

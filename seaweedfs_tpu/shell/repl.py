@@ -39,6 +39,8 @@ HELP = """commands:
   volume.tail -volumeId N [-since NS]   stream appended needles
   volume.tier.upload -volumeId N -endpoint URL -bucket B [-keepLocal]
   volume.tier.download -volumeId N
+  volume.tier.status [-volumeId N]  tiering autopilot: temps, rungs, mover
+  volume.tier.move -volumeId N -toRung hot|ec|cloud [-endpoint URL] [-bucket B]
   volume.tier.move [-toDiskType ssd] [-toNode HOST] [-fullPercent P] [-quietFor S] [-n]
   volume.vacuum [threshold]         compact garbage-heavy volumes
   cluster.ps                        list every cluster process
@@ -401,6 +403,16 @@ def run_command(sh: ShellContext, line: str):
             else:
                 url = sh.master_url  # re-resolve from scratch
                 _time.sleep(0.3)
+    if cmd == "volume.tier.status":
+        vid = flags.get("volumeId")
+        return sh.volume_tier_status(int(vid) if vid else None)
+    if cmd == "volume.tier.move" and flags.get("toRung"):
+        # autopilot-rung transition (hot|ec|cloud) on every replica —
+        # distinct from the disk-type move below
+        return sh.volume_tier_rung_move(
+            int(flags["volumeId"]), flags["toRung"],
+            endpoint=flags.get("endpoint", ""),
+            bucket=flags.get("bucket", "tier"))
     if cmd == "volume.tier.move":
         # move full+quiet volumes to a cold tier: a disk type
         # (-toDiskType ssd), a node (-toNode), or both (reference

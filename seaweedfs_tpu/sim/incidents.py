@@ -1,6 +1,6 @@
 """Scripted incident library + machine-checked invariants.
 
-Nine incidents, each a pure function of (seed, n_actors):
+Ten incidents, each a pure function of (seed, n_actors):
 
   az_loss          grey-failure prelude (scripted latency band on every
                    link), then correlated crash of one whole AZ; the
@@ -53,6 +53,17 @@ Nine incidents, each a pure function of (seed, n_actors):
                    the hot shard's routed share collapses after the
                    flip, and the cooldown/min-share gates prevent
                    ping-pong (no second flip).
+  diurnal_sweep    a virtual day for the tiering autopilot: one sealed
+                   working set carries the day traffic, goes dark for
+                   the "night" phase, then re-heats at dawn.  The REAL
+                   TieringPlanner must walk it down the full ladder
+                   (hot -> EC -> cloud) from heartbeat-shaped
+                   cumulative read counters, pause outright through a
+                   scripted telemetry-silence window, promote the set
+                   back (cloud -> EC -> hot) when it re-heats, and
+                   never touch the steady-warm set or any still-
+                   writable volume — with ZERO failed client requests
+                   and no ping-pong (no demotion after a promotion).
   ec_single_shard_loss
                    ONE shard holder dies under live traffic — the LRC
                    repair drill.  Hybrid incident: the sim cluster must
@@ -755,6 +766,184 @@ def _hot_shard_migration(cluster: SimCluster, n_actors: int,
     return checks
 
 
+def _diurnal_sweep(cluster: SimCluster, n_actors: int,
+                   rate: float) -> list:
+    """A virtual day for the tiering autopilot, closed loop.  The sim's
+    volume actors have no rung state, so the storage tier is modeled
+    HERE around the real production planner: per-vid cumulative read
+    counters (heartbeat telemetry shape) feed a real ``TieringPlanner``
+    at announce cadence, and a modeled mover (copy+verify delay, then
+    commit) applies rung transitions.  Working set A is sealed and
+    carries the day traffic; it must ride the full ladder down
+    (hot -> EC -> cloud) overnight and climb back (cloud -> EC -> hot)
+    at dawn.  A steady-warm sealed set B and the writable background
+    volumes must never move, a scripted telemetry-silence window must
+    pause planning outright, and the whole day costs zero failed
+    client ops."""
+    from seaweedfs_tpu.storage.tiering import (RUNG_CLOUD, RUNG_EC,
+                                               RUNG_HOT, TieringPlanner)
+
+    ladder = (RUNG_HOT, RUNG_EC, RUNG_CLOUD)
+    day_end, night_end, duration = 14.0, 40.0, 60.0
+    sil_start, sil_end = 16.0, 22.5    # telemetry goes dark overnight
+    move_bytes = 64 << 20              # modeled .dat size per move
+    n_vids = cluster.n_vids
+    set_a = tuple(range(0, 6))         # diurnal set (sealed)
+    # the steady set is kept small so its per-vid rate (30% of load
+    # over 3 vids) sits far above the cool band — at 16 actors a
+    # 6-vid steady set leaves each vid thin enough that an honest
+    # multi-bin traffic lull reads as genuine cooling
+    set_b = tuple(range(6, 9))         # steady-warm set (sealed)
+    bg = tuple(range(9, n_vids))       # writable background
+    sealed = set(set_a) | set(set_b)
+
+    # thresholds scale with the offered per-vid hot rate so the same
+    # EWMA half-life walks the bands at any actor count
+    hot_rate = 0.6 * rate / len(set_a)
+    planner = TieringPlanner(
+        window_s=5.0, ewma_alpha=0.5,
+        cool_max=0.15 * hot_rate, cold_max=0.013 * hot_rate,
+        heat_min=0.5 * hot_rate, min_age_s=8.0, cooldown_s=6.0,
+        max_moves_per_plan=len(set_a), cloud_enabled=True)
+
+    rung = {vid: RUNG_HOT for vid in range(n_vids)}
+    has_shards = {vid: False for vid in range(n_vids)}
+    reads_cum = {vid: 0 for vid in range(n_vids)}
+    moves_log: list = []
+    planned_in_silence: list = []
+    last_obs = [0.0]
+    seq = [0]
+
+    def a_is_hot(now: float) -> bool:
+        return now < day_end or now >= night_end
+
+    def dispatch(op) -> None:
+        # 60% of traffic follows the diurnal set (sleeping on the
+        # background volumes overnight), 30% holds set B steady-warm,
+        # the rest trickles over the writable background.  The split
+        # mixes a dispatch counter into the hash so it is uniform
+        # per-op (zipf keys alone are too concentrated to warm every
+        # vid of a set), and stays a pure function of the seed.
+        h = ((op.key * 1103515245 + 12345)
+             ^ (seq[0] * 2654435761)) & 0x7FFFFFFF
+        seq[0] += 1
+        r, base = h % 10, h // 10
+        now = cluster.kernel.now
+        if r < 6 and a_is_hot(now):
+            vid = set_a[base % len(set_a)]
+        elif 6 <= r < 9:
+            vid = set_b[base % len(set_b)]
+        else:
+            vid = bg[base % len(bg)]
+        op.key = base * n_vids + vid   # FilerActor routes key % n_vids
+        reads_cum[vid] += 1
+        cluster._start_op(cluster.filers[base % len(cluster.filers)], op)
+
+    wl = ZipfWorkload(default_tenants(4, rate), seed=cluster.kernel.seed)
+    for op in wl.generate(duration):
+        cluster.kernel.schedule(op.t, dispatch, op)
+
+    def mover(move):
+        yield 1.0  # modeled stream + verify-before-delete readback
+        vid = move["vid"]
+        rung[vid] = move["to"]
+        if move["to"] == RUNG_EC:
+            has_shards[vid] = True  # encode keeps shards alongside
+        elif move["to"] == RUNG_HOT:
+            has_shards[vid] = False
+        planner.note_committed(vid, now=cluster.kernel.now)
+        moves_log.append((cluster.kernel.now, move))
+        cluster.kernel.note("incident", "tier_move",
+                            f"vid={vid} {move['from']}->{move['to']}")
+
+    def control_loop():
+        # the master's heartbeat-ingest cadence: every 2s one modeled
+        # volume server reports cumulative reads + rung state, then
+        # the planner gets one shot
+        while cluster.kernel.now < duration:
+            yield 2.0
+            now = cluster.kernel.now
+            if not (sil_start <= now < sil_end):
+                planner.observe("vs-sim", {"volumes": {
+                    vid: {"reads": reads_cum[vid], "rung": rung[vid],
+                          "size": move_bytes,
+                          "read_only": vid in sealed,
+                          "has_ec_shards": has_shards[vid]}
+                    for vid in range(n_vids)}}, now=now)
+                last_obs[0] = now
+            plan = planner.plan(now=now)
+            if plan is None:
+                continue
+            if now - last_obs[0] > planner.window_s:
+                planned_in_silence.append(now)  # must stay empty
+                continue
+            for m in plan["moves"]:
+                cluster.kernel.spawn(mover(m))
+
+    cluster.kernel.spawn(control_loop())
+    cluster.run(duration)
+    _settle(cluster, wl, duration, 10.0)
+    cluster.run(duration + 12.0)
+
+    checks: list = []
+    _common_invariants(cluster, checks)
+    checks.append(_check(
+        "zero_failed_client_requests", cluster.metrics.fail_total == 0,
+        f"{cluster.metrics.fail_total} failed ops "
+        f"(samples: {cluster.metrics.fail_samples[:3]})"
+        if cluster.metrics.fail_total else
+        f"all {cluster.metrics.ops_total()} ops succeeded across "
+        f"{len(moves_log)} tier move(s)"))
+    by_vid: dict = {}
+    for t, m in moves_log:
+        by_vid.setdefault(m["vid"], []).append((t, m))
+    reached_cloud = [v for v in set_a
+                     if any(m["to"] == RUNG_CLOUD
+                            for _, m in by_vid.get(v, []))]
+    checks.append(_check(
+        "cooled_set_reached_cloud", len(reached_cloud) == len(set_a),
+        f"{len(reached_cloud)}/{len(set_a)} diurnal vids demoted to "
+        f"the cloud rung overnight"))
+    back_hot = [v for v in set_a if rung[v] == RUNG_HOT]
+    checks.append(_check(
+        "reheated_set_promoted_home", len(back_hot) == len(set_a),
+        f"{len(back_hot)}/{len(set_a)} diurnal vids back on the hot "
+        f"rung at dusk (end rungs: "
+        f"{sorted(set(rung[v] for v in set_a))})"))
+    strays = sorted(set(by_vid) - set(set_a))
+    checks.append(_check(
+        "only_diurnal_set_moved", not strays,
+        f"steady-warm + writable volumes untouched "
+        f"({len(moves_log)} moves, all within the diurnal set)"
+        if not strays else f"unexpected moves for vids {strays}"))
+    ping_pong = []
+    for v, seq in by_vid.items():
+        demoting = [ladder.index(m["to"]) > ladder.index(m["from"])
+                    for _, m in seq]
+        # a day is one descent then one climb: any demotion after the
+        # first promotion is thrash
+        first_promo = demoting.index(False) if False in demoting \
+            else len(demoting)
+        if len(seq) > 4 or any(demoting[first_promo:]):
+            ping_pong.append(v)
+    checks.append(_check(
+        "no_ping_pong", not ping_pong,
+        "each vid descends then climbs at most once "
+        f"({max((len(s) for s in by_vid.values()), default=0)} moves "
+        "max per vid)" if not ping_pong
+        else f"thrashing vids {ping_pong}"))
+    checks.append(_check(
+        "silence_paused_planner",
+        not planned_in_silence and planner.paused_on_silence > 0,
+        f"planner held {planner.paused_on_silence} plan tick(s) "
+        f"through the {sil_end - sil_start:.1f}s telemetry-dark window"
+        if not planned_in_silence else
+        f"plans fired on stale telemetry at t={planned_in_silence}"))
+    _tenant_invariant(cluster, checks)
+    _breaker_invariant(cluster, checks)
+    return checks
+
+
 INCIDENTS = {
     "az_loss": _az_loss,
     "rolling_restart": _rolling_restart,
@@ -762,6 +951,7 @@ INCIDENTS = {
     "tenant_flood": _tenant_flood,
     "partition_heal_mid_repair": _partition_heal_mid_repair,
     "hot_shard_migration": _hot_shard_migration,
+    "diurnal_sweep": _diurnal_sweep,
     "ec_single_shard_loss": _ec_single_shard_loss,
     "master_failover_mid_write": _master_failover_mid_write,
     "master_failover_mid_repair": _master_failover_mid_repair,

@@ -873,6 +873,7 @@ class Store:
             "ttl": v.super_block.ttl.to_uint32(),
             "version": v.version,
             "disk_type": self._disk_type_of(v),
+            "tiered": v.is_tiered,
         }
 
     def collect_heartbeat(self) -> dict:

@@ -445,9 +445,13 @@ class Volume:
             return info
 
     def untier(self) -> None:
-        """Pull the .dat back from the tier and serve locally again
-        (reference volume_grpc_tier_download.go)."""
-        from seaweedfs_tpu.storage.backend import (load_volume_info,
+        """Pull the .dat back from the tier, verify it against the
+        size + chained crc32c recorded at demotion, then serve locally
+        again (reference volume_grpc_tier_download.go). A failed
+        verify leaves the volume tiered and the remote copy intact —
+        promotion never installs corrupt bytes."""
+        from seaweedfs_tpu.storage.backend import (file_crc32c,
+                                                   load_volume_info,
                                                    save_volume_info)
         with self._lock:
             if self._backend is None:
@@ -459,6 +463,19 @@ class Volume:
                 for off in range(0, size, step):
                     f.write(self._backend.read_at(off,
                                                   min(step, size - off)))
+            remote = load_volume_info(base).get("remote", {})
+            try:
+                if "size" in remote and \
+                        os.path.getsize(base + ".dat.tmp") != remote["size"]:
+                    raise IOError(
+                        f"untier verify: size mismatch on volume {self.id}")
+                if "crc32c" in remote and \
+                        file_crc32c(base + ".dat.tmp") != remote["crc32c"]:
+                    raise IOError(
+                        f"untier verify: crc mismatch on volume {self.id}")
+            except IOError:
+                os.remove(base + ".dat.tmp")
+                raise
             os.rename(base + ".dat.tmp", base + ".dat")
             info = load_volume_info(base)
             info.pop("remote", None)

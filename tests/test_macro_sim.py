@@ -149,6 +149,19 @@ def test_hot_shard_migration_at_16_actors():
     assert by_check["no_ping_pong"]["ok"]
 
 
+def test_diurnal_sweep_at_16_actors():
+    r = run_incident("diurnal_sweep", seed=0, n_actors=16)
+    assert r["passed"], [c for c in r["invariants"] if not c["ok"]]
+    # the autopilot's whole day is invisible to clients
+    assert r["client"]["failed"] == 0
+    by_check = {c["name"]: c for c in r["invariants"]}
+    assert by_check["cooled_set_reached_cloud"]["ok"]
+    assert by_check["reheated_set_promoted_home"]["ok"]
+    assert by_check["only_diurnal_set_moved"]["ok"]
+    assert by_check["silence_paused_planner"]["ok"]
+    assert by_check["no_ping_pong"]["ok"]
+
+
 def test_master_failover_mid_write_at_16_actors():
     r = run_incident("master_failover_mid_write", seed=0, n_actors=16)
     assert r["passed"], [c for c in r["invariants"] if not c["ok"]]

@@ -169,6 +169,20 @@ CASES = {
                   "    if cur is None or ring.epoch > cur.epoch:\n"
                   "        self.shard_ring = ring\n"),
     },
+    "tier-move-background": {
+        "bad": ("from seaweedfs_tpu.storage.tiering import "
+                "demote_volume\n\n"
+                "def apply(move):\n"
+                "    demote_volume(move['url'], move['vid'], 'ec')\n"),
+        "clean": ("from seaweedfs_tpu.qos import BACKGROUND, "
+                  "class_scope\n"
+                  "from seaweedfs_tpu.storage.tiering import "
+                  "demote_volume\n\n"
+                  "def apply(move):\n"
+                  "    with class_scope(BACKGROUND):\n"
+                  "        demote_volume(move['url'], move['vid'], "
+                  "'ec')\n"),
+    },
 }
 
 

@@ -1,0 +1,200 @@
+"""Live tiering profile: per-rung census, move rates, temperatures.
+
+Polls the master's autopilot (GET /cluster/tiering) and every member
+volume server's `/admin/tier`, printing the planner's view followed by
+one line per server with rates computed from successive samples:
+
+  rungs           hot/ec/cloud volume counts on that server
+  demote/s        rung-down transitions committed since last sample
+  promote/s       rung-up (re-heat) transitions since last sample
+  demoteMB/s      .dat bytes leaving local disk for the tier
+  promoteMB/s     .dat bytes pulled back on re-heat
+  failed          cumulative failed transitions (verify/transport)
+
+The planner header shows the temperature bands, member census, and —
+critically — whether the autopilot is PAUSED on telemetry silence (a
+member's counters went stale, so rates can't be trusted and no move
+may fire on them).
+
+With `--watch` the tool runs until interrupted and adds a per-volume
+table: vid, rung, temperature vs the bands, size, and the in-flight
+move marker — the operator's "why did volume 7 just leave local disk"
+view.
+
+Usage:
+  PYTHONPATH=. python tools/tier_profile.py --master 127.0.0.1:9333 \
+      [--interval 2] [--duration 10] [--json] [--watch]
+  PYTHONPATH=. python tools/tier_profile.py --volume 127.0.0.1:8080 --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from seaweedfs_tpu.utils import clockctl  # noqa: E402
+from seaweedfs_tpu.utils.httpd import http_json  # noqa: E402
+
+
+def fetch_planner(master: str) -> dict:
+    return http_json("GET", f"http://{master}/cluster/tiering",
+                     timeout=5.0)
+
+
+def fetch_server(url: str) -> dict:
+    return http_json("GET", f"http://{url}/admin/tier", timeout=5.0)
+
+
+def discover_servers(planner: dict) -> list:
+    """Member volume servers, from the planner's per-volume url sets —
+    the autopilot only knows servers that heartbeat telemetry, which
+    is exactly the set worth profiling."""
+    urls: set = set()
+    for meta in planner.get("planner", {}).get("volumes", {}).values():
+        urls.update(meta.get("urls", []))
+    return sorted(urls)
+
+
+def _rate(prev: dict, cur: dict, key: str, dt: float) -> float:
+    """Per-second delta of a cumulative stats counter.  Counters reset
+    when a volume server restarts — clamp a negative delta to the
+    absolute count instead of reporting a negative rate."""
+    c = cur.get("stats", {}).get(key, 0)
+    p = (prev or {}).get("stats", {}).get(key, 0)
+    return max(c - p, c if c < p else 0) / dt
+
+
+def _row(url: str, prev: dict, cur: dict, dt: float) -> dict:
+    rungs = cur.get("rungs", {})
+    return {
+        "server": url,
+        "hot": rungs.get("hot", 0),
+        "ec": rungs.get("ec", 0),
+        "cloud": rungs.get("cloud", 0),
+        "demote_per_s": round(_rate(prev, cur, "demotes", dt), 2),
+        "promote_per_s": round(_rate(prev, cur, "promotes", dt), 2),
+        "demote_mb_per_s": round(
+            _rate(prev, cur, "bytes_demoted", dt) / (1 << 20), 2),
+        "promote_mb_per_s": round(
+            _rate(prev, cur, "bytes_promoted", dt) / (1 << 20), 2),
+        "failed": cur.get("stats", {}).get("failed", 0),
+    }
+
+
+def _print_sample(ts: float, planner: dict, rows: list,
+                  watch: bool = False) -> None:
+    p = planner.get("planner", {})
+    mover = planner.get("mover", {})
+    bands = p.get("bands", {})
+    state = "PAUSED(silent)" if p.get("silent") else "observing"
+    print(f"[{time.strftime('%H:%M:%S', time.localtime(ts))}] "
+          f"autopilot {state} members={p.get('members', 0)} "
+          f"plans={p.get('plans', 0)} "
+          f"paused_on_silence={p.get('paused_on_silence', 0)} "
+          f"mover={'busy' if mover.get('busy') else 'idle'} "
+          f"bands: cool<={bands.get('cool_max')} "
+          f"cold<={bands.get('cold_max')} heat>={bands.get('heat_min')}")
+    for r in rows:
+        if "error" in r:
+            print(f"    {r['server']:<22} error={r['error']}")
+            continue
+        print(f"    {r['server']:<22} "
+              f"hot={r['hot']:<3} ec={r['ec']:<3} cloud={r['cloud']:<3} "
+              f"demote/s={r['demote_per_s']:<6} "
+              f"promote/s={r['promote_per_s']:<6} "
+              f"demoteMB/s={r['demote_mb_per_s']:<7} "
+              f"promoteMB/s={r['promote_mb_per_s']:<7} "
+              f"failed={r['failed']}")
+    if watch:
+        vols = p.get("volumes", {})
+        for vid in sorted(vols, key=lambda v: int(v)):
+            meta = vols[vid]
+            temp = meta.get("temp")
+            temp_s = "-" if temp is None else f"{temp:.3f}"
+            line = (f"      vol {vid:>4} rung={meta.get('rung', '?'):<6}"
+                    f" temp={temp_s:<8}"
+                    f" size={meta.get('size', 0):>10}")
+            if meta.get("moved"):
+                line += f" moved={meta['moved']}"
+            print(line)
+
+
+def run(master: str, servers: list, interval: float, duration: float,
+        as_json: bool, once: bool, watch: bool = False) -> int:
+    prev: dict = {}
+    deadline = clockctl.monotonic() + duration
+    while True:
+        planner: dict = {}
+        if master:
+            try:
+                planner = fetch_planner(master)
+            except Exception as e:
+                print(f"master {master} unreachable: {e}",
+                      file=sys.stderr)
+                if not servers:
+                    return 2
+        members = servers or discover_servers(planner)
+        if not members:
+            print("no volume servers observed yet "
+                  "(give --volume, or wait for a heartbeat)",
+                  file=sys.stderr)
+            if once or not watch:
+                return 2
+        cur = {}
+        rows = []
+        for u in members:
+            try:
+                cur[u] = fetch_server(u)
+            except Exception as e:
+                rows.append({"server": u, "error": str(e)})
+                continue
+            rows.append(_row(u, prev.get(u), cur[u],
+                             interval if prev else 1.0))
+        ts = clockctl.now()
+        if as_json:
+            print(json.dumps({"ts": ts,
+                              "planner": planner.get("planner", {}),
+                              "mover": planner.get("mover", {}),
+                              "servers": rows}))
+        else:
+            _print_sample(ts, planner, rows, watch=watch)
+        prev = cur
+        if once or (not watch and clockctl.monotonic() >= deadline):
+            return 0
+        clockctl.sleep(interval)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--master", default="",
+                    help="master HOST:PORT for autopilot + discovery")
+    ap.add_argument("--volume", action="append", default=[],
+                    help="volume server HOST:PORT (repeatable; "
+                         "skips discovery)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--once", action="store_true",
+                    help="one sample and exit")
+    ap.add_argument("--watch", action="store_true",
+                    help="run until interrupted; adds the per-volume "
+                         "temperature table")
+    args = ap.parse_args(argv)
+    args.master = args.master.removeprefix("http://")
+    args.volume = [v.removeprefix("http://") for v in args.volume]
+    if not args.master and not args.volume:
+        ap.error("give --master or --volume")
+    try:
+        return run(args.master, args.volume, args.interval,
+                   args.duration, args.as_json, args.once, args.watch)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -161,6 +161,18 @@ ring-epoch-forward
     that has nothing to do with rings (sim actor incarnations, volume
     cache generations) doesn't name a ring/shard and stays legal;
     ``filer/shard_ring.py`` is the home where epoch semantics live.
+
+tier-move-background
+    a call to a tiering data-mover entry point (``demote_volume`` /
+    ``promote_volume``) outside a ``with class_scope(BACKGROUND)``
+    block.  Tier moves stream whole .dat files (EC encode, cloud
+    upload, re-heat download) — issued on the caller's ambient QoS
+    class they ride the INTERACTIVE admission lane and starve client
+    reads behind a multi-gigabyte transfer.  Every dispatch site must
+    lexically enter ``class_scope(BACKGROUND)`` so admission control
+    and the X-Weed-Class header see the move for what it is.
+    ``storage/tiering.py`` is the home where the mover owns its own
+    scope entry.
 """
 
 from __future__ import annotations
@@ -207,6 +219,9 @@ RULES: dict[str, str] = {
     "ring-epoch-forward":
         "shard-ring epoch compared with == — adoption must be >/>= "
         "(forward-only) or a stale ring can re-install",
+    "tier-move-background":
+        "demote_volume/promote_volume outside class_scope(BACKGROUND) "
+        "— tier moves must ride the background admission lane",
 }
 
 # files that ARE the sanctioned implementation of a contract
@@ -221,6 +236,7 @@ _RULE_HOME = {
     "hardcoded-shard-count": "storage/erasure_coding/layout.py",
     "lease-wall-clock": "utils/clockctl.py",
     "ring-epoch-forward": "filer/shard_ring.py",
+    "tier-move-background": "storage/tiering.py",
 }
 
 _HEADER_PREFIX = "X-Weed-"
@@ -271,6 +287,9 @@ _LEASEISH = re.compile(r"lease|expir", re.IGNORECASE)
 # unrelated "epoch"s stay legal
 _EPOCHISH = re.compile(r"epoch", re.IGNORECASE)
 _RINGISH = re.compile(r"ring|shard", re.IGNORECASE)
+# the tiering mover entry points that stream whole volumes; dispatch
+# sites must enter class_scope(BACKGROUND) before calling them
+_TIER_MOVE_TERMINALS = {"demote_volume", "promote_volume"}
 
 
 def _ident_strings(expr: ast.AST) -> list[str]:
@@ -377,6 +396,20 @@ def _handler_catches(handler: ast.ExceptHandler, names: set[str]) -> bool:
     return any(_terminal(x) in names for x in types)
 
 
+def _is_background_scope(expr: ast.AST) -> bool:
+    """True for ``class_scope(BACKGROUND)`` (or the literal
+    ``class_scope("background")``) used as a with-item."""
+    if not isinstance(expr, ast.Call) or \
+            _terminal(expr.func) != "class_scope":
+        return False
+    for a in expr.args:
+        if _terminal(a) == "BACKGROUND":
+            return True
+        if isinstance(a, ast.Constant) and a.value == "background":
+            return True
+    return False
+
+
 class _Scope:
     """Per-function bookkeeping for rules that need whole-function
     context (persistent-socket-timeout, ambient-scope-loss,
@@ -411,6 +444,8 @@ class Checker(ast.NodeVisitor):
         self.aliases: dict[str, str] = {}      # local name -> module
         self.from_imports: dict[str, str] = {}  # local name -> mod.attr
         self.scopes: list[_Scope] = []
+        # lexical depth inside `with class_scope(BACKGROUND)` blocks
+        self.bg_scope_depth = 0
 
     # ---- reporting ----
 
@@ -464,7 +499,12 @@ class Checker(ast.NodeVisitor):
     def _function_scope(self, node) -> None:
         scope = _Scope(node)
         self.scopes.append(scope)
+        # a def nested inside `with class_scope(...)` runs later,
+        # outside that scope — its body starts unscoped
+        saved_bg = self.bg_scope_depth
+        self.bg_scope_depth = 0
         self.generic_visit(node)
+        self.bg_scope_depth = saved_bg
         self.scopes.pop()
         if scope.create_conn and not scope.has_settimeout:
             for call in scope.create_conn:
@@ -534,6 +574,13 @@ class Checker(ast.NodeVisitor):
                     self.scopes[-1].create_conn.append(node)
         if terminal == "settimeout" and self.scopes:
             self.scopes[-1].has_settimeout = True
+
+        if terminal in _TIER_MOVE_TERMINALS and not self.bg_scope_depth:
+            self.report(node, "tier-move-background",
+                        f"{terminal}() outside class_scope(BACKGROUND) "
+                        "— a tier move streams whole .dat files and "
+                        "must ride the background admission lane; wrap "
+                        "the dispatch in `with class_scope(BACKGROUND):`")
 
         if canonical == "threading.Thread" and \
                 not any(kw.arg == "name" for kw in node.keywords):
@@ -744,6 +791,8 @@ class Checker(ast.NodeVisitor):
                 "and re-enter via span_scope/deadline_scope/class_scope")
 
     def _visit_with(self, node) -> None:
+        is_background = any(_is_background_scope(item.context_expr)
+                            for item in node.items)
         lockish = None
         for item in node.items:
             term = _terminal(item.context_expr)
@@ -774,7 +823,11 @@ class Checker(ast.NodeVisitor):
                         "blocking under a lock serializes every thread "
                         "that touches it; move the I/O outside the "
                         "critical section")
+        if is_background:
+            self.bg_scope_depth += 1
         self.generic_visit(node)
+        if is_background:
+            self.bg_scope_depth -= 1
 
     visit_With = _visit_with
     visit_AsyncWith = _visit_with
