@@ -522,29 +522,45 @@ class FilerServer:
         chunk GC is untouched."""
         entry = self.filer.find_entry(path)
         if entry is None:
+            # creates racing a concurrent sweep can strand child rows
+            # beneath a directory row the sweep already removed (a
+            # peer's stale positive parent-cache skips re-creating the
+            # ancestor row): clear them anyway, so a repeat recursive
+            # delete converges to empty instead of 404-ing past the
+            # orphans forever
+            if recursive:
+                self._sweep_children(path, True)
             raise FileNotFoundError(path)
         if entry.is_directory:
-            from urllib.parse import quote
-            child_owner = self.shard_ring.owner(path)
-            while True:
-                children = self._list_entries_routed(path, limit=256)
-                if not children:
-                    break
-                if not recursive:
-                    raise OSError(f"directory {path} not empty")
-                for child in children:
-                    if child_owner == self.url:
-                        self._delete_entry_sharded(child.full_path, True)
-                    else:
-                        status, body, _ = http_call(
-                            "DELETE",
-                            f"http://{child_owner}"
-                            f"{quote(child.full_path)}?recursive=true",
-                            headers={weed_headers.SHARD_FORWARDED: "1"},
-                            timeout=60)
-                        if status >= 400 and status != 404:
-                            raise HttpError(status, body)
+            self._sweep_children(path, recursive)
         self.filer.delete_entry(path, recursive=True)
+
+    def _sweep_children(self, path: str, recursive: bool) -> None:
+        """Delete every canonical child of `path`, each routed to its
+        row's owner, until a listing comes back empty."""
+        from urllib.parse import quote
+        child_owner = self.shard_ring.owner(path)
+        while True:
+            children = self._list_entries_routed(path, limit=256)
+            if not children:
+                return
+            if not recursive:
+                raise OSError(f"directory {path} not empty")
+            for child in children:
+                if child_owner == self.url:
+                    try:
+                        self._delete_entry_sharded(child.full_path, True)
+                    except FileNotFoundError:
+                        pass  # raced another deleter: already gone
+                else:
+                    status, body, _ = http_call(
+                        "DELETE",
+                        f"http://{child_owner}"
+                        f"{quote(child.full_path)}?recursive=true",
+                        headers={weed_headers.SHARD_FORWARDED: "1"},
+                        timeout=60)
+                    if status >= 400 and status != 404:
+                        raise HttpError(status, body)
 
     def _rename_sharded(self, frm: str, to: str) -> None:
         """Cross-shard rename: children first (a reader never sees the

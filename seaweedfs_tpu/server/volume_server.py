@@ -2023,6 +2023,15 @@ class VolumeServer:
         self._push_deltas()
         return Response({})
 
+    def _tier_key(self, v) -> str:
+        """Node-unique S3 object key for this replica's .dat: replicas
+        of a volume compact independently and need not be
+        byte-identical, so each node demotes to its own object — a
+        shared key would let one replica's upload corrupt another's
+        verified copy."""
+        return (f"{self.url.replace(':', '_')}_"
+                f"{os.path.basename(v.file_name())}.dat")
+
     def _admin_tier_upload(self, req: Request) -> Response:
         """Move a sealed volume's .dat to an S3-compatible tier
         (reference volume_grpc_tier_upload.go)."""
@@ -2032,8 +2041,9 @@ class VolumeServer:
             return Response({"error": "volume not found"}, status=404)
         try:
             info = v.tier_to(b["endpoint"], b["bucket"],
-                             keep_local=b.get("keep_local", False))
-        except (ValueError, IOError) as e:
+                             keep_local=b.get("keep_local", False),
+                             key=self._tier_key(v))
+        except (ValueError, RuntimeError, IOError) as e:
             return Response({"error": str(e)}, status=409)
         return Response({"tiered": v.id, "remote": info.get("remote")})
 
@@ -2046,7 +2056,7 @@ class VolumeServer:
             return Response({"error": "volume not found"}, status=404)
         try:
             v.untier()
-        except (ValueError, IOError) as e:
+        except (ValueError, RuntimeError, IOError) as e:
             return Response({"error": str(e)}, status=409)
         return Response({"downloaded": v.id})
 
@@ -2073,7 +2083,8 @@ class VolumeServer:
             with class_scope(BACKGROUND):
                 size = v.content_size() if not v.is_tiered else 0
                 info = v.tier_to(b["endpoint"], b["bucket"],
-                                 keep_local=b.get("keep_local", False))
+                                 keep_local=b.get("keep_local", False),
+                                 key=self._tier_key(v))
         except (ValueError, RuntimeError, IOError) as e:
             self.tier_stats["failed"] += 1
             return Response({"error": str(e)}, status=409)
@@ -2095,7 +2106,7 @@ class VolumeServer:
         try:
             with class_scope(BACKGROUND):
                 v.untier()
-        except (ValueError, IOError) as e:
+        except (ValueError, RuntimeError, IOError) as e:
             self.tier_stats["failed"] += 1
             return Response({"error": str(e)}, status=409)
         self.tier_stats["promotes"] += 1

@@ -293,15 +293,23 @@ def verify_tiered_copy(remote: S3BackendFile, expect_size: int,
 
 
 def tier_volume_to_s3(base_path: str, endpoint: str, bucket: str,
-                      keep_local: bool = False) -> dict:
+                      keep_local: bool = False,
+                      key: Optional[str] = None) -> dict:
     """Move a sealed volume's .dat to an S3 tier; record in .vif
     (reference volume_tier.go + volume_grpc_tier_upload.go).
 
     Verified demotion: the local file is removed only after a full
     readback through S3BackendFile matches its size and chained
     crc32c. On verify failure the local .dat stays, the .vif is left
-    untouched, and the error surfaces to the caller."""
-    key = os.path.basename(base_path) + ".dat"
+    untouched, and the error surfaces to the caller.
+
+    ``key`` overrides the default object key. Callers demoting
+    replicated volumes must pass a replica-unique key (e.g. prefixed
+    with the serving node's url): replicas compact independently, so
+    a shared key would let replica B's upload overwrite replica A's
+    already-verified object and break A's recorded size/crc."""
+    if key is None:
+        key = os.path.basename(base_path) + ".dat"
     local = base_path + ".dat"
     local_size = os.path.getsize(local)
     local_crc = file_crc32c(local)

@@ -100,9 +100,16 @@ class TieringPlanner:
                  cool_max: float = 0.5, cold_max: float = 0.05,
                  heat_min: float = 2.0, min_age_s: float = 120.0,
                  cooldown_s: float = 300.0, max_moves_per_plan: int = 2,
-                 cloud_enabled: bool = True):
+                 cloud_enabled: bool = True,
+                 stale_after_s: Optional[float] = None):
         self.window_s = window_s
         self.ewma_alpha = ewma_alpha
+        # short silence (one window) pauses planning; long silence
+        # (stale_after_s) forgets the member/replica entirely — a
+        # decommissioned server or a migrated-away replica must not
+        # pause the autopilot or gate temperature() forever
+        self.stale_after_s = (10 * window_s if stale_after_s is None
+                              else stale_after_s)
         self.cool_max = cool_max
         self.cold_max = cold_max
         self.heat_min = heat_min
@@ -151,6 +158,41 @@ class TieringPlanner:
             meta["has_ec_shards"] = bool(v.get("has_ec_shards", False))
             if url not in meta["urls"]:
                 meta["urls"].append(url)
+            # advance the EWMA here, at heartbeat cadence — this is
+            # the ONLY place it mutates, so temperature()/status()
+            # polls cannot change the smoothing dynamics
+            raw = self._rate(key, now)
+            if raw is not None:
+                prev = self._ewma.get(key)
+                self._ewma[key] = raw if prev is None else (
+                    self.ewma_alpha * raw + (1 - self.ewma_alpha) * prev)
+        self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        """Forget members and per-volume replicas that have been dark
+        longer than stale_after_s (distinct from the short-silence
+        planning pause): a decommissioned server must not hold
+        _silent() true forever, and a replica that migrated away must
+        not keep its volume unplannable via a never-refreshed
+        (url, vid) sample key."""
+        horizon = now - self.stale_after_s
+        for url, last in list(self._members.items()):
+            if last < horizon:
+                del self._members[url]
+        for key in list(self._samples):
+            dq = self._samples[key]
+            if dq and dq[-1][0] >= horizon:
+                continue
+            del self._samples[key]
+            self._ewma.pop(key, None)
+            url, vid = key
+            meta = self._meta.get(vid)
+            if meta is not None and url in meta["urls"]:
+                meta["urls"].remove(url)
+        for vid, meta in list(self._meta.items()):
+            if not meta["urls"]:
+                del self._meta[vid]
+                self._moved.pop(vid, None)
 
     def _rate(self, key, now: float) -> Optional[float]:
         """Windowed reads/s for one (url, vid), or None without two
@@ -170,7 +212,9 @@ class TieringPlanner:
     def temperature(self, vid: int,
                     now: Optional[float] = None) -> Optional[float]:
         """EWMA-smoothed aggregate reads/s across the volume's
-        replicas. None when any replica lacks an in-window rate."""
+        replicas. None when any replica lacks an in-window rate.
+        Pure read of the observe()-maintained EWMA — safe to poll
+        from status()/tools without perturbing planning."""
         now = clockctl.monotonic() if now is None else now
         meta = self._meta.get(vid)
         if meta is None:
@@ -181,11 +225,7 @@ class TieringPlanner:
             raw = self._rate(key, now)
             if raw is None:
                 return None
-            prev = self._ewma.get(key)
-            smoothed = raw if prev is None else (
-                self.ewma_alpha * raw + (1 - self.ewma_alpha) * prev)
-            self._ewma[key] = smoothed
-            total += smoothed
+            total += self._ewma.get(key, raw)
         return total
 
     # ---- planning ----
@@ -210,6 +250,7 @@ class TieringPlanner:
         safe to do. Demotions need a sealed volume below the band;
         promotions need a cold volume above heat_min."""
         now = clockctl.monotonic() if now is None else now
+        self._prune(now)
         if not self._members:
             return None
         if self._silent(now):
@@ -348,6 +389,10 @@ class TierMover:
         vid, to_rung, from_rung = move["vid"], move["to"], move["from"]
         self.bandwidth.consume(max(move.get("size", 0), 1))
         demoting = _LADDER.index(to_rung) > _LADDER.index(from_rung)
+        # every replica transitions; cloud demotions are safe to fan
+        # out because each volume server uploads to a node-unique
+        # object key (replica .dat files compact independently and
+        # need not be byte-identical)
         for url in move["urls"]:
             if demoting:
                 demote_volume(url, vid, to_rung,

@@ -66,6 +66,7 @@ class Volume:
         self._lock = threading.RLock()
         self.last_append_at_ns = 0
         self.is_compacting = False
+        self._untiering = False
         # group-commit state: appends take a sequence number under
         # _lock; durability (flush/fsync) is settled afterwards under
         # _flush_cond so one leader's flush covers every append that
@@ -418,9 +419,14 @@ class Volume:
 
     # ---- cloud tier (reference volume_tier.go, volume_grpc_tier_*.go) --
     def tier_to(self, endpoint: str, bucket: str,
-                keep_local: bool = False) -> dict:
+                keep_local: bool = False,
+                key: Optional[str] = None) -> dict:
         """Seal and move the .dat to an S3-compatible tier; keep serving
-        reads through it."""
+        reads through it. ``key`` overrides the object key — replicas of
+        the same volume MUST use distinct keys (they compact
+        independently, so their .dat files need not be byte-identical;
+        a shared key would let one replica's upload invalidate
+        another's verified copy)."""
         from seaweedfs_tpu.storage.backend import tier_volume_to_s3
         with self._lock:
             if self._backend is not None:
@@ -430,11 +436,22 @@ class Volume:
                 # reading from
                 raise RuntimeError(
                     f"volume {self.id} is compacting; retry later")
+            prev_read_only = self.read_only
             self.read_only = True
             self.sync()
             self._dat.close()
-            info = tier_volume_to_s3(self.file_name(), endpoint, bucket,
-                                     keep_local=keep_local)
+            try:
+                info = tier_volume_to_s3(self.file_name(), endpoint,
+                                         bucket, keep_local=keep_local,
+                                         key=key)
+            except BaseException:
+                # a failed upload/verify leaves the local .dat intact
+                # (tier_volume_to_s3 only removes it post-verify) —
+                # reopen it so a transient tier-endpoint outage never
+                # turns a healthy local volume unreadable
+                self._dat = open(self.file_name() + ".dat", "r+b")
+                self.read_only = prev_read_only
+                raise
             if keep_local:
                 self._dat = open(self.file_name() + ".dat", "r+b")
             else:
@@ -449,40 +466,55 @@ class Volume:
         size + chained crc32c recorded at demotion, then serve locally
         again (reference volume_grpc_tier_download.go). A failed
         verify leaves the volume tiered and the remote copy intact —
-        promotion never installs corrupt bytes."""
+        promotion never installs corrupt bytes.
+
+        The download streams to .dat.tmp WITHOUT the volume lock —
+        reads keep serving through the cloud backend while gigabytes
+        come down; the lock is only taken for the verify-passed
+        rename + state swap. .dat.tmp is removed on any failure."""
         from seaweedfs_tpu.storage.backend import (file_crc32c,
                                                    load_volume_info,
                                                    save_volume_info)
         with self._lock:
             if self._backend is None:
                 raise ValueError(f"volume {self.id} is not tiered")
-            size = self._backend.size()
-            base = self.file_name()
-            with open(base + ".dat.tmp", "wb") as f:
+            if self._untiering:
+                raise RuntimeError(
+                    f"volume {self.id} is already untiering")
+            self._untiering = True
+            backend = self._backend
+        base = self.file_name()
+        tmp = base + ".dat.tmp"
+        try:
+            size = backend.size()
+            with open(tmp, "wb") as f:
                 step = 64 * 1024 * 1024
                 for off in range(0, size, step):
-                    f.write(self._backend.read_at(off,
-                                                  min(step, size - off)))
+                    f.write(backend.read_at(off, min(step, size - off)))
             remote = load_volume_info(base).get("remote", {})
-            try:
-                if "size" in remote and \
-                        os.path.getsize(base + ".dat.tmp") != remote["size"]:
-                    raise IOError(
-                        f"untier verify: size mismatch on volume {self.id}")
-                if "crc32c" in remote and \
-                        file_crc32c(base + ".dat.tmp") != remote["crc32c"]:
-                    raise IOError(
-                        f"untier verify: crc mismatch on volume {self.id}")
-            except IOError:
-                os.remove(base + ".dat.tmp")
-                raise
-            os.rename(base + ".dat.tmp", base + ".dat")
-            info = load_volume_info(base)
-            info.pop("remote", None)
-            save_volume_info(base, info)
-            self._backend = None
-            self._dat = open(base + ".dat", "r+b")
-            self.read_only = self.needle_map_kind == "sorted"
+            if "size" in remote and \
+                    os.path.getsize(tmp) != remote["size"]:
+                raise IOError(
+                    f"untier verify: size mismatch on volume {self.id}")
+            if "crc32c" in remote and \
+                    file_crc32c(tmp) != remote["crc32c"]:
+                raise IOError(
+                    f"untier verify: crc mismatch on volume {self.id}")
+            with self._lock:
+                os.rename(tmp, base + ".dat")
+                info = load_volume_info(base)
+                info.pop("remote", None)
+                save_volume_info(base, info)
+                self._backend = None
+                self._dat = open(base + ".dat", "r+b")
+                self.read_only = self.needle_map_kind == "sorted"
+        finally:
+            self._untiering = False
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
 
     def file_count(self) -> int:
         return len(self.nm)

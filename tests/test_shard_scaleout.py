@@ -637,6 +637,31 @@ def test_recursive_delete_races_concurrent_child_creates(shard_cluster):
         assert st == 404, p
 
 
+def test_recursive_delete_converges_past_orphaned_child_rows(shard_cluster):
+    """A create racing a sweep can strand child rows beneath a
+    directory row the sweep already removed (the writer's stale
+    positive parent-cache skips re-creating the ancestor row). A
+    repeat recursive delete must sweep the orphans anyway — never
+    404 past them forever."""
+    master, filers, mc = shard_cluster
+    st, _, _ = mc.filer_call("PUT", "/orph/d/f.bin", body=b"x")
+    assert st in (200, 201)
+    # strand the subtree: drop ONLY /orph's canonical row, exactly
+    # the state the race leaves behind
+    owner = filers[0].shard_ring.owner_for_path("/orph")
+    frow = next(f for f in filers if f.url == owner)
+    frow.filer.store.delete_entry("/orph")
+    if frow.filer.entry_cache is not None:
+        frow.filer.entry_cache.invalidate("/orph")
+    st, _, _ = mc.filer_call("GET", "/orph/d")
+    assert st == 200                          # the orphan is visible...
+    st, _, _ = mc.filer_call("DELETE", "/orph", query="recursive=true")
+    assert st in (204, 404)                   # ...one sweep clears it
+    for p in ("/orph/d/f.bin", "/orph/d", "/orph"):
+        st, _, _ = mc.filer_call("GET", p)
+        assert st == 404, p
+
+
 def test_cluster_shards_shell_command_placement_view(shard_cluster):
     """The operator's `cluster.shards` answer carries the rebalancer's
     placement view: override table, spread() of the overridden dirs,

@@ -158,6 +158,54 @@ def test_single_sample_gives_no_temperature():
     assert p.plan(now=0.0) is None
 
 
+def test_temperature_is_a_pure_read():
+    # polling temperature()/status() (GET /cluster/tiering,
+    # tier_profile --watch) must not re-apply the EWMA blend — the
+    # smoothing advances only at observe() heartbeats
+    p = _planner(ewma_alpha=0.5)
+    p.observe("vs1", _report(0), now=0.0)
+    p.observe("vs1", _report(10), now=4.0)
+    p.observe("vs1", _report(30), now=8.0)
+    t1 = p.temperature(1, now=8.0)
+    for _ in range(5):
+        p.status(now=8.0)
+        assert p.temperature(1, now=8.0) == t1
+
+
+def test_decommissioned_member_ages_out():
+    # short silence pauses planning; silence past stale_after_s
+    # forgets the member so it cannot pause the autopilot forever
+    p = _planner(stale_after_s=50.0)
+    p.observe("vs1", _report(0), now=0.0)
+    p.observe("vs2", {"volumes": {2: {"reads": 0, "rung": "hot",
+                                      "size": 9, "read_only": True}}},
+              now=0.0)  # vs2 is then decommissioned
+    p.observe("vs1", _report(0), now=4.0)
+    assert p.plan(now=14.0) is None          # short silence: pause
+    p.observe("vs1", _report(0), now=101.0)
+    p.observe("vs1", _report(0), now=105.0)
+    plan = p.plan(now=105.0)                 # vs2 forgotten: resume
+    assert plan is not None
+    assert "vs2" not in p._members
+    assert 2 not in p._meta                  # its volume went with it
+
+
+def test_migrated_replica_ages_out_of_urls():
+    # a volume that moved off a server must not stay unplannable via
+    # the old (url, vid) key never getting in-window samples again
+    p = _planner(stale_after_s=50.0)
+    for t in (0.0, 4.0):
+        p.observe("vs1", _report(0), now=t)
+        p.observe("vs2", _report(0), now=t)
+    # vid 1 leaves vs1; both servers keep heartbeating
+    for t in (60.0, 64.0, 100.0, 104.0):
+        p.observe("vs1", {"volumes": {}}, now=t)
+        p.observe("vs2", _report(0), now=t)
+    assert p._meta[1]["urls"] == ["vs2"]
+    assert p.temperature(1, now=104.0) is not None
+    assert p.plan(now=104.0) is not None
+
+
 def test_max_moves_per_plan_caps_batch():
     p = _planner(max_moves_per_plan=2)
     vols = {vid: {"reads": 0, "rung": "hot", "size": 10,
@@ -223,6 +271,63 @@ def test_read_at_slices_a_200_full_body(stub_endpoint):
     assert b.read_at(len(_STUB_BODY) - 5, 5) == _STUB_BODY[-5:]
 
 
+def test_tier_to_failure_reopens_local_dat(tmp_path, monkeypatch):
+    # a transient tier-endpoint outage mid-demotion must leave the
+    # volume exactly as it was: local .dat reopened, writability
+    # restored, every read served — never a closed-handle zombie
+    import seaweedfs_tpu.storage.backend as backend_mod
+
+    v = Volume(str(tmp_path), "", 11)
+    data = b"y" * 64
+    n = Needle(id=1, cookie=5, data=data)
+    n.set_flags_from_fields()
+    v.write_needle(n)
+    v.sync()
+
+    def boom(*a, **kw):
+        raise ConnectionError("tier endpoint down")
+
+    monkeypatch.setattr(backend_mod, "tier_volume_to_s3", boom)
+    with pytest.raises(ConnectionError):
+        v.tier_to("http://127.0.0.1:1", "tier")
+    assert not v.is_tiered
+    assert v.read_only is False
+    assert v.read_needle(1).data == data
+    assert v.content_size() > 0
+    n2 = Needle(id=2, cookie=5, data=b"z" * 16)
+    n2.set_flags_from_fields()
+    v.write_needle(n2)                       # still writable
+    assert v.read_needle(2).data == b"z" * 16
+    v.close()
+
+
+def test_untier_download_error_cleans_tmp(tmp_path):
+    # a failed promotion download must remove .dat.tmp and leave the
+    # volume serving from the tier (only the verify path did before)
+    v = Volume(str(tmp_path), "", 9)
+    n = Needle(id=1, cookie=5, data=b"q" * 32)
+    n.set_flags_from_fields()
+    v.write_needle(n)
+    v.sync()
+
+    class _DownBackend:
+        def size(self):
+            return 1000
+
+        def read_at(self, offset, length):
+            raise ConnectionError("tier endpoint down")
+
+    v._dat.close()
+    v._dat = None
+    v._backend = _DownBackend()
+    v.read_only = True
+    with pytest.raises(ConnectionError):
+        v.untier()
+    assert not os.path.exists(str(tmp_path / "9.dat.tmp"))
+    assert v.is_tiered                       # still on the cloud rung
+    assert not v._untiering                  # a retry is admissible
+
+
 def test_gateway_roundtrip_demote_promote_bit_identical(tmp_path):
     """Full rung cycle against our own S3 gateway: seal -> tier_to
     (verified demotion) -> serve needles from the cloud rung (206
@@ -262,13 +367,16 @@ def test_gateway_roundtrip_demote_promote_bit_identical(tmp_path):
         with open(base + ".dat", "rb") as f:
             original = f.read()
 
-        v.tier_to(f"http://{s3.url}", "tier")
+        # node-unique key, as the volume server passes in production
+        # (replicas must never share one object)
+        v.tier_to(f"http://{s3.url}", "tier", key="nodeA_7.dat")
         assert v.is_tiered
         assert not os.path.exists(base + ".dat")
         for nid, data in payloads.items():
             assert v.read_needle(nid).data == data
         backend = v._backend
         assert isinstance(backend, SBF)
+        assert backend.key == "nodeA_7.dat"
         assert backend.size() == len(original)
         assert backend.read_at(17, 31) == original[17:48]  # 206 path
 
